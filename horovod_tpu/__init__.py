@@ -1,0 +1,112 @@
+"""horovod_tpu — a TPU-native distributed training framework.
+
+Horovod-class capabilities (reference: uber/horovod v0.22.1) re-designed for
+TPU: the data plane is XLA collectives over ICI/DCN meshes instead of
+NCCL/MPI rings; the host control plane is a C++ negotiation core over TCP;
+parallelism (dp/tp/pp/sp/ep) is first-class via ``jax.sharding``.
+
+Drop-in-familiar surface::
+
+    import horovod_tpu as hvd
+    hvd.init()
+    ...
+    grads = hvd.allreduce(grads, op=hvd.Average)
+
+TPU-idiomatic surface::
+
+    mesh = hvd.build_mesh(dp=-1, tp=4)
+    tx = hvd.DistributedOptimizer(optax.adamw(1e-3))   # optax transform
+"""
+
+from horovod_tpu.version import __version__  # noqa: F401
+
+# Lifecycle / identity (reference: horovod/common/basics.py)
+from horovod_tpu.common.basics import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    is_homogeneous,
+    num_devices,
+    global_device_count,
+    start_timeline,
+    stop_timeline,
+    xla_built,
+    tcp_core_built,
+    gloo_built,
+    mpi_built,
+    nccl_built,
+    ccl_built,
+    cuda_built,
+    rocm_built,
+    mpi_enabled,
+    mpi_threads_supported,
+)
+
+# Process sets (reference: horovod/common/process_sets.py)
+from horovod_tpu.common.process_sets import (  # noqa: F401
+    ProcessSet,
+    add_process_set,
+    remove_process_set,
+    global_process_set,
+    process_set_ids,
+    get_process_set_by_id,
+)
+
+# Reduce ops (reference: horovod.torch.mpi_ops constants)
+from horovod_tpu.ops.reduce_op import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    ReduceOp,
+    Sum,
+)
+
+# Eager collectives (reference: horovod/torch/mpi_ops.py surface)
+from horovod_tpu.ops.collectives import (  # noqa: F401
+    allreduce,
+    allreduce_async,
+    grouped_allreduce,
+    grouped_allreduce_async,
+    allgather,
+    allgather_async,
+    broadcast,
+    broadcast_async,
+    alltoall,
+    alltoall_async,
+    reducescatter,
+    reducescatter_async,
+    poll,
+    synchronize,
+    join,
+    barrier,
+)
+
+# Mesh / parallelism (TPU-native; no reference analog)
+from horovod_tpu.parallel import (  # noqa: F401
+    AXIS_ORDER,
+    MeshSpec,
+    build_mesh,
+    single_axis_mesh,
+    batch_sharding,
+    logical_sharding,
+)
+
+# High-level training API (reference: horovod/torch/optimizer.py,
+# horovod/tensorflow/__init__.py DistributedGradientTape)
+from horovod_tpu.train.optimizer import (  # noqa: F401
+    DistributedOptimizer,
+    DistributedGradTransform,
+    distributed_grad,
+    broadcast_parameters,
+    broadcast_optimizer_state,
+    broadcast_object,
+)
+from horovod_tpu.train.compression import Compression  # noqa: F401

@@ -1,0 +1,280 @@
+"""Process identity and lifecycle: ``init`` / ``shutdown`` / rank & size queries.
+
+TPU-native re-think of the reference's ``HorovodBasics`` ctypes wrapper
+(reference: ``horovod/common/basics.py:29-487``) and the C API behind it
+(``horovod/common/operations.cc:869-1083``).
+
+Identity model on TPU: one **process per TPU host** (not per chip, unlike the
+reference's one-process-per-GPU). ``rank``/``size`` count processes, as in the
+reference; the chips a process drives form its local device set and are
+addressed through the data-plane mesh (:mod:`horovod_tpu.parallel.mesh`). The
+launcher (``hvdrun``) injects ``HOROVOD_RANK``-style env vars exactly as the
+reference's launcher does (reference: ``horovod/runner/gloo_run.py:65-76``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import List, Optional, Sequence
+
+from horovod_tpu.common.config import Config, get_config, reset_config
+from horovod_tpu.common.logging import get_logger
+
+
+class NotInitializedError(RuntimeError):
+    def __init__(self) -> None:
+        super().__init__(
+            "horovod_tpu has not been initialized; call hvd.init() first.")
+
+
+class _GlobalState:
+    """Per-process singleton (reference: ``HorovodGlobalState``,
+    ``horovod/common/global_state.h:39-126``)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.initialized = False
+        self.rank = 0
+        self.size = 1
+        self.local_rank = 0
+        self.local_size = 1
+        self.cross_rank = 0
+        self.cross_size = 1
+        self.hostname = ""
+        self.launched_rank = None  # pre-restriction rank when init(ranks) used
+        self.backend = None          # ops.backend.Backend for the global set
+        self.config: Optional[Config] = None
+        self.process_set_table = None  # common.process_sets._ProcessSetTable
+        self.timeline = None
+        self.elastic_enabled = False
+        self.jax_distributed_initialized = False
+
+
+_state = _GlobalState()
+
+
+def _read_identity_from_env() -> dict:
+    """Launcher-injected identity (reference env names,
+    ``horovod/runner/gloo_run.py:65-76``)."""
+    def geti(name: str, default: int) -> int:
+        v = os.environ.get("HVD_TPU_" + name, os.environ.get("HOROVOD_" + name))
+        return int(v) if v not in (None, "") else default
+
+    return dict(
+        rank=geti("RANK", 0),
+        size=geti("SIZE", 1),
+        local_rank=geti("LOCAL_RANK", 0),
+        local_size=geti("LOCAL_SIZE", 1),
+        cross_rank=geti("CROSS_RANK", 0),
+        cross_size=geti("CROSS_SIZE", 1),
+        hostname=os.environ.get(
+            "HVD_TPU_HOSTNAME", os.environ.get("HOROVOD_HOSTNAME", "")),
+    )
+
+
+def _create_backend(state: "_GlobalState"):
+    """Pick the communication backend for eager (process-level) collectives.
+
+    Priority-ordered like the reference's ``CreateOperationManager``
+    (``horovod/common/operations.cc:144-253``): the first available backend
+    wins. On TPU pods the data plane is XLA collectives over ICI/DCN; the
+    TCP core backend is the host-side reference implementation (the
+    "Gloo-equivalent") used for CPU tests and as the control plane.
+    """
+    from horovod_tpu.ops.backend import make_backend
+    return make_backend(state)
+
+
+def init(ranks: Optional[Sequence[int]] = None,
+         process_sets: Optional[list] = None) -> None:
+    """Initialize horovod_tpu (reference: ``horovod_init``,
+    ``operations.cc:869-878`` via ``basics.py:48-146``).
+
+    Args:
+      ranks: optional restriction of the global set to a subset of launched
+        processes (reference semantics of ``hvd.init(ranks)``). Rarely used.
+      process_sets: optional list of :class:`~horovod_tpu.ProcessSet` to
+        register at init time (reference: dynamic/static process sets,
+        ``operations.cc:1194-1260``).
+    """
+    with _state.lock:
+        if _state.initialized:
+            return
+        reset_config()
+        _state.config = get_config()
+        ident = _read_identity_from_env()
+        _state.rank = ident["rank"]
+        _state.size = ident["size"]
+        _state.local_rank = ident["local_rank"]
+        _state.local_size = ident["local_size"]
+        _state.cross_rank = ident["cross_rank"]
+        _state.cross_size = ident["cross_size"]
+        _state.hostname = ident["hostname"] or os.uname().nodename
+
+        if ranks is not None and len(ranks) > 0:
+            ranks = sorted(set(ranks))
+            if _state.rank not in ranks:
+                raise ValueError(
+                    f"hvd.init(ranks={list(ranks)}): this process has rank "
+                    f"{_state.rank}, which is not in the given ranks list.")
+            # Restrict the world to the given launched ranks (reference
+            # semantics of ``hvd.init(ranks)``: the global process set is the
+            # sub-communicator over those ranks, and rank/size are relative
+            # to it — ``operations.cc:881-965`` init_multi_comm).
+            _state.launched_rank = _state.rank
+            _state.rank = ranks.index(_state.rank)
+            _state.size = len(ranks)
+
+        _state.backend = _create_backend(_state)
+
+        from horovod_tpu.common.process_sets import _init_process_set_table
+        _state.process_set_table = _init_process_set_table(
+            _state, process_sets or [])
+
+        # Timeline (host-side chrome tracing; reference timeline.h:48-183).
+        from horovod_tpu.common.timeline import Timeline
+        _state.timeline = Timeline(_state.rank, _state.config.timeline)
+
+        _state.initialized = True
+        get_logger().info(
+            "initialized: rank=%d size=%d local=%d/%d cross=%d/%d backend=%s",
+            _state.rank, _state.size, _state.local_rank, _state.local_size,
+            _state.cross_rank, _state.cross_size,
+            type(_state.backend).__name__)
+
+
+def shutdown() -> None:
+    """Tear down (reference: ``horovod_shutdown``, ``operations.cc:994-1005``)."""
+    with _state.lock:
+        if not _state.initialized:
+            return
+        try:
+            if _state.backend is not None:
+                _state.backend.shutdown()
+        finally:
+            if _state.timeline is not None:
+                _state.timeline.close()
+            _state.backend = None
+            _state.process_set_table = None
+            _state.timeline = None
+            _state.initialized = False
+
+
+atexit.register(shutdown)
+
+
+def is_initialized() -> bool:
+    """Reference: ``horovod_is_initialized`` (``operations.cc:1007``)."""
+    return _state.initialized
+
+
+def _require_init() -> _GlobalState:
+    if not _state.initialized:
+        raise NotInitializedError()
+    return _state
+
+
+def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
+    """Dynamic timeline start (reference: ``horovod_start_timeline``,
+    ``operations.cc:1011-1041``; coordinator-only file)."""
+    st = _require_init()
+    st.timeline.start(file_path, mark_cycles=mark_cycles)
+
+
+def stop_timeline() -> None:
+    st = _require_init()
+    st.timeline.stop()
+
+
+def rank() -> int:
+    return _require_init().rank
+
+
+def size() -> int:
+    return _require_init().size
+
+
+def local_rank() -> int:
+    return _require_init().local_rank
+
+
+def local_size() -> int:
+    return _require_init().local_size
+
+
+def cross_rank() -> int:
+    return _require_init().cross_rank
+
+
+def cross_size() -> int:
+    return _require_init().cross_size
+
+
+def is_homogeneous() -> bool:
+    """True if every host runs the same number of processes
+    (reference: ``horovod_is_homogeneous``, ``operations.cc:1077-1083``).
+
+    Without a cross-host gather of local sizes (done by the controller at
+    init in the multi-process core), the best local test is that this host's
+    ``local_size`` times the host count accounts for every process.
+    """
+    st = _require_init()
+    return st.local_size * max(st.cross_size, 1) == st.size
+
+
+def num_devices() -> int:
+    """TPU chips driven by this process (no reference analog: the reference is
+    one-process-per-GPU; on TPU one process drives a host's chips)."""
+    import jax
+    return jax.local_device_count()
+
+
+def global_device_count() -> int:
+    import jax
+    return jax.device_count()
+
+
+# Build/availability queries (reference: horovod_mpi_built etc.,
+# operations.cc:1085-1130). On TPU, XLA is the data plane; the TCP core is the
+# Gloo-class host backend; there is no MPI/NCCL.
+def xla_built() -> bool:
+    return True
+
+
+def tcp_core_built() -> bool:
+    from horovod_tpu.core import core_available
+    return core_available()
+
+
+def gloo_built() -> bool:  # compat alias: our TCP core fills Gloo's role
+    return tcp_core_built()
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    return False

@@ -1,0 +1,147 @@
+"""Environment-variable configuration surface.
+
+Mirrors the reference's env-knob config system (reference:
+``horovod/common/common.h:107-139`` knob list, parsed in
+``horovod/common/operations.cc:487-588`` and ``horovod/common/utils/env_parser.cc``).
+Every knob accepts a ``HOROVOD_``-prefixed name for drop-in familiarity and an
+``HVD_TPU_``-prefixed alias; the ``HVD_TPU_`` name wins if both are set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Read ``HVD_TPU_<name>`` falling back to ``HOROVOD_<name>``."""
+    v = os.environ.get("HVD_TPU_" + name)
+    if v is None:
+        v = os.environ.get("HOROVOD_" + name)
+    return default if v is None else v
+
+
+def env_int(name: str, default: int) -> int:
+    v = _env(name)
+    try:
+        return int(v) if v not in (None, "") else default
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    v = _env(name)
+    try:
+        return float(v) if v not in (None, "") else default
+    except ValueError:
+        return default
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    v = _env(name)
+    if v in (None, ""):
+        return default
+    return v.lower() not in ("0", "false", "no", "off")
+
+
+def env_str(name: str, default: str = "") -> str:
+    v = _env(name)
+    return default if v in (None, "") else v
+
+
+@dataclasses.dataclass
+class Config:
+    """Snapshot of all runtime knobs.
+
+    Defaults follow the reference: fusion threshold 64 MB is the reference's
+    compile-time default but 128 MB is set at startup
+    (``operations.cc:488``); cycle time 1 ms; cache capacity 1024.
+    """
+
+    # Fusion / cycle (reference: operations.cc:487-538)
+    fusion_threshold_bytes: int = 128 * 1024 * 1024
+    cycle_time_ms: float = 1.0
+    cache_capacity: int = 1024
+    # Hierarchical ops (reference: operations.cc:514-538)
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+    # Autotune (reference: parameter_manager.h:42-105)
+    autotune: bool = False
+    autotune_log: str = ""
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+    # Timeline (reference: timeline.h:48-183)
+    timeline: str = ""
+    timeline_mark_cycles: bool = False
+    # Stall inspection (reference: stall_inspector.h:30-99)
+    stall_check_disable: bool = False
+    stall_warning_time_seconds: float = 60.0
+    stall_shutdown_time_seconds: float = 0.0
+    # Elastic
+    elastic: bool = False
+    reset_limit: int = 0
+    # Backend selection (reference: HOROVOD_CPU_OPERATIONS / HOROVOD_CONTROLLER,
+    # common.h:128; here XLA is the TPU data plane, TCP the host reference plane)
+    tpu_operations: str = "XLA"
+    controller: str = "tcp"
+    # Group fusion (reference: HOROVOD_DISABLE_GROUP_FUSION, group_table.h)
+    disable_group_fusion: bool = False
+    # Compression
+    compression_fp16_on_tpu: bool = True
+    # Misc
+    log_level: str = "WARNING"
+    rendezvous_addr: str = ""
+    rendezvous_port: int = 0
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        d = cls()
+        return cls(
+            fusion_threshold_bytes=env_int(
+                "FUSION_THRESHOLD", d.fusion_threshold_bytes),
+            cycle_time_ms=env_float("CYCLE_TIME", d.cycle_time_ms),
+            cache_capacity=env_int("CACHE_CAPACITY", d.cache_capacity),
+            hierarchical_allreduce=env_bool("HIERARCHICAL_ALLREDUCE"),
+            hierarchical_allgather=env_bool("HIERARCHICAL_ALLGATHER"),
+            autotune=env_bool("AUTOTUNE"),
+            autotune_log=env_str("AUTOTUNE_LOG"),
+            autotune_warmup_samples=env_int(
+                "AUTOTUNE_WARMUP_SAMPLES", d.autotune_warmup_samples),
+            autotune_steps_per_sample=env_int(
+                "AUTOTUNE_STEPS_PER_SAMPLE", d.autotune_steps_per_sample),
+            timeline=env_str("TIMELINE"),
+            timeline_mark_cycles=env_bool("TIMELINE_MARK_CYCLES"),
+            stall_check_disable=env_bool("STALL_CHECK_DISABLE"),
+            stall_warning_time_seconds=env_float(
+                "STALL_CHECK_TIME_SECONDS", d.stall_warning_time_seconds),
+            stall_shutdown_time_seconds=env_float(
+                "STALL_SHUTDOWN_TIME_SECONDS", d.stall_shutdown_time_seconds),
+            elastic=env_bool("ELASTIC"),
+            reset_limit=env_int("RESET_LIMIT", d.reset_limit),
+            tpu_operations=env_str("TPU_OPERATIONS", d.tpu_operations).upper(),
+            controller=env_str("CONTROLLER", d.controller).lower(),
+            disable_group_fusion=env_bool("DISABLE_GROUP_FUSION"),
+            compression_fp16_on_tpu=env_bool(
+                "COMPRESSION_FP16_ON_TPU", d.compression_fp16_on_tpu),
+            log_level=env_str("LOG_LEVEL", d.log_level).upper(),
+            rendezvous_addr=env_str("RENDEZVOUS_ADDR",
+                                    os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR", "")),
+            rendezvous_port=env_int("RENDEZVOUS_PORT", d.rendezvous_port),
+        )
+
+
+_config: Optional[Config] = None
+
+
+def get_config() -> Config:
+    global _config
+    if _config is None:
+        _config = Config.from_env()
+    return _config
+
+
+def reset_config() -> None:
+    """Re-read env on next access (used by elastic re-init and tests)."""
+    global _config
+    _config = None

@@ -1,0 +1,27 @@
+"""Leveled, rank-tagged logging (reference: ``horovod/common/logging.{h,cc}``,
+``LOG(level, rank)`` macros)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LOGGER = None
+
+
+def get_logger() -> logging.Logger:
+    global _LOGGER
+    if _LOGGER is None:
+        from horovod_tpu.common.config import get_config
+        logger = logging.getLogger("horovod_tpu")
+        if not logger.handlers:
+            h = logging.StreamHandler(sys.stderr)
+            rank = os.environ.get("HOROVOD_RANK", os.environ.get("HVD_TPU_RANK", "?"))
+            h.setFormatter(logging.Formatter(
+                f"[%(asctime)s] [hvd-tpu] [rank {rank}] %(levelname)s: %(message)s"))
+            logger.addHandler(h)
+        level = getattr(logging, get_config().log_level, logging.WARNING)
+        logger.setLevel(level)
+        _LOGGER = logger
+    return _LOGGER

@@ -1,0 +1,155 @@
+"""Process sets: named sub-groups of ranks with their own collectives.
+
+Reference: ``horovod/common/process_set.h:26-168``, ``process_set.cc``, Python
+user API ``horovod/common/process_sets.py:18-160``, dynamic registration
+``horovod/common/operations.cc:1194-1260``.
+
+TPU-native design: a process set owns (a) a sub-backend for eager host
+collectives over its ranks and (b) a slice of the data-plane mesh so that
+jitted collectives can run over the corresponding devices (building block for
+MoE / model-parallel hybrids, as in the reference).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+
+class ProcessSet:
+    """User-facing handle (reference: ``process_sets.py:18-70``)."""
+
+    process_set_id: Optional[int]
+    ranks: Optional[List[int]]
+
+    def __init__(self, ranks: Optional[Sequence[int]] = None) -> None:
+        self.process_set_id = None
+        self.ranks = sorted(set(ranks)) if ranks is not None else None
+
+    def included(self) -> bool:
+        from horovod_tpu.common.basics import rank
+        if self.ranks is None:
+            return True
+        return rank() in self.ranks
+
+    def rank(self) -> int:
+        """Rank of this process within the set (-1 if excluded)."""
+        from horovod_tpu.common.basics import rank as global_rank
+        if self.ranks is None:
+            return global_rank()
+        try:
+            return self.ranks.index(global_rank())
+        except ValueError:
+            return -1
+
+    def size(self) -> int:
+        from horovod_tpu.common.basics import size as global_size
+        if self.ranks is None:
+            return global_size()
+        return len(self.ranks)
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, ProcessSet)
+                and self.process_set_id == other.process_set_id
+                and self.ranks == other.ranks)
+
+    def __hash__(self) -> int:
+        return hash((self.process_set_id, tuple(self.ranks or ())))
+
+    def __repr__(self) -> str:
+        return f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})"
+
+
+#: The global process set containing every rank (id 0, like the reference's
+#: global ProcessSet at table slot 0 — ``process_set.h:86-168``).
+global_process_set = ProcessSet()
+global_process_set.process_set_id = 0
+
+
+class _ProcessSetTable:
+    """Registry (reference: ``ProcessSetTable``, ``process_set.h:86-168``)."""
+
+    def __init__(self, state) -> None:
+        self._state = state
+        self._lock = threading.Lock()
+        self._next_id = 1
+        self._sets: Dict[int, ProcessSet] = {0: global_process_set}
+        self._backends: Dict[int, object] = {0: state.backend}
+
+    def register(self, ps: ProcessSet) -> int:
+        with self._lock:
+            if ps.ranks is None:
+                ps.ranks = list(range(self._state.size))
+            for existing in self._sets.values():
+                e_ranks = existing.ranks if existing.ranks is not None \
+                    else list(range(self._state.size))
+                if e_ranks == ps.ranks:
+                    ps.process_set_id = existing.process_set_id
+                    return ps.process_set_id
+            psid = self._next_id
+            self._next_id += 1
+            ps.process_set_id = psid
+            self._sets[psid] = ps
+            self._backends[psid] = self._state.backend.make_subset(ps.ranks)
+            return psid
+
+    def remove(self, ps: ProcessSet) -> None:
+        with self._lock:
+            if ps.process_set_id in (None, 0):
+                raise ValueError(
+                    "Cannot remove an unregistered or the global process set.")
+            be = self._backends.pop(ps.process_set_id, None)
+            self._sets.pop(ps.process_set_id, None)
+            if be is not None and be is not self._state.backend:
+                be.shutdown()
+            ps.process_set_id = None
+
+    def backend_for(self, ps: ProcessSet):
+        with self._lock:
+            if ps.process_set_id is None or ps.process_set_id not in self._sets:
+                raise ValueError(f"Unknown process set: {ps!r}")
+            return self._backends[ps.process_set_id]
+
+    def ids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._sets)
+
+    def get(self, psid: int) -> ProcessSet:
+        with self._lock:
+            return self._sets[psid]
+
+
+def _init_process_set_table(state, initial_sets: List[ProcessSet]):
+    global_process_set.ranks = list(range(state.size))
+    table = _ProcessSetTable(state)
+    for ps in initial_sets:
+        table.register(ps)
+    return table
+
+
+def _table() -> _ProcessSetTable:
+    from horovod_tpu.common.basics import _require_init
+    return _require_init().process_set_table
+
+
+def add_process_set(process_set) -> ProcessSet:
+    """Register a new process set (reference: ``add_process_set``,
+    ``process_sets.py:100-130`` → ``horovod_add_process_set``,
+    ``operations.cc:1194-1229``)."""
+    if not isinstance(process_set, ProcessSet):
+        process_set = ProcessSet(process_set)
+    _table().register(process_set)
+    return process_set
+
+
+def remove_process_set(process_set: ProcessSet) -> None:
+    """Reference: ``remove_process_set`` (``process_sets.py:133-152``)."""
+    _table().remove(process_set)
+
+
+def process_set_ids() -> List[int]:
+    return _table().ids()
+
+
+def get_process_set_by_id(psid: int) -> ProcessSet:
+    return _table().get(psid)

@@ -1,0 +1,140 @@
+"""Chrome-tracing timeline for the host control plane.
+
+Reference: ``horovod/common/timeline.{h,cc}`` — a lock-free SPSC queue feeding
+a dedicated writer thread, producing chrome://tracing JSON; activity names in
+``horovod/common/common.h:73-105``; dynamic start/stop via the C API
+(``operations.cc:1011-1041``). TPU equivalent: the same host-side negotiation
+timeline, while device-side profiling is delegated to ``jax.profiler``
+(see :func:`horovod_tpu.utils.profiler.trace`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+# Reference activity names (common.h:73-105 subset relevant on TPU).
+NEGOTIATE_ALLREDUCE = "NEGOTIATE_ALLREDUCE"
+NEGOTIATE_ALLGATHER = "NEGOTIATE_ALLGATHER"
+NEGOTIATE_BROADCAST = "NEGOTIATE_BROADCAST"
+NEGOTIATE_ALLTOALL = "NEGOTIATE_ALLTOALL"
+WAIT_FOR_DATA = "WAIT_FOR_DATA"
+WAIT_FOR_OTHER_TENSOR_DATA = "WAIT_FOR_OTHER_TENSOR_DATA"
+QUEUE = "QUEUE"
+MEMCPY_IN_FUSION_BUFFER = "MEMCPY_IN_FUSION_BUFFER"
+COMPUTE = "COMPUTE"
+XLA_COLLECTIVE = "XLA_COLLECTIVE"
+MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER"
+
+
+class Timeline:
+    """Asynchronous chrome-tracing writer.
+
+    Events are enqueued from hot paths and serialized by a writer thread
+    (mirrors the reference's SPSC-queue + writer-thread design,
+    ``timeline.h:84-86``). Only the coordinator (rank 0) writes a file by
+    default, matching ``operations.cc:459-475``.
+    """
+
+    def __init__(self, rank: int, file_path: str = "") -> None:
+        self._rank = rank
+        self._q: "queue.Queue[Optional[dict]]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._file = None
+        self._started = False
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._mark_cycles = False
+        if file_path:
+            self.start(file_path)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, file_path: str, mark_cycles: bool = False) -> None:
+        with self._lock:
+            if self._started:
+                return
+            self._mark_cycles = mark_cycles
+            if self._rank != 0:
+                # Workers keep timeline state but only rank 0 writes a file
+                # (reference: coordinator-only file, operations.cc:459-475).
+                self._started = True
+                return
+            try:
+                self._file = open(file_path, "w")
+            except OSError:
+                return
+            self._file.write("[\n")
+            self._thread = threading.Thread(
+                target=self._writer_loop, name="hvd-tpu-timeline", daemon=True)
+            self._thread.start()
+            self._started = True
+
+    def stop(self) -> None:
+        with self._lock:
+            if not self._started:
+                return
+            self._started = False
+            if self._thread is not None:
+                self._q.put(None)
+                self._thread.join(timeout=5)
+                self._thread = None
+            if self._file is not None:
+                try:
+                    self._file.write("{}]\n")
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+
+    def close(self) -> None:
+        self.stop()
+
+    @property
+    def enabled(self) -> bool:
+        return self._started
+
+    # -- event emission ----------------------------------------------------
+    def _emit(self, ph: str, name: str, cat: str, tid: str,
+              args: Optional[dict] = None) -> None:
+        if not self._started or self._file is None:
+            return
+        ev = {"ph": ph, "name": name, "cat": cat, "pid": self._rank,
+              "tid": tid, "ts": (time.monotonic() - self._t0) * 1e6}
+        if args:
+            ev["args"] = args
+        self._q.put(ev)
+
+    def activity_start(self, tensor_name: str, activity: str) -> None:
+        self._emit("B", activity, "activity", tensor_name)
+
+    def activity_end(self, tensor_name: str) -> None:
+        self._emit("E", "", "activity", tensor_name)
+
+    def negotiate_start(self, tensor_name: str, op_name: str) -> None:
+        self._emit("B", f"NEGOTIATE_{op_name.upper()}", "negotiate", tensor_name)
+
+    def negotiate_end(self, tensor_name: str) -> None:
+        self._emit("E", "", "negotiate", tensor_name)
+
+    def mark_cycle(self) -> None:
+        """Cycle tick marker (reference: HOROVOD_TIMELINE_MARK_CYCLES)."""
+        if self._mark_cycles:
+            self._emit("i", "CYCLE_START", "cycle", "cycle")
+
+    def instant(self, name: str, args: Optional[dict] = None) -> None:
+        self._emit("i", name, "marker", "marker", args)
+
+    # -- writer thread -----------------------------------------------------
+    def _writer_loop(self) -> None:
+        while True:
+            ev = self._q.get()
+            if ev is None:
+                return
+            try:
+                self._file.write(json.dumps(ev) + ",\n")
+            except (OSError, ValueError):
+                return

@@ -1,0 +1,16 @@
+"""Shared helpers (reference analog: ``horovod/common/util.py``)."""
+
+from __future__ import annotations
+
+import jax
+
+
+def is_traced(tree) -> bool:
+    """True if any leaf of ``tree`` is a JAX tracer (we're inside jit/grad/
+    shard_map tracing, so only in-graph collectives are legal)."""
+    return any(isinstance(x, jax.core.Tracer)
+               for x in jax.tree_util.tree_leaves(tree))
+
+
+def next_power_of_two(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()

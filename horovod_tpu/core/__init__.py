@@ -1,0 +1,19 @@
+"""Native C++ coordination core (libhvdcore).
+
+TPU-native re-implementation of the reference's C++ core
+(``horovod/common/operations.cc`` background thread + controller + fusion +
+response cache) with a TCP transport replacing Gloo. Built as a shared
+library loaded via ctypes — see :mod:`horovod_tpu.core.bindings`.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "libhvdcore.so")
+
+
+def core_available() -> bool:
+    return os.path.exists(_lib_path())

@@ -1,0 +1,19 @@
+"""ctypes bindings to the native core (placeholder until libhvdcore lands).
+
+Reference analog: ``horovod/common/basics.py:29-149`` loading the C library
+and exposing ``horovod_init``/enqueue functions.
+"""
+
+from __future__ import annotations
+
+from horovod_tpu.core import core_available, _lib_path
+
+
+def core_backend_or_raise(state):
+    if not core_available():
+        raise RuntimeError(
+            f"horovod_tpu was launched with size={state.size} > 1 but the "
+            f"native core library is not built ({_lib_path()} missing). "
+            "Build it with `python setup.py build_ext` or run single-process.")
+    from horovod_tpu.core.core_backend import CoreBackend
+    return CoreBackend(state)

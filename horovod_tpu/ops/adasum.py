@@ -1,0 +1,107 @@
+"""Adasum — adaptive summation that preserves convergence when scaling
+batch size (reference: ``horovod/common/ops/adasum/adasum.h:38-180``, the
+VHDD recursive vector-halving distance-doubling algorithm, and
+``_DistributedAdasumOptimizer``, ``horovod/torch/optimizer.py:335``).
+
+TPU-native formulation: instead of VHDD message passing, the pairwise
+combine
+
+    a' = (1 - dot(a,b) / (2*||a||^2)) * a  +  (1 - dot(a,b) / (2*||b||^2)) * b
+
+is applied in a binary-tree fold over contributions gathered with one XLA
+``all_gather`` (ICI bandwidth makes the gather cheap; the tree fold is pure
+VPU work that XLA fuses). The result is bit-identical in structure to the
+reference's recursion: level k combines partners at distance 2**k.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from horovod_tpu.common.basics import size
+from horovod_tpu.common.process_sets import ProcessSet, global_process_set
+
+
+def adasum_combine(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Pairwise Adasum (reference: ``ComputeDotAndNormSqrds`` +
+    ``ScaledAdd`` fused loop, ``adasum/adasum.h:312-564``)."""
+    af = a.astype(jnp.float32).reshape(-1)
+    bf = b.astype(jnp.float32).reshape(-1)
+    dot = jnp.vdot(af, bf)
+    na = jnp.vdot(af, af)
+    nb = jnp.vdot(bf, bf)
+    # Guard zero norms (reference guards with if-nonzero before dividing).
+    ca = jnp.where(na > 0, 1.0 - dot / (2.0 * jnp.where(na > 0, na, 1.0)), 1.0)
+    cb = jnp.where(nb > 0, 1.0 - dot / (2.0 * jnp.where(nb > 0, nb, 1.0)), 1.0)
+    out = ca * af + cb * bf
+    return out.reshape(a.shape).astype(a.dtype)
+
+
+def adasum_tree_reduce(stacked: jax.Array) -> jax.Array:
+    """Fold ``stacked[n, ...]`` contributions with the Adasum combine in a
+    binary tree, matching VHDD's level structure (distance 1, 2, 4, ...).
+
+    Non-power-of-two ``n`` is handled by zero-padding: ``combine(a, 0) == a``
+    (dot = 0 and the zero-norm guard gives coefficients 1), so padding is
+    exact — the reference handles ragged counts analogously by pairing the
+    overflow ranks before the power-of-two recursion (``adasum.h:205-240``).
+    """
+    n = stacked.shape[0]
+    if n & (n - 1) != 0:
+        from horovod_tpu.common.util import next_power_of_two
+        pad = next_power_of_two(n) - n
+        stacked = jnp.concatenate(
+            [stacked, jnp.zeros((pad,) + stacked.shape[1:], stacked.dtype)])
+        n = stacked.shape[0]
+    while n > 1:
+        half = n // 2
+        a = stacked[0::2][:half]
+        b = stacked[1::2][:half]
+        stacked = jax.vmap(adasum_combine)(a, b)
+        n = half
+    return stacked[0]
+
+
+def adasum_allreduce_along(x: jax.Array, axis_name: str) -> jax.Array:
+    """SPMD Adasum over a named mesh axis (use inside shard_map)."""
+    gathered = jax.lax.all_gather(x, axis_name)  # [axis_size, ...]
+    return adasum_tree_reduce(gathered)
+
+
+def AdasumGradTransform(process_set: ProcessSet = global_process_set,
+                        axis_name: Optional[str] = None
+                        ) -> optax.GradientTransformation:
+    """optax transform applying Adasum across workers (used by
+    ``DistributedOptimizer(op=hvd.Adasum)``)."""
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        from horovod_tpu.common.util import is_traced
+        traced = is_traced(updates)
+        if traced and axis_name is not None:
+            new = jax.tree_util.tree_map(
+                lambda g: adasum_allreduce_along(g, axis_name), updates)
+        elif not traced and size() > 1:
+            from horovod_tpu.ops import collectives as C
+            def one(i, g):
+                stacked = C.allgather(jnp.asarray(g)[None, ...],
+                                      name=f"adasum.{i}",
+                                      process_set=process_set)
+                return adasum_tree_reduce(jnp.asarray(stacked))
+            leaves, treedef = jax.tree_util.tree_flatten(updates)
+            new = jax.tree_util.tree_unflatten(
+                treedef, [one(i, g) for i, g in enumerate(leaves)])
+        else:
+            new = updates  # single contributor: Adasum(a) = a
+        return new, state
+
+    return optax.GradientTransformation(init_fn, update_fn)

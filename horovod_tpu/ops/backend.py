@@ -1,0 +1,206 @@
+"""Process-level communication backends for eager collectives.
+
+This is the seam the reference fills with its OperationManager priority list
+(``horovod/common/operations.cc:144-253``: MPI-GPU/NCCL/Gloo/CCL → first
+``Enabled()`` op wins). TPU-native equivalents:
+
+* :class:`LocalBackend` — single process; collectives are identities over one
+  contributor (the reference behaves the same when run without a launcher).
+* ``CoreBackend`` (:mod:`horovod_tpu.core.bindings`) — the C++ negotiation
+  core with TCP host collectives, the "Gloo-class" reference plane.
+* ``XlaBackend`` (:mod:`horovod_tpu.ops.xla_backend`) — multi-host data plane:
+  collectives ride ICI/DCN as jitted XLA ops over the global mesh, ordered by
+  the C++ controller.
+
+Every backend exposes async enqueue + handle semantics mirroring the
+reference's ``EnqueueTensorAllreduce`` + ``handle_manager``
+(``horovod/torch/mpi_ops_v2.cc:89-127,566-580``).
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from horovod_tpu.ops.reduce_op import ReduceOp
+
+
+class HvdHandle:
+    """Async completion handle (reference: ``HandleManager``,
+    ``horovod/torch/handle_manager.{h,cc}``)."""
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+
+    def _set_result(self, value: Any) -> None:
+        self._result = value
+        self._event.set()
+
+    def _set_error(self, err: BaseException) -> None:
+        self._error = err
+        self._event.set()
+
+    def poll(self) -> bool:
+        """Reference: ``PollHandle`` (``mpi_ops_v2.cc:566-571``)."""
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> Any:
+        """Reference: ``WaitAndClear`` (``mpi_ops_v2.cc:573-580``)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("collective did not complete in time")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    @staticmethod
+    def done(value: Any) -> "HvdHandle":
+        h = HvdHandle()
+        h._set_result(value)
+        return h
+
+
+class Backend(abc.ABC):
+    """Process-group communicator over ``ranks`` (None = all)."""
+
+    def __init__(self, rank: int, size: int) -> None:
+        self.rank = rank
+        self.size = size
+
+    # -- collectives (async; return HvdHandle yielding the result array) ----
+    @abc.abstractmethod
+    def allreduce_async(self, name: str, value, op: ReduceOp,
+                        prescale: float = 1.0, postscale: float = 1.0
+                        ) -> HvdHandle: ...
+
+    @abc.abstractmethod
+    def grouped_allreduce_async(self, names: Sequence[str], values: Sequence,
+                                op: ReduceOp, prescale: float = 1.0,
+                                postscale: float = 1.0) -> HvdHandle: ...
+
+    @abc.abstractmethod
+    def allgather_async(self, name: str, value) -> HvdHandle: ...
+
+    @abc.abstractmethod
+    def broadcast_async(self, name: str, value, root_rank: int) -> HvdHandle: ...
+
+    @abc.abstractmethod
+    def alltoall_async(self, name: str, value,
+                       splits: Optional[Sequence[int]] = None) -> HvdHandle: ...
+
+    def reducescatter_async(self, name: str, value, op: ReduceOp) -> HvdHandle:
+        """Default: allreduce then take this rank's dim-0 slice. Backends with
+        a native reduce-scatter (XLA ``psum_scatter``) override this."""
+        h = self.allreduce_async(name, value, op)
+        out = HvdHandle()
+
+        def finish():
+            try:
+                full = h.wait()
+                n = self.size
+                rows = np.asarray(full).shape[0]
+                if rows % n != 0:
+                    raise ValueError(
+                        f"reducescatter: leading dim {rows} not divisible by "
+                        f"process-set size {n}")
+                chunk = rows // n
+                out._set_result(full[self.rank * chunk:(self.rank + 1) * chunk])
+            except BaseException as e:  # propagate to waiter
+                out._set_error(e)
+
+        threading.Thread(target=finish, daemon=True).start()
+        return out
+
+    @abc.abstractmethod
+    def barrier(self) -> None: ...
+
+    def join(self, device: int = -1) -> int:
+        """Reference Join op (``EnqueueJoin``, ``operations.cc:1714-1742``):
+        declare this rank out of data; returns rank of the last joiner."""
+        return self.size - 1
+
+    # -- lifecycle ----------------------------------------------------------
+    @abc.abstractmethod
+    def make_subset(self, ranks: Sequence[int]) -> "Backend": ...
+
+    def shutdown(self) -> None:
+        pass
+
+
+def _scale(arr, factor: float):
+    if factor == 1.0:
+        return arr
+    if np.issubdtype(np.asarray(arr).dtype, np.integer) \
+            and float(factor) != int(factor):
+        raise ValueError(
+            f"prescale/postscale factor {factor} is fractional but the tensor "
+            f"dtype is integral ({np.asarray(arr).dtype}); cast to float "
+            "first (matches the reference rejecting non-float scaling).")
+    if isinstance(arr, np.ndarray):
+        return (arr * factor).astype(arr.dtype)
+    return (arr * factor).astype(arr.dtype)
+
+
+class LocalBackend(Backend):
+    """Single-contributor group: every collective is (scaled) identity.
+
+    Matches reference behavior with ``size() == 1`` — e.g. allreduce returns
+    the tensor itself after pre/postscale, allgather returns the input,
+    broadcast requires root 0.
+    """
+
+    def __init__(self, rank: int = 0, size: int = 1) -> None:
+        assert size == 1
+        super().__init__(rank, size)
+
+    def allreduce_async(self, name, value, op, prescale=1.0, postscale=1.0):
+        out = _scale(_scale(value, prescale), postscale)
+        if op == ReduceOp.AVERAGE:
+            pass  # average over one contributor
+        return HvdHandle.done(out)
+
+    def grouped_allreduce_async(self, names, values, op,
+                                prescale=1.0, postscale=1.0):
+        outs = [_scale(_scale(v, prescale), postscale) for v in values]
+        return HvdHandle.done(outs)
+
+    def allgather_async(self, name, value):
+        return HvdHandle.done(value)
+
+    def broadcast_async(self, name, value, root_rank):
+        if root_rank != self.rank:
+            raise ValueError(
+                f"broadcast root_rank={root_rank} out of range for size 1")
+        return HvdHandle.done(value)
+
+    def alltoall_async(self, name, value, splits=None):
+        if splits is None:
+            recv_splits = np.asarray([np.asarray(value).shape[0]],
+                                     dtype=np.int32)
+        else:
+            splits = np.asarray(splits, dtype=np.int32)
+            if splits.shape != (1,):
+                raise ValueError("alltoall splits must have one entry per rank")
+            recv_splits = splits
+        return HvdHandle.done((value, recv_splits))
+
+    def barrier(self) -> None:
+        return
+
+    def make_subset(self, ranks):
+        return LocalBackend(0, 1)
+
+
+def make_backend(state) -> Backend:
+    """Priority selection (reference: ``CreateOperationManager``,
+    ``operations.cc:144-253``)."""
+    if state.size <= 1:
+        return LocalBackend(state.rank, 1)
+    # Multi-process: the C++ core (TCP controller + host collectives, with
+    # the XLA data plane layered on top when TPU devices are present).
+    from horovod_tpu.core.bindings import core_backend_or_raise
+    return core_backend_or_raise(state)

@@ -1,0 +1,198 @@
+"""Public eager collective API on ``jax.Array`` / numpy.
+
+Mirrors the reference's per-framework op surface
+(``horovod/torch/mpi_ops.py:143-903``, ``horovod/tensorflow/mpi_ops.py:108-356``):
+sync and async variants of allreduce / grouped_allreduce / allgather /
+broadcast / alltoall, plus ``poll``/``synchronize``/``join``/``barrier``.
+
+Semantics notes vs the reference:
+
+* ``op=Average`` divides by the process-set size (reference: AVERAGE →
+  postscale 1/size, ``operations.cc:1342-1500``).
+* Gradient flow: the JAX-idiomatic counterpart of torch autograd hooks /
+  ``tf.RegisterGradient`` is :func:`horovod_tpu.DistributedGradTransform`
+  (gradient averaging inside the optimizer transform) — these eager functions
+  operate on concrete arrays outside of traced code.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.common.basics import _require_init
+from horovod_tpu.common.process_sets import ProcessSet, global_process_set
+from horovod_tpu.ops.backend import Backend, HvdHandle
+from horovod_tpu.ops.reduce_op import Adasum, Average, ReduceOp, Sum
+
+_name_counter = [0]
+
+
+def _auto_name(prefix: str, name: Optional[str]) -> str:
+    if name is not None:
+        return name
+    _name_counter[0] += 1
+    return f"{prefix}.noname.{_name_counter[0]}"
+
+
+def _backend_for(process_set: ProcessSet) -> Backend:
+    st = _require_init()
+    return st.process_set_table.backend_for(process_set)
+
+
+def _check_op(op: Optional[ReduceOp], average: Optional[bool]) -> ReduceOp:
+    """Reference: ``handle_average_backwards_compatibility``
+    (``horovod/common/util.py``)."""
+    if average is not None:
+        if op is not None:
+            raise ValueError("Cannot specify both op and average.")
+        return Average if average else Sum
+    return Average if op is None else op
+
+
+# -- allreduce --------------------------------------------------------------
+
+def allreduce_async(value, average: Optional[bool] = None,
+                    name: Optional[str] = None,
+                    op: Optional[ReduceOp] = None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0,
+                    process_set: ProcessSet = global_process_set) -> HvdHandle:
+    op = _check_op(op, average)
+    be = _backend_for(process_set)
+    st = _require_init()
+    name = _auto_name("allreduce", name)
+    if st.timeline is not None:
+        st.timeline.instant("enqueue_allreduce", {"tensor": name})
+    return be.allreduce_async(name, value, op, prescale_factor,
+                              postscale_factor)
+
+
+def allreduce(value, average: Optional[bool] = None,
+              name: Optional[str] = None, op: Optional[ReduceOp] = None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              process_set: ProcessSet = global_process_set):
+    return allreduce_async(value, average, name, op, prescale_factor,
+                           postscale_factor, process_set).wait()
+
+
+def grouped_allreduce_async(values: Sequence, average: Optional[bool] = None,
+                            name: Optional[str] = None,
+                            op: Optional[ReduceOp] = None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0,
+                            process_set: ProcessSet = global_process_set
+                            ) -> HvdHandle:
+    """Reference: ``grouped_allreduce_async_`` (``torch/mpi_ops.py:383``);
+    grouping guarantees the tensors fuse into one collective
+    (``GroupTable``, ``horovod/common/group_table.h:30-60``)."""
+    op = _check_op(op, average)
+    be = _backend_for(process_set)
+    base = _auto_name("grouped_allreduce", name)
+    names = [f"{base}.{i}" for i in range(len(values))]
+    return be.grouped_allreduce_async(names, list(values), op,
+                                      prescale_factor, postscale_factor)
+
+
+def grouped_allreduce(values: Sequence, average: Optional[bool] = None,
+                      name: Optional[str] = None,
+                      op: Optional[ReduceOp] = None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0,
+                      process_set: ProcessSet = global_process_set) -> List:
+    return grouped_allreduce_async(values, average, name, op, prescale_factor,
+                                   postscale_factor, process_set).wait()
+
+
+# -- allgather --------------------------------------------------------------
+
+def allgather_async(value, name: Optional[str] = None,
+                    process_set: ProcessSet = global_process_set) -> HvdHandle:
+    """Concat along dim 0 across ranks; ranks may differ in dim 0 (reference:
+    ``EnqueueTensorAllgather`` ``operations.cc:1504-1556`` with per-rank
+    first-dim sizes in the Response)."""
+    be = _backend_for(process_set)
+    return be.allgather_async(_auto_name("allgather", name), value)
+
+
+def allgather(value, name: Optional[str] = None,
+              process_set: ProcessSet = global_process_set):
+    return allgather_async(value, name, process_set).wait()
+
+
+# -- broadcast --------------------------------------------------------------
+
+def broadcast_async(value, root_rank: int, name: Optional[str] = None,
+                    process_set: ProcessSet = global_process_set) -> HvdHandle:
+    be = _backend_for(process_set)
+    return be.broadcast_async(_auto_name("broadcast", name), value, root_rank)
+
+
+def broadcast(value, root_rank: int, name: Optional[str] = None,
+              process_set: ProcessSet = global_process_set):
+    return broadcast_async(value, root_rank, name, process_set).wait()
+
+
+# -- alltoall ---------------------------------------------------------------
+
+def alltoall_async(value, splits: Optional[Sequence[int]] = None,
+                   name: Optional[str] = None,
+                   process_set: ProcessSet = global_process_set) -> HvdHandle:
+    """Uneven alltoallv (reference: ``EnqueueTensorAlltoall``
+    ``operations.cc:1630-1710``): ``splits[i]`` rows of dim 0 go to rank i;
+    result is (received tensor, received splits)."""
+    be = _backend_for(process_set)
+    return be.alltoall_async(_auto_name("alltoall", name), value, splits)
+
+
+def alltoall(value, splits: Optional[Sequence[int]] = None,
+             name: Optional[str] = None,
+             process_set: ProcessSet = global_process_set):
+    return alltoall_async(value, splits, name, process_set).wait()
+
+
+# -- reducescatter ----------------------------------------------------------
+
+def reducescatter_async(value, op: Optional[ReduceOp] = None,
+                        name: Optional[str] = None,
+                        process_set: ProcessSet = global_process_set
+                        ) -> HvdHandle:
+    """Reduce-scatter over dim 0 (the reference added this in later versions;
+    first-class here because ``reduce_scatter`` is the cheap half of a TPU
+    ring allreduce and the core of ZeRO-style sharded optimizers)."""
+    op = op if op is not None else Sum
+    be = _backend_for(process_set)
+    name = _auto_name("reducescatter", name)
+    if be.size == 1:
+        return be.allreduce_async(name, value, op)
+    return be.reducescatter_async(name, value, op)
+
+
+def reducescatter(value, op: Optional[ReduceOp] = None,
+                  name: Optional[str] = None,
+                  process_set: ProcessSet = global_process_set):
+    return reducescatter_async(value, op, name, process_set).wait()
+
+
+# -- handles / control ------------------------------------------------------
+
+def poll(handle: HvdHandle) -> bool:
+    return handle.poll()
+
+
+def synchronize(handle: HvdHandle):
+    return handle.wait()
+
+
+def join(device: int = -1) -> int:
+    """Reference: ``hvd.join`` (``torch/mpi_ops.py:860-903``)."""
+    st = _require_init()
+    return st.backend.join(device)
+
+
+def barrier(process_set: ProcessSet = global_process_set) -> None:
+    _backend_for(process_set).barrier()

@@ -1,0 +1,26 @@
+"""Reduction-op enumeration (reference: ``horovod_reduce_op_average/sum/
+adasum/min/max/product`` C API codes, ``horovod/common/operations.cc:1132-1160``
+and the Python-side constants in each framework's ``mpi_ops.py``)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class ReduceOp(enum.IntEnum):
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+    PRODUCT = 5
+
+
+# Module-level aliases matching the reference's public names
+# (``horovod.torch.mpi_ops.Average`` etc.).
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT

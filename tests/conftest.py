@@ -1,0 +1,40 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The TPU analog of the reference's test strategy (SURVEY.md §4): parallel
+collective numerics are validated on a multi-device host platform the way the
+reference runs Gloo/MPI on localhost.
+
+Note: this environment's sitecustomize may pre-register a TPU plugin and force
+``jax_platforms``; we override back to CPU before any backend client exists.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+assert jax.device_count() == 8, (
+    f"tests require the 8-device virtual CPU mesh, got {jax.devices()}")
+
+
+@pytest.fixture
+def hvd():
+    import horovod_tpu as hvd
+    hvd.shutdown()
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
+
+
+@pytest.fixture
+def mesh8():
+    import horovod_tpu as hvd
+    return hvd.build_mesh(dp=2, pp=1, ep=1, sp=2, tp=2)

@@ -1,0 +1,59 @@
+"""Lifecycle & identity tests (reference analog: test/single/ init tests and
+process-set tests in test/parallel/test_process_sets_*)."""
+
+import pytest
+
+
+def test_init_idempotent(hvd):
+    assert hvd.is_initialized()
+    hvd.init()  # second init is a no-op
+    assert hvd.is_initialized()
+
+
+def test_identity_single_process(hvd):
+    assert hvd.rank() == 0
+    assert hvd.size() == 1
+    assert hvd.local_rank() == 0
+    assert hvd.local_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.is_homogeneous()
+
+
+def test_built_queries(hvd):
+    assert hvd.xla_built()
+    assert not hvd.mpi_built()
+    assert not hvd.nccl_built()
+    assert not hvd.cuda_built()
+
+
+def test_num_devices(hvd):
+    assert hvd.num_devices() == 8  # virtual CPU mesh from conftest
+    assert hvd.global_device_count() == 8
+
+
+def test_requires_init():
+    import horovod_tpu as hvd
+    hvd.shutdown()
+    with pytest.raises(RuntimeError):
+        hvd.rank()
+
+
+def test_process_sets(hvd):
+    # At size 1, any ranks list equals the global set → dedup to id 0
+    # (reference: ProcessSetTable dedup of identical rank lists).
+    ps = hvd.add_process_set([0])
+    assert ps.process_set_id == 0
+    assert ps.included()
+    assert ps.rank() == 0
+    assert ps.size() == 1
+    assert hvd.process_set_ids() == [0]
+    with pytest.raises(ValueError):
+        hvd.remove_process_set(hvd.global_process_set)
+
+
+def test_shutdown_and_reinit(hvd):
+    hvd.shutdown()
+    assert not hvd.is_initialized()
+    hvd.init()
+    assert hvd.rank() == 0

@@ -1,0 +1,71 @@
+"""Eager collective semantics, single-process (reference analog:
+test/parallel/test_torch.py collective tests degeneratet to one rank)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+
+def test_allreduce_identity(hvd):
+    x = jnp.arange(8.0)
+    out = hvd.allreduce(x)
+    np.testing.assert_allclose(np.asarray(out), np.arange(8.0))
+
+
+def test_allreduce_ops(hvd):
+    x = jnp.ones((4, 4))
+    for op in (hvd.Sum, hvd.Average, hvd.Min, hvd.Max, hvd.Product):
+        out = hvd.allreduce(x, op=op)
+        np.testing.assert_allclose(np.asarray(out), np.ones((4, 4)))
+
+
+def test_allreduce_prescale_postscale(hvd):
+    x = jnp.ones(4)
+    out = hvd.allreduce(x, op=hvd.Sum, prescale_factor=2.0,
+                        postscale_factor=3.0)
+    np.testing.assert_allclose(np.asarray(out), 6.0 * np.ones(4))
+
+
+def test_allreduce_average_and_op_conflict(hvd):
+    with pytest.raises(ValueError):
+        hvd.allreduce(jnp.ones(2), average=True, op=hvd.Sum)
+
+
+def test_grouped_allreduce(hvd):
+    xs = [jnp.ones(3), jnp.arange(4.0)]
+    outs = hvd.grouped_allreduce(xs, op=hvd.Sum)
+    assert len(outs) == 2
+    np.testing.assert_allclose(np.asarray(outs[1]), np.arange(4.0))
+
+
+def test_allgather(hvd):
+    x = jnp.arange(6.0).reshape(3, 2)
+    out = hvd.allgather(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_broadcast(hvd):
+    x = jnp.arange(4.0)
+    out = hvd.broadcast(x, root_rank=0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    with pytest.raises(ValueError):
+        hvd.broadcast(x, root_rank=1)  # out of range for size 1
+
+
+def test_alltoall(hvd):
+    x = jnp.arange(10.0)
+    out, recv_splits = hvd.alltoall(x, splits=[10])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    assert list(np.asarray(recv_splits)) == [10]
+
+
+def test_async_handles(hvd):
+    h = hvd.allreduce_async(jnp.ones(2), op=hvd.Sum)
+    assert hvd.poll(h)
+    out = hvd.synchronize(h)
+    np.testing.assert_allclose(np.asarray(out), np.ones(2))
+
+
+def test_join_barrier(hvd):
+    assert hvd.join() == 0
+    hvd.barrier()
