@@ -1,0 +1,138 @@
+"""Mixture-of-Experts with expert parallelism over the ``ep`` mesh axis.
+
+The reference's users build MoE from ``alltoall`` + process sets (SURVEY.md
+§2.6: EP "absent as a strategy; alltoall + process sets are the primitives").
+Here the full strategy ships: GShard/Switch-style capacity-based dense
+dispatch (MXU-friendly einsums, static shapes — no dynamic gather inside
+jit) with ``lax.all_to_all`` token exchange across expert shards.
+
+Dataflow per ep-shard (G local tokens, E global experts, C capacity):
+  gates = softmax(router(x))                      [G, E]
+  dispatch/combine one-hots via top-k + cumsum    [G, E, C]
+  xs = einsum(gm,gec->ecm)(x, dispatch)           [E, C, M]
+  xs = all_to_all(ep)                             [E/ep, ep*C, M]
+  ys = expert_ffn(xs)  (local experts only)
+  ys = all_to_all back; y = einsum(ecm,gec->gm)(ys, combine)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+class MoEMetrics(NamedTuple):
+    aux_loss: jax.Array       # load-balancing loss (Switch aux loss)
+    fraction_dropped: jax.Array
+
+
+def top_k_gating(logits: jax.Array, k: int, capacity: int
+                 ) -> Tuple[jax.Array, jax.Array, MoEMetrics]:
+    """Compute dense dispatch/combine tensors.
+
+    logits: [G, E]. Returns dispatch [G, E, C] (0/1), combine [G, E, C]
+    (gate weights), metrics.
+    """
+    G, E = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)          # [G, E]
+
+    # Switch aux loss: E * sum_e (mean_g gates_e * mean_g route_e)
+    top1 = jnp.argmax(gates, axis=-1)
+    density = jnp.mean(jax.nn.one_hot(top1, E), axis=0)
+    density_proxy = jnp.mean(gates, axis=0)
+    aux = E * jnp.sum(density * density_proxy)
+
+    dispatch = jnp.zeros((G, E, capacity), jnp.float32)
+    combine = jnp.zeros((G, E, capacity), jnp.float32)
+    # Track per-expert fill across the k choices so slots are not reused.
+    fill = jnp.zeros((E,), jnp.int32)
+    masked_gates = gates
+    dropped = jnp.zeros((), jnp.float32)
+    for _ in range(k):
+        choice = jnp.argmax(masked_gates, axis=-1)               # [G]
+        onehot = jax.nn.one_hot(choice, E, dtype=jnp.int32)      # [G, E]
+        pos_in_expert = (jnp.cumsum(onehot, axis=0) - onehot) \
+            + fill[None, :]                                      # [G, E]
+        pos = jnp.sum(pos_in_expert * onehot, axis=-1)           # [G]
+        keep = pos < capacity
+        gate_val = jnp.take_along_axis(
+            gates, choice[:, None], axis=-1)[:, 0]               # [G]
+        disp = (jax.nn.one_hot(choice, E)[:, :, None]
+                * jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1),
+                                 capacity)[:, None, :]
+                * keep[:, None, None])
+        dispatch = dispatch + disp
+        combine = combine + disp * gate_val[:, None, None]
+        dropped = dropped + jnp.sum(1.0 - keep) / (G * k)
+        fill = fill + jnp.sum(onehot * keep[:, None], axis=0)
+        masked_gates = masked_gates * (1.0 - jax.nn.one_hot(choice, E))
+    return dispatch, combine, MoEMetrics(aux, dropped)
+
+
+def moe_layer_spmd(x: jax.Array, router_w: jax.Array,
+                   expert_fn: Callable[[jax.Array, jax.Array], jax.Array],
+                   expert_params, axis_name: str = "ep", k: int = 2,
+                   capacity_factor: float = 1.25
+                   ) -> Tuple[jax.Array, MoEMetrics]:
+    """SPMD MoE (inside shard_map). Local shapes:
+
+    x: [G, M] local tokens; router_w: [M, E] (replicated); expert_params:
+    pytree with leading dim E_local = E/ep (this shard's experts).
+    expert_fn(params_e, tokens [N, M]) -> [N, M], vmapped over local experts.
+    """
+    n = lax.axis_size(axis_name) if axis_name else 1
+    G, M = x.shape
+    E = router_w.shape[1]
+    e_local = E // max(n, 1)
+    capacity = max(1, int(capacity_factor * k * G / E))
+
+    logits = x @ router_w                                  # [G, E]
+    dispatch, combine, metrics = top_k_gating(logits, k, capacity)
+
+    xs = jnp.einsum("gm,gec->ecm", x.astype(jnp.float32),
+                    dispatch).astype(x.dtype)              # [E, C, M]
+    if n > 1:
+        # split expert dim across shards; gather the source dim into rows:
+        # [E, C, M] -> [E/ep, ep*C, M]
+        xs = lax.all_to_all(xs, axis_name, split_axis=0, concat_axis=1,
+                            tiled=True)
+    ys = jax.vmap(expert_fn)(expert_params, xs)            # [E/ep, n*C, M]
+    if n > 1:
+        ys = lax.all_to_all(ys, axis_name, split_axis=1, concat_axis=0,
+                            tiled=True)                    # [E, C, M]
+    y = jnp.einsum("ecm,gec->gm", ys.astype(jnp.float32),
+                   combine).astype(x.dtype)                # [G, M]
+    return y, metrics
+
+
+def moe_layer(x: jax.Array, router_w: jax.Array, expert_fn: Callable,
+              expert_params, mesh: Mesh, axis_name: str = "ep",
+              k: int = 2, capacity_factor: float = 1.25,
+              token_axes: Tuple[Optional[str], ...] = ("dp",)
+              ) -> Tuple[jax.Array, MoEMetrics]:
+    """Array-level MoE: x ``[T, M]`` tokens sharded over ``token_axes``;
+    expert_params leading dim E sharded over ``axis_name``."""
+    n = mesh.shape.get(axis_name, 1)
+    tok_ax = tuple(a for a in token_axes if mesh.shape.get(a, 1) > 1) or None
+    tok_spec = P(tok_ax)
+    ep_ax = axis_name if n > 1 else None
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(tok_spec, P(), P(ep_ax)),
+        out_specs=(tok_spec, P()), check_vma=False)
+    def run(xl, rw, ep_params):
+        y, met = moe_layer_spmd(xl, rw, expert_fn, ep_params,
+                                axis_name if n > 1 else None,
+                                k, capacity_factor)
+        if n > 1:
+            met = MoEMetrics(lax.pmean(met.aux_loss, axis_name),
+                             lax.pmean(met.fraction_dropped, axis_name))
+        return y, met
+
+    return run(x, router_w, expert_params)

@@ -1,0 +1,99 @@
+"""Pipeline parallelism (GPipe-style) over the ``pp`` mesh axis.
+
+Absent from the reference (SURVEY.md §2.6). TPU-native design: all stages
+run the same SPMD program under ``shard_map``; stage-to-stage transfer is a
+``lax.ppermute`` ring shift of the activation; microbatches flow for
+``M + S - 1`` ticks (fill + steady state + drain). Stage parameters are the
+same pytree with a leading stage dim sharded over ``pp`` — so the schedule
+is a compiled ``lax.scan``, with no host round-trips between ticks (the
+whole pipeline is one XLA program; ICI transfers overlap with stage compute).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_spmd(stage_fn: Callable, stage_params, x_microbatches: jax.Array,
+                  axis_name: str = "pp") -> jax.Array:
+    """SPMD body (inside shard_map over ``axis_name``).
+
+    stage_params: this stage's params — pytree, leaves ``[1, ...]`` (leading
+    stage dim sharded to size 1 locally).
+    x_microbatches: ``[M, mb, ...]`` all microbatches (stage 0 consumes them;
+    other stages ignore).
+    Returns ``[M, mb, ...]`` outputs (valid on every shard after the final
+    cross-stage reduction).
+    """
+    S = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    M = x_microbatches.shape[0]
+    my_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+
+    mb_shape = x_microbatches.shape[1:]
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        act, ys = carry
+        prev = lax.ppermute(act, axis_name, fwd_perm)
+        feed = x_microbatches[jnp.clip(t, 0, M - 1)]
+        cur = jnp.where(stage == 0, feed, prev)
+        out = stage_fn(my_params, cur)
+        emit = t - (S - 1)
+        is_emit = (stage == S - 1) & (emit >= 0) & (emit < M)
+        idx = jnp.clip(emit, 0, M - 1)
+        ys = ys.at[idx].set(jnp.where(is_emit, out, ys[idx]))
+        return (out, ys), None
+
+    act0 = jnp.zeros(mb_shape, x_microbatches.dtype)
+    ys0 = jnp.zeros((M,) + mb_shape, x_microbatches.dtype)
+    (act, ys), _ = lax.scan(tick, (act0, ys0), jnp.arange(M + S - 1))
+    # Only the last stage holds real outputs; replicate via masked psum.
+    ys = jnp.where(stage == S - 1, ys, jnp.zeros_like(ys))
+    return lax.psum(ys, axis_name)
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array, mesh: Mesh,
+                   n_microbatches: int, axis_name: str = "pp",
+                   batch_axis: Optional[str] = "dp") -> jax.Array:
+    """Array-level GPipe.
+
+    stage_fn(params_for_one_stage, microbatch) -> microbatch (same shape).
+    stage_params: pytree with leading dim = pp size, sharded over ``pp``.
+    x: ``[T, ...]`` global batch; split into ``n_microbatches``.
+    """
+    S = mesh.shape.get(axis_name, 1)
+    if S == 1:
+        one = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+        return stage_fn(one, x)
+    T = x.shape[0]
+    if T % n_microbatches != 0:
+        raise ValueError(f"batch {T} not divisible by microbatches "
+                         f"{n_microbatches}")
+    xm = x.reshape((n_microbatches, T // n_microbatches) + x.shape[1:])
+    b_ax = batch_axis if (batch_axis and mesh.shape.get(batch_axis, 1) > 1) \
+        else None
+    x_spec = P(None, b_ax)
+    out_spec = P(None, b_ax)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(axis_name), x_spec),
+                       out_specs=out_spec, check_vma=False)
+    def run(params_l, xm_l):
+        return pipeline_spmd(stage_fn, params_l, xm_l, axis_name)
+
+    ym = run(stage_params, xm)
+    return ym.reshape((T,) + ym.shape[2:])
+
+
+def stage_stacked(params_per_stage: list):
+    """Stack a list of per-stage parameter pytrees into the leading-dim
+    layout ``pipeline_apply`` expects (shard the result over ``pp``)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *params_per_stage)

@@ -1,0 +1,66 @@
+"""Ulysses-style sequence parallelism: all_to_all head↔sequence re-sharding.
+
+The reference exposes the ``alltoall`` primitive this is built on
+(``operations.cc:1630-1710``) but not the strategy (SURVEY.md §2.6). Here the
+full pattern is provided: sequence-sharded activations are re-sharded to
+head-sharded for exact (non-blocked) attention, then re-sharded back —
+2 all_to_alls per attention instead of a ring of ppermutes. On TPU both
+all_to_alls ride ICI; Ulysses is preferable when H >= sp and sequence blocks
+are small; ring attention when S is huge (memory-bound).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.parallel.ring_attention import _plain_attention
+
+
+def ulysses_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array,
+                           axis_name: str = "sp", causal: bool = True,
+                           scale: Optional[float] = None) -> jax.Array:
+    """SPMD body (inside shard_map): local shapes ``[B, S/sp, H, D]``.
+
+    all_to_all #1: scatter heads, gather sequence → ``[B, S, H/sp, D]``;
+    exact attention on full sequence for the local head group;
+    all_to_all #2: scatter sequence, gather heads → ``[B, S/sp, H, D]``.
+    """
+    n = lax.axis_size(axis_name)
+    B, Sl, H, D = q.shape
+    if H % n != 0:
+        raise ValueError(f"Ulysses needs heads ({H}) divisible by axis ({n})")
+    # [B, S/sp, H, D] -> split heads -> gather seq: [B, S, H/sp, D]
+    def to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+    def to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = _plain_attention(qh, kh, vh, causal, scale)
+    return to_seq(out)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                      axis_name: str = "sp", causal: bool = True,
+                      scale: Optional[float] = None,
+                      batch_axis: Optional[str] = "dp") -> jax.Array:
+    """Array-level wrapper: global ``[B, S, H, D]``, S sharded on axis."""
+    if mesh.shape.get(axis_name, 1) == 1:
+        return _plain_attention(q, k, v, causal, scale)
+    b_ax = batch_axis if (batch_axis and mesh.shape.get(batch_axis, 1) > 1) \
+        else None
+    spec = P(b_ax, axis_name)
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec,) * 3,
+                       out_specs=spec, check_vma=False)
+    def run(ql, kl, vl):
+        return ulysses_attention_spmd(ql, kl, vl, axis_name, causal, scale)
+
+    return run(q, k, v)
