@@ -1,0 +1,81 @@
+"""Headline benchmark: ResNet-50 synthetic training throughput per chip.
+
+Matches the reference's canonical harness (synthetic-data img/sec,
+``examples/pytorch/pytorch_synthetic_benchmark.py`` /
+``docs/benchmarks.rst:67-80``). Baseline for ``vs_baseline``: the reference's
+published 16-GPU ResNet-101 number — 1656.82 img/s total = 103.55
+img/s/GPU (``docs/benchmarks.rst:32-43``, 4×4 Pascal P100, batch 64) — the
+only absolute throughput the reference publishes.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+REFERENCE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # docs/benchmarks.rst:32-43
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.resnet import (ResNet50, create_resnet_state,
+                                           make_resnet_train_step,
+                                           batch_sharding)
+
+    hvd.init()
+    mesh = hvd.build_mesh(dp=-1)
+    n_chips = int(np.prod(list(mesh.shape.values())))
+
+    batch_per_chip = 128
+    B = batch_per_chip * n_chips
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    params, batch_stats = create_resnet_state(
+        model, jax.random.PRNGKey(0), image_size=224, mesh=mesh)
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = jax.jit(tx.init)(params)
+    step = make_resnet_train_step(model, tx, mesh)
+
+    rng = np.random.RandomState(0)
+    images = jax.device_put(
+        jnp.asarray(rng.rand(B, 224, 224, 3), jnp.bfloat16),
+        batch_sharding(mesh))
+    labels = jax.device_put(
+        jnp.asarray(rng.randint(0, 1000, (B,)), jnp.int32),
+        batch_sharding(mesh))
+
+    # warmup (compile + stabilize), then drain the dispatch queue with a
+    # host readback — jax.block_until_ready is unreliable on the axon
+    # platform (returns before execution completes), so timing brackets use
+    # float() readbacks.
+    for _ in range(3):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels)
+    float(loss)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels)
+    float(loss)  # forces completion of the whole chain
+    dt = time.perf_counter() - t0
+
+    img_per_sec = B * iters / dt
+    per_chip = img_per_sec / n_chips
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(per_chip / REFERENCE_IMG_PER_SEC_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+from horovod_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    init_params,
+    param_shardings,
+    shard_params,
+    make_train_step,
+    make_grad_fn,
+    make_forward,
+    init_opt_state,
+    shard_batch,
+    data_sharding_spec,
+)

@@ -1,0 +1,439 @@
+"""Flagship model: GPT-style (optionally MoE) transformer with full 5-axis
+parallelism — dp (batch), pp (stages), ep (experts), sp (sequence/ring
+attention), tp (tensor) — written as ONE manual-SPMD program under
+``jax.shard_map`` over the canonical mesh.
+
+The reference framework scales *batch only* (SURVEY.md §2.6); its model zoo
+is "whatever TF/Torch model you wrap". This module is the TPU-native
+counterpart of that contract at modern scale: the training step compiles to
+a single XLA program whose collectives (psum over tp, ppermute rings over
+sp and pp, all_to_all over ep, psum over dp for gradients) all ride ICI.
+
+Layout conventions (local = per-device shapes):
+  tokens          [B/dp, S/sp]
+  embedding       [V/tp, M]          (vocab-sharded, tied softmax)
+  attention       heads sharded tp → q/k/v [B', S', H/tp, Dh], ring over sp
+  mlp             w1 [M, F/tp], w2 [F/tp, M], psum(tp) after w2
+  MoE             experts sharded ep; tokens dispatched via all_to_all
+  layers          stacked [pp, L/pp, ...]; GPipe schedule over pp
+Gradient sync: params are replicated over (dp, sp) → psum over those axes
+after ``jax.grad``; tp/ep/pp-sharded leaves keep local (sharded) grads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from horovod_tpu.parallel.ring_attention import ring_attention_spmd
+from horovod_tpu.parallel.moe import moe_layer_spmd, top_k_gating
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_seq: int = 2048
+    n_experts: int = 0          # 0 → dense FFN; >0 → MoE every layer
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    n_microbatches: int = 1     # pipeline microbatches (per pp>1)
+    remat: bool = True          # jax.checkpoint each block (HBM for FLOPs)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+# ---------------------------------------------------------------------------
+# Parameter init (host-side, then device_put with shardings)
+# ---------------------------------------------------------------------------
+
+def init_params(rng: np.random.RandomState, cfg: TransformerConfig,
+                n_stages: int = 1) -> Dict:
+    """Initialize parameters in the stacked-stage layout ``[pp, L/pp, ...]``."""
+    L = cfg.n_layers
+    assert L % n_stages == 0, (L, n_stages)
+    lps = L // n_stages
+    M, H, Dh, F = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+
+    def w(*shape, scale=None):
+        scale = scale if scale is not None else (1.0 / np.sqrt(shape[-2]))
+        return (rng.randn(*shape) * scale).astype(np.float32)
+
+    layer: Dict[str, np.ndarray] = {
+        "ln1": np.ones((n_stages, lps, M), np.float32),
+        "wq": w(n_stages, lps, M, H * Dh),
+        "wk": w(n_stages, lps, M, H * Dh),
+        "wv": w(n_stages, lps, M, H * Dh),
+        "wo": w(n_stages, lps, H * Dh, M),
+        "ln2": np.ones((n_stages, lps, M), np.float32),
+    }
+    if cfg.n_experts > 0:
+        layer.update({
+            "router": w(n_stages, lps, M, cfg.n_experts, scale=0.02),
+            "we1": w(n_stages, lps, cfg.n_experts, M, F),
+            "we2": w(n_stages, lps, cfg.n_experts, F, M),
+        })
+    else:
+        layer.update({
+            "w1": w(n_stages, lps, M, F),
+            "w2": w(n_stages, lps, F, M),
+        })
+    return {
+        "embed": (rng.randn(cfg.vocab_size, M) * 0.02).astype(np.float32),
+        "ln_f": np.ones((M,), np.float32),
+        "layers": layer,
+    }
+
+
+def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict:
+    """NamedSharding tree matching :func:`init_params` layout."""
+    def s(*spec):
+        return NamedSharding(mesh, P(*spec))
+    tp = "tp" if mesh.shape.get("tp", 1) > 1 else None
+    pp = "pp" if mesh.shape.get("pp", 1) > 1 else None
+    ep = "ep" if mesh.shape.get("ep", 1) > 1 else None
+    layers = {
+        "ln1": s(pp), "ln2": s(pp),
+        "wq": s(pp, None, None, tp), "wk": s(pp, None, None, tp),
+        "wv": s(pp, None, None, tp), "wo": s(pp, None, tp, None),
+    }
+    if cfg.n_experts > 0:
+        layers.update({
+            "router": s(pp),
+            "we1": s(pp, None, ep, None, tp),
+            "we2": s(pp, None, ep, tp, None),
+        })
+    else:
+        layers.update({"w1": s(pp, None, None, tp),
+                       "w2": s(pp, None, tp, None)})
+    return {"embed": s(tp), "ln_f": s(), "layers": layers}
+
+
+def shard_params(params: Dict, cfg: TransformerConfig, mesh: Mesh) -> Dict:
+    sh = param_shardings(cfg, mesh)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(jnp.asarray(x), s), params, sh)
+
+
+# ---------------------------------------------------------------------------
+# SPMD building blocks (all run inside shard_map over the full mesh)
+# ---------------------------------------------------------------------------
+
+def _axis_live(name: str) -> bool:
+    """True if ``name`` is a manual axis of size > 1 in the current context."""
+    try:
+        return lax.axis_size(name) > 1
+    except NameError:
+        return False
+
+
+def _psum_if(x, name):
+    return lax.psum(x, name) if _axis_live(name) else x
+
+
+def _rmsnorm(x, g):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6)
+            ).astype(x.dtype) * g.astype(x.dtype)
+
+
+def _rope(x, positions):
+    """Rotary embedding; x [B, S, H, D], positions [S] absolute."""
+    B, S, H, D = x.shape
+    half = D // 2
+    freqs = 1.0 / (10000 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    ang = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, half]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]
+    cos = cos[None, :, None, :].astype(x.dtype)
+    sin = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+
+
+def _embed_lookup(emb_local, tokens):
+    """Vocab-sharded embedding lookup: mask + psum over tp."""
+    Vl, M = emb_local.shape
+    if _axis_live("tp"):
+        off = lax.axis_index("tp") * Vl
+        idx = tokens - off
+        ok = (idx >= 0) & (idx < Vl)
+        x = jnp.where(ok[..., None],
+                      emb_local[jnp.clip(idx, 0, Vl - 1)], 0)
+        return lax.psum(x, "tp")
+    return emb_local[tokens]
+
+
+def _sharded_softmax_xent(logits_local, targets):
+    """Cross-entropy with vocab dim sharded over tp. logits [B, S, V/tp]."""
+    lf = logits_local.astype(jnp.float32)
+    m_loc = jnp.max(lf, axis=-1)
+    # stability shift only — stop the gradient *before* pmax (pmax has no
+    # differentiation rule, and the shift cancels in exact arithmetic)
+    m_loc = lax.stop_gradient(m_loc)
+    m = lax.pmax(m_loc, "tp") if _axis_live("tp") else m_loc
+    se = jnp.sum(jnp.exp(lf - m[..., None]), axis=-1)
+    se = _psum_if(se, "tp")
+    Vl = lf.shape[-1]
+    if _axis_live("tp"):
+        off = lax.axis_index("tp") * Vl
+        idx = targets - off
+        ok = (idx >= 0) & (idx < Vl)
+        corr = jnp.take_along_axis(
+            lf, jnp.clip(idx, 0, Vl - 1)[..., None], axis=-1)[..., 0]
+        corr = lax.psum(jnp.where(ok, corr, 0.0), "tp")
+    else:
+        corr = jnp.take_along_axis(lf, targets[..., None], axis=-1)[..., 0]
+    return jnp.log(se) + m - corr     # [B, S]
+
+
+def _attention_block(p, x, positions, cfg: TransformerConfig):
+    """x: [B', S', M] local. Heads sharded over tp; sequence over sp."""
+    B, S, M = x.shape
+    h = _rmsnorm(x, p["ln1"])
+    q = (h @ p["wq"].astype(h.dtype))
+    k = (h @ p["wk"].astype(h.dtype))
+    v = (h @ p["wv"].astype(h.dtype))
+    Hl = q.shape[-1] // cfg.head_dim
+    q = q.reshape(B, S, Hl, cfg.head_dim)
+    k = k.reshape(B, S, Hl, cfg.head_dim)
+    v = v.reshape(B, S, Hl, cfg.head_dim)
+    q, k = _rope(q, positions), _rope(k, positions)
+    if _axis_live("sp"):
+        o = ring_attention_spmd(q, k, v, "sp", causal=True)
+    else:
+        from horovod_tpu.parallel.ring_attention import _plain_attention
+        o = _plain_attention(q, k, v, causal=True)
+    o = o.reshape(B, S, Hl * cfg.head_dim) @ p["wo"].astype(x.dtype)
+    o = _psum_if(o, "tp")
+    return x + o
+
+
+def _dense_ffn(p, x):
+    h = jax.nn.gelu(x @ p["w1"].astype(x.dtype))
+    o = h @ p["w2"].astype(x.dtype)
+    return _psum_if(o, "tp")
+
+
+def _moe_ffn(p, x, cfg: TransformerConfig):
+    """x: [B', S', M] local → tokens [G, M]; experts over ep, inner mats tp."""
+    B, S, M = x.shape
+    toks = x.reshape(B * S, M)
+
+    def expert_fn(ep_params, t):
+        h = jax.nn.gelu(t @ ep_params["w1"].astype(t.dtype))
+        o = h @ ep_params["w2"].astype(t.dtype)
+        return _psum_if(o, "tp")
+
+    y, metrics = moe_layer_spmd(
+        toks, p["router"].astype(jnp.float32),
+        expert_fn, {"w1": p["we1"], "w2": p["we2"]},
+        axis_name="ep" if _axis_live("ep") else None,
+        k=cfg.moe_top_k, capacity_factor=cfg.capacity_factor)
+    return y.reshape(B, S, M), metrics
+
+
+def _block(p, x, positions, cfg: TransformerConfig):
+    x = _attention_block(p, x, positions, cfg)
+    h = _rmsnorm(x, p["ln2"])
+    if cfg.n_experts > 0:
+        o, metrics = _moe_ffn(p, h, cfg)
+        aux = metrics.aux_loss
+    else:
+        o, aux = _dense_ffn(p, h), jnp.zeros((), jnp.float32)
+    return x + o.astype(x.dtype), aux
+
+
+def _stage_fn_factory(cfg: TransformerConfig, positions):
+    """Returns stage_fn(stage_params, act) running L/pp blocks via scan.
+
+    The MoE aux loss rides as one extra feature column of the activation so
+    the pipeline carry stays a single array (pipeline_spmd requirement); it
+    accumulates across stages and is read back after the pipeline.
+    """
+    def one_block(x, lp):
+        def fn(xx):
+            return _block(lp, xx, positions, cfg)
+        if cfg.remat:
+            fn = jax.checkpoint(fn)
+        return fn(x)
+
+    def stage_fn(stage_params, act_with_aux):
+        act = act_with_aux[..., :-1]
+        aux_in = act_with_aux[..., -1:]
+        def scan_body(x, lp):
+            y, aux = one_block(x, lp)
+            return y, aux
+        y, auxs = lax.scan(scan_body, act.astype(cfg.dtype), stage_params)
+        aux_out = aux_in + jnp.sum(auxs) / max(cfg.n_layers, 1)
+        return jnp.concatenate([y.astype(jnp.float32), aux_out], axis=-1)
+
+    return stage_fn
+
+
+# ---------------------------------------------------------------------------
+# Forward + loss (SPMD body)
+# ---------------------------------------------------------------------------
+
+def forward_loss_spmd(params, tokens, targets, cfg: TransformerConfig):
+    """Local shapes: tokens/targets [B', S']. Returns (loss, aux_loss)."""
+    B, S = tokens.shape
+    sp_idx = lax.axis_index("sp") if _axis_live("sp") else 0
+    positions = sp_idx * S + jnp.arange(S)
+
+    x = _embed_lookup(params["embed"].astype(cfg.dtype), tokens)  # [B,S,M]
+
+    lp = params["layers"]
+    n_stages = lp["ln1"].shape[0]
+    aux_total = jnp.zeros((), jnp.float32)
+
+    if _axis_live("pp"):
+        from horovod_tpu.parallel.pipeline import pipeline_spmd
+        stage_fn = _stage_fn_factory(cfg, positions)
+        aux_col = jnp.zeros(x.shape[:-1] + (1,), jnp.float32)
+        xa = jnp.concatenate([x.astype(jnp.float32), aux_col], -1)
+        M = cfg.n_microbatches
+        xm = xa.reshape((M, B // M) + xa.shape[1:])
+        ym = pipeline_spmd(stage_fn, lp, xm, "pp")
+        ya = ym.reshape((B,) + ym.shape[2:])
+        x = ya[..., :-1].astype(cfg.dtype)
+        aux_total = jnp.mean(ya[..., -1])
+    else:
+        # no pipeline: scan all layers of the single stage
+        def scan_body(carry, layer_p):
+            y, aux = _block(layer_p, carry, positions, cfg)
+            return y, aux
+        flat = jax.tree_util.tree_map(
+            lambda a: a.reshape((-1,) + a.shape[2:]), lp)
+        x, auxs = lax.scan(scan_body, x, flat)
+        aux_total = jnp.sum(auxs) / max(cfg.n_layers, 1)
+
+    x = _rmsnorm(x, params["ln_f"])
+    logits_local = x @ params["embed"].astype(cfg.dtype).T    # [B,S,V/tp]
+    nll = _sharded_softmax_xent(logits_local, targets)        # [B,S]
+    loss = jnp.mean(nll)
+    # average over data-like axes so every shard reports the global loss
+    # (ep subdivides the batch — see data_sharding_spec)
+    for ax in ("dp", "ep", "sp"):
+        if _axis_live(ax):
+            loss = lax.pmean(loss, ax)
+            aux_total = lax.pmean(aux_total, ax)
+    return loss, aux_total
+
+
+# ---------------------------------------------------------------------------
+# Jitted train/eval step factories
+# ---------------------------------------------------------------------------
+
+def data_sharding_spec(mesh: Mesh) -> P:
+    """Batch dim shards over every live data-like axis (dp and — because
+    expert parallelism subdivides the data-parallel groups, DeepSpeed-MoE
+    style — ep); sequence dim over sp."""
+    batch_axes = tuple(a for a in ("dp", "ep") if mesh.shape.get(a, 1) > 1)
+    sp = "sp" if mesh.shape.get("sp", 1) > 1 else None
+    return P(batch_axes if batch_axes else None, sp)
+
+
+def _grad_sync(grads, pspec):
+    """psum each gradient over the *data* axes (dp, ep, sp) its parameter is
+    replicated over; axes present in the leaf's own sharding spec (tp/ep on
+    sharded weights, pp on stages) keep shard-local gradients — the Megatron
+    rule, and the in-graph analog of the reference's allreduce hooks
+    (``torch/optimizer.py:164-206``)."""
+    def one(g, spec):
+        used = set()
+        for part in spec:
+            if part is None:
+                continue
+            if isinstance(part, (tuple, list)):
+                used.update(part)
+            else:
+                used.add(part)
+        for ax in ("dp", "ep", "sp"):
+            if ax not in used:
+                g = _psum_if(g, ax)
+        return g
+    return jax.tree_util.tree_map(one, grads, pspec)
+
+
+def make_grad_fn(cfg: TransformerConfig, mesh: Mesh):
+    """SPMD (loss, aux, grads) function over the mesh; grads come back with
+    param shardings, ready for any optax optimizer applied under jit."""
+    data_spec = data_sharding_spec(mesh)
+    psh = param_shardings(cfg, mesh)
+    pspec = jax.tree_util.tree_map(lambda s: s.spec, psh)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(pspec, data_spec, data_spec),
+        out_specs=(P(), P(), pspec),
+        check_vma=False)
+    def grad_fn(params, tokens, targets):
+        def loss_fn(p):
+            loss, aux = forward_loss_spmd(p, tokens, targets, cfg)
+            return loss + 0.01 * aux, (loss, aux)
+        grads, (loss, aux) = jax.grad(loss_fn, has_aux=True)(params)
+        grads = _grad_sync(grads, pspec)
+        return loss, aux, grads
+
+    return grad_fn
+
+
+def make_train_step(cfg: TransformerConfig, mesh: Mesh, optimizer):
+    """Jitted full train step: manual-SPMD fwd/bwd (shard_map) + optimizer
+    update in GSPMD-auto mode (XLA keeps the elementwise update sharded as
+    the params are)."""
+    import optax
+    grad_fn = make_grad_fn(cfg, mesh)
+
+    @jax.jit
+    def step(params, opt_state, tokens, targets):
+        loss, aux, grads = grad_fn(params, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, aux
+
+    return step
+
+
+def make_forward(cfg: TransformerConfig, mesh: Mesh):
+    """Jitted forward (loss only) — used by ``__graft_entry__.entry``."""
+    data_spec = data_sharding_spec(mesh)
+    psh = param_shardings(cfg, mesh)
+    pspec = jax.tree_util.tree_map(lambda s: s.spec, psh)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(pspec, data_spec, data_spec),
+                       out_specs=P(), check_vma=False)
+    def fwd(params, tokens, targets):
+        loss, aux = forward_loss_spmd(params, tokens, targets, cfg)
+        return loss + 0.01 * aux
+
+    return jax.jit(fwd)
+
+
+def init_opt_state(optimizer, params, mesh: Mesh, cfg: TransformerConfig):
+    """Initialize optimizer state under jit so every state leaf inherits the
+    corresponding parameter's sharding (adam moments mirror params; scalars
+    replicate)."""
+    return jax.jit(optimizer.init)(params)
+
+
+def shard_batch(tokens, targets, mesh: Mesh):
+    spec = data_sharding_spec(mesh)
+    sh = NamedSharding(mesh, spec)
+    return jax.device_put(tokens, sh), jax.device_put(targets, sh)

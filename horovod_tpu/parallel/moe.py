@@ -88,7 +88,8 @@ def moe_layer_spmd(x: jax.Array, router_w: jax.Array,
     n = lax.axis_size(axis_name) if axis_name else 1
     G, M = x.shape
     E = router_w.shape[1]
-    e_local = E // max(n, 1)
+    if E % max(n, 1) != 0:
+        raise ValueError(f"n_experts ({E}) must divide the ep axis size ({n})")
     capacity = max(1, int(capacity_factor * k * G / E))
 
     logits = x @ router_w                                  # [G, E]
@@ -117,10 +118,15 @@ def moe_layer(x: jax.Array, router_w: jax.Array, expert_fn: Callable,
               ) -> Tuple[jax.Array, MoEMetrics]:
     """Array-level MoE: x ``[T, M]`` tokens sharded over ``token_axes``;
     expert_params leading dim E sharded over ``axis_name``."""
-    n = mesh.shape.get(axis_name, 1)
-    tok_ax = tuple(a for a in token_axes if mesh.shape.get(a, 1) > 1) or None
+    from horovod_tpu.parallel.mesh import mesh_axis_size
+    n = mesh_axis_size(mesh, axis_name)
+    tok_ax = tuple(a for a in token_axes if mesh_axis_size(mesh, a) > 1) \
+        or None
     tok_spec = P(tok_ax)
     ep_ax = axis_name if n > 1 else None
+    # metrics must be averaged over every axis the computation varies on —
+    # the token shards AND the ep shards — to honor the replicated out_spec
+    metric_axes = tuple(tok_ax or ()) + ((axis_name,) if n > 1 else ())
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
@@ -130,9 +136,9 @@ def moe_layer(x: jax.Array, router_w: jax.Array, expert_fn: Callable,
         y, met = moe_layer_spmd(xl, rw, expert_fn, ep_params,
                                 axis_name if n > 1 else None,
                                 k, capacity_factor)
-        if n > 1:
-            met = MoEMetrics(lax.pmean(met.aux_loss, axis_name),
-                             lax.pmean(met.fraction_dropped, axis_name))
+        if metric_axes:
+            met = MoEMetrics(lax.pmean(met.aux_loss, metric_axes),
+                             lax.pmean(met.fraction_dropped, metric_axes))
         return y, met
 
     return run(x, router_w, expert_params)

@@ -68,7 +68,15 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array, mesh: Mesh,
     stage_params: pytree with leading dim = pp size, sharded over ``pp``.
     x: ``[T, ...]`` global batch; split into ``n_microbatches``.
     """
-    S = mesh.shape.get(axis_name, 1)
+    from horovod_tpu.parallel.mesh import mesh_axis_size
+    S = mesh_axis_size(mesh, axis_name)
+    leading = {leaf.shape[0] for leaf in
+               jax.tree_util.tree_leaves(stage_params)}
+    if leading != {S}:
+        raise ValueError(
+            f"stage_params leading dims {sorted(leading)} must all equal the "
+            f"'{axis_name}' mesh axis size ({S}); restack the stages for "
+            f"this mesh (stage_stacked) instead of silently dropping some.")
     if S == 1:
         one = jax.tree_util.tree_map(lambda p: p[0], stage_params)
         return stage_fn(one, x)
@@ -77,7 +85,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array, mesh: Mesh,
         raise ValueError(f"batch {T} not divisible by microbatches "
                          f"{n_microbatches}")
     xm = x.reshape((n_microbatches, T // n_microbatches) + x.shape[1:])
-    b_ax = batch_axis if (batch_axis and mesh.shape.get(batch_axis, 1) > 1) \
+    b_ax = batch_axis if (batch_axis and mesh_axis_size(mesh, batch_axis) > 1) \
         else None
     x_spec = P(None, b_ax)
     out_spec = P(None, b_ax)

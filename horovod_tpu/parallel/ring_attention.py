@@ -79,8 +79,7 @@ def ring_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array,
 
     qf = q.astype(jnp.float32)
 
-    def body(t, carry):
-        acc, m_run, l_run, k_t, v_t = carry
+    def attend(t, acc, m_run, l_run, k_t, v_t):
         src_block = (my - t) % n                  # whose K/V we hold now
         if causal:
             # absolute positions: q row i ↔ my*Sq+i; k col j ↔ src*Sk+j
@@ -92,7 +91,11 @@ def ring_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array,
             bias = None
         m_new, l_new, pv = _block_attend(qf, k_t.astype(jnp.float32),
                                          v_t.astype(jnp.float32), bias, scale)
-        acc, m_run, l_run = _online_update(acc, m_run, l_run, m_new, l_new, pv)
+        return _online_update(acc, m_run, l_run, m_new, l_new, pv)
+
+    def body(t, carry):
+        acc, m_run, l_run, k_t, v_t = carry
+        acc, m_run, l_run = attend(t, acc, m_run, l_run, k_t, v_t)
         # rotate K/V to the next device (ring); overlapped with next block's
         # compute by XLA's async collective scheduling on TPU
         perm = [(i, (i + 1) % n) for i in range(n)]
@@ -100,8 +103,11 @@ def ring_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array,
         v_t = lax.ppermute(v_t, axis_name, perm)
         return acc, m_run, l_run, k_t, v_t
 
-    acc, m_run, l_run, _, _ = lax.fori_loop(
-        0, n, body, (acc, m_run, l_run, k, v))
+    # n-1 rotate-and-attend steps, then the final block without the wasted
+    # last rotation (its result would be discarded)
+    acc, m_run, l_run, k_t, v_t = lax.fori_loop(
+        0, n - 1, body, (acc, m_run, l_run, k, v))
+    acc, m_run, l_run = attend(n - 1, acc, m_run, l_run, k_t, v_t)
     # normalize: acc / l  (l: [B,H,Sq] → [B,Sq,H,1]); guard fully-masked rows
     l_ = jnp.transpose(l_run, (0, 2, 1))[..., None]
     out = acc / jnp.maximum(l_, 1e-30)
@@ -114,10 +120,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    batch_axis: Optional[str] = "dp") -> jax.Array:
     """Array-level ring attention: global ``[B, S, H, D]`` inputs with S
     sharded over ``axis_name`` (and optionally B over ``batch_axis``)."""
-    if mesh.shape.get(axis_name, 1) == 1:
+    from horovod_tpu.parallel.mesh import mesh_axis_size
+    if mesh_axis_size(mesh, axis_name) == 1:
         # degenerate ring: plain attention
         return _plain_attention(q, k, v, causal, scale)
-    b_ax = batch_axis if (batch_axis and mesh.shape.get(batch_axis, 1) > 1) \
+    b_ax = batch_axis if (batch_axis and mesh_axis_size(mesh, batch_axis) > 1) \
         else None
     spec = P(b_ax, axis_name)
 

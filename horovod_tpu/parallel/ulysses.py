@@ -52,9 +52,10 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                       scale: Optional[float] = None,
                       batch_axis: Optional[str] = "dp") -> jax.Array:
     """Array-level wrapper: global ``[B, S, H, D]``, S sharded on axis."""
-    if mesh.shape.get(axis_name, 1) == 1:
+    from horovod_tpu.parallel.mesh import mesh_axis_size
+    if mesh_axis_size(mesh, axis_name) == 1:
         return _plain_attention(q, k, v, causal, scale)
-    b_ax = batch_axis if (batch_axis and mesh.shape.get(batch_axis, 1) > 1) \
+    b_ax = batch_axis if (batch_axis and mesh_axis_size(mesh, batch_axis) > 1) \
         else None
     spec = P(b_ax, axis_name)
 

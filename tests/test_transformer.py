@@ -1,0 +1,111 @@
+"""Flagship transformer: forward/backward under every parallelism layout on
+the 8-device virtual mesh, checked for finiteness, cross-layout loss
+agreement, and training progress."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import optax
+
+from horovod_tpu.models import (TransformerConfig, init_params, shard_params,
+                                make_train_step, make_forward, init_opt_state,
+                                shard_batch)
+from horovod_tpu.parallel import build_mesh
+
+CFG = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=4,
+                        d_ff=64, max_seq=32, dtype=jnp.float32,
+                        n_microbatches=2, remat=False)
+MOE_CFG = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=4,
+                            d_ff=64, max_seq=32, n_experts=4,
+                            dtype=jnp.float32, n_microbatches=2, remat=False)
+
+
+def _batch(B=8, S=16, vocab=64, seed=0):
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(0, vocab, (B, S)).astype(np.int32)
+    targets = np.roll(tokens, -1, axis=1).astype(np.int32)
+    return jnp.asarray(tokens), jnp.asarray(targets)
+
+
+MESHES = {
+    "dp8": dict(dp=8),
+    "dp2_tp4": dict(dp=2, tp=4),
+    "dp2_sp2_tp2": dict(dp=2, sp=2, tp=2),
+    "dp2_pp2_tp2": dict(dp=2, pp=2, tp=2),
+    "dp2_pp2_sp2": dict(dp=2, pp=2, sp=2),
+}
+
+
+@pytest.mark.parametrize("name", list(MESHES))
+def test_forward_loss_agrees_across_layouts(name):
+    """Same params + data must give (nearly) the same loss on every layout —
+    the cross-layout analog of the reference's multi-rank numeric equality
+    tests."""
+    mesh_ref = build_mesh(dp=8)
+    fwd_ref = make_forward(CFG, mesh_ref)
+    rngp = np.random.RandomState(42)
+    params_host = init_params(rngp, CFG, n_stages=1)
+    tokens, targets = _batch()
+
+    p_ref = shard_params(params_host, CFG, mesh_ref)
+    t_ref, y_ref = shard_batch(tokens, targets, mesh_ref)
+    ref = float(fwd_ref(p_ref, t_ref, y_ref))
+
+    mesh = build_mesh(**MESHES[name])
+    n_stages = MESHES[name].get("pp", 1)
+    params_host_s = init_params(np.random.RandomState(42), CFG,
+                                n_stages=n_stages)
+    p = shard_params(params_host_s, CFG, mesh)
+    t, y = shard_batch(tokens, targets, mesh)
+    fwd = make_forward(CFG, mesh)
+    out = float(fwd(p, t, y))
+    assert np.isfinite(out)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_moe_forward_all_axes():
+    """MoE config on a mesh using dp, ep and tp simultaneously."""
+    mesh = build_mesh(dp=2, ep=2, tp=2)
+    params_host = init_params(np.random.RandomState(1), MOE_CFG, n_stages=1)
+    p = shard_params(params_host, MOE_CFG, mesh)
+    tokens, targets = _batch()
+    t, y = shard_batch(tokens, targets, mesh)
+    out = float(make_forward(MOE_CFG, mesh)(p, t, y))
+    assert np.isfinite(out)
+
+
+def test_train_step_reduces_loss():
+    mesh = build_mesh(dp=2, sp=2, tp=2)
+    params_host = init_params(np.random.RandomState(3), CFG, n_stages=1)
+    p = shard_params(params_host, CFG, mesh)
+    tokens, targets = _batch()
+    t, y = shard_batch(tokens, targets, mesh)
+    tx = optax.adam(1e-2)
+    step = make_train_step(CFG, mesh, tx)
+    opt_state = init_opt_state(tx, p, mesh, CFG)
+    losses = []
+    for i in range(10):
+        p, opt_state, loss, aux = step(p, opt_state, t, y)
+        jax.block_until_ready(loss)  # 1-core CPU: avoid rendezvous pile-up
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_train_step_pipeline_moe():
+    """The everything-at-once layout: dp, pp, and ep+tp shared... (8 devices:
+    dp2 × pp2 × ep... ) — use dp2/pp2/tp2 with MoE (ep=1 degenerates to
+    replicated experts, still exercising the MoE code path in the pipeline)."""
+    mesh = build_mesh(dp=2, pp=2, tp=2)
+    cfg = MOE_CFG
+    params_host = init_params(np.random.RandomState(4), cfg, n_stages=2)
+    p = shard_params(params_host, cfg, mesh)
+    tokens, targets = _batch()
+    t, y = shard_batch(tokens, targets, mesh)
+    tx = optax.sgd(1e-2)
+    step = make_train_step(cfg, mesh, tx)
+    opt_state = init_opt_state(tx, p, mesh, cfg)
+    p, opt_state, loss, aux = step(p, opt_state, t, y)
+    jax.block_until_ready(loss)
+    assert np.isfinite(float(loss))
+    assert np.isfinite(float(aux))
