@@ -1,0 +1,159 @@
+// extern "C" surface loaded from Python via ctypes (reference:
+// horovod/common/operations.cc:869-1260 C API + basics.py ctypes wrapper).
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "core.h"
+
+using hvd::Core;
+using hvd::CoreConfig;
+using hvd::DataType;
+using hvd::ReduceOp;
+
+namespace {
+
+thread_local std::string g_last_error;
+
+int SetError(const hvd::Status& s) {
+  g_last_error = s.reason;
+  return -1;
+}
+
+const char* EnvOr(const char* a, const char* b, const char* dflt) {
+  const char* v = getenv(a);
+  if (v && *v) return v;
+  v = getenv(b);
+  if (v && *v) return v;
+  return dflt;
+}
+
+}  // namespace
+
+extern "C" {
+
+int hvd_init() {
+  CoreConfig cfg;
+  cfg.rank = atoi(EnvOr("HVD_TPU_RANK", "HOROVOD_RANK", "0"));
+  cfg.size = atoi(EnvOr("HVD_TPU_SIZE", "HOROVOD_SIZE", "1"));
+  cfg.coord_addr = EnvOr("HVD_TPU_COORD_ADDR",
+                         "HOROVOD_GLOO_RENDEZVOUS_ADDR", "127.0.0.1");
+  cfg.coord_port = atoi(EnvOr("HVD_TPU_COORD_PORT",
+                              "HOROVOD_GLOO_RENDEZVOUS_PORT", "37592"));
+  cfg.fusion_threshold =
+      atoll(EnvOr("HVD_TPU_FUSION_THRESHOLD", "HOROVOD_FUSION_THRESHOLD",
+                  "67108864"));
+  cfg.cycle_time_ms =
+      atof(EnvOr("HVD_TPU_CYCLE_TIME", "HOROVOD_CYCLE_TIME", "1.0"));
+  cfg.cache_capacity = (size_t)atoll(
+      EnvOr("HVD_TPU_CACHE_CAPACITY", "HOROVOD_CACHE_CAPACITY", "1024"));
+  cfg.stall_warning_secs = atof(EnvOr("HVD_TPU_STALL_CHECK_TIME_SECONDS",
+                                      "HOROVOD_STALL_CHECK_TIME_SECONDS",
+                                      "60"));
+  cfg.autotune = atoi(EnvOr("HVD_TPU_AUTOTUNE", "HOROVOD_AUTOTUNE", "0"));
+  cfg.timeline_path = EnvOr("HVD_TPU_TIMELINE", "HOROVOD_TIMELINE", "");
+  auto st = Core::Get().Init(cfg);
+  if (!st.ok()) return SetError(st);
+  return 0;
+}
+
+void hvd_shutdown() { Core::Get().Shutdown(); }
+
+int hvd_initialized() { return Core::Get().initialized() ? 1 : 0; }
+int hvd_rank() { return Core::Get().rank(); }
+int hvd_size() { return Core::Get().size(); }
+
+const char* hvd_last_error() { return g_last_error.c_str(); }
+
+int hvd_enqueue_allreduce(const char* name, const void* in, void* out,
+                          int dtype, int ndim, const int64_t* shape, int op,
+                          double prescale, double postscale, int domain) {
+  std::vector<int64_t> sh(shape, shape + ndim);
+  return Core::Get().EnqueueAllreduce(domain, name, in, out,
+                                      (DataType)dtype, sh, (ReduceOp)op,
+                                      prescale, postscale);
+}
+
+int hvd_enqueue_allgather(const char* name, const void* in, int dtype,
+                          int ndim, const int64_t* shape, int domain) {
+  std::vector<int64_t> sh(shape, shape + ndim);
+  return Core::Get().EnqueueAllgather(domain, name, in, (DataType)dtype, sh);
+}
+
+int hvd_enqueue_broadcast(const char* name, const void* in, void* out,
+                          int root, int dtype, int ndim,
+                          const int64_t* shape, int domain) {
+  std::vector<int64_t> sh(shape, shape + ndim);
+  return Core::Get().EnqueueBroadcast(domain, name, in, out, root,
+                                      (DataType)dtype, sh);
+}
+
+int hvd_enqueue_alltoall(const char* name, const void* in,
+                         const int64_t* splits, int nsplits, int dtype,
+                         int ndim, const int64_t* shape, int domain) {
+  std::vector<int64_t> sp(splits, splits + nsplits);
+  std::vector<int64_t> sh(shape, shape + ndim);
+  return Core::Get().EnqueueAlltoall(domain, name, in, sp, (DataType)dtype,
+                                     sh);
+}
+
+int hvd_enqueue_join(int domain) { return Core::Get().EnqueueJoin(domain); }
+
+int hvd_barrier(int domain) {
+  auto st = Core::Get().ExecBarrier(domain);
+  if (!st.ok()) return SetError(st);
+  return 0;
+}
+
+int hvd_poll(int handle) { return Core::Get().Poll(handle) ? 1 : 0; }
+
+int hvd_wait(int handle, double timeout_s) {
+  auto st = Core::Get().WaitHandle(handle, timeout_s);
+  if (st.type == hvd::StatusType::kInProgress) {
+    g_last_error = st.reason;
+    return -2;  // timeout: handle remains valid, caller may retry
+  }
+  if (!st.ok()) return SetError(st);
+  return 0;
+}
+
+// For variable-size results: query ndim then shape, then copy.
+int hvd_result_ndim(int handle) {
+  return (int)Core::Get().ResultShape(handle).size();
+}
+
+int hvd_result_shape(int handle, int64_t* out, int max_ndim) {
+  auto s = Core::Get().ResultShape(handle);
+  int n = (int)std::min((size_t)max_ndim, s.size());
+  for (int i = 0; i < n; ++i) out[i] = s[i];
+  return n;
+}
+
+int hvd_recv_splits(int handle, int64_t* out, int max_n) {
+  auto s = Core::Get().RecvSplits(handle);
+  int n = (int)std::min((size_t)max_n, s.size());
+  for (int i = 0; i < n; ++i) out[i] = s[i];
+  return n;
+}
+
+int hvd_copy_result(int handle, void* dst, int64_t max_bytes) {
+  auto st = Core::Get().CopyResult(handle, dst, max_bytes);
+  if (!st.ok()) return SetError(st);
+  return 0;
+}
+
+void hvd_free_handle(int handle) { Core::Get().FreeHandle(handle); }
+
+int hvd_add_process_set(const int* ranks, int n) {
+  std::vector<int> r(ranks, ranks + n);
+  return Core::Get().AddProcessSet(r);
+}
+
+void hvd_remove_process_set(int id) { Core::Get().RemoveProcessSet(id); }
+
+int hvd_last_join_rank(int domain) {
+  return Core::Get().last_join_rank(domain);
+}
+
+}  // extern "C"

@@ -1,0 +1,58 @@
+// Host CPU collectives over the TCP transport — the framework's
+// "Gloo-class" reference data plane (reference: horovod/common/ops/
+// gloo_operations.cc + mpi_operations.cc). Ring allreduce (reduce-scatter +
+// allgather phases, the same algorithm NCCL/Gloo rings implement),
+// allgatherv, broadcast, alltoallv, barrier. On TPU pods the hot data plane
+// is XLA collectives; this one serves CPU testing, host-side state sync and
+// the control plane.
+#pragma once
+
+#include <cstdint>
+#include <vector>
+
+#include "transport.h"
+#include "types.h"
+
+namespace hvd {
+
+// A communicator over a subset of global ranks (reference: sub-communicator
+// per process set, horovod/common/process_set.h).
+struct Group {
+  std::vector<int> ranks;  // global ranks, sorted
+  int my_index = 0;        // position of this process in `ranks`
+
+  int size() const { return (int)ranks.size(); }
+  int global(int idx) const { return ranks[idx]; }
+};
+
+Status RingAllreduce(Transport& t, const Group& g, int32_t tag, void* data,
+                     int64_t nelem, DataType dtype, ReduceOp op,
+                     double prescale, double postscale);
+
+// Gather variable-size row blocks from every rank, concatenated in rank
+// order. send_bytes must be a multiple of row_bytes.
+Status AllgatherV(Transport& t, const Group& g, int32_t tag,
+                  const void* send, int64_t send_bytes,
+                  std::vector<int64_t>* per_rank_bytes,
+                  std::vector<uint8_t>* out);
+
+Status Broadcast(Transport& t, const Group& g, int32_t tag, void* data,
+                 int64_t nbytes, int root_index);
+
+// splits[i] = rows this rank sends to group index i. Returns received
+// buffer (rank-order concat) and recv_splits.
+Status AlltoallV(Transport& t, const Group& g, int32_t tag, const void* send,
+                 const std::vector<int64_t>& splits, int64_t row_bytes,
+                 std::vector<int64_t>* recv_splits,
+                 std::vector<uint8_t>* out);
+
+Status Barrier(Transport& t, const Group& g, int32_t tag);
+
+// Bitwise AND/OR across ranks (for the response-cache coordinator,
+// reference: response_cache.h CacheCoordinator bitvector sync).
+Status BitvectorAnd(Transport& t, const Group& g, int32_t tag,
+                    std::vector<uint8_t>* bits);
+Status BitvectorOr(Transport& t, const Group& g, int32_t tag,
+                   std::vector<uint8_t>* bits);
+
+}  // namespace hvd

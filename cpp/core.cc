@@ -1,0 +1,961 @@
+#include "core.h"
+
+#include <algorithm>
+#include <cstring>
+#include <sstream>
+
+#include "timeline.h"
+#include "wire.h"
+
+namespace hvd {
+
+namespace {
+// Tag space per coordination domain: domain*16 + channel
+constexpr int kTagNegotiate = 0;  // worker -> coordinator request lists
+constexpr int kTagResponse = 1;   // coordinator -> worker response lists
+constexpr int kTagData = 2;       // collective payload (uses +1 too)
+constexpr int kTagBarrier = 6;
+
+int32_t DomTag(int domain, int channel) { return domain * 16 + channel; }
+
+constexpr size_t kAlign = 64;  // fusion alignment (reference common.h:146)
+size_t AlignUp(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// TensorQueue (reference: tensor_queue.cc)
+// ---------------------------------------------------------------------------
+
+void TensorQueue::Push(TensorTableEntry entry, Request req) {
+  std::lock_guard<std::mutex> lk(mu_);
+  table_[entry.name] = std::move(entry);
+  requests_.push_back(std::move(req));
+}
+
+std::vector<Request> TensorQueue::PopRequests() {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::vector<Request> out(requests_.begin(), requests_.end());
+  requests_.clear();
+  return out;
+}
+
+bool TensorQueue::Take(const std::string& name, TensorTableEntry* out) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = table_.find(name);
+  if (it == table_.end()) return false;
+  *out = std::move(it->second);
+  table_.erase(it);
+  return true;
+}
+
+void TensorQueue::FinalizeAllWithError(const Status& s) {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (auto& kv : table_)
+    if (kv.second.callback) kv.second.callback(s);
+  table_.clear();
+  requests_.clear();
+}
+
+size_t TensorQueue::pending() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return table_.size();
+}
+
+// ---------------------------------------------------------------------------
+// ResponseCache (reference: response_cache.cc)
+// ---------------------------------------------------------------------------
+
+std::string ResponseCache::Key(const Request& r) {
+  std::ostringstream os;
+  os << r.name << '|' << (int)r.type << '|' << (int)r.dtype << '|'
+     << (int)r.op << '|' << r.root_rank << '|' << r.prescale << '|'
+     << r.postscale;
+  for (auto d : r.shape) os << ',' << d;
+  return os.str();
+}
+
+int ResponseCache::Lookup(const std::string& key) const {
+  auto it = index_.find(key);
+  return it == index_.end() ? -1 : it->second;
+}
+
+int ResponseCache::Insert(const std::string& key, const Response& resp) {
+  if (entries_.size() >= capacity_) return -1;  // full: stop caching
+  int bit = (int)entries_.size();
+  entries_.emplace_back(key, resp);
+  index_[key] = bit;
+  return bit;
+}
+
+const Response& ResponseCache::Get(int bit) const {
+  return entries_[bit].second;
+}
+
+// ---------------------------------------------------------------------------
+// StallInspector (reference: stall_inspector.cc)
+// ---------------------------------------------------------------------------
+
+void StallInspector::RecordPending(const std::string& name,
+                                   const std::vector<int>& ranks, int size) {
+  auto it = pending_.find(name);
+  if (it == pending_.end()) {
+    pending_[name] = {std::chrono::steady_clock::now(), ranks, false};
+  } else {
+    it->second.ready_ranks = ranks;
+  }
+}
+
+void StallInspector::RemoveReady(const std::string& name) {
+  pending_.erase(name);
+}
+
+std::string StallInspector::Check(double warn_seconds) {
+  auto now = std::chrono::steady_clock::now();
+  std::ostringstream os;
+  for (auto& kv : pending_) {
+    double waited =
+        std::chrono::duration<double>(now - kv.second.first_seen).count();
+    if (waited > warn_seconds && !kv.second.warned) {
+      kv.second.warned = true;
+      os << "tensor '" << kv.first << "' stalled " << (int)waited
+         << "s; ready ranks: ";
+      for (int r : kv.second.ready_ranks) os << r << ' ';
+      os << '\n';
+    }
+  }
+  return os.str();
+}
+
+// ---------------------------------------------------------------------------
+// ParameterManager — cyclic coordinate descent over a discrete grid
+// ---------------------------------------------------------------------------
+
+namespace {
+const int64_t kFusionGrid[] = {8 << 20, 32 << 20, 64 << 20, 128 << 20};
+const double kCycleGrid[] = {0.5, 1.0, 2.5, 5.0};
+}  // namespace
+
+void ParameterManager::Enable(int64_t init_fusion, double init_cycle) {
+  enabled_ = true;
+  best_fusion_ = init_fusion;
+  best_cycle_ = init_cycle;
+  window_start_ = std::chrono::steady_clock::now();
+}
+
+void ParameterManager::Record(int64_t bytes) { bytes_acc_ += bytes; }
+
+bool ParameterManager::Tune(int64_t* fusion_bytes, double* cycle_ms) {
+  if (!enabled_) return false;
+  auto now = std::chrono::steady_clock::now();
+  double secs = std::chrono::duration<double>(now - window_start_).count();
+  if (secs < 2.0) return false;  // sample window
+  double score = bytes_acc_ / secs;
+  if (score > best_score_) {
+    best_score_ = score;
+    best_fusion_ = *fusion_bytes;
+    best_cycle_ = *cycle_ms;
+  }
+  bytes_acc_ = 0;
+  window_start_ = now;
+  samples_++;
+  // explore next grid point on the current coordinate
+  if (phase_ == 0) {
+    fusion_idx_ = (fusion_idx_ + 1) % 4;
+    *fusion_bytes = kFusionGrid[fusion_idx_];
+    if (fusion_idx_ == 0) phase_ = 1;
+  } else {
+    cycle_idx_ = (cycle_idx_ + 1) % 4;
+    *cycle_ms = kCycleGrid[cycle_idx_];
+    if (cycle_idx_ == 0) phase_ = 0;
+  }
+  if (samples_ > 16) {  // converge to best seen
+    *fusion_bytes = best_fusion_;
+    *cycle_ms = best_cycle_;
+    enabled_ = false;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Core
+// ---------------------------------------------------------------------------
+
+Core& Core::Get() {
+  static Core core;
+  return core;
+}
+
+Core::~Core() { Shutdown(); }
+
+int Core::NewHandle(TensorTableEntry*) {
+  int h = next_handle_.fetch_add(1);
+  auto hs = std::make_shared<HandleState>();
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  handles_[h] = hs;
+  return h;
+}
+
+std::shared_ptr<Core::HandleState> Core::GetHandle(int h) {
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  auto it = handles_.find(h);
+  return it == handles_.end() ? nullptr : it->second;
+}
+
+void Core::PushToDomain(int domain, TensorTableEntry e, Request r) {
+  if (loop_done_.load()) {
+    if (e.callback)
+      e.callback(Status::Aborted("hvdcore background loop is not running"));
+    return;
+  }
+  std::lock_guard<std::mutex> lk(domains_mu_);
+  auto it = domains_.find(domain);
+  if (it == domains_.end()) {
+    if (e.callback)
+      e.callback(Status::Error("unknown process set / coordination domain"));
+    return;
+  }
+  it->second->queue.Push(std::move(e), std::move(r));
+}
+
+Status Core::Init(const CoreConfig& cfg) {
+  if (initialized_) return Status::OK();
+  cfg_ = cfg;
+  transport_.reset(
+      new Transport(cfg.rank, cfg.size, cfg.coord_addr, cfg.coord_port));
+  auto st = transport_->Init();
+  if (!st.ok()) return st;
+  timeline_.reset(new Timeline(cfg.rank, cfg.timeline_path));
+  if (cfg.autotune)
+    param_mgr_.Enable(cfg.fusion_threshold, cfg.cycle_time_ms);
+
+  auto global = std::unique_ptr<CoordDomain>(new CoordDomain());
+  global->id = 0;
+  global->group.ranks.resize(cfg.size);
+  for (int i = 0; i < cfg.size; ++i) global->group.ranks[i] = i;
+  global->group.my_index = cfg.rank;
+  global->cache.reset(new ResponseCache(cfg.cache_capacity));
+  global->joined_ranks.assign(cfg.size, false);
+  {
+    std::lock_guard<std::mutex> lk(domains_mu_);
+    domains_[0] = std::move(global);
+  }
+  shutdown_requested_ = false;
+  loop_done_ = false;
+  initialized_ = true;
+  loop_ = std::thread([this] { Loop(); });
+  return Status::OK();
+}
+
+void Core::Shutdown() {
+  if (!initialized_) return;
+  shutdown_requested_ = true;
+  // Prefer the negotiated shutdown (all ranks vote, coordinator emits a
+  // SHUTDOWN response — reference: operations.cc:994-1005); if a peer died
+  // mid-collective the loop may be blocked in Recv, so force-close the
+  // transport after a grace period to unblock it.
+  for (int i = 0; i < 100 && !loop_done_.load(); ++i)
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  if (!loop_done_.load() && transport_) transport_->Shutdown();
+  if (loop_.joinable()) loop_.join();
+  {
+    std::lock_guard<std::mutex> lk(domains_mu_);
+    for (auto& kv : domains_)
+      kv.second->queue.FinalizeAllWithError(
+          Status::Aborted("hvdcore shut down"));
+  }
+  if (timeline_) timeline_->Close();
+  if (transport_) transport_->Shutdown();
+  initialized_ = false;
+}
+
+// -- enqueue ----------------------------------------------------------------
+
+int Core::EnqueueAllreduce(int domain, const std::string& name,
+                           const void* in, void* out, DataType dt,
+                           const std::vector<int64_t>& shape, ReduceOp op,
+                           double prescale, double postscale) {
+  int h = NewHandle(nullptr);
+  auto hs = GetHandle(h);
+  TensorTableEntry e;
+  e.name = name;
+  e.type = Request::kAllreduce;
+  e.input = in;
+  e.output = out;
+  e.dtype = dt;
+  e.shape = shape;
+  e.op = op;
+  e.prescale = prescale;
+  e.postscale = postscale;
+  e.callback = [hs](const Status& s) {
+    std::lock_guard<std::mutex> lk(hs->mu);
+    hs->status = s;
+    hs->done = true;
+    hs->cv.notify_all();
+  };
+  Request r;
+  r.type = Request::kAllreduce;
+  r.rank = cfg_.rank;
+  r.name = name;
+  r.dtype = dt;
+  r.shape = shape;
+  r.op = op;
+  r.prescale = prescale;
+  r.postscale = postscale;
+  PushToDomain(domain, std::move(e), std::move(r));
+  return h;
+}
+
+int Core::EnqueueAllgather(int domain, const std::string& name,
+                           const void* in, DataType dt,
+                           const std::vector<int64_t>& shape) {
+  int h = NewHandle(nullptr);
+  auto hs = GetHandle(h);
+  TensorTableEntry e;
+  e.name = name;
+  e.type = Request::kAllgather;
+  e.input = in;
+  e.dtype = dt;
+  e.shape = shape;
+  e.result = std::make_shared<std::vector<uint8_t>>();
+  e.result_shape = std::make_shared<std::vector<int64_t>>();
+  e.callback = [hs](const Status& s) {
+    std::lock_guard<std::mutex> lk(hs->mu);
+    hs->status = s;
+    hs->done = true;
+    hs->cv.notify_all();
+  };
+  // share the result buffers with the handle so Execute's writes are
+  // visible through the handle-query API
+  hs->entry = e;
+  Request r;
+  r.type = Request::kAllgather;
+  r.rank = cfg_.rank;
+  r.name = name;
+  r.dtype = dt;
+  r.shape = shape;
+  PushToDomain(domain, std::move(e), std::move(r));
+  return h;
+}
+
+int Core::EnqueueBroadcast(int domain, const std::string& name,
+                           const void* in, void* out, int root, DataType dt,
+                           const std::vector<int64_t>& shape) {
+  int h = NewHandle(nullptr);
+  auto hs = GetHandle(h);
+  TensorTableEntry e;
+  e.name = name;
+  e.type = Request::kBroadcast;
+  e.input = in;
+  e.output = out;
+  e.root_rank = root;
+  e.dtype = dt;
+  e.shape = shape;
+  e.callback = [hs](const Status& s) {
+    std::lock_guard<std::mutex> lk(hs->mu);
+    hs->status = s;
+    hs->done = true;
+    hs->cv.notify_all();
+  };
+  Request r;
+  r.type = Request::kBroadcast;
+  r.rank = cfg_.rank;
+  r.name = name;
+  r.dtype = dt;
+  r.shape = shape;
+  r.root_rank = root;
+  PushToDomain(domain, std::move(e), std::move(r));
+  return h;
+}
+
+int Core::EnqueueAlltoall(int domain, const std::string& name,
+                          const void* in, const std::vector<int64_t>& splits,
+                          DataType dt, const std::vector<int64_t>& shape) {
+  int h = NewHandle(nullptr);
+  auto hs = GetHandle(h);
+  TensorTableEntry e;
+  e.name = name;
+  e.type = Request::kAlltoall;
+  e.input = in;
+  e.dtype = dt;
+  e.shape = shape;
+  e.splits = splits;
+  e.result = std::make_shared<std::vector<uint8_t>>();
+  e.result_shape = std::make_shared<std::vector<int64_t>>();
+  e.recv_splits = std::make_shared<std::vector<int64_t>>();
+  e.callback = [hs](const Status& s) {
+    std::lock_guard<std::mutex> lk(hs->mu);
+    hs->status = s;
+    hs->done = true;
+    hs->cv.notify_all();
+  };
+  hs->entry = e;
+  Request r;
+  r.type = Request::kAlltoall;
+  r.rank = cfg_.rank;
+  r.name = name;
+  r.dtype = dt;
+  r.shape = shape;
+  PushToDomain(domain, std::move(e), std::move(r));
+  return h;
+}
+
+int Core::EnqueueJoin(int domain) {
+  int h = NewHandle(nullptr);
+  auto hs = GetHandle(h);
+  TensorTableEntry e;
+  e.name = "__join__";
+  e.type = Request::kJoin;
+  e.callback = [hs](const Status& s) {
+    std::lock_guard<std::mutex> lk(hs->mu);
+    hs->status = s;
+    hs->done = true;
+    hs->cv.notify_all();
+  };
+  Request r;
+  r.type = Request::kJoin;
+  r.rank = cfg_.rank;
+  r.name = "__join__";
+  PushToDomain(domain, std::move(e), std::move(r));
+  return h;
+}
+
+Status Core::ExecBarrier(int domain) {
+  int h = NewHandle(nullptr);
+  auto hs = GetHandle(h);
+  TensorTableEntry e;
+  e.name = "__barrier__";
+  e.type = Request::kBarrier;
+  e.callback = [hs](const Status& s) {
+    std::lock_guard<std::mutex> lk(hs->mu);
+    hs->status = s;
+    hs->done = true;
+    hs->cv.notify_all();
+  };
+  Request r;
+  r.type = Request::kBarrier;
+  r.rank = cfg_.rank;
+  r.name = "__barrier__";
+  PushToDomain(domain, std::move(e), std::move(r));
+  auto st = WaitHandle(h, 600.0);
+  FreeHandle(h);
+  return st;
+}
+
+// -- handles ----------------------------------------------------------------
+
+bool Core::Poll(int h) {
+  auto hs = GetHandle(h);
+  if (!hs) return true;
+  std::lock_guard<std::mutex> lk(hs->mu);
+  return hs->done;
+}
+
+Status Core::WaitHandle(int h, double timeout_s) {
+  auto hs = GetHandle(h);
+  if (!hs) return Status::Error("unknown handle");
+  std::unique_lock<std::mutex> lk(hs->mu);
+  if (!hs->cv.wait_for(lk, std::chrono::duration<double>(timeout_s),
+                       [&] { return hs->done; }))
+    return Status{StatusType::kInProgress, "timeout waiting for collective"};
+  return hs->status;
+}
+
+std::vector<int64_t> Core::ResultShape(int h) {
+  auto hs = GetHandle(h);
+  if (!hs || !hs->entry.result_shape) return {};
+  std::lock_guard<std::mutex> lk(hs->mu);
+  return *hs->entry.result_shape;
+}
+
+std::vector<int64_t> Core::RecvSplits(int h) {
+  auto hs = GetHandle(h);
+  if (!hs || !hs->entry.recv_splits) return {};
+  std::lock_guard<std::mutex> lk(hs->mu);
+  return *hs->entry.recv_splits;
+}
+
+Status Core::CopyResult(int h, void* dst, int64_t max_bytes) {
+  auto hs = GetHandle(h);
+  if (!hs) return Status::Error("unknown handle");
+  std::lock_guard<std::mutex> lk(hs->mu);
+  if (!hs->entry.result) return Status::Error("handle has no result buffer");
+  int64_t n = std::min<int64_t>(max_bytes, hs->entry.result->size());
+  memcpy(dst, hs->entry.result->data(), n);
+  return Status::OK();
+}
+
+void Core::FreeHandle(int h) {
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  handles_.erase(h);
+}
+
+// -- process sets -----------------------------------------------------------
+
+int Core::AddProcessSet(const std::vector<int>& ranks) {
+  std::lock_guard<std::mutex> lk(domains_mu_);
+  int id = next_domain_++;
+  auto d = std::unique_ptr<CoordDomain>(new CoordDomain());
+  d->id = id;
+  d->group.ranks = ranks;
+  std::sort(d->group.ranks.begin(), d->group.ranks.end());
+  auto it = std::find(d->group.ranks.begin(), d->group.ranks.end(),
+                      cfg_.rank);
+  d->group.my_index = it == d->group.ranks.end()
+                          ? -1
+                          : (int)(it - d->group.ranks.begin());
+  d->cache.reset(new ResponseCache(cfg_.cache_capacity));
+  d->joined_ranks.assign(d->group.ranks.size(), false);
+  domains_[id] = std::move(d);
+  return id;
+}
+
+void Core::RemoveProcessSet(int id) {
+  std::lock_guard<std::mutex> lk(domains_mu_);
+  if (id != 0) domains_.erase(id);
+}
+
+int Core::last_join_rank(int domain) {
+  std::lock_guard<std::mutex> lk(domains_mu_);
+  auto it = domains_.find(domain);
+  return it == domains_.end() ? -1 : it->second->join_count;
+}
+
+// -- background loop (reference: BackgroundThreadLoop / RunLoopOnce) --------
+
+void Core::Loop() {
+  while (RunOnce()) {
+    std::this_thread::sleep_for(
+        std::chrono::duration<double, std::milli>(cfg_.cycle_time_ms));
+  }
+  loop_done_ = true;
+  // Abnormal exits (peer death mid-collective) leave waiters pending —
+  // finalize them with the real error instead of letting them time out
+  // (reference: operations.cc finalizes the tensor queue at shutdown).
+  std::lock_guard<std::mutex> lk(domains_mu_);
+  for (auto& kv : domains_)
+    kv.second->queue.FinalizeAllWithError(
+        Status::Aborted("hvdcore background loop terminated "
+                        "(peer failure or shutdown)"));
+}
+
+void Core::HandleRequests(CoordDomain& d, int from_rank,
+                          std::vector<Request>& reqs) {
+  int gsize = d.group.size();
+  for (auto& r : reqs) {
+    if (r.type == Request::kJoin) {
+      int idx = (int)(std::find(d.group.ranks.begin(), d.group.ranks.end(),
+                                from_rank) -
+                      d.group.ranks.begin());
+      if (!d.joined_ranks[idx]) {
+        d.joined_ranks[idx] = true;
+        d.join_count = from_rank;  // last joiner (reference: join returns it)
+      }
+      continue;
+    }
+    // Keyed by NAME (reference: controller.cc IncrementTensorCount) —
+    // allgather ranks legitimately differ in dim 0. Mismatched dtypes or
+    // non-first dims become an error response at fuse time.
+    auto& slot = d.ready_table_[r.name];
+    if (slot.second.empty()) slot.first = r;
+    slot.second.push_back(from_rank);
+  }
+  (void)gsize;
+}
+
+void Core::HandleCacheBits(CoordDomain& d, int from_rank,
+                           const std::vector<int32_t>& bits) {
+  for (auto b : bits) d.bit_ready_[b].push_back(from_rank);
+}
+
+std::vector<Response> Core::CollectReady(CoordDomain& d) {
+  // A tensor/bit is ready when every non-joined rank announced it
+  // (reference: controller.cc IncrementTensorCount).
+  int needed = 0;
+  for (size_t i = 0; i < d.joined_ranks.size(); ++i)
+    if (!d.joined_ranks[i]) needed++;
+
+  std::vector<Response> out;
+  // 1) steady-state fast path: common cache bits, ascending (identical
+  //    caches on every rank → identical responses)
+  std::vector<int> ready_bits;
+  for (auto it = d.bit_ready_.begin(); it != d.bit_ready_.end();) {
+    if ((int)it->second.size() >= needed && needed > 0) {
+      ready_bits.push_back(it->first);
+      it = d.bit_ready_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  std::sort(ready_bits.begin(), ready_bits.end());
+  for (int b : ready_bits) {
+    Response resp = d.cache->Get(b);
+    resp.from_cache = true;
+    out.push_back(std::move(resp));
+  }
+
+  // 2) negotiated tensors
+  std::vector<std::pair<std::string, Request>> ready;
+  for (auto it = d.ready_table_.begin(); it != d.ready_table_.end();) {
+    if ((int)it->second.second.size() >= needed && needed > 0) {
+      ready.emplace_back(it->first, it->second.first);
+      d.stall.RemoveReady(it->second.first.name);
+      it = d.ready_table_.erase(it);
+    } else {
+      d.stall.RecordPending(it->second.first.name, it->second.second,
+                            d.group.size());
+      ++it;
+    }
+  }
+  std::sort(ready.begin(), ready.end(),
+            [](auto& a, auto& b) { return a.first < b.first; });
+  for (auto& kv : ready) {
+    auto& r = kv.second;
+    Response resp;
+    resp.type = (Response::Type)r.type;
+    resp.names = {r.name};
+    resp.dtypes = {r.dtype};
+    resp.shapes = {r.shape};
+    resp.root_rank = r.root_rank;
+    resp.op = r.op;
+    resp.prescale = r.prescale;
+    resp.postscale = r.postscale;
+    out.push_back(std::move(resp));
+  }
+
+  // all ranks joined → emit Join response and reset
+  bool all_joined =
+      !d.joined_ranks.empty() &&
+      std::all_of(d.joined_ranks.begin(), d.joined_ranks.end(),
+                  [](bool b) { return b; });
+  if (all_joined) {
+    Response resp;
+    resp.type = Response::kJoin;
+    resp.last_joined_rank = d.join_count;
+    out.push_back(resp);
+    std::fill(d.joined_ranks.begin(), d.joined_ranks.end(), false);
+  }
+  return out;
+}
+
+std::vector<Response> Core::FuseResponses(
+    const std::vector<Response>& singles) {
+  std::vector<Response> out;
+  std::map<std::string, Response> open;  // fuse-group key -> accumulating
+  std::map<std::string, int64_t> open_bytes;
+  for (auto& s : singles) {
+    if (s.type != Response::kAllreduce) {
+      out.push_back(s);
+      continue;
+    }
+    std::ostringstream gk;
+    gk << (int)s.dtypes[0] << '|' << (int)s.op << '|' << s.prescale << '|'
+       << s.postscale;
+    std::string key = gk.str();
+    int64_t sz = DataTypeSize(s.dtypes[0]);
+    for (auto dim : s.shapes[0]) sz *= dim;
+    auto it = open.find(key);
+    if (it != open.end() &&
+        open_bytes[key] + sz > cfg_.fusion_threshold) {
+      out.push_back(std::move(it->second));
+      open.erase(it);
+      open_bytes.erase(key);
+      it = open.end();
+    }
+    if (it == open.end()) {
+      open[key] = s;
+      open_bytes[key] = sz;
+    } else {
+      it->second.names.push_back(s.names[0]);
+      it->second.dtypes.push_back(s.dtypes[0]);
+      it->second.shapes.push_back(s.shapes[0]);
+      open_bytes[key] += sz;
+    }
+  }
+  for (auto& kv : open) out.push_back(std::move(kv.second));
+  return out;
+}
+
+namespace {
+std::string KeyFromSingleResponse(const hvd::Response& r) {
+  // must match ResponseCache::Key(Request) for an allreduce request
+  hvd::Request q;
+  q.type = hvd::Request::kAllreduce;
+  q.name = r.names[0];
+  q.dtype = r.dtypes[0];
+  q.shape = r.shapes[0];
+  q.op = r.op;
+  q.prescale = r.prescale;
+  q.postscale = r.postscale;
+  q.root_rank = 0;
+  return hvd::ResponseCache::Key(q);
+}
+}  // namespace
+
+bool Core::RunOnce() {
+  bool want_shutdown = shutdown_requested_.load();
+
+  std::vector<int> domain_ids;
+  {
+    std::lock_guard<std::mutex> lk(domains_mu_);
+    for (auto& kv : domains_) domain_ids.push_back(kv.first);
+  }
+
+  bool got_shutdown_response = false;
+  for (int id : domain_ids) {
+    CoordDomain* d;
+    {
+      std::lock_guard<std::mutex> lk(domains_mu_);
+      auto it = domains_.find(id);
+      if (it == domains_.end()) continue;
+      d = it->second.get();
+    }
+    if (d->group.my_index < 0) continue;  // not a member
+
+    // partition my requests: allreduce cache hits travel as bits (the
+    // steady-state fast path, reference: response_cache.h CacheCoordinator);
+    // everything else as full requests
+    auto popped = d->queue.PopRequests();
+    std::vector<Request> misses;
+    std::vector<int32_t> my_bits;
+    for (auto& r : popped) {
+      if (r.type == Request::kAllreduce && cfg_.cache_enabled) {
+        int bit = d->cache->Lookup(ResponseCache::Key(r));
+        if (bit >= 0) {
+          my_bits.push_back(bit);
+          continue;
+        }
+      }
+      misses.push_back(r);
+    }
+
+    int coord = d->group.global(0);
+    bool is_coord = d->group.my_index == 0;
+
+    std::vector<Response> singles;
+    if (d->group.size() == 1) {
+      HandleRequests(*d, cfg_.rank, misses);
+      HandleCacheBits(*d, cfg_.rank, my_bits);
+      singles = CollectReady(*d);
+      if (want_shutdown && id == 0) got_shutdown_response = true;
+    } else if (is_coord) {
+      // gather (lockstep cycle; reference: MPIController::RecvReadyTensors)
+      HandleRequests(*d, cfg_.rank, misses);
+      HandleCacheBits(*d, cfg_.rank, my_bits);
+      int shutdown_votes = want_shutdown ? 1 : 0;
+      for (int i = 1; i < d->group.size(); ++i) {
+        std::vector<uint8_t> buf;
+        auto st = transport_->Recv(d->group.global(i),
+                                   DomTag(id, kTagNegotiate), &buf);
+        if (!st.ok()) return false;
+        bool sd;
+        std::vector<int32_t> bits;
+        auto rl = wire::DecodeRequestList(buf.data(), buf.size(), &sd, &bits);
+        if (sd) shutdown_votes++;
+        HandleRequests(*d, d->group.global(i), rl);
+        HandleCacheBits(*d, d->group.global(i), bits);
+      }
+      singles = CollectReady(*d);
+      if (id == 0 && shutdown_votes == d->group.size()) {
+        Response sd;
+        sd.type = Response::kShutdown;
+        singles.push_back(sd);
+      }
+      auto payload = wire::EncodeResponseList(singles);
+      for (int i = 1; i < d->group.size(); ++i) {
+        auto st = transport_->Send(d->group.global(i),
+                                   DomTag(id, kTagResponse), payload.data(),
+                                   payload.size());
+        if (!st.ok()) return false;
+      }
+      // stall check (reference: controller.cc:132-143)
+      auto warn = d->stall.Check(cfg_.stall_warning_secs);
+      if (!warn.empty()) fprintf(stderr, "[hvdcore] STALL WARNING:\n%s",
+                                 warn.c_str());
+    } else {
+      auto payload = wire::EncodeRequestList(misses, want_shutdown, my_bits);
+      auto st = transport_->Send(coord, DomTag(id, kTagNegotiate),
+                                 payload.data(), payload.size());
+      if (!st.ok()) return false;
+      std::vector<uint8_t> buf;
+      st = transport_->Recv(coord, DomTag(id, kTagResponse), &buf);
+      if (!st.ok()) return false;
+      singles = wire::DecodeResponseList(buf.data(), buf.size());
+    }
+
+    // every rank inserts newly negotiated allreduce responses in identical
+    // (broadcast) order, keeping cache bit spaces aligned across ranks
+    if (cfg_.cache_enabled) {
+      for (auto& s : singles) {
+        if (s.type == Response::kAllreduce && !s.from_cache)
+          d->cache->Insert(KeyFromSingleResponse(s), s);
+      }
+    }
+
+    auto units = FuseResponses(singles);
+    for (auto& resp : units) {
+      if (resp.type == Response::kShutdown) {
+        got_shutdown_response = true;
+        continue;
+      }
+      Execute(*d, resp);
+    }
+  }
+
+  if (got_shutdown_response) return false;
+
+  // autotune (reference: RunLoopOnce -> ParameterManager)
+  int64_t fusion = cfg_.fusion_threshold;
+  double cycle = cfg_.cycle_time_ms;
+  if (param_mgr_.Tune(&fusion, &cycle)) {
+    cfg_.fusion_threshold = fusion;
+    cfg_.cycle_time_ms = cycle;
+  }
+  return true;
+}
+
+// -- execution (reference: PerformOperation, operations.cc:257-306) ---------
+
+void Core::Execute(CoordDomain& d, const Response& r) {
+  int id = d.id;
+  int32_t dtag = DomTag(id, kTagData);
+  if (timeline_ && timeline_->enabled() && !r.names.empty())
+    timeline_->Begin(r.names[0], "EXECUTE");
+
+  switch (r.type) {
+    case Response::kAllreduce: {
+      // gather entries; joined ranks contribute zeros
+      struct Slot {
+        TensorTableEntry e;
+        bool have;
+        size_t off;
+        int64_t bytes;
+      };
+      std::vector<Slot> slots(r.names.size());
+      size_t total = 0;
+      for (size_t i = 0; i < r.names.size(); ++i) {
+        slots[i].have = d.queue.Take(r.names[i], &slots[i].e);
+        int64_t n = DataTypeSize(r.dtypes[i]);
+        for (auto dim : r.shapes[i]) n *= dim;
+        slots[i].bytes = n;
+        slots[i].off = total;
+        total += AlignUp(n);
+      }
+      std::vector<uint8_t> fusion(total, 0);
+      for (auto& s : slots)
+        if (s.have)
+          memcpy(fusion.data() + s.off, s.e.input, s.bytes);
+      int64_t nelem = 0;
+      // element count: all same dtype; compute from bytes
+      size_t esz = DataTypeSize(r.dtypes[0]);
+      nelem = total / esz;
+      auto st = RingAllreduce(*transport_, d.group, dtag, fusion.data(),
+                              nelem, r.dtypes[0], r.op, r.prescale,
+                              r.postscale);
+      param_mgr_.Record(total);
+      for (auto& s : slots) {
+        if (!s.have) continue;
+        if (st.ok() && s.e.output)
+          memcpy(s.e.output, fusion.data() + s.off, s.bytes);
+        if (s.e.callback) s.e.callback(st);
+      }
+      break;
+    }
+    case Response::kAllgather: {
+      TensorTableEntry e;
+      bool have = d.queue.Take(r.names[0], &e);
+      int64_t row_bytes = DataTypeSize(r.dtypes[0]);
+      auto shape = r.shapes[0];
+      for (size_t i = 1; i < shape.size(); ++i) row_bytes *= shape[i];
+      int64_t send_bytes = have ? (int64_t)e.ByteSize() : 0;
+      std::vector<int64_t> sizes;
+      std::vector<uint8_t> out;
+      static const uint8_t kEmpty = 0;
+      auto st = AllgatherV(*transport_, d.group, dtag,
+                           have && e.input ? e.input : &kEmpty, send_bytes,
+                           &sizes, &out);
+      if (have) {
+        if (st.ok()) {
+          *e.result = std::move(out);
+          int64_t rows = (int64_t)e.result->size() /
+                         std::max<int64_t>(row_bytes, 1);
+          *e.result_shape = shape;
+          if (!e.result_shape->empty()) (*e.result_shape)[0] = rows;
+        }
+        if (e.callback) e.callback(st);
+      }
+      break;
+    }
+    case Response::kBroadcast: {
+      TensorTableEntry e;
+      bool have = d.queue.Take(r.names[0], &e);
+      int64_t nbytes = DataTypeSize(r.dtypes[0]);
+      for (auto dim : r.shapes[0]) nbytes *= dim;
+      std::vector<uint8_t> scratch;
+      void* buf;
+      if (have) {
+        if (d.group.global(d.group.my_index) == r.root_rank)
+          memcpy(e.output, e.input, nbytes);
+        buf = e.output;
+      } else {
+        scratch.resize(nbytes);
+        buf = scratch.data();
+      }
+      int root_index =
+          (int)(std::find(d.group.ranks.begin(), d.group.ranks.end(),
+                          r.root_rank) -
+                d.group.ranks.begin());
+      auto st = Broadcast(*transport_, d.group, dtag, buf, nbytes,
+                          root_index);
+      if (have && e.callback) e.callback(st);
+      break;
+    }
+    case Response::kAlltoall: {
+      TensorTableEntry e;
+      bool have = d.queue.Take(r.names[0], &e);
+      int64_t row_bytes = DataTypeSize(r.dtypes[0]);
+      auto shape = r.shapes[0];
+      for (size_t i = 1; i < shape.size(); ++i) row_bytes *= shape[i];
+      std::vector<int64_t> splits =
+          have ? e.splits : std::vector<int64_t>(d.group.size(), 0);
+      std::vector<int64_t> recv_splits;
+      std::vector<uint8_t> out;
+      static const uint8_t kEmpty2 = 0;
+      auto st = AlltoallV(*transport_, d.group, dtag,
+                          have && e.input ? e.input : &kEmpty2, splits,
+                          row_bytes, &recv_splits, &out);
+      if (have) {
+        if (st.ok()) {
+          *e.result = std::move(out);
+          *e.recv_splits = recv_splits;
+          int64_t rows = 0;
+          for (auto s : recv_splits) rows += s;
+          *e.result_shape = shape;
+          if (!e.result_shape->empty()) (*e.result_shape)[0] = rows;
+        }
+        if (e.callback) e.callback(st);
+      }
+      break;
+    }
+    case Response::kBarrier: {
+      TensorTableEntry e;
+      bool have = d.queue.Take(r.names[0], &e);
+      auto st = Barrier(*transport_, d.group, DomTag(id, kTagBarrier));
+      if (have && e.callback) e.callback(st);
+      break;
+    }
+    case Response::kJoin: {
+      TensorTableEntry e;
+      bool have = d.queue.Take("__join__", &e);
+      d.joined = false;
+      d.join_count = r.last_joined_rank;
+      if (have && e.callback) e.callback(Status::OK());
+      break;
+    }
+    default:
+      break;
+  }
+  if (timeline_ && timeline_->enabled() && !r.names.empty())
+    timeline_->End(r.names[0]);
+}
+
+}  // namespace hvd

@@ -1,0 +1,229 @@
+// The hvdcore engine: background coordination thread, tensor queue,
+// coordinator-worker negotiation, response cache, fusion, stall inspection,
+// autotuning, timeline.
+//
+// TPU-native re-design of the reference core (horovod/common/operations.cc
+// BackgroundThreadLoop/RunLoopOnce, controller.cc ComputeResponseList,
+// tensor_queue.h, response_cache.h, fusion_buffer_manager.h,
+// stall_inspector.h, parameter_manager.h). The data plane here is host TCP
+// (cpp/collectives.h); on TPU pods the per-chip data plane stays in XLA and
+// this engine provides ordering/negotiation for eager multi-process ops.
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <deque>
+#include <list>
+#include <map>
+#include <memory>
+#include <thread>
+#include <unordered_map>
+
+#include "collectives.h"
+#include "transport.h"
+#include "types.h"
+
+namespace hvd {
+
+// Thread-safe queue of pending submissions (reference:
+// horovod/common/tensor_queue.h:28-64).
+class TensorQueue {
+ public:
+  void Push(TensorTableEntry entry, Request req);
+  // Pop all pending requests this cycle.
+  std::vector<Request> PopRequests();
+  bool Take(const std::string& name, TensorTableEntry* out);
+  void FinalizeAllWithError(const Status& s);
+  size_t pending() const;
+
+ private:
+  mutable std::mutex mu_;
+  std::deque<Request> requests_;
+  std::unordered_map<std::string, TensorTableEntry> table_;
+};
+
+// LRU cache of Responses keyed by request signature (reference:
+// horovod/common/response_cache.h:45-102). A hit means every rank already
+// agreed on this exact op before — skip negotiation, just bitvector-AND
+// the hit sets each cycle.
+class ResponseCache {
+ public:
+  explicit ResponseCache(size_t capacity) : capacity_(capacity) {}
+  static std::string Key(const Request& r);
+  // returns bit position, or -1 if not cached
+  int Lookup(const std::string& key) const;
+  int Insert(const std::string& key, const Response& resp);
+  const Response& Get(int bit) const;
+  size_t size() const { return entries_.size(); }
+
+ private:
+  size_t capacity_;
+  std::vector<std::pair<std::string, Response>> entries_;  // bit -> entry
+  std::unordered_map<std::string, int> index_;
+};
+
+// Stall detection (reference: horovod/common/stall_inspector.h:30-99).
+class StallInspector {
+ public:
+  void RecordPending(const std::string& name, const std::vector<int>& ranks,
+                     int size);
+  void RemoveReady(const std::string& name);
+  // returns warning string if stalled tensors exist past the threshold
+  std::string Check(double warn_seconds);
+
+ private:
+  struct Info {
+    std::chrono::steady_clock::time_point first_seen;
+    std::vector<int> ready_ranks;
+    bool warned = false;
+  };
+  std::map<std::string, Info> pending_;
+};
+
+// Online autotune of cycle time & fusion threshold (reference:
+// horovod/common/parameter_manager.h — Bayesian opt; here a simple
+// cyclic coordinate search over a discrete grid, scored by bytes/sec).
+class ParameterManager {
+ public:
+  void Enable(int64_t init_fusion, double init_cycle);
+  bool enabled() const { return enabled_; }
+  void Record(int64_t bytes);
+  // maybe update params; returns true if changed
+  bool Tune(int64_t* fusion_bytes, double* cycle_ms);
+
+ private:
+  bool enabled_ = false;
+  int64_t bytes_acc_ = 0;
+  std::chrono::steady_clock::time_point window_start_;
+  int samples_ = 0;
+  double best_score_ = 0;
+  int64_t best_fusion_ = 0;
+  double best_cycle_ = 0;
+  int fusion_idx_ = 0, cycle_idx_ = 0, phase_ = 0;
+};
+
+struct CoreConfig {
+  int rank = 0;
+  int size = 1;
+  std::string coord_addr = "127.0.0.1";
+  int coord_port = 37592;
+  int64_t fusion_threshold = 64 * 1024 * 1024;
+  double cycle_time_ms = 1.0;
+  size_t cache_capacity = 1024;
+  bool cache_enabled = true;
+  double stall_warning_secs = 60.0;
+  bool autotune = false;
+  std::string timeline_path;
+};
+
+class Timeline;
+
+// One coordination domain (global or a process set); owns queue + group
+// (reference: horovod/common/process_set.h:26-81).
+struct CoordDomain {
+  int id = 0;
+  Group group;
+  TensorQueue queue;
+  std::unique_ptr<ResponseCache> cache;
+  StallInspector stall;
+  bool joined = false;             // this rank has submitted Join
+  int join_count = 0;              // coordinator: ranks joined (cumulative)
+  std::vector<bool> joined_ranks;
+  // coordinator negotiation state: name -> set of ready ranks
+  std::unordered_map<std::string, std::pair<Request, std::vector<int>>>
+      ready_table_;
+  // coordinator: cache-bit -> ranks that hit it this steady-state round
+  std::unordered_map<int, std::vector<int>> bit_ready_;
+};
+
+class Core {
+ public:
+  static Core& Get();
+
+  ~Core();
+  Status Init(const CoreConfig& cfg);
+  void Shutdown();
+  bool initialized() const { return initialized_; }
+
+  int rank() const { return cfg_.rank; }
+  int size() const { return cfg_.size; }
+
+  // async enqueue; handle is resolved when the op completes
+  int EnqueueAllreduce(int domain, const std::string& name, const void* in,
+                       void* out, DataType dt,
+                       const std::vector<int64_t>& shape, ReduceOp op,
+                       double prescale, double postscale);
+  int EnqueueAllgather(int domain, const std::string& name, const void* in,
+                       DataType dt, const std::vector<int64_t>& shape);
+  int EnqueueBroadcast(int domain, const std::string& name, const void* in,
+                       void* out, int root, DataType dt,
+                       const std::vector<int64_t>& shape);
+  int EnqueueAlltoall(int domain, const std::string& name, const void* in,
+                      const std::vector<int64_t>& splits, DataType dt,
+                      const std::vector<int64_t>& shape);
+  int EnqueueJoin(int domain);
+  Status ExecBarrier(int domain);
+
+  // handle API (reference: horovod/torch/handle_manager.h)
+  bool Poll(int handle);
+  Status WaitHandle(int handle, double timeout_s);
+  // variable-size results
+  std::vector<int64_t> ResultShape(int handle);
+  std::vector<int64_t> RecvSplits(int handle);
+  Status CopyResult(int handle, void* dst, int64_t max_bytes);
+  void FreeHandle(int handle);
+
+  int AddProcessSet(const std::vector<int>& ranks);
+  void RemoveProcessSet(int id);
+  int last_join_rank(int domain);
+
+  Transport* transport() { return transport_.get(); }
+
+ private:
+  Core() = default;
+  void Loop();
+  bool RunOnce();
+  // coordinator: integrate rank's requests into ready table, return
+  // responses that became ready
+  void HandleRequests(CoordDomain& d, int from_rank,
+                      std::vector<Request>& reqs);
+  void HandleCacheBits(CoordDomain& d, int from_rank,
+                       const std::vector<int32_t>& bits);
+  // coordinator: ready cached bits + negotiated tensors → SINGLE-tensor
+  // responses in deterministic order
+  std::vector<Response> CollectReady(CoordDomain& d);
+  // merge compatible allreduce singles into fused units (reference:
+  // controller.cc:793 FuseResponses); identical input → identical output on
+  // every rank
+  std::vector<Response> FuseResponses(const std::vector<Response>& singles);
+  void Execute(CoordDomain& d, const Response& r);
+
+  CoreConfig cfg_;
+  std::atomic<bool> initialized_{false};
+  std::atomic<bool> shutdown_requested_{false};
+  std::atomic<bool> loop_done_{false};
+  std::unique_ptr<Transport> transport_;
+  std::thread loop_;
+  std::unique_ptr<Timeline> timeline_;
+  ParameterManager param_mgr_;
+
+  std::mutex domains_mu_;
+  std::map<int, std::unique_ptr<CoordDomain>> domains_;
+  int next_domain_ = 1;
+
+  struct HandleState {
+    std::mutex mu;
+    std::condition_variable cv;
+    bool done = false;
+    Status status;
+    TensorTableEntry entry;  // holds results for var-size ops
+  };
+  std::mutex handles_mu_;
+  std::unordered_map<int, std::shared_ptr<HandleState>> handles_;
+  std::atomic<int> next_handle_{1};
+  int NewHandle(TensorTableEntry* entry_out_binding);
+  std::shared_ptr<HandleState> GetHandle(int h);
+  void PushToDomain(int domain, TensorTableEntry e, Request r);
+};
+
+}  // namespace hvd

@@ -1,0 +1,73 @@
+#include "timeline.h"
+
+namespace hvd {
+
+Timeline::Timeline(int rank, const std::string& path) : rank_(rank) {
+  t0_ = std::chrono::steady_clock::now();
+  if (path.empty() || rank != 0) return;  // coordinator-only file
+  file_ = fopen(path.c_str(), "w");
+  if (!file_) return;
+  fputs("[\n", file_);
+  writer_ = std::thread([this] { WriterLoop(); });
+}
+
+Timeline::~Timeline() { Close(); }
+
+double Timeline::Now() {
+  return std::chrono::duration<double, std::micro>(
+             std::chrono::steady_clock::now() - t0_)
+      .count();
+}
+
+void Timeline::Begin(const std::string& tid, const std::string& name) {
+  if (!file_) return;
+  std::lock_guard<std::mutex> lk(mu_);
+  q_.push({'B', tid, name, Now()});
+  cv_.notify_one();
+}
+
+void Timeline::End(const std::string& tid) {
+  if (!file_) return;
+  std::lock_guard<std::mutex> lk(mu_);
+  q_.push({'E', tid, "", Now()});
+  cv_.notify_one();
+}
+
+void Timeline::Instant(const std::string& name) {
+  if (!file_) return;
+  std::lock_guard<std::mutex> lk(mu_);
+  q_.push({'i', "marker", name, Now()});
+  cv_.notify_one();
+}
+
+void Timeline::WriterLoop() {
+  for (;;) {
+    Event ev;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_.wait(lk, [&] { return !q_.empty() || closing_; });
+      if (q_.empty()) return;
+      ev = q_.front();
+      q_.pop();
+    }
+    fprintf(file_,
+            "{\"ph\":\"%c\",\"name\":\"%s\",\"pid\":%d,\"tid\":\"%s\","
+            "\"ts\":%.3f},\n",
+            ev.ph, ev.name.c_str(), rank_, ev.tid.c_str(), ev.ts_us);
+  }
+}
+
+void Timeline::Close() {
+  if (!file_) return;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    closing_ = true;
+    cv_.notify_all();
+  }
+  if (writer_.joinable()) writer_.join();
+  fputs("{}]\n", file_);
+  fclose(file_);
+  file_ = nullptr;
+}
+
+}  // namespace hvd

@@ -1,0 +1,44 @@
+// Chrome-tracing timeline for the native core (reference:
+// horovod/common/timeline.{h,cc} — writer thread + activity events;
+// coordinator-only file, operations.cc:459-475).
+#pragma once
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+
+namespace hvd {
+
+class Timeline {
+ public:
+  Timeline(int rank, const std::string& path);
+  ~Timeline();
+  bool enabled() const { return file_ != nullptr; }
+  void Begin(const std::string& tid, const std::string& name);
+  void End(const std::string& tid);
+  void Instant(const std::string& name);
+  void Close();
+
+ private:
+  struct Event {
+    char ph;
+    std::string tid, name;
+    double ts_us;
+  };
+  void WriterLoop();
+  double Now();
+  int rank_;
+  FILE* file_ = nullptr;
+  std::chrono::steady_clock::time_point t0_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::queue<Event> q_;
+  bool closing_ = false;
+  std::thread writer_;
+};
+
+}  // namespace hvd

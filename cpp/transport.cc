@@ -1,0 +1,291 @@
+#include "transport.h"
+#include "wire.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+
+namespace hvd {
+
+namespace {
+
+Status WriteAll(int fd, const void* data, size_t len) {
+  const uint8_t* p = (const uint8_t*)data;
+  while (len > 0) {
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR)) continue;
+      return Status::Error("socket send failed: " +
+                           std::string(strerror(errno)));
+    }
+    p += n;
+    len -= n;
+  }
+  return Status::OK();
+}
+
+Status ReadAll(int fd, void* data, size_t len) {
+  uint8_t* p = (uint8_t*)data;
+  while (len > 0) {
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return Status::Error("socket recv failed/closed");
+    }
+    p += n;
+    len -= n;
+  }
+  return Status::OK();
+}
+
+int MakeListenSocket(int port, int* actual_port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(port);
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  if (listen(fd, 128) != 0) {
+    close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (sockaddr*)&addr, &alen);
+  if (actual_port) *actual_port = ntohs(addr.sin_port);
+  return fd;
+}
+
+void SetNoDelay(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+}  // namespace
+
+Transport::Transport(int rank, int size, const std::string& coord_addr,
+                     int coord_port)
+    : rank_(rank), size_(size), coord_addr_(coord_addr),
+      coord_port_(coord_port) {
+  peer_fds_.assign(size, -1);
+  inbox_.resize(size);
+  for (int i = 0; i < size; ++i)
+    send_mu_.emplace_back(new std::mutex());
+}
+
+Transport::~Transport() { Shutdown(); }
+
+Status Transport::ConnectTo(const std::string& host, int port, int* fd_out) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return Status::Error("socket() failed");
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+    return Status::Error("bad address: " + host);
+  // retry loop: peers may not be listening yet
+  for (int attempt = 0; attempt < 600; ++attempt) {
+    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0) {
+      SetNoDelay(fd);
+      *fd_out = fd;
+      return Status::OK();
+    }
+    close(fd);
+    usleep(100 * 1000);
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  }
+  return Status::Error("could not connect to " + host + ":" +
+                       std::to_string(port));
+}
+
+Status Transport::Init() {
+  if (size_ == 1) return Status::OK();
+  // Every rank opens its own listen socket on an ephemeral port.
+  int my_port = 0;
+  listen_fd_ = MakeListenSocket(rank_ == 0 ? coord_port_ : 0, &my_port);
+  if (listen_fd_ < 0) return Status::Error("listen socket failed");
+
+  // Rendezvous: rank 0 accepts size-1 registrations (rank, port), replies
+  // with the full table; like the reference's KV-store rendezvous
+  // (gloo_context.cc:67-94) with rank 0 as the store.
+  std::vector<std::string> hosts(size_);
+  std::vector<int> ports(size_, 0);
+  hosts[0] = coord_addr_;
+  ports[0] = my_port;
+
+  if (rank_ == 0) {
+    std::vector<int> reg_fds(size_, -1);
+    for (int i = 1; i < size_; ++i) {
+      sockaddr_in peer{};
+      socklen_t plen = sizeof(peer);
+      int fd = accept(listen_fd_, (sockaddr*)&peer, &plen);
+      if (fd < 0) return Status::Error("accept failed in rendezvous");
+      SetNoDelay(fd);
+      int32_t hdr[2];
+      auto st = ReadAll(fd, hdr, sizeof(hdr));
+      if (!st.ok()) return st;
+      int r = hdr[0];
+      char ip[64];
+      inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
+      hosts[r] = ip;
+      ports[r] = hdr[1];
+      reg_fds[r] = fd;
+    }
+    // broadcast table
+    wire::Writer w;
+    for (int i = 0; i < size_; ++i) {
+      w.str(hosts[i]);
+      w.i32(ports[i]);
+    }
+    for (int i = 1; i < size_; ++i) {
+      int32_t len = (int32_t)w.buf.size();
+      auto st = WriteAll(reg_fds[i], &len, 4);
+      if (st.ok()) st = WriteAll(reg_fds[i], w.buf.data(), w.buf.size());
+      if (!st.ok()) return st;
+      close(reg_fds[i]);
+    }
+  } else {
+    int fd;
+    auto st = ConnectTo(coord_addr_, coord_port_, &fd);
+    if (!st.ok()) return st;
+    int32_t hdr[2] = {rank_, my_port};
+    st = WriteAll(fd, hdr, sizeof(hdr));
+    if (!st.ok()) return st;
+    int32_t len;
+    st = ReadAll(fd, &len, 4);
+    if (!st.ok()) return st;
+    std::vector<uint8_t> buf(len);
+    st = ReadAll(fd, buf.data(), len);
+    if (!st.ok()) return st;
+    close(fd);
+    wire::Reader rd(buf.data(), buf.size());
+    for (int i = 0; i < size_; ++i) {
+      hosts[i] = rd.str();
+      ports[i] = rd.i32();
+    }
+  }
+
+  // Full mesh: connect to lower ranks; accept from higher ranks.
+  for (int peer = 0; peer < rank_; ++peer) {
+    int fd;
+    auto st = ConnectTo(hosts[peer], ports[peer], &fd);
+    if (!st.ok()) return st;
+    int32_t me = rank_;
+    st = WriteAll(fd, &me, 4);
+    if (!st.ok()) return st;
+    peer_fds_[peer] = fd;
+  }
+  for (int i = rank_ + 1; i < size_; ++i) {
+    int fd = accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) return Status::Error("accept failed in mesh setup");
+    SetNoDelay(fd);
+    int32_t who;
+    auto st = ReadAll(fd, &who, 4);
+    if (!st.ok()) return st;
+    peer_fds_[who] = fd;
+  }
+
+  for (int peer = 0; peer < size_; ++peer) {
+    if (peer == rank_) continue;
+    readers_.emplace_back([this, peer] { ReaderLoop(peer); });
+  }
+  return Status::OK();
+}
+
+std::shared_ptr<Transport::TagQueue> Transport::GetQueue(int peer,
+                                                         int32_t tag) {
+  std::lock_guard<std::mutex> lk(inbox_mu_);
+  auto& m = inbox_[peer];
+  auto it = m.find(tag);
+  if (it == m.end()) {
+    auto q = std::make_shared<TagQueue>();
+    m[tag] = q;
+    return q;
+  }
+  return it->second;
+}
+
+void Transport::ReaderLoop(int peer) {
+  int fd = peer_fds_[peer];
+  for (;;) {
+    int32_t hdr[2];  // tag, len
+    if (!ReadAll(fd, hdr, sizeof(hdr)).ok()) break;
+    std::vector<uint8_t> payload(hdr[1]);
+    if (hdr[1] > 0 && !ReadAll(fd, payload.data(), hdr[1]).ok()) break;
+    auto q = GetQueue(peer, hdr[0]);
+    {
+      std::lock_guard<std::mutex> lk(q->mu);
+      q->q.push(std::move(payload));
+    }
+    q->cv.notify_all();
+  }
+  // close all queues for this peer so blocked recvs fail fast
+  std::lock_guard<std::mutex> lk(inbox_mu_);
+  for (auto& kv : inbox_[peer]) {
+    std::lock_guard<std::mutex> qk(kv.second->mu);
+    kv.second->closed = true;
+    kv.second->cv.notify_all();
+  }
+}
+
+Status Transport::Send(int peer, int32_t tag, const void* data, size_t len) {
+  if (peer == rank_) {
+    auto q = GetQueue(peer, tag);
+    std::vector<uint8_t> payload((const uint8_t*)data,
+                                 (const uint8_t*)data + len);
+    {
+      std::lock_guard<std::mutex> lk(q->mu);
+      q->q.push(std::move(payload));
+    }
+    q->cv.notify_all();
+    return Status::OK();
+  }
+  std::lock_guard<std::mutex> lk(*send_mu_[peer]);
+  int fd = peer_fds_[peer];
+  if (fd < 0) return Status::Error("no connection to peer");
+  int32_t hdr[2] = {tag, (int32_t)len};
+  auto st = WriteAll(fd, hdr, sizeof(hdr));
+  if (!st.ok()) return st;
+  return WriteAll(fd, data, len);
+}
+
+Status Transport::Recv(int peer, int32_t tag, std::vector<uint8_t>* out) {
+  auto q = GetQueue(peer, tag);
+  std::unique_lock<std::mutex> lk(q->mu);
+  q->cv.wait(lk, [&] { return !q->q.empty() || q->closed; });
+  if (q->q.empty())
+    return Status::Aborted("connection closed");
+  *out = std::move(q->q.front());
+  q->q.pop();
+  return Status::OK();
+}
+
+void Transport::Shutdown() {
+  if (shutting_down_.exchange(true)) return;
+  for (auto& fd : peer_fds_) {
+    if (fd >= 0) {
+      ::shutdown(fd, SHUT_RDWR);
+    }
+  }
+  if (listen_fd_ >= 0) close(listen_fd_);
+  for (auto& t : readers_)
+    if (t.joinable()) t.join();
+  for (auto& fd : peer_fds_) {
+    if (fd >= 0) close(fd);
+    fd = -1;
+  }
+  listen_fd_ = -1;
+}
+
+}  // namespace hvd

@@ -1,0 +1,71 @@
+// TCP full-mesh transport with coordinator-based rendezvous.
+//
+// Fills the role of the reference's Gloo context + HTTP-KV rendezvous
+// (horovod/common/gloo/gloo_context.cc:67-131): workers learn each other's
+// addresses through rank 0 (address from HOROVOD_GLOO_RENDEZVOUS_ADDR-style
+// env) and build a full mesh of TCP connections; framed messages are
+// demultiplexed by (peer, tag) with per-tag blocking queues.
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "types.h"
+
+namespace hvd {
+
+struct Frame {
+  int32_t tag;
+  std::vector<uint8_t> payload;
+};
+
+class Transport {
+ public:
+  // rank/size/coordinator address resolved from env by the caller.
+  Transport(int rank, int size, const std::string& coord_addr,
+            int coord_port);
+  ~Transport();
+
+  Status Init();            // rendezvous + full mesh
+  void Shutdown();
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+  // Framed point-to-point messaging. Send is thread-safe per peer; recv
+  // blocks until a frame with `tag` arrives from `peer`.
+  Status Send(int peer, int32_t tag, const void* data, size_t len);
+  Status Recv(int peer, int32_t tag, std::vector<uint8_t>* out);
+
+ private:
+  void ReaderLoop(int peer);
+  Status ConnectTo(const std::string& host, int port, int* fd_out);
+
+  int rank_, size_;
+  std::string coord_addr_;
+  int coord_port_;
+  int listen_fd_ = -1;
+  std::vector<int> peer_fds_;                 // index = peer rank
+  std::vector<std::unique_ptr<std::mutex>> send_mu_;
+  std::vector<std::thread> readers_;
+
+  struct TagQueue {
+    std::mutex mu;
+    std::condition_variable cv;
+    std::queue<std::vector<uint8_t>> q;
+    bool closed = false;
+  };
+  // inbox_[peer][tag]
+  std::mutex inbox_mu_;
+  std::vector<std::map<int32_t, std::shared_ptr<TagQueue>>> inbox_;
+  std::shared_ptr<TagQueue> GetQueue(int peer, int32_t tag);
+  std::atomic<bool> shutting_down_{false};
+};
+
+}  // namespace hvd

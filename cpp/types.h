@@ -1,0 +1,147 @@
+// Core types for the hvdcore native coordination engine.
+//
+// TPU-native re-design of the reference's common types
+// (horovod/common/common.h:150-340: Status, DataType, TensorShape,
+// TensorTableEntry). No framework tensor abstraction is needed: the Python
+// layer hands us raw host buffers (numpy / jax device->host), the engine
+// coordinates + moves bytes, and the TPU data plane stays in XLA.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+enum class DataType : int32_t {
+  kUint8 = 0,
+  kInt8 = 1,
+  kInt32 = 4,
+  kInt64 = 5,
+  kFloat16 = 6,
+  kFloat32 = 7,
+  kFloat64 = 8,
+  kBool = 9,
+  kBFloat16 = 10,
+};
+
+inline size_t DataTypeSize(DataType t) {
+  switch (t) {
+    case DataType::kUint8:
+    case DataType::kInt8:
+    case DataType::kBool:
+      return 1;
+    case DataType::kFloat16:
+    case DataType::kBFloat16:
+      return 2;
+    case DataType::kInt32:
+    case DataType::kFloat32:
+      return 4;
+    case DataType::kInt64:
+    case DataType::kFloat64:
+      return 8;
+  }
+  return 1;
+}
+
+enum class ReduceOp : int32_t {
+  kAverage = 0,
+  kSum = 1,
+  kAdasum = 2,
+  kMin = 3,
+  kMax = 4,
+  kProduct = 5,
+};
+
+enum class StatusType : int32_t { kOk = 0, kAborted = 1, kInvalid = 2,
+                                  kInProgress = 3 };
+
+struct Status {
+  StatusType type = StatusType::kOk;
+  std::string reason;
+  static Status OK() { return Status(); }
+  static Status Error(const std::string& msg) {
+    return Status{StatusType::kInvalid, msg};
+  }
+  static Status Aborted(const std::string& msg) {
+    return Status{StatusType::kAborted, msg};
+  }
+  bool ok() const { return type == StatusType::kOk; }
+};
+
+// Request: one rank announcing a tensor is ready (reference:
+// horovod/common/message.h:55-140).
+struct Request {
+  enum Type : int32_t { kAllreduce = 0, kAllgather = 1, kBroadcast = 2,
+                        kAlltoall = 3, kJoin = 4, kBarrier = 5 };
+  Type type = kAllreduce;
+  int32_t rank = 0;
+  std::string name;
+  DataType dtype = DataType::kFloat32;
+  std::vector<int64_t> shape;
+  int32_t root_rank = 0;
+  ReduceOp op = ReduceOp::kSum;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  int32_t group_id = -1;
+};
+
+// Response: coordinator's instruction to execute a (possibly fused) op
+// (reference: horovod/common/message.h:143-252).
+struct Response {
+  enum Type : int32_t { kAllreduce = 0, kAllgather = 1, kBroadcast = 2,
+                        kAlltoall = 3, kJoin = 4, kBarrier = 5, kError = 6,
+                        kShutdown = 7 };
+  Type type = kAllreduce;
+  std::vector<std::string> names;
+  std::string error_message;
+  // per-tensor metadata so non-submitting (joined) ranks can participate
+  std::vector<DataType> dtypes;
+  std::vector<std::vector<int64_t>> shapes;
+  int32_t root_rank = 0;
+  ReduceOp op = ReduceOp::kSum;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  int32_t last_joined_rank = -1;
+  // true when served from the response cache (receivers must not re-insert)
+  bool from_cache = false;
+};
+
+using StatusCallback = std::function<void(const Status&)>;
+
+// One enqueued tensor awaiting coordination (reference:
+// horovod/common/common.h:297-332 TensorTableEntry).
+struct TensorTableEntry {
+  std::string name;
+  Request::Type type = Request::kAllreduce;
+  const void* input = nullptr;   // caller-owned until callback fires
+  void* output = nullptr;        // allreduce/broadcast: same-shape output
+  DataType dtype = DataType::kFloat32;
+  std::vector<int64_t> shape;
+  int32_t root_rank = 0;
+  ReduceOp op = ReduceOp::kSum;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  std::vector<int64_t> splits;          // alltoall send splits
+  // results for variable-size ops (allgather/alltoall); shared with the
+  // caller's handle so Execute's writes are visible through the handle
+  std::shared_ptr<std::vector<uint8_t>> result;
+  std::shared_ptr<std::vector<int64_t>> result_shape;
+  std::shared_ptr<std::vector<int64_t>> recv_splits;
+  StatusCallback callback;
+
+  int64_t NumElements() const {
+    int64_t n = 1;
+    for (auto d : shape) n *= d;
+    return n;
+  }
+  size_t ByteSize() const { return NumElements() * DataTypeSize(dtype); }
+};
+
+}  // namespace hvd

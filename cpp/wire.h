@@ -1,0 +1,172 @@
+// Compact binary wire format for Request/Response lists.
+//
+// Replaces the reference's FlatBuffers schema (horovod/common/wire/
+// message.fbs + message_generated.h): control messages here are small and
+// point-to-point on a trusted cluster network, so a hand-rolled
+// length-prefixed encoding avoids the third-party dependency entirely.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "types.h"
+
+namespace hvd {
+namespace wire {
+
+class Writer {
+ public:
+  std::vector<uint8_t> buf;
+  void u8(uint8_t v) { buf.push_back(v); }
+  void i32(int32_t v) { append(&v, 4); }
+  void i64(int64_t v) { append(&v, 8); }
+  void f64(double v) { append(&v, 8); }
+  void str(const std::string& s) {
+    i32((int32_t)s.size());
+    append(s.data(), s.size());
+  }
+  void shape(const std::vector<int64_t>& s) {
+    i32((int32_t)s.size());
+    for (auto d : s) i64(d);
+  }
+  void append(const void* p, size_t n) {
+    auto* b = (const uint8_t*)p;
+    buf.insert(buf.end(), b, b + n);
+  }
+};
+
+class Reader {
+ public:
+  const uint8_t* p;
+  size_t len, off = 0;
+  Reader(const uint8_t* data, size_t n) : p(data), len(n) {}
+  uint8_t u8() { return p[off++]; }
+  int32_t i32() { int32_t v; memcpy(&v, p + off, 4); off += 4; return v; }
+  int64_t i64() { int64_t v; memcpy(&v, p + off, 8); off += 8; return v; }
+  double f64() { double v; memcpy(&v, p + off, 8); off += 8; return v; }
+  std::string str() {
+    int32_t n = i32();
+    std::string s((const char*)p + off, n);
+    off += n;
+    return s;
+  }
+  std::vector<int64_t> shape() {
+    int32_t n = i32();
+    std::vector<int64_t> s(n);
+    for (auto& d : s) d = i64();
+    return s;
+  }
+};
+
+inline void EncodeRequest(Writer& w, const Request& r) {
+  w.i32(r.type);
+  w.i32(r.rank);
+  w.str(r.name);
+  w.i32((int32_t)r.dtype);
+  w.shape(r.shape);
+  w.i32(r.root_rank);
+  w.i32((int32_t)r.op);
+  w.f64(r.prescale);
+  w.f64(r.postscale);
+  w.i32(r.group_id);
+}
+
+inline Request DecodeRequest(Reader& rd) {
+  Request r;
+  r.type = (Request::Type)rd.i32();
+  r.rank = rd.i32();
+  r.name = rd.str();
+  r.dtype = (DataType)rd.i32();
+  r.shape = rd.shape();
+  r.root_rank = rd.i32();
+  r.op = (ReduceOp)rd.i32();
+  r.prescale = rd.f64();
+  r.postscale = rd.f64();
+  r.group_id = rd.i32();
+  return r;
+}
+
+inline void EncodeResponse(Writer& w, const Response& r) {
+  w.u8(r.from_cache ? 1 : 0);
+  w.i32(r.type);
+  w.i32((int32_t)r.names.size());
+  for (auto& n : r.names) w.str(n);
+  w.str(r.error_message);
+  w.i32((int32_t)r.dtypes.size());
+  for (auto d : r.dtypes) w.i32((int32_t)d);
+  w.i32((int32_t)r.shapes.size());
+  for (auto& s : r.shapes) w.shape(s);
+  w.i32(r.root_rank);
+  w.i32((int32_t)r.op);
+  w.f64(r.prescale);
+  w.f64(r.postscale);
+  w.i32(r.last_joined_rank);
+}
+
+inline Response DecodeResponse(Reader& rd) {
+  Response r;
+  r.from_cache = rd.u8() != 0;
+  r.type = (Response::Type)rd.i32();
+  int32_t n = rd.i32();
+  r.names.resize(n);
+  for (auto& s : r.names) s = rd.str();
+  r.error_message = rd.str();
+  n = rd.i32();
+  r.dtypes.resize(n);
+  for (auto& d : r.dtypes) d = (DataType)rd.i32();
+  n = rd.i32();
+  r.shapes.resize(n);
+  for (auto& s : r.shapes) s = rd.shape();
+  r.root_rank = rd.i32();
+  r.op = (ReduceOp)rd.i32();
+  r.prescale = rd.f64();
+  r.postscale = rd.f64();
+  r.last_joined_rank = rd.i32();
+  return r;
+}
+
+inline std::vector<uint8_t> EncodeRequestList(
+    const std::vector<Request>& reqs, bool shutdown,
+    const std::vector<int32_t>& cache_bits) {
+  Writer w;
+  w.u8(shutdown ? 1 : 0);
+  w.i32((int32_t)cache_bits.size());
+  for (auto b : cache_bits) w.i32(b);
+  w.i32((int32_t)reqs.size());
+  for (auto& r : reqs) EncodeRequest(w, r);
+  return std::move(w.buf);
+}
+
+inline std::vector<Request> DecodeRequestList(
+    const uint8_t* p, size_t n, bool* shutdown,
+    std::vector<int32_t>* cache_bits) {
+  Reader rd(p, n);
+  *shutdown = rd.u8() != 0;
+  int32_t nb = rd.i32();
+  cache_bits->resize(nb);
+  for (auto& b : *cache_bits) b = rd.i32();
+  int32_t cnt = rd.i32();
+  std::vector<Request> reqs(cnt);
+  for (auto& r : reqs) r = DecodeRequest(rd);
+  return reqs;
+}
+
+inline std::vector<uint8_t> EncodeResponseList(
+    const std::vector<Response>& rs) {
+  Writer w;
+  w.i32((int32_t)rs.size());
+  for (auto& r : rs) EncodeResponse(w, r);
+  return std::move(w.buf);
+}
+
+inline std::vector<Response> DecodeResponseList(const uint8_t* p, size_t n) {
+  Reader rd(p, n);
+  int32_t cnt = rd.i32();
+  std::vector<Response> rs(cnt);
+  for (auto& r : rs) r = DecodeResponse(rd);
+  return rs;
+}
+
+}  // namespace wire
+}  // namespace hvd

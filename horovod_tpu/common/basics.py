@@ -44,6 +44,8 @@ class _GlobalState:
         self.cross_size = 1
         self.hostname = ""
         self.launched_rank = None  # pre-restriction rank when init(ranks) used
+        self.launched_size = 1     # env world size before any restriction
+        self.world_ranks = None    # restricted global set (init(ranks))
         self.backend = None          # ops.backend.Backend for the global set
         self.config: Optional[Config] = None
         self.process_set_table = None  # common.process_sets._ProcessSetTable
@@ -107,6 +109,7 @@ def init(ranks: Optional[Sequence[int]] = None,
         ident = _read_identity_from_env()
         _state.rank = ident["rank"]
         _state.size = ident["size"]
+        _state.launched_size = ident["size"]
         _state.local_rank = ident["local_rank"]
         _state.local_size = ident["local_size"]
         _state.cross_rank = ident["cross_rank"]
@@ -115,17 +118,28 @@ def init(ranks: Optional[Sequence[int]] = None,
 
         if ranks is not None and len(ranks) > 0:
             ranks = sorted(set(ranks))
-            if _state.rank not in ranks:
-                raise ValueError(
-                    f"hvd.init(ranks={list(ranks)}): this process has rank "
-                    f"{_state.rank}, which is not in the given ranks list.")
             # Restrict the world to the given launched ranks (reference
             # semantics of ``hvd.init(ranks)``: the global process set is the
             # sub-communicator over those ranks, and rank/size are relative
-            # to it — ``operations.cc:881-965`` init_multi_comm).
-            _state.launched_rank = _state.rank
-            _state.rank = ranks.index(_state.rank)
-            _state.size = len(ranks)
+            # to it — ``operations.cc:881-965`` init_multi_comm). Launched
+            # processes NOT in the list still participate in the core world
+            # (so rendezvous completes) but are excluded from the global set
+            # — their rank() is -1. Single-process, exclusion is an error.
+            if _state.rank not in ranks:
+                if _state.size == 1:
+                    raise ValueError(
+                        f"hvd.init(ranks={list(ranks)}): this process has "
+                        f"rank {_state.rank}, which is not in the ranks "
+                        "list.")
+                _state.launched_rank = _state.rank
+                _state.world_ranks = ranks
+                _state.rank = -1
+                _state.size = len(ranks)
+            else:
+                _state.launched_rank = _state.rank
+                _state.world_ranks = ranks
+                _state.rank = ranks.index(_state.rank)
+                _state.size = len(ranks)
 
         _state.backend = _create_backend(_state)
 
@@ -134,8 +148,13 @@ def init(ranks: Optional[Sequence[int]] = None,
             _state, process_sets or [])
 
         # Timeline (host-side chrome tracing; reference timeline.h:48-183).
+        # In multi-process mode the C++ core writes the timeline file (it
+        # sees the same env var); opening it here too would interleave two
+        # writers into one path — so the Python timeline only owns the file
+        # single-process.
         from horovod_tpu.common.timeline import Timeline
-        _state.timeline = Timeline(_state.rank, _state.config.timeline)
+        own_file = _state.config.timeline if _state.size == 1 else ""
+        _state.timeline = Timeline(_state.rank, own_file)
 
         _state.initialized = True
         get_logger().info(

@@ -1,0 +1,335 @@
+"""ctypes Backend over libhvdcore — the multi-process eager path.
+
+Reference analog: ``horovod/common/basics.py`` (ctypes init/identity) +
+``horovod/torch/mpi_ops_v2.cc`` (enqueue + handle manager). Arrays are
+moved to host (numpy), enqueued into the C++ core (which negotiates,
+fuses and runs TCP ring collectives), and returned in the caller's array
+flavor.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from horovod_tpu.core import _lib_path
+from horovod_tpu.ops.backend import Backend, HvdHandle
+from horovod_tpu.ops.reduce_op import ReduceOp
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+
+# DataType codes must match cpp/types.h
+_DTYPE_CODES = {
+    "uint8": 0, "int8": 1, "int32": 4, "int64": 5,
+    "float16": 6, "float32": 7, "float64": 8, "bool": 9, "bfloat16": 10,
+}
+
+
+def _load_lib():
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        lib = ctypes.CDLL(_lib_path())
+        lib.hvd_init.restype = ctypes.c_int
+        lib.hvd_last_error.restype = ctypes.c_char_p
+        lib.hvd_rank.restype = ctypes.c_int
+        lib.hvd_size.restype = ctypes.c_int
+        lib.hvd_enqueue_allreduce.restype = ctypes.c_int
+        lib.hvd_enqueue_allreduce.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.c_double, ctypes.c_double, ctypes.c_int]
+        lib.hvd_enqueue_allgather.restype = ctypes.c_int
+        lib.hvd_enqueue_allgather.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+        lib.hvd_enqueue_broadcast.restype = ctypes.c_int
+        lib.hvd_enqueue_broadcast.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int]
+        lib.hvd_enqueue_alltoall.restype = ctypes.c_int
+        lib.hvd_enqueue_alltoall.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+        lib.hvd_enqueue_join.restype = ctypes.c_int
+        lib.hvd_barrier.restype = ctypes.c_int
+        lib.hvd_poll.restype = ctypes.c_int
+        lib.hvd_wait.restype = ctypes.c_int
+        lib.hvd_wait.argtypes = [ctypes.c_int, ctypes.c_double]
+        lib.hvd_result_ndim.restype = ctypes.c_int
+        lib.hvd_result_shape.restype = ctypes.c_int
+        lib.hvd_result_shape.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+        lib.hvd_recv_splits.restype = ctypes.c_int
+        lib.hvd_recv_splits.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+        lib.hvd_copy_result.restype = ctypes.c_int
+        lib.hvd_copy_result.argtypes = [ctypes.c_int, ctypes.c_void_p,
+                                        ctypes.c_int64]
+        lib.hvd_add_process_set.restype = ctypes.c_int
+        lib.hvd_last_join_rank.restype = ctypes.c_int
+        _LIB = lib
+        return lib
+
+
+def _np_dtype_code(dtype) -> int:
+    name = np.dtype(dtype).name
+    if name == "bool":
+        name = "bool"
+    code = _DTYPE_CODES.get(name)
+    if code is None:
+        # jax bfloat16 comes through ml_dtypes
+        if "bfloat16" in str(dtype):
+            return 10
+        raise TypeError(f"unsupported dtype for core collectives: {dtype}")
+    return code
+
+
+def _to_host(value):
+    """Return (contiguous numpy array, reconstruct_fn)."""
+    try:
+        import jax
+        if isinstance(value, jax.Array):
+            arr = np.asarray(value)
+            import jax.numpy as jnp
+            return np.ascontiguousarray(arr), lambda a: jnp.asarray(a)
+    except ImportError:
+        pass
+    arr = np.ascontiguousarray(np.asarray(value))
+    return arr, lambda a: a
+
+
+def _shape_arg(shape):
+    arr = (ctypes.c_int64 * max(len(shape), 1))(*shape)
+    return arr, len(shape)
+
+
+# Buffers referenced by in-flight C++ entries. Keyed by C handle id and
+# released on completion; a handle abandoned without wait() leaks its
+# buffers here rather than letting the background thread write freed memory
+# (the reference keeps tensors alive in the tensor table the same way).
+_INFLIGHT_BUFFERS = {}
+_INFLIGHT_LOCK = threading.Lock()
+
+
+def _pin_buffers(ch: int, bufs) -> None:
+    with _INFLIGHT_LOCK:
+        _INFLIGHT_BUFFERS[ch] = bufs
+
+
+def _unpin_buffers(ch: int) -> None:
+    with _INFLIGHT_LOCK:
+        _INFLIGHT_BUFFERS.pop(ch, None)
+
+
+class CoreHandle(HvdHandle):
+    """Handle backed by the C++ handle manager (polls the core instead of a
+    Python event)."""
+
+    def __init__(self, lib, ch: int, finisher):
+        super().__init__()
+        self._lib = lib
+        self._ch = ch
+        self._finisher = finisher
+        self._finished = False
+        self._flock = threading.Lock()
+
+    def poll(self) -> bool:
+        if self._finished:
+            return True
+        return bool(self._lib.hvd_poll(self._ch))
+
+    def wait(self, timeout: Optional[float] = None):
+        with self._flock:
+            if self._finished:
+                return super().wait(0)
+            t = 1e9 if timeout is None else float(timeout)
+            rc = self._lib.hvd_wait(self._ch, t)
+            if rc == -2:
+                # timed out: handle and buffers stay valid for a retry
+                raise TimeoutError("collective did not complete in time")
+            if rc != 0:
+                err = self._lib.hvd_last_error().decode()
+                self._set_error(RuntimeError(f"collective failed: {err}"))
+            else:
+                try:
+                    self._set_result(self._finisher())
+                except BaseException as e:
+                    self._set_error(e)
+            self._lib.hvd_free_handle(self._ch)
+            _unpin_buffers(self._ch)
+            self._finished = True
+        return super().wait(0)
+
+
+class CoreBackend(Backend):
+    """Backend over the native core for one coordination domain."""
+
+    def __init__(self, state=None, domain: int = 0, rank: int = None,
+                 size: int = None, lib=None, owns_core: bool = None):
+        self._lib = lib or _load_lib()
+        if domain == 0:
+            rc = self._lib.hvd_init()
+            if rc != 0:
+                raise RuntimeError("hvdcore init failed: " +
+                                   self._lib.hvd_last_error().decode())
+            rank = self._lib.hvd_rank()
+            size = self._lib.hvd_size()
+            self._owns_core = True if owns_core is None else owns_core
+            # hvd.init(ranks=...) restriction: the "global" set is a subset
+            # of the launched world (reference: init_multi_comm,
+            # operations.cc:881-965). The core still spans the full world;
+            # the restricted global set is a process-set domain.
+            world_ranks = getattr(state, "world_ranks", None) if state else \
+                None
+            if world_ranks is not None and list(world_ranks) != \
+                    list(range(size)):
+                super().__init__(rank, size)  # temp for make_subset
+                self._domain = 0
+                sub = self.make_subset(world_ranks)
+                self._domain = sub._domain
+                self._ranks = sub._ranks
+                rank = sub.rank
+                size = sub.size
+                super().__init__(rank, size)
+                return
+        else:
+            self._owns_core = False
+        super().__init__(rank, size)
+        self._domain = domain
+
+    # -- collectives ---------------------------------------------------------
+    def allreduce_async(self, name, value, op, prescale=1.0, postscale=1.0):
+        arr, back = _to_host(value)
+        out = np.empty_like(arr)
+        sh, nd = _shape_arg(arr.shape)
+        ch = self._lib.hvd_enqueue_allreduce(
+            name.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p), _np_dtype_code(arr.dtype),
+            nd, sh, int(op), float(prescale), float(postscale), self._domain)
+        _pin_buffers(ch, (arr, out))
+        return CoreHandle(self._lib, ch, lambda: back(out))
+
+    def grouped_allreduce_async(self, names, values, op,
+                                prescale=1.0, postscale=1.0):
+        handles = [self.allreduce_async(n, v, op, prescale, postscale)
+                   for n, v in zip(names, values)]
+        agg = HvdHandle()
+
+        def waiter():
+            try:
+                agg._set_result([h.wait() for h in handles])
+            except BaseException as e:
+                agg._set_error(e)
+
+        threading.Thread(target=waiter, daemon=True).start()
+        return agg
+
+    def allgather_async(self, name, value):
+        arr, back = _to_host(value)
+        sh, nd = _shape_arg(arr.shape)
+        ch = self._lib.hvd_enqueue_allgather(
+            name.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+            _np_dtype_code(arr.dtype), nd, sh, self._domain)
+
+        def finish():
+            ndim = self._lib.hvd_result_ndim(ch)
+            shape = (ctypes.c_int64 * max(ndim, 1))()
+            self._lib.hvd_result_shape(ch, shape, ndim)
+            out_shape = tuple(shape[i] for i in range(ndim))
+            out = np.empty(out_shape, dtype=arr.dtype)
+            self._lib.hvd_copy_result(
+                ch, out.ctypes.data_as(ctypes.c_void_p), out.nbytes)
+            return back(out)
+
+        _pin_buffers(ch, (arr,))
+        return CoreHandle(self._lib, ch, finish)
+
+    def broadcast_async(self, name, value, root_rank):
+        arr, back = _to_host(value)
+        out = np.array(arr, copy=True)
+        sh, nd = _shape_arg(arr.shape)
+        # root_rank is relative to the process set; core wants global rank
+        globl = self._global_rank_of(root_rank)
+        ch = self._lib.hvd_enqueue_broadcast(
+            name.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p), globl,
+            _np_dtype_code(arr.dtype), nd, sh, self._domain)
+        _pin_buffers(ch, (arr, out))
+        return CoreHandle(self._lib, ch, lambda: back(out))
+
+    def alltoall_async(self, name, value, splits=None):
+        arr, back = _to_host(value)
+        if splits is None:
+            if arr.shape[0] % self.size != 0:
+                raise ValueError(
+                    "alltoall without splits requires dim 0 divisible by "
+                    f"size ({self.size})")
+            splits = [arr.shape[0] // self.size] * self.size
+        splits = list(int(s) for s in splits)
+        if len(splits) != self.size:
+            raise ValueError("alltoall splits must have one entry per rank")
+        sp = (ctypes.c_int64 * len(splits))(*splits)
+        sh, nd = _shape_arg(arr.shape)
+        ch = self._lib.hvd_enqueue_alltoall(
+            name.encode(), arr.ctypes.data_as(ctypes.c_void_p), sp,
+            len(splits), _np_dtype_code(arr.dtype), nd, sh, self._domain)
+
+        def finish():
+            ndim = self._lib.hvd_result_ndim(ch)
+            shape = (ctypes.c_int64 * max(ndim, 1))()
+            self._lib.hvd_result_shape(ch, shape, ndim)
+            out_shape = tuple(shape[i] for i in range(ndim))
+            out = np.empty(out_shape, dtype=arr.dtype)
+            self._lib.hvd_copy_result(
+                ch, out.ctypes.data_as(ctypes.c_void_p), out.nbytes)
+            rs = (ctypes.c_int64 * self.size)()
+            nrs = self._lib.hvd_recv_splits(ch, rs, self.size)
+            recv_splits = np.asarray([rs[i] for i in range(nrs)],
+                                     dtype=np.int32)
+            return back(out), recv_splits
+
+        _pin_buffers(ch, (arr,))
+        return CoreHandle(self._lib, ch, finish)
+
+    def barrier(self):
+        rc = self._lib.hvd_barrier(self._domain)
+        if rc != 0:
+            raise RuntimeError("barrier failed: " +
+                               self._lib.hvd_last_error().decode())
+
+    def join(self, device: int = -1) -> int:
+        ch = self._lib.hvd_enqueue_join(self._domain)
+        CoreHandle(self._lib, ch, lambda: None).wait()
+        return self._lib.hvd_last_join_rank(self._domain)
+
+    # -- lifecycle -----------------------------------------------------------
+    def make_subset(self, ranks: Sequence[int]):
+        ranks = sorted(set(int(r) for r in ranks))
+        arr = (ctypes.c_int * len(ranks))(*ranks)
+        domain = self._lib.hvd_add_process_set(arr, len(ranks))
+        my_global = self._lib.hvd_rank()
+        sub_rank = ranks.index(my_global) if my_global in ranks else -1
+        be = CoreBackend(domain=domain, rank=sub_rank, size=len(ranks),
+                         lib=self._lib)
+        be._ranks = ranks
+        return be
+
+    def shutdown(self):
+        if self._owns_core:
+            self._lib.hvd_shutdown()
+        elif self._domain != 0:
+            self._lib.hvd_remove_process_set(self._domain)
+
+    def _global_rank_of(self, set_rank: int) -> int:
+        ranks = getattr(self, "_ranks", None)
+        if ranks is None:
+            return set_rank
+        return ranks[set_rank]

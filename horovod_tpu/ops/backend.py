@@ -197,8 +197,13 @@ class LocalBackend(Backend):
 
 def make_backend(state) -> Backend:
     """Priority selection (reference: ``CreateOperationManager``,
-    ``operations.cc:144-253``)."""
-    if state.size <= 1:
+    ``operations.cc:144-253``).
+
+    The decision keys off the LAUNCHED world size: a process restricted to a
+    1-rank global set by ``init(ranks=[r])`` in a multi-process launch must
+    still join the core world so the other processes' rendezvous completes.
+    """
+    if getattr(state, "launched_size", state.size) <= 1:
         return LocalBackend(state.rank, 1)
     # Multi-process: the C++ core (TCP controller + host collectives, with
     # the XLA data plane layered on top when TPU devices are present).

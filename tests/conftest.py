@@ -24,6 +24,18 @@ import pytest  # noqa: E402
 assert jax.device_count() == 8, (
     f"tests require the 8-device virtual CPU mesh, got {jax.devices()}")
 
+# Build the native core if it isn't present (kept out of git; ~20 s once).
+import subprocess  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SO = os.path.join(_REPO, "horovod_tpu", "core", "libhvdcore.so")
+if not os.path.exists(_SO):
+    try:
+        subprocess.run(["make", "-j4"], cwd=os.path.join(_REPO, "cpp"),
+                       check=False, capture_output=True, timeout=300)
+    except Exception:
+        pass  # core tests skip cleanly when the .so is absent
+
 
 @pytest.fixture
 def hvd():

@@ -1,0 +1,125 @@
+"""Worker script for multi-process core tests (launched by
+test_core_multiprocess.py with HOROVOD_RANK/SIZE env). The numpy-only analog
+of the reference's test/parallel suite bodies."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.core.core_backend import CoreBackend  # noqa: E402
+from horovod_tpu.ops.reduce_op import ReduceOp  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    be = CoreBackend()
+    assert be.rank == rank and be.size == size, (be.rank, be.size)
+
+    # -- allreduce sum across dtypes -----------------------------------------
+    for dtype in (np.float32, np.float64, np.int32, np.int64):
+        x = (np.arange(17, dtype=dtype) + rank)
+        out = be.allreduce_async(f"ar.{np.dtype(dtype).name}", x,
+                                 ReduceOp.SUM).wait()
+        expect = sum((np.arange(17, dtype=dtype) + r) for r in range(size))
+        np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+    # -- average + prescale/postscale ----------------------------------------
+    x = np.full((8,), float(rank + 1), np.float32)
+    out = be.allreduce_async("ar.avg", x, ReduceOp.AVERAGE,
+                             prescale=2.0, postscale=0.5).wait()
+    expect = np.full((8,), np.mean([(r + 1) * 2.0 for r in range(size)]) * 0.5,
+                     np.float32)
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+    # -- min / max -------------------------------------------------------------
+    x = np.asarray([rank, -rank, 10 + rank], np.float32)
+    mn = be.allreduce_async("ar.min", x, ReduceOp.MIN).wait()
+    mx = be.allreduce_async("ar.max", x, ReduceOp.MAX).wait()
+    np.testing.assert_allclose(mn, [0, -(size - 1), 10])
+    np.testing.assert_allclose(mx, [size - 1, 0, 10 + size - 1])
+
+    # -- grouped (fused) allreduce --------------------------------------------
+    vals = [np.full((5,), float(rank), np.float32),
+            np.full((1000,), 1.0, np.float32),
+            np.full((3, 3), float(rank * 2), np.float32)]
+    outs = be.grouped_allreduce_async(
+        [f"g.{i}" for i in range(3)], vals, ReduceOp.SUM).wait()
+    np.testing.assert_allclose(outs[0], np.full((5,), sum(range(size))))
+    np.testing.assert_allclose(outs[1], np.full((1000,), float(size)))
+    np.testing.assert_allclose(outs[2],
+                               np.full((3, 3), 2.0 * sum(range(size))))
+
+    # -- bfloat16 via raw uint16 view is exercised through jax in other tests;
+    # float16 here
+    x = np.full((64,), 0.5, np.float16) * (rank + 1)
+    out = be.allreduce_async("ar.f16", x, ReduceOp.SUM).wait()
+    np.testing.assert_allclose(out.astype(np.float32),
+                               np.full((64,), 0.5 * sum(r + 1 for r in
+                                                        range(size))),
+                               rtol=1e-2)
+
+    # -- allgather with ragged first dims --------------------------------------
+    rows = rank + 1
+    x = np.arange(rows * 2, dtype=np.float32).reshape(rows, 2) + 100 * rank
+    out = be.allgather_async("ag", x).wait()
+    expect = np.concatenate([
+        np.arange((r + 1) * 2, dtype=np.float32).reshape(r + 1, 2) + 100 * r
+        for r in range(size)])
+    np.testing.assert_allclose(out, expect)
+
+    # -- broadcast -------------------------------------------------------------
+    for root in range(size):
+        x = (np.arange(6, dtype=np.float64) * (rank + 1))
+        out = be.broadcast_async(f"bc.{root}", x, root).wait()
+        np.testing.assert_allclose(out, np.arange(6, dtype=np.float64) *
+                                   (root + 1))
+
+    # -- alltoall with uneven splits -------------------------------------------
+    # rank r sends (i+1) rows of value r*10+i to rank i
+    splits = [i + 1 for i in range(size)]
+    total = sum(splits)
+    sendbuf = np.concatenate([
+        np.full((i + 1, 2), rank * 10 + i, np.float32) for i in range(size)])
+    assert sendbuf.shape[0] == total
+    out, recv_splits = be.alltoall_async("a2a", sendbuf, splits).wait()
+    assert list(recv_splits) == [rank + 1] * size
+    expect = np.concatenate([
+        np.full((rank + 1, 2), r * 10 + rank, np.float32)
+        for r in range(size)])
+    np.testing.assert_allclose(out, expect)
+
+    # -- barrier ----------------------------------------------------------------
+    be.barrier()
+
+    # -- process set (first two ranks) -------------------------------------------
+    if size >= 2:
+        sub = be.make_subset([0, 1])
+        if rank in (0, 1):
+            x = np.full((4,), float(rank + 5), np.float32)
+            out = sub.allreduce_async("ps.ar", x, ReduceOp.SUM).wait()
+            np.testing.assert_allclose(out, np.full((4,), 5.0 + 6.0))
+        be.barrier()
+
+    # -- join: odd ranks join early; even ranks allreduce once more -------------
+    if size >= 2:
+        if rank % 2 == 1:
+            last = be.join()
+        else:
+            x = np.full((4,), 1.0, np.float32)
+            out = be.allreduce_async("post_join", x, ReduceOp.SUM).wait()
+            # joined ranks contribute zeros
+            n_even = (size + 1) // 2
+            np.testing.assert_allclose(out, np.full((4,), float(n_even)))
+            last = be.join()
+        assert isinstance(last, int)
+
+    be.shutdown()
+    print(f"worker {rank}: OK")
+
+
+if __name__ == "__main__":
+    main()

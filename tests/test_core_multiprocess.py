@@ -1,0 +1,89 @@
+"""Multi-process tests of the native core: spawn N local workers over the
+TCP transport and assert collective numerics — the analog of the reference's
+``horovodrun``-driven test/parallel suite run on localhost Gloo."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.core import core_available
+
+WORKER = os.path.join(os.path.dirname(__file__), "core_worker.py")
+HVD_WORKER = os.path.join(os.path.dirname(__file__), "hvd_worker.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch(size, extra_env=None, timeout=120, worker=WORKER):
+    port = _free_port()
+    procs = []
+    for rank in range(size):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.update({
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(size),
+            "HVD_TPU_COORD_ADDR": "127.0.0.1",
+            "HVD_TPU_COORD_PORT": str(port),
+            "HOROVOD_LOCAL_RANK": str(rank),
+            "HOROVOD_LOCAL_SIZE": str(size),
+        })
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, worker], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    ok = True
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(f"--- rank {rank} (rc={p.returncode}) ---\n"
+                    + out.decode())
+        ok = ok and p.returncode == 0
+    assert ok, "\n".join(outs)
+
+
+needs_core = pytest.mark.skipif(not core_available(),
+                                reason="libhvdcore.so not built")
+
+
+@needs_core
+@pytest.mark.parametrize("size", [2, 4])
+def test_core_collectives(size):
+    _launch(size)
+
+
+@needs_core
+def test_core_with_small_fusion_threshold():
+    """Force multi-buffer fusion splitting."""
+    _launch(2, {"HVD_TPU_FUSION_THRESHOLD": str(512)})
+
+
+@needs_core
+def test_core_with_timeline(tmp_path):
+    tl = str(tmp_path / "timeline.json")
+    _launch(2, {"HVD_TPU_TIMELINE": tl})
+    import json
+    with open(tl) as f:
+        events = json.load(f)
+    assert any(e.get("name") == "EXECUTE" for e in events if e)
+
+
+@needs_core
+@pytest.mark.parametrize("size", [2, 3])
+def test_hvd_full_stack(size):
+    """Public hvd API over the core with jax-cpu arrays."""
+    _launch(size, timeout=240, worker=HVD_WORKER)
