@@ -26,10 +26,12 @@ size_t AlignUp(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
 // TensorQueue (reference: tensor_queue.cc)
 // ---------------------------------------------------------------------------
 
-void TensorQueue::Push(TensorTableEntry entry, Request req) {
+bool TensorQueue::Push(TensorTableEntry entry, Request req) {
   std::lock_guard<std::mutex> lk(mu_);
+  if (table_.count(entry.name)) return false;  // reference: DUPLICATE_NAME
   table_[entry.name] = std::move(entry);
   requests_.push_back(std::move(req));
+  return true;
 }
 
 std::vector<Request> TensorQueue::PopRequests() {
@@ -214,7 +216,20 @@ void Core::PushToDomain(int domain, TensorTableEntry e, Request r) {
       e.callback(Status::Error("unknown process set / coordination domain"));
     return;
   }
-  it->second->queue.Push(std::move(e), std::move(r));
+  if (it->second->group.my_index < 0) {
+    if (e.callback)
+      e.callback(Status::Error(
+          "this rank is not a member of the process set"));
+    return;
+  }
+  auto cb = e.callback;
+  std::string name = e.name;
+  if (!it->second->queue.Push(std::move(e), std::move(r))) {
+    if (cb)
+      cb(Status::Error("duplicate tensor name submitted before previous "
+                       "operation on '" + name + "' completed (reference: "
+                       "DUPLICATE_NAME error)"));
+  }
 }
 
 Status Core::Init(const CoreConfig& cfg) {
@@ -553,10 +568,31 @@ void Core::HandleRequests(CoordDomain& d, int from_rank,
       continue;
     }
     // Keyed by NAME (reference: controller.cc IncrementTensorCount) —
-    // allgather ranks legitimately differ in dim 0. Mismatched dtypes or
-    // non-first dims become an error response at fuse time.
+    // allgather ranks legitimately differ in dim 0.
     auto& slot = d.ready_table_[r.name];
-    if (slot.second.empty()) slot.first = r;
+    if (slot.second.empty()) {
+      slot.first = r;
+    } else {
+      // duplicate announcement from the same rank must not count twice
+      if (std::find(slot.second.begin(), slot.second.end(), from_rank) !=
+          slot.second.end())
+        continue;
+      // validate agreement (reference: ConstructResponse mismatch errors)
+      const Request& first = slot.first;
+      bool mismatch = first.dtype != r.dtype || first.type != r.type ||
+                      (int)first.op != (int)r.op;
+      if (!mismatch && r.type == Request::kAllreduce &&
+          first.shape != r.shape)
+        mismatch = true;
+      if (!mismatch && r.type != Request::kAllreduce &&
+          first.shape.size() == r.shape.size() && !r.shape.empty()) {
+        for (size_t k = 1; k < r.shape.size(); ++k)
+          if (first.shape[k] != r.shape[k]) mismatch = true;
+      }
+      if (mismatch)
+        d.error_table_[r.name] =
+            "mismatched dtype/shape/op for tensor '" + r.name + "'";
+    }
     slot.second.push_back(from_rank);
   }
   (void)gsize;
@@ -610,6 +646,16 @@ std::vector<Response> Core::CollectReady(CoordDomain& d) {
             [](auto& a, auto& b) { return a.first < b.first; });
   for (auto& kv : ready) {
     auto& r = kv.second;
+    auto err = d.error_table_.find(r.name);
+    if (err != d.error_table_.end()) {
+      Response resp;
+      resp.type = Response::kError;
+      resp.names = {r.name};
+      resp.error_message = err->second;
+      d.error_table_.erase(err);
+      out.push_back(std::move(resp));
+      continue;
+    }
     Response resp;
     resp.type = (Response::Type)r.type;
     resp.names = {r.name};
@@ -760,7 +806,8 @@ bool Core::RunOnce() {
         sd.type = Response::kShutdown;
         singles.push_back(sd);
       }
-      auto payload = wire::EncodeResponseList(singles);
+      auto payload = wire::EncodeResponseList(singles,
+                                              cfg_.fusion_threshold);
       for (int i = 1; i < d->group.size(); ++i) {
         auto st = transport_->Send(d->group.global(i),
                                    DomTag(id, kTagResponse), payload.data(),
@@ -779,7 +826,12 @@ bool Core::RunOnce() {
       std::vector<uint8_t> buf;
       st = transport_->Recv(coord, DomTag(id, kTagResponse), &buf);
       if (!st.ok()) return false;
-      singles = wire::DecodeResponseList(buf.data(), buf.size());
+      int64_t coord_threshold = cfg_.fusion_threshold;
+      singles = wire::DecodeResponseList(buf.data(), buf.size(),
+                                         &coord_threshold);
+      // adopt the coordinator's threshold so FuseResponses groups
+      // identically on every rank (autotune is coordinator-only)
+      cfg_.fusion_threshold = coord_threshold;
     }
 
     // every rank inserts newly negotiated allreduce responses in identical
@@ -803,12 +855,16 @@ bool Core::RunOnce() {
 
   if (got_shutdown_response) return false;
 
-  // autotune (reference: RunLoopOnce -> ParameterManager)
-  int64_t fusion = cfg_.fusion_threshold;
-  double cycle = cfg_.cycle_time_ms;
-  if (param_mgr_.Tune(&fusion, &cycle)) {
-    cfg_.fusion_threshold = fusion;
-    cfg_.cycle_time_ms = cycle;
+  // autotune (reference: RunLoopOnce -> ParameterManager). Coordinator
+  // only: workers adopt the tuned fusion threshold from the response list,
+  // keeping fusion grouping identical across ranks.
+  if (cfg_.rank == 0) {
+    int64_t fusion = cfg_.fusion_threshold;
+    double cycle = cfg_.cycle_time_ms;
+    if (param_mgr_.Tune(&fusion, &cycle)) {
+      cfg_.fusion_threshold = fusion;
+      cfg_.cycle_time_ms = cycle;
+    }
   }
   return true;
 }
@@ -941,6 +997,12 @@ void Core::Execute(CoordDomain& d, const Response& r) {
       bool have = d.queue.Take(r.names[0], &e);
       auto st = Barrier(*transport_, d.group, DomTag(id, kTagBarrier));
       if (have && e.callback) e.callback(st);
+      break;
+    }
+    case Response::kError: {
+      TensorTableEntry e;
+      if (d.queue.Take(r.names[0], &e) && e.callback)
+        e.callback(Status::Error(r.error_message));
       break;
     }
     case Response::kJoin: {

@@ -29,7 +29,8 @@ namespace hvd {
 // horovod/common/tensor_queue.h:28-64).
 class TensorQueue {
  public:
-  void Push(TensorTableEntry entry, Request req);
+  // false if an entry with the same name is already in flight
+  bool Push(TensorTableEntry entry, Request req);
   // Pop all pending requests this cycle.
   std::vector<Request> PopRequests();
   bool Take(const std::string& name, TensorTableEntry* out);
@@ -134,6 +135,8 @@ struct CoordDomain {
       ready_table_;
   // coordinator: cache-bit -> ranks that hit it this steady-state round
   std::unordered_map<int, std::vector<int>> bit_ready_;
+  // coordinator: tensors whose ranks disagreed on dtype/shape/op
+  std::unordered_map<std::string, std::string> error_table_;
 };
 
 class Core {

@@ -80,6 +80,7 @@ Transport::Transport(int rank, int size, const std::string& coord_addr,
       coord_port_(coord_port) {
   peer_fds_.assign(size, -1);
   inbox_.resize(size);
+  dead_.assign(size, false);
   for (int i = 0; i < size; ++i)
     send_mu_.emplace_back(new std::mutex());
 }
@@ -210,6 +211,7 @@ std::shared_ptr<Transport::TagQueue> Transport::GetQueue(int peer,
   auto it = m.find(tag);
   if (it == m.end()) {
     auto q = std::make_shared<TagQueue>();
+    if (dead_[peer]) q->closed = true;  // peer already gone
     m[tag] = q;
     return q;
   }
@@ -230,8 +232,10 @@ void Transport::ReaderLoop(int peer) {
     }
     q->cv.notify_all();
   }
-  // close all queues for this peer so blocked recvs fail fast
+  // close all queues for this peer so blocked recvs fail fast; mark the
+  // peer dead so queues created later are born closed
   std::lock_guard<std::mutex> lk(inbox_mu_);
+  dead_[peer] = true;
   for (auto& kv : inbox_[peer]) {
     std::lock_guard<std::mutex> qk(kv.second->mu);
     kv.second->closed = true;
@@ -273,6 +277,18 @@ Status Transport::Recv(int peer, int32_t tag, std::vector<uint8_t>* out) {
 
 void Transport::Shutdown() {
   if (shutting_down_.exchange(true)) return;
+  {
+    // unblock every pending and future Recv
+    std::lock_guard<std::mutex> lk(inbox_mu_);
+    for (size_t p = 0; p < inbox_.size(); ++p) {
+      dead_[p] = true;
+      for (auto& kv : inbox_[p]) {
+        std::lock_guard<std::mutex> qk(kv.second->mu);
+        kv.second->closed = true;
+        kv.second->cv.notify_all();
+      }
+    }
+  }
   for (auto& fd : peer_fds_) {
     if (fd >= 0) {
       ::shutdown(fd, SHUT_RDWR);

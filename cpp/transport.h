@@ -64,6 +64,7 @@ class Transport {
   // inbox_[peer][tag]
   std::mutex inbox_mu_;
   std::vector<std::map<int32_t, std::shared_ptr<TagQueue>>> inbox_;
+  std::vector<bool> dead_;  // peer's reader exited: new queues born closed
   std::shared_ptr<TagQueue> GetQueue(int peer, int32_t tag);
   std::atomic<bool> shutting_down_{false};
 };

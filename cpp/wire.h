@@ -153,15 +153,18 @@ inline std::vector<Request> DecodeRequestList(
 }
 
 inline std::vector<uint8_t> EncodeResponseList(
-    const std::vector<Response>& rs) {
+    const std::vector<Response>& rs, int64_t fusion_threshold) {
   Writer w;
+  w.i64(fusion_threshold);  // coordinator's (possibly autotuned) value
   w.i32((int32_t)rs.size());
   for (auto& r : rs) EncodeResponse(w, r);
   return std::move(w.buf);
 }
 
-inline std::vector<Response> DecodeResponseList(const uint8_t* p, size_t n) {
+inline std::vector<Response> DecodeResponseList(const uint8_t* p, size_t n,
+                                                int64_t* fusion_threshold) {
   Reader rd(p, n);
+  *fusion_threshold = rd.i64();
   int32_t cnt = rd.i32();
   std::vector<Response> rs(cnt);
   for (auto& r : rs) r = DecodeResponse(rd);

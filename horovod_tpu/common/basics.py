@@ -153,7 +153,7 @@ def init(ranks: Optional[Sequence[int]] = None,
         # writers into one path — so the Python timeline only owns the file
         # single-process.
         from horovod_tpu.common.timeline import Timeline
-        own_file = _state.config.timeline if _state.size == 1 else ""
+        own_file = _state.config.timeline if _state.launched_size == 1 else ""
         _state.timeline = Timeline(_state.rank, own_file)
 
         _state.initialized = True

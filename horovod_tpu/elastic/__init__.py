@@ -1,0 +1,187 @@
+"""Worker-side elastic training API.
+
+Reference: ``horovod/common/elastic.py`` (``State``/``ObjectState``
+commit/restore/sync :26-148, ``run_fn`` retry loop :151-175) and
+``horovod/torch/elastic/state.py`` (framework state handlers).
+
+TPU-native model: elasticity is process-restart based (see
+``runner/elastic/driver.py`` docstring) — ``State.commit()`` persists to the
+driver-provided checkpoint directory so a relaunched generation resumes
+where the last commit left off, and ``sync()`` broadcasts from rank 0 so
+fresh workers join consistently. ``HorovodInternalError`` still triggers an
+in-process ``restore()`` retry exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from horovod_tpu.common.basics import is_initialized
+from horovod_tpu.common.basics import rank as _hvd_rank
+from horovod_tpu.common.basics import size as _hvd_size
+
+
+def rank() -> int:
+    """Worker rank — from hvd if initialized, else the launcher env (elastic
+    states are usable with the raw core backend too)."""
+    if is_initialized():
+        return _hvd_rank()
+    return int(os.environ.get("HOROVOD_RANK", os.environ.get("HVD_TPU_RANK",
+                                                             "0")))
+
+
+def size() -> int:
+    if is_initialized():
+        return _hvd_size()
+    return int(os.environ.get("HOROVOD_SIZE", os.environ.get("HVD_TPU_SIZE",
+                                                             "1")))
+
+
+class HorovodInternalError(RuntimeError):
+    """A collective failed mid-step (reference: ``HorovodInternalError``)."""
+
+
+class HostsUpdatedInterrupt(RuntimeError):
+    """Membership changed; re-sync required (reference:
+    ``HostsUpdatedInterrupt``)."""
+
+
+class State:
+    """Commit/restore/sync contract (reference: ``common/elastic.py:26-96``)."""
+
+    def __init__(self) -> None:
+        self._reset_callbacks = []
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        for cb in self._reset_callbacks:
+            cb()
+
+    def commit(self) -> None:
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        # Process-restart elasticity: membership changes arrive as process
+        # restarts, not in-band notifications, so this is a no-op hook kept
+        # for reference API parity.
+        pass
+
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+
+def _ckpt_path(name: str) -> str:
+    base = os.environ.get("HVD_ELASTIC_CKPT", tempfile.gettempdir())
+    os.makedirs(base, exist_ok=True)
+    return os.path.join(base, f"hvd_state_{name}.pkl")
+
+
+class ObjectState(State):
+    """Arbitrary-attribute state with pickle persistence + rank-0 broadcast
+    sync (reference: ``ObjectState``, ``common/elastic.py:99-148``)."""
+
+    def __init__(self, name: str = "default", **kwargs: Any) -> None:
+        super().__init__()
+        self._name = name
+        self._saved: Dict[str, Any] = {}
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._attrs = list(kwargs)
+        if not self._maybe_load():
+            self._snapshot()
+
+    def _public(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._attrs}
+
+    def _snapshot(self) -> None:
+        self._saved = {k: _copy_leaf(v) for k, v in self._public().items()}
+
+    def _maybe_load(self) -> bool:
+        path = _ckpt_path(self._name)
+        if not os.path.exists(path):
+            return False
+        try:
+            with open(path, "rb") as f:
+                data = pickle.load(f)
+        except Exception:
+            return False
+        for k, v in data.items():
+            setattr(self, k, v)
+            if k not in self._attrs:
+                self._attrs.append(k)
+        self._snapshot()
+        return True
+
+    def save(self) -> None:
+        self._snapshot()
+        if rank() == 0:
+            tmp = _ckpt_path(self._name) + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(self._saved, f)
+            os.replace(tmp, _ckpt_path(self._name))
+
+    def restore(self) -> None:
+        for k, v in self._saved.items():
+            setattr(self, k, _copy_leaf(v))
+        self.on_reset()
+
+    def sync(self) -> None:
+        if size() > 1:
+            from horovod_tpu.train.optimizer import broadcast_object
+            data = broadcast_object(self._public(), root_rank=0,
+                                    name=f"elastic.{self._name}")
+            for k, v in data.items():
+                setattr(self, k, v)
+        self._snapshot()
+
+
+def _copy_leaf(v: Any) -> Any:
+    try:
+        import jax
+        if isinstance(v, jax.Array):
+            return np.asarray(v).copy()
+    except ImportError:
+        pass
+    if isinstance(v, np.ndarray):
+        return v.copy()
+    if isinstance(v, (dict, list, tuple)):
+        return pickle.loads(pickle.dumps(v))
+    return v
+
+
+class TpuState(ObjectState):
+    """Convenience for (params, opt_state, ...) pytrees of jax arrays —
+    the analog of ``TorchState`` (``torch/elastic/state.py:27``)."""
+
+
+def run(func: Callable) -> Callable:
+    """Elastic run decorator (reference: ``run_fn``,
+    ``common/elastic.py:151-175``): retry on HorovodInternalError with
+    ``state.restore()``; resync on HostsUpdatedInterrupt."""
+
+    def wrapper(state: State, *args: Any, **kwargs: Any):
+        state.sync()
+        while True:
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                state.restore()
+                state.sync()
+            except HostsUpdatedInterrupt:
+                state.sync()
+
+    return wrapper

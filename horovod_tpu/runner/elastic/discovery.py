@@ -1,0 +1,95 @@
+"""Elastic host discovery.
+
+Reference: ``horovod/runner/elastic/discovery.py`` — ``HostDiscoveryScript``
+(user script printing ``host:slots`` lines), ``FixedHosts``, and
+``HostManager`` tracking diffs + blacklist. On TPU the script typically
+enumerates pod-slice hosts (e.g. from the cloud metadata service) instead of
+GPU nodes.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+from typing import Dict, List, Optional, Set
+
+from horovod_tpu.runner.hosts import HostInfo
+
+
+class HostDiscovery:
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        raise NotImplementedError
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Runs the user script; stdout lines ``hostname:slots`` (reference:
+    ``HostDiscoveryScript.find_available_hosts_and_slots``)."""
+
+    def __init__(self, script_path: str) -> None:
+        self._script = script_path
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        out = subprocess.run([self._script], capture_output=True,
+                             timeout=60, check=True, shell=False)
+        hosts: Dict[str, int] = {}
+        for line in out.stdout.decode().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if ":" in line:
+                host, slots = line.rsplit(":", 1)
+                hosts[host] = int(slots)
+            else:
+                hosts[line] = 1
+        return hosts
+
+
+class FixedHosts(HostDiscovery):
+    def __init__(self, hosts: List[HostInfo]) -> None:
+        self._hosts = {h.hostname: h.slots for h in hosts}
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self._hosts)
+
+
+class HostManager:
+    """Tracks current/blacklisted hosts and computes ordered assignments
+    with rank stability (reference: ``HostManager`` + the driver's
+    stable-rank assignment, ``elastic/driver.py:233-275``)."""
+
+    def __init__(self, discovery: HostDiscovery) -> None:
+        self._discovery = discovery
+        self._lock = threading.Lock()
+        self._current: Dict[str, int] = {}
+        self._blacklist: Set[str] = set()
+        self._order: List[str] = []   # stable ordering of known hosts
+
+    def blacklist(self, host: str) -> None:
+        with self._lock:
+            self._blacklist.add(host)
+
+    def is_blacklisted(self, host: str) -> bool:
+        with self._lock:
+            return host in self._blacklist
+
+    def update_available_hosts(self) -> bool:
+        """Refresh; True if the usable host set changed (reference:
+        discovery thread, ``driver.py:181-201``)."""
+        found = self._discovery.find_available_hosts_and_slots()
+        with self._lock:
+            usable = {h: s for h, s in found.items()
+                      if h not in self._blacklist}
+            changed = usable != self._current
+            self._current = usable
+            # stable order: keep existing positions, append new hosts
+            self._order = [h for h in self._order if h in usable] + \
+                [h for h in usable if h not in self._order]
+            return changed
+
+    def current_hosts(self) -> List[HostInfo]:
+        with self._lock:
+            return [HostInfo(h, self._current[h]) for h in self._order]
+
+    def slot_count(self) -> int:
+        with self._lock:
+            return sum(self._current.values())

@@ -1,0 +1,176 @@
+"""Elastic driver: discovery-driven launch/relaunch with blacklist and
+rank-stable assignments.
+
+Reference: ``horovod/runner/elastic/driver.py`` (``ElasticDriver``: discovery
+thread :181-201, stable rank assignment :233-275, worker spawn per slot
+:277-295, blacklist + exit handling :297-313).
+
+TPU-native design difference: the reference hot-resyncs surviving worker
+processes (NCCL communicators can be rebuilt in place). On TPU the XLA
+runtime and meshes must be re-created on world change anyway, so elasticity
+is **process-restart based**: on membership change or worker failure the
+driver terminates the generation, recomputes assignments (stable ranks,
+failed hosts blacklisted), and relaunches; workers resume from their last
+committed :class:`horovod_tpu.elastic.State` checkpoint (epoch passed via
+``HVD_ELASTIC_EPOCH``/``HVD_ELASTIC_CKPT``).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from horovod_tpu.common.logging import get_logger
+from horovod_tpu.runner.elastic.discovery import HostDiscovery, HostManager
+from horovod_tpu.runner.elastic.registration import (FAILURE, SUCCESS,
+                                                     TERMINATED,
+                                                     WorkerStateRegistry)
+from horovod_tpu.runner.exec_run import (free_port, slot_command)
+from horovod_tpu.runner.hosts import get_host_assignments
+from horovod_tpu.runner.safe_exec import safe_execute
+
+DISCOVERY_INTERVAL_S = 1.0
+
+
+class ElasticDriver:
+    def __init__(self, discovery: HostDiscovery, command: List[str],
+                 min_np: int = 1, max_np: Optional[int] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 reset_limit: Optional[int] = None,
+                 verbose: bool = False,
+                 ckpt_dir: Optional[str] = None) -> None:
+        self._hosts = HostManager(discovery)
+        self._command = command
+        self._min_np = min_np
+        self._max_np = max_np
+        self._env = dict(env if env is not None else os.environ)
+        self._registry = WorkerStateRegistry(reset_limit)
+        self._verbose = verbose
+        self._ckpt_dir = ckpt_dir or tempfile.mkdtemp(prefix="hvd_elastic_")
+        self._stop = threading.Event()
+        self._hosts_changed = threading.Event()
+        self._generation = 0
+
+    # -- discovery thread (reference: driver.py:181-201) --------------------
+    def _discovery_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self._hosts.update_available_hosts():
+                    self._hosts_changed.set()
+            except Exception as e:  # discovery script hiccup: keep going
+                get_logger().warning("host discovery failed: %s", e)
+            time.sleep(DISCOVERY_INTERVAL_S)
+
+    def _wait_for_min_hosts(self, timeout: float = 600.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            self._hosts.update_available_hosts()
+            if self._hosts.slot_count() >= self._min_np:
+                return
+            time.sleep(DISCOVERY_INTERVAL_S)
+        raise TimeoutError(
+            f"needed {self._min_np} slots, found {self._hosts.slot_count()}")
+
+    # -- one generation ------------------------------------------------------
+    def _run_generation(self) -> str:
+        """Launch workers for the current host set; returns SUCCESS /
+        FAILURE / 'HOSTS_CHANGED'."""
+        hosts = self._hosts.current_hosts()
+        np = min(self._max_np or self._hosts.slot_count(),
+                 self._hosts.slot_count())
+        slots = get_host_assignments(hosts, np)
+        coord_port = free_port()
+        coord_addr = "127.0.0.1" if slots[0].hostname in (
+            "localhost", "127.0.0.1") else slots[0].hostname
+        self._registry.reset(np)
+        self._hosts_changed.clear()
+        gen = self._generation
+        self._generation += 1
+        get_logger().info("elastic generation %d: np=%d hosts=%s", gen, np,
+                          [h.hostname for h in hosts])
+
+        failure = threading.Event()
+        outcome = {"result": SUCCESS}
+
+        fail_lock = threading.Lock()
+
+        def run_slot(slot):
+            # local-vs-ssh dispatch shared with the static launcher so
+            # multi-host elastic jobs actually place workers remotely
+            cmd, env = slot_command(
+                slot, self._command, coord_addr, coord_port, self._env,
+                extra_env={"HVD_TPU_ELASTIC": "1",
+                           "HVD_ELASTIC_GENERATION": str(gen),
+                           "HVD_ELASTIC_CKPT": self._ckpt_dir})
+            prefix = f"[{slot.rank}]" if self._verbose else ""
+            rc = safe_execute(cmd, env=env, prefix=prefix,
+                              events=[failure, self._hosts_changed])
+            if rc == 0:
+                self._registry.record(slot.rank, slot.hostname, SUCCESS)
+                return
+            # distinguish the originating failure from workers the driver
+            # tore down because of it (those must not poison the blacklist)
+            with fail_lock:
+                torn_down = failure.is_set() or self._hosts_changed.is_set()
+                failure.set()
+            self._registry.record(slot.rank, slot.hostname,
+                                  TERMINATED if torn_down else FAILURE)
+
+        threads = [threading.Thread(target=run_slot, args=(s,), daemon=True)
+                   for s in slots]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        if self._registry.count(SUCCESS) == np:
+            return SUCCESS
+        if self._hosts_changed.is_set() and \
+                self._registry.count(FAILURE) == 0:
+            return "HOSTS_CHANGED"
+        if self._registry.count(FAILURE) > 0:
+            for host, n in self._registry.failed_hosts().items():
+                # a host whose every worker failed is blacklisted
+                # (reference: driver blacklist, driver.py:297-313)
+                host_slots = sum(1 for s in slots if s.hostname == host)
+                if n >= host_slots:
+                    self._hosts.blacklist(host)
+            return FAILURE
+        return SUCCESS
+
+    # -- main loop -----------------------------------------------------------
+    def run(self) -> int:
+        self._wait_for_min_hosts()
+        disc = threading.Thread(target=self._discovery_loop, daemon=True)
+        disc.start()
+        try:
+            while True:
+                result = self._run_generation()
+                if result == SUCCESS:
+                    return 0
+                if self._registry.reset_limit_reached():
+                    get_logger().error(
+                        "elastic reset limit reached after %d generations",
+                        self._registry.reset_count)
+                    return 1
+                # wait until we have enough usable slots again
+                try:
+                    self._wait_for_min_hosts()
+                except TimeoutError:
+                    return 1
+        finally:
+            self._stop.set()
+            disc.join(timeout=3)
+
+
+def run_elastic(discovery: HostDiscovery, np: int, command: List[str],
+                min_np: int = 1, max_np: Optional[int] = None,
+                env: Optional[Dict[str, str]] = None,
+                verbose: bool = False,
+                reset_limit: Optional[int] = None) -> int:
+    driver = ElasticDriver(discovery, command, min_np=min_np, max_np=max_np,
+                           env=env, verbose=verbose, reset_limit=reset_limit)
+    return driver.run()

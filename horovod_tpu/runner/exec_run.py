@@ -1,0 +1,111 @@
+"""Static job execution: one worker process per slot, local or over ssh.
+
+Reference: ``horovod/runner/gloo_run.py`` — rendezvous server on the driver,
+slot env injection (:65-76), threaded ssh/local execs (:114-186, 226-271).
+The TCP core's coordinator (rank 0) plays the Gloo rendezvous role, so the
+driver only needs to pick a free port and point every worker at rank 0's
+host.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import socket
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.runner.hosts import HostInfo, SlotInfo, get_host_assignments
+from horovod_tpu.runner.safe_exec import safe_execute
+
+SSH_COMMAND_PREFIX = ["ssh", "-o", "StrictHostKeyChecking=no",
+                      "-o", "BatchMode=yes"]
+
+_LOCAL_NAMES = {"localhost", "127.0.0.1", socket.gethostname()}
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("0.0.0.0", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _is_local(host: str) -> bool:
+    return host in _LOCAL_NAMES
+
+
+def build_worker_env(slot: SlotInfo, coord_addr: str, coord_port: int,
+                     base_env: Optional[Dict[str, str]] = None
+                     ) -> Dict[str, str]:
+    env = dict(base_env if base_env is not None else os.environ)
+    env.update(slot.to_env())
+    env["HVD_TPU_COORD_ADDR"] = coord_addr
+    env["HVD_TPU_COORD_PORT"] = str(coord_port)
+    # reference also exports HOROVOD_GLOO_RENDEZVOUS_* (gloo_run.py:187-198)
+    env["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = coord_addr
+    env["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(coord_port)
+    return env
+
+
+def slot_command(slot: SlotInfo, command: List[str], coord_addr: str,
+                 coord_port: int, env: Optional[Dict[str, str]] = None,
+                 extra_env: Optional[Dict[str, str]] = None
+                 ) -> Tuple[List[str], Dict[str, str]]:
+    """Build the (argv, env) to execute for one slot: direct exec locally,
+    ssh with a fully shell-quoted remote line otherwise (reference:
+    ``get_remote_command``, ``gloo_run.py:114-132``)."""
+    wenv = build_worker_env(slot, coord_addr, coord_port, env)
+    if extra_env:
+        wenv.update(extra_env)
+    if _is_local(slot.hostname):
+        return command, wenv
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in wenv.items()
+        if k.startswith(("HOROVOD_", "HVD_TPU_", "HVD_ELASTIC_", "PATH",
+                         "PYTHONPATH")))
+    remote = (f"cd {shlex.quote(os.getcwd())} && env {exports} "
+              + " ".join(shlex.quote(c) for c in command))
+    return SSH_COMMAND_PREFIX + [slot.hostname, remote], dict(os.environ)
+
+
+def launch_static(hosts: List[HostInfo], np: int, command: List[str],
+                  env: Optional[Dict[str, str]] = None,
+                  coord_addr: Optional[str] = None,
+                  coord_port: Optional[int] = None,
+                  verbose: bool = False) -> int:
+    """Run ``command`` on every slot; return first nonzero exit code (or 0).
+
+    Reference: ``launch_gloo`` (``gloo_run.py:226``): assignment → env →
+    per-slot exec threads; any failure terminates the rest.
+    """
+    slots = get_host_assignments(hosts, np)
+    coord_addr = coord_addr or (
+        "127.0.0.1" if _is_local(slots[0].hostname) else slots[0].hostname)
+    coord_port = coord_port or free_port()
+
+    results: List[Optional[int]] = [None] * np
+    failure = threading.Event()
+
+    def run_slot(idx: int, slot: SlotInfo) -> None:
+        cmd, run_env = slot_command(slot, command, coord_addr, coord_port,
+                                    env)
+        prefix = f"[{slot.rank}]<stdout/err> " if verbose else ""
+        rc = safe_execute(cmd, env=run_env, prefix=prefix,
+                          events=[failure])
+        results[idx] = rc
+        if rc != 0:
+            failure.set()
+
+    threads = [threading.Thread(target=run_slot, args=(i, s), daemon=True)
+               for i, s in enumerate(slots)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for rc in results:
+        if rc:
+            return rc
+    return 0

@@ -1,0 +1,110 @@
+"""HTTP key-value store + rendezvous server and client.
+
+Reference: ``horovod/runner/http/http_server.py:35-175`` (``KVStoreHandler``
+GET/PUT by scope/key; ``RendezvousHandler`` adds slot-info GET and DELETE
+finalization) and ``http/http_client.py``. Used by the launcher for run-func
+result collection and by the elastic driver for re-rendezvous.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+from urllib.request import Request, urlopen
+from urllib.error import HTTPError
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # silence
+        pass
+
+    def _split(self) -> Tuple[str, str]:
+        parts = self.path.strip("/").split("/", 1)
+        scope = parts[0] if parts else ""
+        key = parts[1] if len(parts) > 1 else ""
+        return scope, key
+
+    def do_GET(self):
+        scope, key = self._split()
+        with self.server.kv_lock:
+            val = self.server.kv.get(scope, {}).get(key)
+        if val is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(val)))
+        self.end_headers()
+        self.wfile.write(val)
+
+    def do_PUT(self):
+        scope, key = self._split()
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        with self.server.kv_lock:
+            self.server.kv.setdefault(scope, {})[key] = body
+        self.send_response(200)
+        self.end_headers()
+
+    def do_DELETE(self):
+        scope, _ = self._split()
+        with self.server.kv_lock:
+            self.server.kv.pop(scope, None)
+        self.send_response(200)
+        self.end_headers()
+
+
+class KVStoreServer:
+    """Threaded KV server (reference: ``RendezvousServer.start``,
+    ``http_server.py:152``)."""
+
+    def __init__(self, port: int = 0) -> None:
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
+        self._httpd.kv = {}
+        self._httpd.kv_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # direct access for in-process use
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        with self._httpd.kv_lock:
+            self._httpd.kv.setdefault(scope, {})[key] = value
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        with self._httpd.kv_lock:
+            return self._httpd.kv.get(scope, {}).get(key)
+
+    def scope(self, scope: str) -> Dict[str, bytes]:
+        with self._httpd.kv_lock:
+            return dict(self._httpd.kv.get(scope, {}))
+
+
+def kv_put(addr: str, port: int, scope: str, key: str, value: bytes) -> None:
+    req = Request(f"http://{addr}:{port}/{scope}/{key}", data=value,
+                  method="PUT")
+    urlopen(req, timeout=30).read()
+
+
+def kv_get(addr: str, port: int, scope: str, key: str) -> Optional[bytes]:
+    try:
+        return urlopen(f"http://{addr}:{port}/{scope}/{key}",
+                       timeout=30).read()
+    except HTTPError as e:
+        if e.code == 404:
+            return None
+        raise

@@ -1,0 +1,122 @@
+"""``hvdrun`` — the launcher CLI.
+
+Reference: ``horovod/runner/launch.py`` (``run_commandline`` at :763,
+``_run`` at :736 dispatching static vs elastic, ``parse_args`` with the full
+env-knob mirror via ``config_parser``). TPU-native differences: one worker
+process per HOST (driving all local chips) instead of per accelerator; the
+controller is the native TCP core (no mpirun/jsrun variants).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List
+
+from horovod_tpu.runner.hosts import (HostInfo, parse_hostfile, parse_hosts)
+from horovod_tpu.runner.exec_run import launch_static
+from horovod_tpu.version import __version__
+
+
+def parse_args(argv: List[str]) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu distributed job "
+                    "(Horovod-class launcher for TPU hosts)")
+    p.add_argument("--version", action="version", version=__version__)
+    p.add_argument("-np", "--num-proc", type=int, default=None,
+                   help="number of worker processes (TPU hosts)")
+    p.add_argument("-H", "--hosts", default=None,
+                   help='host list "h1:slots,h2:slots"')
+    p.add_argument("--hostfile", default=None,
+                   help="hostfile with lines 'host slots=N'")
+    p.add_argument("--verbose", action="store_true")
+    # elastic (reference: --min-np/--max-np/--host-discovery-script)
+    p.add_argument("--min-np", type=int, default=None)
+    p.add_argument("--max-np", type=int, default=None)
+    p.add_argument("--host-discovery-script", default=None,
+                   help="script printing 'host:slots' lines; enables "
+                        "elastic mode")
+    # knobs mirrored to env (reference: config_parser.py)
+    p.add_argument("--fusion-threshold-mb", type=float, default=None)
+    p.add_argument("--cycle-time-ms", type=float, default=None)
+    p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--autotune", action="store_true")
+    p.add_argument("--stall-timeout-seconds", type=float, default=None)
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="program and args to run on every worker")
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no command given")
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    return args
+
+
+def knobs_to_env(args: argparse.Namespace) -> Dict[str, str]:
+    """CLI knob → env mirror (reference: ``config_parser.set_env_from_args``)."""
+    env: Dict[str, str] = {}
+    if args.fusion_threshold_mb is not None:
+        env["HOROVOD_FUSION_THRESHOLD"] = str(
+            int(args.fusion_threshold_mb * 1024 * 1024))
+    if args.cycle_time_ms is not None:
+        env["HOROVOD_CYCLE_TIME"] = str(args.cycle_time_ms)
+    if args.cache_capacity is not None:
+        env["HOROVOD_CACHE_CAPACITY"] = str(args.cache_capacity)
+    if args.timeline_filename:
+        env["HOROVOD_TIMELINE"] = args.timeline_filename
+    if args.autotune:
+        env["HOROVOD_AUTOTUNE"] = "1"
+    if args.stall_timeout_seconds is not None:
+        env["HOROVOD_STALL_CHECK_TIME_SECONDS"] = str(
+            args.stall_timeout_seconds)
+    return env
+
+
+def resolve_hosts(args: argparse.Namespace) -> List[HostInfo]:
+    if args.hosts and args.hostfile:
+        raise ValueError("Specify either --hosts or --hostfile, not both")
+    if args.hostfile:
+        return parse_hostfile(args.hostfile)
+    if args.hosts:
+        return parse_hosts(args.hosts)
+    np = args.num_proc or 1
+    return [HostInfo("localhost", np)]
+
+
+def run_commandline(argv: List[str] = None) -> int:
+    """Reference: ``run_commandline`` (``launch.py:763``)."""
+    args = parse_args(argv if argv is not None else sys.argv[1:])
+    env = dict(os.environ)
+    env.update(knobs_to_env(args))
+
+    elastic = args.host_discovery_script is not None or \
+        args.min_np is not None
+    if elastic:
+        from horovod_tpu.runner.elastic.driver import run_elastic
+        from horovod_tpu.runner.elastic.discovery import (
+            FixedHosts, HostDiscoveryScript)
+        if args.host_discovery_script:
+            discovery = HostDiscoveryScript(args.host_discovery_script)
+        else:
+            discovery = FixedHosts(resolve_hosts(args))
+        np = args.num_proc or args.min_np or 1
+        return run_elastic(discovery, np, args.command,
+                           min_np=args.min_np or 1,
+                           max_np=args.max_np,
+                           env=env, verbose=args.verbose)
+
+    hosts = resolve_hosts(args)
+    np = args.num_proc or sum(h.slots for h in hosts)
+    return launch_static(hosts, np, args.command, env=env,
+                         verbose=args.verbose)
+
+
+def main() -> None:
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Process execution with group cleanup and output pumping.
+
+Reference: ``horovod/runner/common/util/safe_shell_exec.py`` — spawn in a new
+process group, pump stdout/stderr with threads, kill the whole group on
+termination so stray grandchildren don't leak.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+GRACEFUL_TERMINATION_TIME_S = 5
+
+
+def _pump(stream, out, prefix: str = "") -> None:
+    for line in iter(stream.readline, b""):
+        try:
+            text = line.decode(errors="replace")
+            out.write(prefix + text)
+            out.flush()
+        except ValueError:
+            break
+    stream.close()
+
+
+def safe_execute(command: List[str], env: Optional[Dict[str, str]] = None,
+                 stdout=None, stderr=None, prefix: str = "",
+                 events: Optional[List[threading.Event]] = None) -> int:
+    """Run command; if any event fires, terminate the process group
+    (reference: ``safe_shell_exec.execute``)."""
+    stdout = stdout or sys.stdout
+    stderr = stderr or sys.stderr
+    proc = subprocess.Popen(
+        command, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        preexec_fn=os.setsid)
+    pumps = [
+        threading.Thread(target=_pump, args=(proc.stdout, stdout, prefix),
+                         daemon=True),
+        threading.Thread(target=_pump, args=(proc.stderr, stderr, prefix),
+                         daemon=True),
+    ]
+    for t in pumps:
+        t.start()
+
+    stop = threading.Event()
+
+    def watch_events() -> None:
+        while not stop.is_set():
+            for ev in events or []:
+                if ev.is_set():
+                    terminate_process_group(proc)
+                    return
+            time.sleep(0.1)
+
+    watcher = None
+    if events:
+        watcher = threading.Thread(target=watch_events, daemon=True)
+        watcher.start()
+
+    rc = proc.wait()
+    stop.set()
+    for t in pumps:
+        t.join(timeout=2)
+    if watcher:
+        watcher.join(timeout=1)
+    return rc
+
+
+def terminate_process_group(proc: subprocess.Popen) -> None:
+    """SIGTERM the group, escalate to SIGKILL (reference:
+    ``safe_shell_exec`` graceful termination)."""
+    try:
+        pgid = os.getpgid(proc.pid)
+    except ProcessLookupError:
+        return
+    try:
+        os.killpg(pgid, signal.SIGTERM)
+    except ProcessLookupError:
+        return
+    deadline = time.time() + GRACEFUL_TERMINATION_TIME_S
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            return
+        time.sleep(0.1)
+    try:
+        os.killpg(pgid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass

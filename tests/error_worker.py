@@ -1,0 +1,42 @@
+"""Worker exercising the core's error paths: shape mismatch across ranks and
+duplicate in-flight names (reference analog: error cases in
+test/parallel/test_torch.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np  # noqa: E402
+from horovod_tpu.core.core_backend import CoreBackend  # noqa: E402
+from horovod_tpu.ops.reduce_op import ReduceOp  # noqa: E402
+
+
+def main():
+    be = CoreBackend()
+    rank = be.rank
+    # 1) mismatched shapes must produce a clean error on every rank
+    x = np.ones(5 if rank == 0 else 10, np.float32)
+    try:
+        be.allreduce_async("mismatch", x, ReduceOp.SUM).wait(30)
+        raise SystemExit(f"rank {rank}: mismatch did NOT error")
+    except RuntimeError as e:
+        assert "mismatched" in str(e), e
+    # 2) duplicate name while in flight → immediate DUPLICATE error;
+    #    serialize ranks so the negotiation can't complete the first one
+    h1 = be.allreduce_async("dup", np.ones(4, np.float32), ReduceOp.SUM)
+    try:
+        be.allreduce_async("dup", np.ones(4, np.float32), ReduceOp.SUM).wait(5)
+        raise SystemExit(f"rank {rank}: duplicate did NOT error")
+    except RuntimeError as e:
+        assert "duplicate" in str(e).lower(), e
+    out = h1.wait(30)
+    np.testing.assert_allclose(out, 2.0)
+    # 3) normal op still works after the errors
+    out = be.allreduce_async("after", np.ones(3, np.float32),
+                             ReduceOp.SUM).wait(30)
+    np.testing.assert_allclose(out, 2.0)
+    be.shutdown()
+    print(f"error worker {rank}: OK")
+
+
+if __name__ == "__main__":
+    main()

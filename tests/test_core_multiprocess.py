@@ -13,6 +13,7 @@ from horovod_tpu.core import core_available
 
 WORKER = os.path.join(os.path.dirname(__file__), "core_worker.py")
 HVD_WORKER = os.path.join(os.path.dirname(__file__), "hvd_worker.py")
+ERROR_WORKER = os.path.join(os.path.dirname(__file__), "error_worker.py")
 
 
 def _free_port():
@@ -87,3 +88,10 @@ def test_core_with_timeline(tmp_path):
 def test_hvd_full_stack(size):
     """Public hvd API over the core with jax-cpu arrays."""
     _launch(size, timeout=240, worker=HVD_WORKER)
+
+
+@needs_core
+def test_core_error_paths():
+    """Shape mismatch and duplicate in-flight names produce clean errors and
+    the core keeps working afterwards."""
+    _launch(2, timeout=120, worker=ERROR_WORKER)

@@ -1,0 +1,157 @@
+"""Elastic tests: driver logic with mocked discovery (reference analog:
+test/single/test_elastic_driver.py — simulated host add/remove, rank
+stability, blacklisting) and a real fake-cluster integration run on
+localhost (reference analog: test/integration/elastic_common.py:34-118 —
+a discovery script whose output changes over time + scripted failures)."""
+
+import os
+import stat
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from horovod_tpu.core import core_available
+from horovod_tpu.runner.elastic.discovery import (FixedHosts, HostDiscovery,
+                                                  HostManager)
+from horovod_tpu.runner.elastic.registration import (FAILURE, SUCCESS,
+                                                     WorkerStateRegistry)
+from horovod_tpu.runner.hosts import HostInfo
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class MockDiscovery(HostDiscovery):
+    """Scripted sequence of host sets (reference analog: the elastic tests'
+    fake discovery)."""
+
+    def __init__(self, sequences):
+        self._sequences = sequences
+        self._idx = 0
+
+    def find_available_hosts_and_slots(self):
+        seq = self._sequences[min(self._idx, len(self._sequences) - 1)]
+        self._idx += 1
+        return dict(seq)
+
+
+def test_host_manager_change_detection():
+    disc = MockDiscovery([{"a": 2}, {"a": 2}, {"a": 2, "b": 2}, {"b": 2}])
+    hm = HostManager(disc)
+    assert hm.update_available_hosts() is True       # initial
+    assert hm.update_available_hosts() is False      # no change
+    assert hm.update_available_hosts() is True       # b added
+    # rank stability: 'a' keeps its position while it exists
+    assert [h.hostname for h in hm.current_hosts()] == ["a", "b"]
+    assert hm.update_available_hosts() is True       # a removed
+    assert [h.hostname for h in hm.current_hosts()] == ["b"]
+
+
+def test_host_manager_blacklist():
+    disc = MockDiscovery([{"a": 2, "b": 2}])
+    hm = HostManager(disc)
+    hm.blacklist("b")
+    hm.update_available_hosts()
+    assert [h.hostname for h in hm.current_hosts()] == ["a"]
+    assert hm.slot_count() == 2
+
+
+def test_worker_state_registry():
+    reg = WorkerStateRegistry(reset_limit=2)
+    reg.reset(2)
+    reg.record(0, "a", SUCCESS)
+    reg.record(1, "b", FAILURE)
+    assert reg.count(SUCCESS) == 1
+    assert reg.count(FAILURE) == 1
+    assert reg.failed_hosts() == {"b": 1}
+    assert not reg.reset_limit_reached()
+    reg.reset(2)
+    reg.reset(2)
+    assert reg.reset_limit_reached()
+
+
+def test_object_state_commit_restore(hvd, tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_ELASTIC_CKPT", str(tmp_path))
+    from horovod_tpu.elastic import ObjectState
+    st = ObjectState(name="t1", epoch=0, w=[1.0, 2.0])
+    st.epoch = 5
+    st.w = [9.0, 9.0]
+    st.commit()
+    st.epoch = 7
+    st.restore()
+    assert st.epoch == 5 and st.w == [9.0, 9.0]
+    # a fresh process (new State object) resumes from the committed file
+    st2 = ObjectState(name="t1", epoch=0, w=[0.0])
+    assert st2.epoch == 5 and st2.w == [9.0, 9.0]
+
+
+def test_elastic_run_decorator_retries(hvd, tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_ELASTIC_CKPT", str(tmp_path))
+    from horovod_tpu import elastic
+
+    state = elastic.ObjectState(name="t2", count=0)
+    attempts = []
+
+    @elastic.run
+    def train(state):
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise elastic.HorovodInternalError("simulated collective fail")
+        return state.count
+
+    assert train(state) == 0
+    assert len(attempts) == 3
+
+
+needs_core = pytest.mark.skipif(not core_available(),
+                                reason="libhvdcore.so not built")
+
+
+@needs_core
+def test_elastic_integration_fake_cluster(tmp_path):
+    """Real elastic run on localhost: the discovery script's output changes
+    with an epoch file, worker of generation 0 fails once, generation 1
+    succeeds resuming from committed state (reference analog:
+    test/integration/elastic_common.py scripted discovery + exit)."""
+    epoch_file = tmp_path / "epoch"
+    epoch_file.write_text("0")
+    disco = tmp_path / "discover.sh"
+    disco.write_text("#!/bin/bash\necho localhost:2\n")
+    disco.chmod(disco.stat().st_mode | stat.S_IEXEC)
+
+    prog = tmp_path / "train.py"
+    prog.write_text(textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {REPO!r})
+        import numpy as np
+        from horovod_tpu.core.core_backend import CoreBackend
+        from horovod_tpu.ops.reduce_op import ReduceOp
+        from horovod_tpu import elastic
+
+        be = CoreBackend()
+        state = elastic.ObjectState(name="itg", step=0)
+        gen = int(os.environ.get("HVD_ELASTIC_GENERATION", 0))
+        # first generation: rank 1 crashes at step 2 after committing step 1
+        for step in range(state.step, 5):
+            out = be.allreduce_async(f"s{{step}}",
+                                     np.ones(4, np.float32),
+                                     ReduceOp.SUM).wait(30)
+            state.step = step + 1
+            state.save()
+            if gen == 0 and be.rank == 1 and step == 1:
+                os._exit(17)
+        print(f"rank {{be.rank}} gen {{gen}} finished at step "
+              f"{{state.step}}", flush=True)
+        assert state.step == 5
+        be.shutdown()
+    """))
+
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.elastic.discovery import HostDiscoveryScript
+    driver = ElasticDriver(
+        HostDiscoveryScript(str(disco)), [sys.executable, str(prog)],
+        min_np=2, max_np=2, reset_limit=3, ckpt_dir=str(tmp_path))
+    rc = driver.run()
+    assert rc == 0

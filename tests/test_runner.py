@@ -1,0 +1,134 @@
+"""Launcher unit tests (reference analog: test/single/test_run.py — arg
+parsing, host parsing, slot assignment) plus real localhost integration runs
+(reference analog: test/integration/test_static_run.py)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from horovod_tpu.core import core_available
+from horovod_tpu.runner.hosts import (HostInfo, get_host_assignments,
+                                      parse_hostfile, parse_hosts)
+from horovod_tpu.runner.launch import knobs_to_env, parse_args, resolve_hosts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- parsing ----------------------------------------------------------------
+
+def test_parse_hosts():
+    hosts = parse_hosts("h1:4,h2:2,h3")
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("h1", 4), ("h2", 2), ("h3", 1)]
+
+
+def test_parse_hostfile(tmp_path):
+    p = tmp_path / "hostfile"
+    p.write_text("# comment\nh1 slots=4\nh2 slots=2\nh3\n")
+    hosts = parse_hostfile(str(p))
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("h1", 4), ("h2", 2), ("h3", 1)]
+
+
+def test_slot_assignment():
+    slots = get_host_assignments(parse_hosts("a:2,b:2"), 4)
+    assert [s.rank for s in slots] == [0, 1, 2, 3]
+    assert [s.hostname for s in slots] == ["a", "a", "b", "b"]
+    assert [s.local_rank for s in slots] == [0, 1, 0, 1]
+    assert [s.cross_rank for s in slots] == [0, 0, 1, 1]
+    assert all(s.size == 4 for s in slots)
+    assert all(s.local_size == 2 for s in slots)
+    env = slots[2].to_env()
+    assert env["HOROVOD_RANK"] == "2"
+    assert env["HOROVOD_CROSS_RANK"] == "1"
+
+
+def test_slot_assignment_too_few():
+    with pytest.raises(ValueError):
+        get_host_assignments(parse_hosts("a:1"), 2)
+
+
+def test_parse_args_and_knobs():
+    args = parse_args(["-np", "4", "-H", "localhost:4", "--autotune",
+                       "--fusion-threshold-mb", "32",
+                       "--cycle-time-ms", "2.5",
+                       "python", "train.py", "--lr", "0.1"])
+    assert args.num_proc == 4
+    assert args.command == ["python", "train.py", "--lr", "0.1"]
+    env = knobs_to_env(args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HOROVOD_CYCLE_TIME"] == "2.5"
+    assert env["HOROVOD_AUTOTUNE"] == "1"
+    assert [(h.hostname, h.slots) for h in resolve_hosts(args)] == [
+        ("localhost", 4)]
+
+
+def test_parse_args_requires_command(capsys):
+    with pytest.raises(SystemExit):
+        parse_args(["-np", "2"])
+
+
+# -- integration: real hvdrun on localhost ----------------------------------
+
+needs_core = pytest.mark.skipif(not core_available(),
+                                reason="libhvdcore.so not built")
+
+WORKER_PROG = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, %r)
+    import numpy as np
+    from horovod_tpu.core.core_backend import CoreBackend
+    from horovod_tpu.ops.reduce_op import ReduceOp
+    be = CoreBackend()
+    out = be.allreduce_async("t", np.ones(4, np.float32),
+                             ReduceOp.SUM).wait(30)
+    assert float(out[0]) == be.size, out
+    print(f"rank {be.rank}/{be.size} ok")
+    be.shutdown()
+""" % REPO)
+
+
+@needs_core
+def test_hvdrun_static_localhost(tmp_path):
+    prog = tmp_path / "worker.py"
+    prog.write_text(WORKER_PROG)
+    rc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         "-H", "localhost:2", sys.executable, str(prog)],
+        cwd=REPO, capture_output=True, timeout=120)
+    assert rc.returncode == 0, rc.stdout.decode() + rc.stderr.decode()
+
+
+@needs_core
+def test_hvdrun_propagates_failure(tmp_path):
+    prog = tmp_path / "worker.py"
+    prog.write_text("import sys; sys.exit(3)")
+    rc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         sys.executable, str(prog)],
+        cwd=REPO, capture_output=True, timeout=60)
+    assert rc.returncode != 0
+
+
+def test_interactive_run():
+    if not core_available():
+        pytest.skip("libhvdcore.so not built")
+    code = textwrap.dedent("""
+        import sys
+        sys.path.insert(0, %r)
+        from horovod_tpu.runner import run
+
+        def work(x):
+            import horovod_tpu as hvd
+            return hvd.rank() * 10 + x
+
+        print(run(work, args=(7,), np=2))
+    """ % REPO)
+    rc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                        timeout=120, cwd=REPO)
+    assert rc.returncode == 0, rc.stdout.decode() + rc.stderr.decode()
+    assert "[7, 17]" in rc.stdout.decode()
