@@ -584,10 +584,13 @@ void Core::HandleRequests(CoordDomain& d, int from_rank,
       if (!mismatch && r.type == Request::kAllreduce &&
           first.shape != r.shape)
         mismatch = true;
-      if (!mismatch && r.type != Request::kAllreduce &&
-          first.shape.size() == r.shape.size() && !r.shape.empty()) {
-        for (size_t k = 1; k < r.shape.size(); ++k)
-          if (first.shape[k] != r.shape[k]) mismatch = true;
+      if (!mismatch && r.type != Request::kAllreduce) {
+        if (first.shape.size() != r.shape.size()) {
+          mismatch = true;  // ndim must agree even when dim 0 is ragged
+        } else {
+          for (size_t k = 1; k < r.shape.size(); ++k)
+            if (first.shape[k] != r.shape[k]) mismatch = true;
+        }
       }
       if (mismatch)
         d.error_table_[r.name] =

@@ -1,0 +1,52 @@
+"""Elastic training with committed state (reference analog:
+``examples/elastic/pytorch/pytorch_mnist_elastic.py``).
+
+Run:  hvdrun --min-np 2 --host-discovery-script ./discover.sh \
+          python examples/jax/elastic_train.py
+where discover.sh prints lines like "localhost:2".
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+
+def main():
+    hvd.init()
+    params = hvd.broadcast_parameters(
+        {"w": jnp.zeros((32, 4))}, root_rank=0)
+    tx = hvd.DistributedOptimizer(optax.sgd(0.05))
+    state = elastic.TpuState(name="elastic_example", epoch=0,
+                             params=params, opt_state=tx.init(params))
+
+    rng = np.random.RandomState(hvd.rank())
+    W_true = np.random.RandomState(0).randn(32, 4).astype(np.float32)
+
+    @elastic.run
+    def train(state):
+        grad_fn = jax.jit(jax.value_and_grad(
+            lambda p, x, y: jnp.mean((x @ p["w"] - y) ** 2)))
+        for epoch in range(state.epoch, 20):
+            x = jnp.asarray(rng.randn(64, 32), jnp.float32)
+            y = x @ jnp.asarray(W_true)
+            loss, grads = grad_fn(state.params, x, y)
+            updates, state.opt_state = tx.update(grads, state.opt_state,
+                                                 state.params)
+            state.params = optax.apply_updates(state.params, updates)
+            state.epoch = epoch + 1
+            state.commit()  # survives worker loss / membership change
+            if hvd.rank() == 0:
+                print(f"epoch {epoch}: loss {float(loss):.5f}", flush=True)
+        return state.epoch
+
+    final = train(state)
+    print(f"rank {hvd.rank()}: finished at epoch {final}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -157,7 +157,11 @@ class CoreHandle(HvdHandle):
                 raise TimeoutError("collective did not complete in time")
             if rc != 0:
                 err = self._lib.hvd_last_error().decode()
-                self._set_error(RuntimeError(f"collective failed: {err}"))
+                # HorovodInternalError (a RuntimeError) so elastic.run's
+                # restore()-and-retry path actually triggers on peer failure
+                from horovod_tpu.elastic import HorovodInternalError
+                self._set_error(
+                    HorovodInternalError(f"collective failed: {err}"))
             else:
                 try:
                     self._set_result(self._finisher())

@@ -1,0 +1,5 @@
+from horovod_tpu.data.data_loader import (  # noqa: F401
+    AsyncDataLoaderMixin,
+    BaseDataLoader,
+    ShardedDataset,
+)

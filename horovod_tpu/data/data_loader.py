@@ -1,0 +1,112 @@
+"""Data loading helpers.
+
+Reference: ``horovod/data/data_loader_base.py`` (``BaseDataLoader`` and
+``AsyncDataLoaderMixin`` — a background-thread prefetch queue, :23-151).
+TPU additions: :class:`ShardedDataset` for per-worker sharding (the
+reference leaves sharding to torch's DistributedSampler) and device
+prefetch hooks (host→HBM transfer overlapped with compute).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterator, Optional, Sequence
+
+
+class BaseDataLoader:
+    """Iteration contract (reference: ``BaseDataLoader:23-60``)."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def _iterate(self) -> Iterator[Any]:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[Any]:
+        return self._iterate()
+
+
+class AsyncDataLoaderMixin:
+    """Background-thread prefetch (reference: ``AsyncDataLoaderMixin:63-151``).
+
+    Mix in FIRST: ``class MyAsyncLoader(AsyncDataLoaderMixin, MyLoader)``.
+    ``async_loader_queue_size=0`` disables prefetch (synchronous).
+    """
+
+    def __init__(self, *args: Any, async_loader_queue_size: int = 64,
+                 **kwargs: Any) -> None:
+        self._queue_size = async_loader_queue_size
+        self._q: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._closing = False
+        super().__init__(*args, **kwargs)
+
+    def close_async_loader(self) -> None:
+        """Reference: ``close_async_loader`` — drain and join."""
+        self._closing = True
+        if self._q is not None:
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _producer(self) -> None:
+        try:
+            for item in super()._iterate():
+                if self._closing:
+                    return
+                self._q.put(item)
+        finally:
+            self._q.put(None)
+
+    def __iter__(self) -> Iterator[Any]:
+        if self._queue_size <= 0:
+            yield from super()._iterate()
+            return
+        self._closing = False
+        self._q = queue.Queue(self._queue_size)
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+        while True:
+            item = self._q.get()
+            if item is None:
+                break
+            yield item
+
+
+class ShardedDataset(BaseDataLoader):
+    """Deterministic per-worker shard of an indexable dataset: worker r of n
+    sees items ``r, r+n, r+2n, ...`` after an epoch-seeded shuffle — the
+    sharding contract of torch's DistributedSampler that reference users
+    pair with hvd (``torch/elastic/sampler.py`` is its elastic variant)."""
+
+    def __init__(self, data: Sequence[Any], rank: int, size: int,
+                 shuffle: bool = True, seed: int = 0) -> None:
+        self._data = data
+        self._rank = rank
+        self._size = max(size, 1)
+        self._shuffle = shuffle
+        self._seed = seed
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reshuffle per epoch (reference: ``ElasticSampler.set_epoch``)."""
+        self._epoch = epoch
+
+    def __len__(self) -> int:
+        return len(self._data) // self._size
+
+    def _iterate(self) -> Iterator[Any]:
+        import numpy as np
+        idx = np.arange(len(self._data))
+        if self._shuffle:
+            rng = np.random.RandomState(self._seed + self._epoch)
+            rng.shuffle(idx)
+        n = len(self) * self._size  # drop remainder so all workers agree
+        for i in idx[self._rank:n:self._size]:
+            yield self._data[int(i)]

@@ -1,0 +1,172 @@
+"""BERT-Large — the second headline benchmark family
+(reference: BASELINE "BERT-Large pretraining (PyTorch DistributedOptimizer +
+fp16 compression)"; the reference has no model zoo — users bring torch/TF
+BERT and wrap its optimizer).
+
+TPU-native: a flax encoder in bf16 with fp32 layernorms, MLM + NSP heads,
+trained in GSPMD-auto mode — batch over data axes, optionally tensor-
+parallel via logical axis annotations (``nn.with_partitioning``) so heads /
+mlp shard over ``tp`` when the mesh has one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 1024          # BERT-Large
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: int = 4096
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dtype: Any = jnp.bfloat16
+
+
+def bert_large(dtype=jnp.bfloat16) -> "BertConfig":
+    return BertConfig(dtype=dtype)
+
+
+def bert_base(dtype=jnp.bfloat16) -> "BertConfig":
+    return BertConfig(hidden_size=768, num_layers=12, num_heads=12,
+                      intermediate_size=3072, dtype=dtype)
+
+
+class SelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        c = self.cfg
+        head_dim = c.hidden_size // c.num_heads
+        dense = lambda name: nn.DenseGeneral(
+            (c.num_heads, head_dim), dtype=c.dtype, name=name,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.normal(0.02), (None, "tp", None)))
+        q = dense("query")(x)
+        k = dense("key")(x)
+        v = dense("value")(x)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+            jnp.asarray(head_dim, c.dtype))
+        s = jnp.where(mask[:, None, None, :], s, -1e9)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(c.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        o = nn.DenseGeneral(c.hidden_size, axis=(-2, -1), dtype=c.dtype,
+                            name="out",
+                            kernel_init=nn.with_partitioning(
+                                nn.initializers.normal(0.02),
+                                ("tp", None, None)))(o)
+        return o
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        c = self.cfg
+        a = SelfAttention(c, name="attention")(x, mask)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_att")(x + a)
+        h = nn.Dense(c.intermediate_size, dtype=c.dtype, name="ffn_in",
+                     kernel_init=nn.with_partitioning(
+                         nn.initializers.normal(0.02), (None, "tp")))(x)
+        h = nn.gelu(h)
+        h = nn.Dense(c.hidden_size, dtype=c.dtype, name="ffn_out",
+                     kernel_init=nn.with_partitioning(
+                         nn.initializers.normal(0.02), ("tp", None)))(h)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_ffn")(x + h)
+        return x
+
+
+class Bert(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, attention_mask):
+        c = self.cfg
+        emb = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
+                       name="word_embeddings",
+                       embedding_init=nn.with_partitioning(
+                           nn.initializers.normal(0.02), ("tp", None)))
+        x = emb(input_ids)
+        pos = jnp.arange(input_ids.shape[1])[None]
+        x = x + nn.Embed(c.max_position, c.hidden_size, dtype=c.dtype,
+                         name="position_embeddings")(pos)
+        x = x + nn.Embed(c.type_vocab_size, c.hidden_size, dtype=c.dtype,
+                         name="token_type_embeddings")(token_type_ids)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(x)
+        for i in range(c.num_layers):
+            x = BertLayer(c, name=f"layer_{i}")(x, attention_mask)
+        # MLM head (tied to word embeddings) + NSP head on [CLS]
+        h = nn.Dense(c.hidden_size, dtype=c.dtype, name="mlm_transform")(x)
+        h = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(nn.gelu(h))
+        mlm_logits = emb.attend(h.astype(c.dtype)).astype(jnp.float32)
+        nsp_logits = nn.Dense(2, dtype=jnp.float32, name="nsp")(
+            x[:, 0].astype(jnp.float32))
+        return mlm_logits, nsp_logits
+
+
+def pretrain_loss(mlm_logits, nsp_logits, mlm_labels, mlm_mask, nsp_labels):
+    """Masked-LM + next-sentence loss (standard BERT pretraining)."""
+    v = mlm_logits.shape[-1]
+    mlm = optax.softmax_cross_entropy(
+        mlm_logits, jax.nn.one_hot(mlm_labels, v))
+    denom = jnp.maximum(jnp.sum(mlm_mask), 1.0)
+    mlm = jnp.sum(mlm * mlm_mask) / denom
+    nsp = optax.softmax_cross_entropy(
+        nsp_logits, jax.nn.one_hot(nsp_labels, 2)).mean()
+    return mlm + nsp
+
+
+def make_bert_train_step(model: Bert, optimizer, mesh: Mesh):
+    """GSPMD-auto pretraining step; flax partitioning metadata shards the
+    big matrices over ``tp`` while XLA handles dp gradient reduction."""
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        def loss_fn(p):
+            mlm_logits, nsp_logits = model.apply(
+                {"params": p}, batch["input_ids"], batch["token_type_ids"],
+                batch["attention_mask"])
+            return pretrain_loss(mlm_logits, nsp_logits,
+                                 batch["mlm_labels"], batch["mlm_mask"],
+                                 batch["nsp_labels"])
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def init_bert(model: Bert, rng_key, seq_len: int = 128, mesh: Mesh = None):
+    """Initialize; apply flax logical partitioning onto the mesh's tp axis
+    (replicated when tp is absent)."""
+    dummy = jnp.zeros((1, seq_len), jnp.int32)
+    variables = model.init(rng_key, dummy, dummy,
+                           jnp.ones((1, seq_len), bool))
+    params = variables["params"]
+    if mesh is not None:
+        import flax
+        tp_live = mesh.shape.get("tp", 1) > 1
+
+        def place(x):
+            if isinstance(x, nn.Partitioned):
+                spec = P(*x.names) if tp_live else P()
+                arr = jax.device_put(x.value, NamedSharding(mesh, spec))
+                return x.replace_boxed(arr)
+            return jax.device_put(x, NamedSharding(mesh, P()))
+        params = jax.tree_util.tree_map(
+            place, params,
+            is_leaf=lambda x: isinstance(x, nn.Partitioned))
+    return params

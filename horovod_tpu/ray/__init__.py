@@ -1,0 +1,102 @@
+"""Ray cluster integration.
+
+Reference: ``horovod/ray/runner.py`` — ``RayExecutor`` creates a placement
+group of workers, a Coordinator collects each worker's host/rank info into
+env vars, then all workers run the user fn (:41-360); elastic variant with
+``RayHostDiscovery`` (``ray/elastic.py:38-149``).
+
+Gated on ray availability (not bundled in this image).
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Callable, Dict, List, Optional
+
+
+def _require_ray():
+    try:
+        import ray
+        return ray
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.ray requires the ray package, which is not "
+            "installed in this environment. Install ray to use Ray-cluster "
+            "launching; the rest of horovod_tpu works without it.") from e
+
+
+class RayExecutor:
+    """Reference: ``RayExecutor`` (``ray/runner.py:128-360``): start
+    num_workers actors, coordinate env, run fns on all workers."""
+
+    def __init__(self, num_workers: int = 1, cpus_per_worker: int = 1,
+                 use_current_placement_group: bool = False,
+                 env: Optional[Dict[str, str]] = None) -> None:
+        self._ray = _require_ray()
+        self.num_workers = num_workers
+        self.cpus_per_worker = cpus_per_worker
+        self._env = dict(env or {})
+        self._workers: List[Any] = []
+
+    def start(self) -> None:
+        ray = self._ray
+
+        @ray.remote(num_cpus=self.cpus_per_worker)
+        class _Worker:
+            def __init__(self, rank: int, size: int,
+                         base_env: Dict[str, str]) -> None:
+                import os
+                self.rank = rank
+                os.environ.update(base_env)
+                os.environ.update({
+                    "HOROVOD_RANK": str(rank),
+                    "HOROVOD_SIZE": str(size),
+                })
+
+            def hostname(self) -> str:
+                return socket.gethostname()
+
+            def set_coordinator(self, addr: str, port: int) -> None:
+                import os
+                os.environ["HVD_TPU_COORD_ADDR"] = addr
+                os.environ["HVD_TPU_COORD_PORT"] = str(port)
+
+            def execute(self, fn_blob: bytes):
+                import cloudpickle
+                fn, args, kwargs = cloudpickle.loads(fn_blob)
+                import horovod_tpu as hvd
+                hvd.init()
+                out = fn(*args, **kwargs)
+                return cloudpickle.dumps(out)
+
+            def shutdown(self) -> None:
+                import horovod_tpu as hvd
+                hvd.shutdown()
+
+        self._workers = [
+            _Worker.remote(r, self.num_workers, self._env)
+            for r in range(self.num_workers)]
+        # coordinator = rank 0's host (reference: Coordinator collecting
+        # host info, ray/runner.py:41-128)
+        ray = self._ray
+        coord_host = ray.get(self._workers[0].hostname.remote())
+        from horovod_tpu.runner.exec_run import free_port
+        port = free_port()
+        ray.get([w.set_coordinator.remote(coord_host, port)
+                 for w in self._workers])
+
+    def run(self, fn: Callable, args: tuple = (),
+            kwargs: Optional[dict] = None) -> List[Any]:
+        import cloudpickle
+        ray = self._ray
+        blob = cloudpickle.dumps((fn, args, kwargs or {}))
+        outs = ray.get([w.execute.remote(blob) for w in self._workers])
+        return [cloudpickle.loads(o) for o in outs]
+
+    def shutdown(self) -> None:
+        ray = self._ray
+        if self._workers:
+            ray.get([w.shutdown.remote() for w in self._workers])
+            for w in self._workers:
+                ray.kill(w)
+            self._workers = []

@@ -42,10 +42,12 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     kwargs = kwargs or {}
     # cloudpickle serializes closures/lambdas by value (the reference uses
     # it for the same purpose in run-func mode)
-    try:
-        import cloudpickle as pickler
-    except ImportError:
-        pickler = pickle
+    pickler = pickle
+    if use_cloudpickle:
+        try:
+            import cloudpickle as pickler
+        except ImportError:
+            pass
     with tempfile.TemporaryDirectory(prefix="hvd_run_") as tmp:
         fn_path = os.path.join(tmp, "fn.pkl")
         with open(fn_path, "wb") as f:

@@ -41,11 +41,13 @@ class ElasticDriver:
                  env: Optional[Dict[str, str]] = None,
                  reset_limit: Optional[int] = None,
                  verbose: bool = False,
-                 ckpt_dir: Optional[str] = None) -> None:
+                 ckpt_dir: Optional[str] = None,
+                 target_np: Optional[int] = None) -> None:
         self._hosts = HostManager(discovery)
         self._command = command
         self._min_np = min_np
         self._max_np = max_np
+        self._target_np = target_np
         self._env = dict(env if env is not None else os.environ)
         self._registry = WorkerStateRegistry(reset_limit)
         self._verbose = verbose
@@ -67,7 +69,10 @@ class ElasticDriver:
     def _wait_for_min_hosts(self, timeout: float = 600.0) -> None:
         deadline = time.time() + timeout
         while time.time() < deadline:
-            self._hosts.update_available_hosts()
+            try:
+                self._hosts.update_available_hosts()
+            except Exception as e:  # transient discovery hiccup: keep going
+                get_logger().warning("host discovery failed: %s", e)
             if self._hosts.slot_count() >= self._min_np:
                 return
             time.sleep(DISCOVERY_INTERVAL_S)
@@ -79,7 +84,8 @@ class ElasticDriver:
         """Launch workers for the current host set; returns SUCCESS /
         FAILURE / 'HOSTS_CHANGED'."""
         hosts = self._hosts.current_hosts()
-        np = min(self._max_np or self._hosts.slot_count(),
+        np = min(self._target_np or self._hosts.slot_count(),
+                 self._max_np or self._hosts.slot_count(),
                  self._hosts.slot_count())
         slots = get_host_assignments(hosts, np)
         coord_port = free_port()
@@ -93,8 +99,6 @@ class ElasticDriver:
                           [h.hostname for h in hosts])
 
         failure = threading.Event()
-        outcome = {"result": SUCCESS}
-
         fail_lock = threading.Lock()
 
         def run_slot(slot):
@@ -166,11 +170,13 @@ class ElasticDriver:
             disc.join(timeout=3)
 
 
-def run_elastic(discovery: HostDiscovery, np: int, command: List[str],
+def run_elastic(discovery: HostDiscovery, np: Optional[int],
+                command: List[str],
                 min_np: int = 1, max_np: Optional[int] = None,
                 env: Optional[Dict[str, str]] = None,
                 verbose: bool = False,
                 reset_limit: Optional[int] = None) -> int:
     driver = ElasticDriver(discovery, command, min_np=min_np, max_np=max_np,
-                           env=env, verbose=verbose, reset_limit=reset_limit)
+                           env=env, verbose=verbose, reset_limit=reset_limit,
+                           target_np=np)
     return driver.run()

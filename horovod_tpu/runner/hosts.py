@@ -90,16 +90,24 @@ def get_host_assignments(hosts: List[HostInfo], np: int,
             slots.append(SlotInfo(h.hostname, rank, local, 0, np, 0, 0))
             cross_size[local] = cross_size.get(local, 0) + 1
             rank += 1
-    # fill local_size / cross ranks
+    # fill local_size / cross ranks. cross_rank is this host's ordinal among
+    # the hosts that HAVE this local_rank (reference semantics: the "cross"
+    # communicator groups same-local_rank processes across hosts), so with
+    # ragged slot counts cross_rank stays < cross_size.
     per_host: Dict[str, int] = {}
     for s in slots:
         per_host[s.hostname] = per_host.get(s.hostname, 0) + 1
-    host_index: Dict[str, int] = {}
+    host_order: List[str] = []
     for s in slots:
-        if s.hostname not in host_index:
-            host_index[s.hostname] = len(host_index)
+        if s.hostname not in host_order:
+            host_order.append(s.hostname)
+    hosts_with_local: Dict[int, List[str]] = {}
+    for s in slots:
+        hosts_with_local.setdefault(s.local_rank, [])
+        if s.hostname not in hosts_with_local[s.local_rank]:
+            hosts_with_local[s.local_rank].append(s.hostname)
     for s in slots:
         s.local_size = per_host[s.hostname]
-        s.cross_rank = host_index[s.hostname]
+        s.cross_rank = hosts_with_local[s.local_rank].index(s.hostname)
         s.cross_size = cross_size.get(s.local_rank, 0)
     return slots

@@ -2,8 +2,13 @@
 
 Reference: ``horovod/runner/http/http_server.py:35-175`` (``KVStoreHandler``
 GET/PUT by scope/key; ``RendezvousHandler`` adds slot-info GET and DELETE
-finalization) and ``http/http_client.py``. Used by the launcher for run-func
-result collection and by the elastic driver for re-rendezvous.
+finalization) and ``http/http_client.py``.
+
+Note: the default stack does NOT need this server — the TCP core performs
+its own rendezvous through rank 0 and ``runner.run`` collects results via a
+shared tmpdir. It is provided for custom orchestration (cross-host result
+collection, external schedulers publishing worker metadata) and as the
+reference-parity KV surface.
 """
 
 from __future__ import annotations

@@ -38,6 +38,8 @@ def parse_args(argv: List[str]) -> argparse.Namespace:
     p.add_argument("--host-discovery-script", default=None,
                    help="script printing 'host:slots' lines; enables "
                         "elastic mode")
+    p.add_argument("--reset-limit", type=int, default=None,
+                   help="max elastic relaunch generations before giving up")
     # knobs mirrored to env (reference: config_parser.py)
     p.add_argument("--fusion-threshold-mb", type=float, default=None)
     p.add_argument("--cycle-time-ms", type=float, default=None)
@@ -102,11 +104,11 @@ def run_commandline(argv: List[str] = None) -> int:
             discovery = HostDiscoveryScript(args.host_discovery_script)
         else:
             discovery = FixedHosts(resolve_hosts(args))
-        np = args.num_proc or args.min_np or 1
-        return run_elastic(discovery, np, args.command,
+        return run_elastic(discovery, args.num_proc, args.command,
                            min_np=args.min_np or 1,
                            max_np=args.max_np,
-                           env=env, verbose=args.verbose)
+                           env=env, verbose=args.verbose,
+                           reset_limit=args.reset_limit)
 
     hosts = resolve_hosts(args)
     np = args.num_proc or sum(h.slots for h in hosts)

@@ -1,0 +1,84 @@
+"""Spark cluster integration.
+
+Reference: ``horovod/spark/runner.py`` — ``horovod.spark.run(fn, ...)``
+spawns task services in Spark executors, collects host info on the driver,
+launches the distributed job over them, and returns per-rank results
+(:197-306). This module provides the same contract on top of the TPU
+launcher: each Spark task hosts one worker process (one TPU host).
+
+Gated on pyspark availability (not bundled in this image); the Store
+abstraction (reference: ``spark/common/store.py:36-530``) is usable without
+Spark for checkpoint/output management.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, List, Optional
+
+from horovod_tpu.spark.store import FilesystemStore, LocalStore, Store  # noqa: F401
+
+
+def _require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+        return pyspark
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.spark requires pyspark, which is not installed in "
+            "this environment. Install pyspark to use Spark-cluster "
+            "launching; the rest of horovod_tpu works without it.") from e
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+        num_proc: Optional[int] = None, env: Optional[dict] = None,
+        verbose: int = 1) -> List[Any]:
+    """Run ``fn`` on ``num_proc`` Spark tasks with horovod_tpu initialized
+    (reference: ``horovod.spark.run``, ``spark/runner.py:197-306``).
+
+    Strategy: a barrier-mode Spark job where every task reports its host to
+    the driver via the accumulated host list, then rank 0's host runs the
+    coordinator and each task execs the worker fn — mirroring the
+    reference's task-service handshake with Spark's own scheduling.
+    """
+    pyspark = _require_pyspark()
+    from pyspark.sql import SparkSession
+    import cloudpickle
+
+    kwargs = kwargs or {}
+    spark = SparkSession.builder.getOrCreate()
+    sc = spark.sparkContext
+    num_proc = num_proc or int(sc.defaultParallelism)
+    payload = cloudpickle.dumps((fn, args, kwargs))
+    coord_port = 37611
+    extra_env = dict(env or {})
+
+    def task(idx_it):
+        import socket
+        from pyspark import BarrierTaskContext
+        ctx = BarrierTaskContext.get()
+        rank = ctx.partitionId()
+        infos = ctx.getTaskInfos()
+        coord = infos[0].address.split(":")[0]
+        os.environ.update(extra_env)
+        os.environ.update({
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(len(infos)),
+            "HVD_TPU_COORD_ADDR": coord,
+            "HVD_TPU_COORD_PORT": str(coord_port),
+            "HOROVOD_HOSTNAME": socket.gethostname(),
+        })
+        ctx.barrier()
+        f, a, k = cloudpickle.loads(payload)
+        import horovod_tpu as hvd
+        hvd.init()
+        result = f(*a, **k)
+        hvd.shutdown()
+        return [(rank, cloudpickle.dumps(result))]
+
+    rdd = sc.parallelize(range(num_proc), num_proc).barrier()
+    gathered = rdd.mapPartitions(task).collect()
+    out: List[Any] = [None] * num_proc
+    for rank, blob in gathered:
+        out[rank] = cloudpickle.loads(blob)
+    return out

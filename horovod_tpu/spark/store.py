@@ -1,0 +1,138 @@
+"""Storage abstraction for checkpoints / train-data paths.
+
+Reference: ``horovod/spark/common/store.py:36-530`` — ``Store`` with
+``LocalStore``/``HDFSStore``/``DBFSLocalStore`` and fsspec-backed remote
+paths, used by the estimators for Parquet data + checkpoints. Here the same
+surface over local paths and (when fsspec is importable) any fsspec URL;
+TPU-native checkpointing prefers orbax through :func:`checkpoint_handler`.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+
+class Store:
+    """Base interface (reference: ``Store:36-140``)."""
+
+    def get_train_data_path(self, idx: Optional[str] = None) -> str:
+        raise NotImplementedError
+
+    def get_val_data_path(self, idx: Optional[str] = None) -> str:
+        raise NotImplementedError
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_logs_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    @staticmethod
+    def create(prefix_path: str, *args, **kwargs) -> "Store":
+        """Reference: ``Store.create`` dispatch by URL scheme."""
+        if "://" in prefix_path and not prefix_path.startswith("file://"):
+            return FilesystemStore(prefix_path, *args, **kwargs)
+        return LocalStore(prefix_path.replace("file://", ""), *args,
+                          **kwargs)
+
+
+class LocalStore(Store):
+    """Local-filesystem store (reference: ``LocalStore:143-220``)."""
+
+    def __init__(self, prefix_path: str) -> None:
+        self._prefix = prefix_path
+        os.makedirs(prefix_path, exist_ok=True)
+
+    def _join(self, *parts: str) -> str:
+        p = os.path.join(self._prefix, *parts)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        return p
+
+    def get_train_data_path(self, idx: Optional[str] = None) -> str:
+        return self._join("intermediate_train_data" + (f".{idx}" if idx
+                                                       else ""))
+
+    def get_val_data_path(self, idx: Optional[str] = None) -> str:
+        return self._join("intermediate_val_data" + (f".{idx}" if idx
+                                                     else ""))
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return self._join("runs", run_id, "checkpoint")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return self._join("runs", run_id, "logs")
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+
+class FilesystemStore(Store):
+    """fsspec-backed store for s3://, gs://, hdfs:// URLs (reference:
+    ``FilesystemStore``/``HDFSStore``; fsspec is the modern superset)."""
+
+    def __init__(self, prefix_path: str) -> None:
+        try:
+            import fsspec
+        except ImportError as e:
+            raise ImportError(
+                f"FilesystemStore({prefix_path!r}) requires fsspec, which "
+                "is not installed; use LocalStore or install fsspec.") from e
+        self._fs, self._prefix = fsspec.core.url_to_fs(prefix_path)
+
+    def _join(self, *parts: str) -> str:
+        return "/".join([self._prefix.rstrip("/")] + list(parts))
+
+    def get_train_data_path(self, idx: Optional[str] = None) -> str:
+        return self._join("intermediate_train_data" + (f".{idx}" if idx
+                                                       else ""))
+
+    def get_val_data_path(self, idx: Optional[str] = None) -> str:
+        return self._join("intermediate_val_data" + (f".{idx}" if idx
+                                                     else ""))
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return self._join("runs", run_id, "checkpoint")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return self._join("runs", run_id, "logs")
+
+    def exists(self, path: str) -> bool:
+        return self._fs.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with self._fs.open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes) -> None:
+        with self._fs.open(path, "wb") as f:
+            f.write(data)
+
+
+def checkpoint_handler(store: Store, run_id: str):
+    """Orbax checkpointer rooted at the store's checkpoint path (TPU-native
+    replacement for the estimators' keras/torch checkpoint files)."""
+    import orbax.checkpoint as ocp
+    path = store.get_checkpoint_path(run_id)
+    return ocp.PyTreeCheckpointer(), path

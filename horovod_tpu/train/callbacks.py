@@ -1,0 +1,82 @@
+"""Training-loop callbacks.
+
+Reference: ``horovod/_keras/callbacks.py`` — ``BroadcastGlobalVariables``
+(:23-47), ``MetricAverageCallback`` (:49-93), ``LearningRateWarmupCallback``
+(:118-192). The reference hooks Keras; here the hooks are framework-neutral
+callables for JAX training loops (works with any loop that calls
+``on_train_begin`` / ``on_epoch_end``-style hooks or uses them directly).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from horovod_tpu.common.basics import rank, size
+from horovod_tpu.ops import collectives as C
+from horovod_tpu.ops.reduce_op import Average
+
+
+class BroadcastGlobalVariablesCallback:
+    """Broadcast params/opt-state from root at training start (reference:
+    ``BroadcastGlobalVariablesCallbackImpl:23-47``)."""
+
+    def __init__(self, root_rank: int = 0) -> None:
+        self.root_rank = root_rank
+
+    def on_train_begin(self, params, opt_state=None):
+        from horovod_tpu.train.optimizer import (broadcast_optimizer_state,
+                                                 broadcast_parameters)
+        params = broadcast_parameters(params, self.root_rank)
+        if opt_state is not None:
+            opt_state = broadcast_optimizer_state(opt_state, self.root_rank)
+            return params, opt_state
+        return params
+
+
+class MetricAverageCallback:
+    """Average logged metrics across workers at epoch end (reference:
+    ``MetricAverageCallbackImpl:49-93``)."""
+
+    def on_epoch_end(self, logs: Dict[str, Any]) -> Dict[str, Any]:
+        if size() == 1:
+            return dict(logs)
+        out = {}
+        for k, v in logs.items():
+            if isinstance(v, (int, float, np.floating, np.integer)):
+                red = C.allreduce(np.asarray([float(v)], np.float64),
+                                  op=Average, name=f"metric.{k}")
+                out[k] = float(np.asarray(red)[0])
+            else:
+                out[k] = v
+        return out
+
+
+class LearningRateWarmupCallback:
+    """Linear LR warmup from ``initial_lr/size`` to ``initial_lr * size``
+    over warmup epochs (reference: ``LearningRateWarmupCallbackImpl:118-192``
+    — the "facebook 1-hour" scaling recipe). Returns a schedule fn usable as
+    an optax learning-rate schedule."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 steps_per_epoch: int = 1, momentum_correction: bool = True,
+                 verbose: bool = False) -> None:
+        self.initial_lr = initial_lr
+        self.warmup_epochs = warmup_epochs
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+
+    def schedule(self) -> Callable[[int], float]:
+        import jax.numpy as jnp
+        scale = size()
+        warm_steps = max(1, self.warmup_epochs * self.steps_per_epoch)
+        base = self.initial_lr
+
+        def fn(step):
+            frac = jnp.minimum(step / warm_steps, 1.0)
+            # exponential ramp from lr to lr*size (reference uses
+            # lr * (size ** (epoch/warmup)) per batch)
+            return base * (scale ** frac)
+
+        return fn
