@@ -33,7 +33,7 @@ def main() -> None:
     mesh = hvd.build_mesh(dp=-1)
     n_chips = int(np.prod(list(mesh.shape.values())))
 
-    batch_per_chip = 128
+    batch_per_chip = 256
     B = batch_per_chip * n_chips
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
     params, batch_stats = create_resnet_state(

@@ -58,7 +58,7 @@ def main():
     for epoch in range(3):
         ds.set_epoch(epoch)
         items = list(ds)
-        for i in range(0, len(items) - batch, batch):
+        for i in range(0, len(items) - batch + 1, batch):
             xb = jnp.asarray(np.stack([it[0] for it in items[i:i + batch]]))
             yb = jnp.asarray(np.stack([it[1] for it in items[i:i + batch]]))
             loss, grads = grad_fn(params, xb, yb)

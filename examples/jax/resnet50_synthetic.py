@@ -22,7 +22,7 @@ from horovod_tpu.models.resnet import (ResNet50, batch_sharding,
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch-size", type=int, default=128,
+    ap.add_argument("--batch-size", type=int, default=256,
                     help="per-chip batch size")
     ap.add_argument("--num-iters", type=int, default=10)
     ap.add_argument("--num-warmup", type=int, default=3)

@@ -55,14 +55,30 @@ class AsyncDataLoaderMixin:
             self._thread.join(timeout=10)
             self._thread = None
 
+    class _End:
+        def __init__(self, error=None):
+            self.error = error
+
+    def _put(self, item) -> bool:
+        """Bounded put that aborts when the loader is closing (so the
+        producer can never wedge in a full queue)."""
+        while not self._closing:
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _producer(self) -> None:
+        error = None
         try:
             for item in super()._iterate():
-                if self._closing:
+                if not self._put(item):
                     return
-                self._q.put(item)
-        finally:
-            self._q.put(None)
+        except BaseException as e:  # surface loader errors to the consumer
+            error = e
+        self._put(self._End(error))
 
     def __iter__(self) -> Iterator[Any]:
         if self._queue_size <= 0:
@@ -74,7 +90,9 @@ class AsyncDataLoaderMixin:
         self._thread.start()
         while True:
             item = self._q.get()
-            if item is None:
+            if isinstance(item, AsyncDataLoaderMixin._End):
+                if item.error is not None:
+                    raise item.error
                 break
             yield item
 

@@ -216,8 +216,9 @@ def _attention_block(p, x, positions, cfg: TransformerConfig):
     if _axis_live("sp"):
         o = ring_attention_spmd(q, k, v, "sp", causal=True)
     else:
-        from horovod_tpu.parallel.ring_attention import _plain_attention
-        o = _plain_attention(q, k, v, causal=True)
+        # pallas flash kernel on TPU when tiling permits, XLA otherwise
+        from horovod_tpu.ops.pallas_attention import attend
+        o = attend(q, k, v, causal=True)
     o = o.reshape(B, S, Hl * cfg.head_dim) @ p["wo"].astype(x.dtype)
     o = _psum_if(o, "tp")
     return x + o

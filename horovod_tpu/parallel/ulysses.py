@@ -43,7 +43,8 @@ def ulysses_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array,
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    out = _plain_attention(qh, kh, vh, causal, scale)
+    from horovod_tpu.ops.pallas_attention import attend
+    out = attend(qh, kh, vh, causal, scale)
     return to_seq(out)
 
 

@@ -56,6 +56,14 @@ class RayExecutor:
             def hostname(self) -> str:
                 return socket.gethostname()
 
+            def pick_free_port(self) -> int:
+                import socket as s
+                sock = s.socket()
+                sock.bind(("0.0.0.0", 0))
+                port = sock.getsockname()[1]
+                sock.close()
+                return port
+
             def set_coordinator(self, addr: str, port: int) -> None:
                 import os
                 os.environ["HVD_TPU_COORD_ADDR"] = addr
@@ -80,8 +88,8 @@ class RayExecutor:
         # host info, ray/runner.py:41-128)
         ray = self._ray
         coord_host = ray.get(self._workers[0].hostname.remote())
-        from horovod_tpu.runner.exec_run import free_port
-        port = free_port()
+        # the coordinator binds on rank 0's host, so pick the port THERE
+        port = ray.get(self._workers[0].pick_free_port.remote())
         ray.get([w.set_coordinator.remote(coord_host, port)
                  for w in self._workers])
 

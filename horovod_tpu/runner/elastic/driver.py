@@ -68,11 +68,20 @@ class ElasticDriver:
 
     def _wait_for_min_hosts(self, timeout: float = 600.0) -> None:
         deadline = time.time() + timeout
+        consecutive_failures = 0
         while time.time() < deadline:
             try:
                 self._hosts.update_available_hosts()
+                consecutive_failures = 0
             except Exception as e:  # transient discovery hiccup: keep going
+                consecutive_failures += 1
                 get_logger().warning("host discovery failed: %s", e)
+                if consecutive_failures >= 5:
+                    # permanent misconfiguration (bad script path etc.):
+                    # surface the real error instead of spinning to timeout
+                    raise RuntimeError(
+                        "host discovery failed 5 times in a row; check the "
+                        f"discovery script: {e}") from e
             if self._hosts.slot_count() >= self._min_np:
                 return
             time.sleep(DISCOVERY_INTERVAL_S)

@@ -50,7 +50,6 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     sc = spark.sparkContext
     num_proc = num_proc or int(sc.defaultParallelism)
     payload = cloudpickle.dumps((fn, args, kwargs))
-    coord_port = 37611
     extra_env = dict(env or {})
 
     def task(idx_it):
@@ -60,6 +59,14 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
         rank = ctx.partitionId()
         infos = ctx.getTaskInfos()
         coord = infos[0].address.split(":")[0]
+        # rank 0 binds a free port on ITS host and shares it with everyone
+        my_port = ""
+        if rank == 0:
+            s = socket.socket()
+            s.bind(("0.0.0.0", 0))
+            my_port = str(s.getsockname()[1])
+            s.close()
+        coord_port = int(ctx.allGather(my_port)[0])
         os.environ.update(extra_env)
         os.environ.update({
             "HOROVOD_RANK": str(rank),

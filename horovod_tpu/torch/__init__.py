@@ -1,0 +1,258 @@
+"""``horovod_tpu.torch`` — drop-in surface for reference PyTorch users.
+
+Reference: ``horovod/torch/__init__.py`` + ``mpi_ops.py`` (:143-903) +
+``optimizer.py`` (:35-590) + ``functions.py`` (:29-266). A user of the
+reference's ``import horovod.torch as hvd`` can switch the import and keep
+their script: eager collectives on ``torch.Tensor`` (CPU tensors — torch is
+the host-side framework here; device compute belongs to JAX/XLA), the
+gradient-hook DistributedOptimizer, and parameter/optimizer broadcast.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+# identity / lifecycle re-exports (reference: torch/mpi_ops.py:40-90)
+from horovod_tpu.common.basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, is_homogeneous, mpi_threads_supported,
+    mpi_built, gloo_built, nccl_built, ccl_built, cuda_built, rocm_built,
+    start_timeline, stop_timeline)
+from horovod_tpu.common.process_sets import (  # noqa: F401
+    ProcessSet, add_process_set, remove_process_set, global_process_set)
+from horovod_tpu.ops.reduce_op import (  # noqa: F401
+    Adasum, Average, Max, Min, Product, ReduceOp, Sum)
+from horovod_tpu.ops import collectives as _C
+from horovod_tpu.ops.backend import HvdHandle
+from horovod_tpu.train.compression import Compression  # noqa: F401
+
+
+def _torch():
+    import torch
+    return torch
+
+
+def _to_np(tensor) -> np.ndarray:
+    return tensor.detach().cpu().numpy()
+
+
+def _from_np(arr, like) -> "Any":
+    torch = _torch()
+    return torch.from_numpy(np.ascontiguousarray(arr)).to(like.dtype)
+
+
+class _TorchHandle:
+    """Wraps an HvdHandle, converting results back to torch."""
+
+    def __init__(self, handle: HvdHandle, like, post=None) -> None:
+        self._h = handle
+        self._like = like
+        self._post = post
+
+    def poll(self) -> bool:
+        return self._h.poll()
+
+    def wait(self, timeout: Optional[float] = None):
+        out = self._h.wait(timeout)
+        if self._post is not None:
+            return self._post(out)
+        return _from_np(np.asarray(out), self._like)
+
+
+def allreduce_async(tensor, average: Optional[bool] = None,
+                    name: Optional[str] = None,
+                    op: Optional[ReduceOp] = None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0,
+                    process_set: ProcessSet = global_process_set):
+    h = _C.allreduce_async(_to_np(tensor), average, name, op,
+                           prescale_factor, postscale_factor, process_set)
+    return _TorchHandle(h, tensor)
+
+
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, op: Optional[ReduceOp] = None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              process_set: ProcessSet = global_process_set):
+    return allreduce_async(tensor, average, name, op, prescale_factor,
+                           postscale_factor, process_set).wait()
+
+
+def allreduce_(tensor, average: Optional[bool] = None,
+               name: Optional[str] = None, op: Optional[ReduceOp] = None,
+               process_set: ProcessSet = global_process_set):
+    """In-place variant (reference: ``allreduce_``)."""
+    out = allreduce(tensor, average, name, op, process_set=process_set)
+    tensor.copy_(out)
+    return tensor
+
+
+def grouped_allreduce(tensors, average: Optional[bool] = None,
+                      name: Optional[str] = None,
+                      op: Optional[ReduceOp] = None,
+                      process_set: ProcessSet = global_process_set):
+    outs = _C.grouped_allreduce([_to_np(t) for t in tensors], average, name,
+                                op, process_set=process_set)
+    return [_from_np(np.asarray(o), t) for o, t in zip(outs, tensors)]
+
+
+def allgather_async(tensor, name: Optional[str] = None,
+                    process_set: ProcessSet = global_process_set):
+    h = _C.allgather_async(_to_np(tensor), name, process_set)
+    return _TorchHandle(h, tensor)
+
+
+def allgather(tensor, name: Optional[str] = None,
+              process_set: ProcessSet = global_process_set):
+    return allgather_async(tensor, name, process_set).wait()
+
+
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
+                    process_set: ProcessSet = global_process_set):
+    h = _C.broadcast_async(_to_np(tensor), root_rank, name, process_set)
+    return _TorchHandle(h, tensor)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              process_set: ProcessSet = global_process_set):
+    return broadcast_async(tensor, root_rank, name, process_set).wait()
+
+
+def broadcast_(tensor, root_rank: int, name: Optional[str] = None,
+               process_set: ProcessSet = global_process_set):
+    out = broadcast(tensor, root_rank, name, process_set)
+    tensor.copy_(out)
+    return tensor
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set: ProcessSet = global_process_set):
+    t, recv_splits = _C.alltoall(
+        _to_np(tensor), None if splits is None else _to_np(splits)
+        if hasattr(splits, "detach") else splits, name, process_set)
+    torch = _torch()
+    return (_from_np(np.asarray(t), tensor),
+            torch.from_numpy(np.asarray(recv_splits)))
+
+
+def synchronize(handle):
+    return handle.wait()
+
+
+def poll(handle) -> bool:
+    return handle.poll()
+
+
+def join(device: int = -1) -> int:
+    return _C.join(device)
+
+
+def barrier(process_set: ProcessSet = global_process_set) -> None:
+    _C.barrier(process_set)
+
+
+# -- parameter / optimizer broadcast (reference: torch/functions.py) --------
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """In-place broadcast of a state_dict or named_parameters iterable
+    (reference: ``broadcast_parameters``, ``functions.py:29-68``)."""
+    if hasattr(params, "items"):
+        items = list(params.items())
+    else:
+        items = list(params)
+    handles = [(name, broadcast_async(p, root_rank, name=f"bp.{name}"))
+               for name, p in items if hasattr(p, "copy_")]
+    for (name, h), (_, p) in zip(handles, [(n, p) for n, p in items
+                                           if hasattr(p, "copy_")]):
+        p.copy_(h.wait())
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
+    """Reference: ``broadcast_optimizer_state`` (``functions.py:116-266``)."""
+    from horovod_tpu.train.optimizer import broadcast_object as _bo
+    state = optimizer.state_dict()
+    state = _bo(state, root_rank, name="opt_state")
+    optimizer.load_state_dict(state)
+
+
+def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
+    from horovod_tpu.train.optimizer import broadcast_object as _bo
+    return _bo(obj, root_rank, name=name)
+
+
+# -- DistributedOptimizer (reference: torch/optimizer.py) -------------------
+
+class _DistributedOptimizer:
+    """Wraps a torch optimizer: allreduce gradients before each step
+    (reference: ``_DistributedOptimizer``, ``torch/optimizer.py:35-333``;
+    hook-free variant — gradients are reduced in ``step`` as one grouped
+    (fused) submission, which the core fuses exactly like the reference's
+    per-hook enqueues land in one fusion buffer)."""
+
+    def __init__(self, optimizer, named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1,
+                 op: ReduceOp = Average,
+                 process_set: ProcessSet = global_process_set) -> None:
+        self._opt = optimizer
+        self._compression = compression
+        self._op = op
+        self._process_set = process_set
+        self.backward_passes_per_step = backward_passes_per_step
+        self._pass_count = 0
+        if named_parameters is not None:
+            self._names = {id(p): n for n, p in named_parameters}
+        else:
+            self._names = {}
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    def _param_name(self, p, i: int, j: int) -> str:
+        return self._names.get(id(p), f"grad.{i}.{j}")
+
+    def synchronize(self) -> None:
+        """Allreduce all gradients now (reference: ``synchronize``,
+        ``optimizer.py:249-292``)."""
+        params, names = [], []
+        for i, group in enumerate(self._opt.param_groups):
+            for j, p in enumerate(group["params"]):
+                if p.grad is not None:
+                    params.append(p)
+                    names.append(self._param_name(p, i, j))
+        if size() <= 1 or not params:
+            return
+        compressed, ctxs = [], []
+        for p in params:
+            c, ctx = self._compression.compress(_to_np(p.grad))
+            compressed.append(np.asarray(c))
+            ctxs.append(ctx)
+        outs = _C.grouped_allreduce(compressed, op=self._op,
+                                    name="torchgrad." + names[0],
+                                    process_set=self._process_set)
+        for p, o, ctx in zip(params, outs, ctxs):
+            o = self._compression.decompress(np.asarray(o), ctx)
+            p.grad.copy_(_from_np(np.asarray(o), p.grad))
+
+    def step(self, closure=None):
+        self._pass_count += 1
+        if self._pass_count >= self.backward_passes_per_step:
+            self._pass_count = 0
+            self.synchronize()
+            return self._opt.step(closure)
+        return None
+
+    def zero_grad(self, *args: Any, **kwargs: Any):
+        return self._opt.zero_grad(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op: ReduceOp = Average,
+                         process_set: ProcessSet = global_process_set):
+    """Factory (reference: ``DistributedOptimizer``, ``optimizer.py:506``)."""
+    return _DistributedOptimizer(optimizer, named_parameters, compression,
+                                 backward_passes_per_step, op, process_set)

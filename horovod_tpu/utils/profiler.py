@@ -1,0 +1,53 @@
+"""Device-side profiling annotations.
+
+Reference: NVTX ranges wrapping each user-facing op for Nsight
+(``horovod/common/nvtx_op_range.{h,cc}``, enqueue sites
+``operations.cc:1455-1470``). TPU equivalent: ``jax.profiler`` traces +
+named annotations that show up in XProf/TensorBoard, plus a context manager
+pair mirroring ``hvd.start_timeline``/``stop_timeline`` for the device side.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+
+def start_trace(log_dir: str) -> None:
+    """Begin a device trace viewable in TensorBoard/XProf (the device-side
+    counterpart of ``hvd.start_timeline``)."""
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace() -> None:
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    start_trace(log_dir)
+    try:
+        yield
+    finally:
+        stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named range on the device timeline (NVTX-range analog)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def annotate_fn(name: Optional[str] = None):
+    """Decorator form: ``@annotate_fn("allreduce.grads")``."""
+    def deco(fn):
+        label = name or fn.__name__
+
+        def wrapped(*args, **kwargs):
+            with jax.profiler.TraceAnnotation(label):
+                return fn(*args, **kwargs)
+        return wrapped
+    return deco

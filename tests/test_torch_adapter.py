@@ -1,0 +1,79 @@
+"""Torch adapter tests (reference analog: test/parallel/test_torch.py, run
+single-process here; the multi-process path shares the core backend already
+covered by test_core_multiprocess)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture
+def thvd(hvd):
+    import horovod_tpu.torch as thvd
+    return thvd
+
+
+def test_torch_allreduce(thvd):
+    x = torch.arange(6, dtype=torch.float32)
+    out = thvd.allreduce(x, op=thvd.Sum)
+    assert torch.allclose(out, x)
+    # in-place
+    y = x.clone()
+    thvd.allreduce_(y, op=thvd.Average)
+    assert torch.allclose(y, x)
+
+
+def test_torch_grouped_and_gather(thvd):
+    outs = thvd.grouped_allreduce([torch.ones(3), torch.zeros(2)],
+                                  op=thvd.Sum)
+    assert torch.allclose(outs[0], torch.ones(3))
+    g = thvd.allgather(torch.eye(2))
+    assert g.shape == (2, 2)
+
+
+def test_torch_broadcast_parameters(thvd):
+    model = torch.nn.Linear(4, 2)
+    thvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    thvd.broadcast_optimizer_state(opt, root_rank=0)
+
+
+def test_torch_distributed_optimizer_trains(thvd):
+    torch.manual_seed(0)
+    model = torch.nn.Linear(8, 1)
+    opt = thvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05),
+        named_parameters=model.named_parameters())
+    x = torch.randn(64, 8)
+    w = torch.randn(8, 1)
+    y = x @ w
+    losses = []
+    for i in range(50):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.1
+
+
+def test_torch_backward_passes_per_step(thvd):
+    model = torch.nn.Linear(2, 1)
+    opt = thvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        backward_passes_per_step=2)
+    before = model.weight.detach().clone()
+    loss = model(torch.ones(1, 2)).sum()
+    loss.backward()
+    assert opt.step() is None           # accumulating, no update
+    assert torch.allclose(model.weight, before)
+    loss = model(torch.ones(1, 2)).sum()
+    loss.backward()
+    opt.step()                          # second pass applies
+    assert not torch.allclose(model.weight, before)
+
+
+def test_torch_join_barrier(thvd):
+    assert thvd.join() == 0
+    thvd.barrier()
