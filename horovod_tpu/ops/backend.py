@@ -205,7 +205,13 @@ def make_backend(state) -> Backend:
     """
     if getattr(state, "launched_size", state.size) <= 1:
         return LocalBackend(state.rank, 1)
-    # Multi-process: the C++ core (TCP controller + host collectives, with
-    # the XLA data plane layered on top when TPU devices are present).
+    # Multi-process. HVD_TPU_OPERATIONS=XLA_EAGER selects the XLA data
+    # plane (jitted collectives over the global mesh via jax.distributed);
+    # default is the C++ core (TCP controller + host collectives), which
+    # additionally negotiates dynamic submission order.
+    if state.config is not None and \
+            state.config.tpu_operations == "XLA_EAGER":
+        from horovod_tpu.ops.xla_backend import XlaBackend
+        return XlaBackend(state)
     from horovod_tpu.core.bindings import core_backend_or_raise
     return core_backend_or_raise(state)

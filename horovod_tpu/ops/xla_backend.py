@@ -1,0 +1,233 @@
+"""XLA data-plane backend: eager multi-process collectives executed as
+jitted XLA collectives over the global mesh (ICI within a slice, DCN/gloo
+across hosts).
+
+This is the reference's NCCL role (SURVEY.md §2.7: "NCCL → ICI collectives
+via jitted XLA ops over the pod slice") for the EAGER path: per-process
+arrays become shards of a global array and one cached jitted shard_map
+program moves the bytes — no host round-trip through the TCP rings.
+
+Contract: every member process must issue the same collectives in the same
+order (the standard data-parallel training pattern, and exactly what the
+reference's response cache converges to in steady state). For dynamically
+ordered submissions use the TCP core backend, which negotiates ordering.
+Select with ``HVD_TPU_OPERATIONS=XLA_EAGER`` (reference knob analog:
+``HOROVOD_CPU_OPERATIONS``/compile-time ``HOROVOD_GPU_ALLREDUCE``).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from horovod_tpu.ops.backend import Backend, HvdHandle
+from horovod_tpu.ops.reduce_op import ReduceOp
+
+_DIST_LOCK = threading.Lock()
+_DIST_INITIALIZED = False
+
+
+def _ensure_jax_distributed(coord_addr: str, port: int, size: int,
+                            rank: int) -> None:
+    global _DIST_INITIALIZED
+    with _DIST_LOCK:
+        if _DIST_INITIALIZED:
+            return
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=f"{coord_addr}:{port}",
+            num_processes=size, process_id=rank)
+        _DIST_INITIALIZED = True
+
+
+class XlaBackend(Backend):
+    def __init__(self, state) -> None:
+        import jax
+        coord = os.environ.get("HVD_TPU_COORD_ADDR", "127.0.0.1")
+        base = int(os.environ.get("HVD_TPU_COORD_PORT", "37592"))
+        xla_port = int(os.environ.get("HVD_TPU_XLA_COORD_PORT",
+                                      str(base + 1)))
+        _ensure_jax_distributed(coord, xla_port, state.launched_size,
+                                state.launched_rank
+                                if state.launched_rank is not None
+                                else state.rank)
+        super().__init__(jax.process_index(), jax.process_count())
+        self._jax = jax
+        import jax.numpy as jnp
+        self._jnp = jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        self._P = P
+        self._NS = NamedSharding
+        nlocal = jax.local_device_count()
+        devs = np.asarray(jax.devices()).reshape(self.size, nlocal)
+        self._mesh = Mesh(devs, ("proc", "local"))
+        self._fn_cache = {}
+
+    # -- helpers -------------------------------------------------------------
+    def _to_global(self, arr: np.ndarray):
+        """Per-process contribution → global array [size, ...] sharded over
+        'proc' (replicated over local devices)."""
+        jax = self._jax
+        sharding = self._NS(self._mesh, self._P("proc"))
+        row = np.asarray(arr)[None]
+        shards = [jax.device_put(row, d) for d in jax.local_devices()]
+        return jax.make_array_from_single_device_arrays(
+            (self.size,) + np.asarray(arr).shape, sharding, shards)
+
+    def _local_view(self, garr) -> np.ndarray:
+        return np.asarray(garr.addressable_shards[0].data)
+
+    def _collective(self, kind: str, op: ReduceOp, shape, dtype, extra=()):
+        key = (kind, op, tuple(shape), str(dtype), tuple(extra))
+        fn = self._fn_cache.get(key)
+        if fn is not None:
+            return fn
+        jax, jnp, P = self._jax, self._jnp, self._P
+        mesh = self._mesh
+        from horovod_tpu.ops.mesh_collectives import preduce
+
+        if kind == "allreduce":
+            @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("proc"),
+                               out_specs=P(), check_vma=False)
+            def body(x):
+                return preduce(x[0], "proc", op)
+        elif kind == "allgather":
+            @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("proc"),
+                               out_specs=P(), check_vma=False)
+            def body(x):
+                return jax.lax.all_gather(x[0], "proc", axis=0, tiled=True)
+        elif kind == "broadcast":
+            (root,) = extra
+
+            @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("proc"),
+                               out_specs=P(), check_vma=False)
+            def body(x):
+                idx = jax.lax.axis_index("proc")
+                masked = jnp.where(idx == root, x[0],
+                                   jnp.zeros_like(x[0]))
+                return jax.lax.psum(masked, "proc")
+        elif kind == "alltoall":
+            @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("proc"),
+                               out_specs=P("proc"), check_vma=False)
+            def body(x):
+                return jax.lax.all_to_all(x, "proc", split_axis=1,
+                                          concat_axis=0, tiled=False)
+        else:
+            raise ValueError(kind)
+        fn = jax.jit(body)
+        self._fn_cache[key] = fn
+        return fn
+
+    # -- collectives ---------------------------------------------------------
+    def allreduce_async(self, name, value, op, prescale=1.0, postscale=1.0):
+        arr = np.asarray(value)
+        if prescale != 1.0:
+            arr = arr * prescale
+        garr = self._to_global(arr)
+        fn = self._collective("allreduce", op, arr.shape, arr.dtype)
+        out = self._local_view(fn(garr))
+        if op == ReduceOp.AVERAGE:
+            pass  # preduce already averaged (pmean)
+        if postscale != 1.0:
+            out = (out * postscale).astype(arr.dtype)
+        result = self._jnp.asarray(out) if not isinstance(value, np.ndarray) \
+            else out
+        return HvdHandle.done(result)
+
+    def grouped_allreduce_async(self, names, values, op,
+                                prescale=1.0, postscale=1.0):
+        outs = [self.allreduce_async(n, v, op, prescale, postscale).wait()
+                for n, v in zip(names, values)]
+        return HvdHandle.done(outs)
+
+    def allgather_async(self, name, value):
+        arr = np.asarray(value)
+        # ragged dim 0: pad to the max (sizes exchanged via an allreduce)
+        sizes = np.zeros(self.size, np.int64)
+        sizes[self.rank] = arr.shape[0]
+        sizes = np.asarray(self.allreduce_async(
+            f"{name}.sizes", sizes, ReduceOp.SUM).wait()).astype(np.int64)
+        max_rows = int(sizes.max())
+        padded = np.zeros((max_rows,) + arr.shape[1:], arr.dtype)
+        padded[:arr.shape[0]] = arr
+        garr = self._to_global(padded)
+        fn = self._collective("allgather", ReduceOp.SUM, padded.shape,
+                              padded.dtype)
+        full = self._local_view(fn(garr))  # [size*max_rows, ...]
+        chunks = [full[i * max_rows:i * max_rows + int(sizes[i])]
+                  for i in range(self.size)]
+        out = np.concatenate(chunks, axis=0)
+        result = self._jnp.asarray(out) if not isinstance(value, np.ndarray) \
+            else out
+        return HvdHandle.done(result)
+
+    def broadcast_async(self, name, value, root_rank):
+        arr = np.asarray(value)
+        garr = self._to_global(arr)
+        fn = self._collective("broadcast", ReduceOp.SUM, arr.shape,
+                              arr.dtype, (int(root_rank),))
+        out = self._local_view(fn(garr))
+        result = self._jnp.asarray(out) if not isinstance(value, np.ndarray) \
+            else out
+        return HvdHandle.done(result)
+
+    def alltoall_async(self, name, value, splits=None):
+        arr = np.asarray(value)
+        if splits is None:
+            if arr.shape[0] % self.size != 0:
+                raise ValueError("alltoall without splits requires dim 0 "
+                                 f"divisible by size ({self.size})")
+            splits = [arr.shape[0] // self.size] * self.size
+        splits = [int(s) for s in splits]
+        if len(splits) != self.size:
+            raise ValueError("alltoall splits must have one entry per rank")
+        if len(set(splits)) == 1:
+            # uniform: single fused XLA all_to_all
+            rows = splits[0]
+            blocks = arr.reshape((self.size, rows) + arr.shape[1:])
+            garr = self._to_global(blocks)
+            fn = self._collective("alltoall", ReduceOp.SUM, blocks.shape,
+                                  blocks.dtype)
+            out = self._local_view(fn(garr)).reshape(
+                (self.size * rows,) + arr.shape[1:])
+            recv = np.asarray([rows] * self.size, np.int32)
+        else:
+            # uneven: exchange split tables, then allgather + slice (the
+            # correctness path; ragged_all_to_all is a future optimization)
+            table = np.zeros((self.size, self.size), np.int64)
+            table[self.rank] = splits
+            table = np.asarray(self.allreduce_async(
+                f"{name}.splits", table, ReduceOp.SUM).wait())
+            gathered = np.asarray(self.allgather_async(
+                f"{name}.data", arr).wait())
+            row_offsets = np.concatenate(
+                [[0], np.cumsum(table.sum(1))])[:-1]
+            pieces = []
+            recv = []
+            for src in range(self.size):
+                start = row_offsets[src] + table[src, :self.rank].sum()
+                n = table[src, self.rank]
+                pieces.append(gathered[int(start):int(start + n)])
+                recv.append(int(n))
+            out = np.concatenate(pieces, axis=0)
+            recv = np.asarray(recv, np.int32)
+        result = self._jnp.asarray(out) if not isinstance(value, np.ndarray) \
+            else out
+        return HvdHandle.done((result, recv))
+
+    def barrier(self) -> None:
+        self.allreduce_async("__barrier__", np.zeros(1, np.float32),
+                             ReduceOp.SUM).wait()
+
+    def make_subset(self, ranks: Sequence[int]):
+        raise NotImplementedError(
+            "process sets over the XLA eager backend are not supported yet; "
+            "use the TCP core backend (unset HVD_TPU_OPERATIONS) for "
+            "process-set workloads")
+
+    def shutdown(self) -> None:
+        pass  # jax.distributed teardown happens at process exit

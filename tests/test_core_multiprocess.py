@@ -14,6 +14,7 @@ from horovod_tpu.core import core_available
 WORKER = os.path.join(os.path.dirname(__file__), "core_worker.py")
 HVD_WORKER = os.path.join(os.path.dirname(__file__), "hvd_worker.py")
 ERROR_WORKER = os.path.join(os.path.dirname(__file__), "error_worker.py")
+XLA_WORKER = os.path.join(os.path.dirname(__file__), "xla_worker.py")
 
 
 def _free_port():
@@ -24,7 +25,7 @@ def _free_port():
     return port
 
 
-def _launch(size, extra_env=None, timeout=120, worker=WORKER):
+def _launch(size, extra_env=None, timeout=240, worker=WORKER):
     port = _free_port()
     procs = []
     for rank in range(size):
@@ -87,7 +88,8 @@ def test_core_with_timeline(tmp_path):
 @pytest.mark.parametrize("size", [2, 3])
 def test_hvd_full_stack(size):
     """Public hvd API over the core with jax-cpu arrays."""
-    _launch(size, timeout=240, worker=HVD_WORKER)
+    # generous timeout: N jax processes compiling on this 1-core box
+    _launch(size, timeout=480, worker=HVD_WORKER)
 
 
 @needs_core
@@ -95,3 +97,11 @@ def test_core_error_paths():
     """Shape mismatch and duplicate in-flight names produce clean errors and
     the core keeps working afterwards."""
     _launch(2, timeout=120, worker=ERROR_WORKER)
+
+
+@pytest.mark.parametrize("size", [2, 3])
+def test_xla_eager_backend(size):
+    """Eager collectives over the XLA data plane (jax.distributed global
+    mesh) — the SPMD analog of the NCCL path."""
+    _launch(size, timeout=480, worker=XLA_WORKER,
+            extra_env={"HOROVOD_TPU_OPERATIONS": "XLA_EAGER"})

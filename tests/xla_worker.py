@@ -1,0 +1,75 @@
+"""Worker for the XLA eager backend (HVD_TPU_OPERATIONS=XLA_EAGER):
+collectives ride jitted XLA programs over the jax.distributed global mesh."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["HOROVOD_TPU_OPERATIONS"] = "XLA_EAGER"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    hvd.init()
+    assert hvd.rank() == rank and hvd.size() == size
+    from horovod_tpu.ops.xla_backend import XlaBackend
+    from horovod_tpu.common.basics import _require_init
+    assert isinstance(_require_init().backend, XlaBackend)
+
+    # allreduce sum / average
+    out = hvd.allreduce(jnp.arange(8.0) + rank, op=hvd.Sum, name="s")
+    np.testing.assert_allclose(
+        np.asarray(out), sum(np.arange(8.0) + r for r in range(size)))
+    out = hvd.allreduce(jnp.ones(4) * (rank + 1), name="a")
+    np.testing.assert_allclose(np.asarray(out),
+                               np.mean([r + 1 for r in range(size)]))
+    # min/max
+    mn = hvd.allreduce(jnp.asarray([float(rank)]), op=hvd.Min, name="mn")
+    mx = hvd.allreduce(jnp.asarray([float(rank)]), op=hvd.Max, name="mx")
+    assert float(np.asarray(mn)[0]) == 0 and \
+        float(np.asarray(mx)[0]) == size - 1
+
+    # broadcast from nonzero root
+    b = hvd.broadcast(jnp.full(3, float(rank)), root_rank=size - 1, name="b")
+    np.testing.assert_allclose(np.asarray(b), float(size - 1))
+
+    # ragged allgather
+    g = hvd.allgather(jnp.ones((rank + 1, 2)) * rank, name="g")
+    assert np.asarray(g).shape == (sum(r + 1 for r in range(size)), 2)
+
+    # uniform alltoall
+    t, rs = hvd.alltoall(jnp.arange(float(size * 2)).reshape(size * 2, 1),
+                         name="t")
+    assert list(np.asarray(rs)) == [2] * size
+
+    # uneven alltoall: rank r sends (i+1) rows of value r*10+i to rank i
+    splits = [i + 1 for i in range(size)]
+    sendbuf = np.concatenate([
+        np.full((i + 1, 2), rank * 10 + i, np.float32)
+        for i in range(size)])
+    out, recv = hvd.alltoall(jnp.asarray(sendbuf), splits=splits, name="u")
+    expect = np.concatenate([
+        np.full((rank + 1, 2), r * 10 + rank, np.float32)
+        for r in range(size)])
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+    hvd.barrier()
+    hvd.shutdown()
+    print(f"xla worker {rank}: OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
