@@ -1,0 +1,199 @@
+// Adasum allreduce — vector-halving distance-doubling (VHDD) on the host
+// TCP data plane.
+//
+// Reference: horovod/common/ops/adasum/adasum.h:38-564 — the recursive
+// algorithm: at level L, hypercube partners exchange vector halves, compute
+// partial dot/norms on the kept half, sum the three scalars across the
+// active subcube, and combine
+//     a' = (1 - dot/(2*|a|^2)) a + (1 - dot/(2*|b|^2)) b ;
+// after log2(p) levels each rank owns 1/p of the combined vector, which an
+// allgather-doubling phase reassembles. Non-power-of-two groups fold the
+// extra ranks onto partners first (reference: adasum.h:205-240).
+#include <cmath>
+#include <cstring>
+#include <vector>
+
+#include "collectives.h"
+
+namespace hvd {
+
+namespace {
+
+template <typename T>
+void DotAndNorms(const T* a, const T* b, int64_t n, double* out3) {
+  double dot = 0, na = 0, nb = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    double x = (double)a[i], y = (double)b[i];
+    dot += x * y;
+    na += x * x;
+    nb += y * y;
+  }
+  out3[0] = dot;
+  out3[1] = na;
+  out3[2] = nb;
+}
+
+template <typename T>
+void ScaledAdd(T* dst, double ca, const T* a, double cb, const T* b,
+               int64_t n) {
+  for (int64_t i = 0; i < n; ++i)
+    dst[i] = (T)(ca * (double)a[i] + cb * (double)b[i]);
+}
+
+template <typename T>
+void AddInto(T* dst, const T* src, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) dst[i] += src[i];
+}
+
+// Sum 3 doubles across the 2^(level+1)-rank subcube containing my_pos
+// (recursive doubling on the hypercube).
+template <typename T>
+Status SubcubeScalarSum(Transport& t, const Group& g, int32_t tag,
+                        const std::vector<int>& cube, int my_pos,
+                        int levels, double* vals) {
+  for (int l = 0; l < levels; ++l) {
+    int partner_pos = my_pos ^ (1 << l);
+    int peer = g.global(cube[partner_pos]);
+    auto st = t.Send(peer, tag, vals, 3 * sizeof(double));
+    if (!st.ok()) return st;
+    std::vector<uint8_t> buf;
+    st = t.Recv(peer, tag, &buf);
+    if (!st.ok()) return st;
+    const double* other = (const double*)buf.data();
+    vals[0] += other[0];
+    vals[1] += other[1];
+    vals[2] += other[2];
+  }
+  return Status::OK();
+}
+
+template <typename T>
+Status VhddTyped(Transport& t, const Group& g, int32_t tag, T* data,
+                 int64_t nelem) {
+  int p = g.size();
+  int me = g.my_index;
+  if (p == 1 || nelem == 0) return Status::OK();
+
+  // largest power of two <= p
+  int pow2 = 1;
+  while (pow2 * 2 <= p) pow2 *= 2;
+  int extras = p - pow2;
+
+  // fold extras: rank pow2+i sends its vector to rank i, which combines
+  // locally with the Adasum rule; at the end the partner sends the result
+  // back (reference adasum.h:205-240 pairing)
+  std::vector<T> fold;
+  if (me >= pow2) {
+    auto st = t.Send(g.global(me - pow2), tag, data, nelem * sizeof(T));
+    if (!st.ok()) return st;
+    std::vector<uint8_t> buf;
+    st = t.Recv(g.global(me - pow2), tag + 1, &buf);
+    if (!st.ok()) return st;
+    memcpy(data, buf.data(), nelem * sizeof(T));
+    return Status::OK();
+  }
+  if (me < extras) {
+    std::vector<uint8_t> buf;
+    auto st = t.Recv(g.global(me + pow2), tag, &buf);
+    if (!st.ok()) return st;
+    const T* other = (const T*)buf.data();
+    double d3[3];
+    DotAndNorms(data, other, nelem, d3);
+    double ca = d3[1] > 0 ? 1.0 - d3[0] / (2.0 * d3[1]) : 1.0;
+    double cb = d3[2] > 0 ? 1.0 - d3[0] / (2.0 * d3[2]) : 1.0;
+    ScaledAdd(data, ca, data, cb, other, nelem);
+  }
+
+  // VHDD among the first pow2 ranks
+  std::vector<int> cube(pow2);
+  for (int i = 0; i < pow2; ++i) cube[i] = i;
+  int64_t start = 0, len = nelem;
+  int levels = 0;
+  while ((1 << levels) < pow2) levels++;
+  std::vector<int64_t> seg_starts(levels), seg_lens(levels);
+
+  std::vector<uint8_t> buf;
+  for (int l = 0; l < levels; ++l) {
+    int partner = me ^ (1 << l);
+    int peer = g.global(partner);
+    int64_t half = len / 2;
+    int64_t my_start, my_len, their_start, their_len;
+    if (me < partner) {  // keep left half, send right
+      my_start = start;
+      my_len = half;
+      their_start = start + half;
+      their_len = len - half;
+    } else {             // keep right half, send left
+      my_start = start + half;
+      my_len = len - half;
+      their_start = start;
+      their_len = half;
+    }
+    auto st = t.Send(peer, tag + 2, data + their_start,
+                     their_len * sizeof(T));
+    if (!st.ok()) return st;
+    st = t.Recv(peer, tag + 2, &buf);
+    if (!st.ok()) return st;
+    const T* theirs = (const T*)buf.data();  // partner's copy of MY segment
+    // role consistency across the pair: "a" is always the LOWER partner's
+    // vector so the summed partial norms refer to the same operand on both
+    // sides (reference fixes roles the same way)
+    const T* va = (me < partner) ? data + my_start : theirs;
+    const T* vb = (me < partner) ? theirs : data + my_start;
+    double d3[3];
+    DotAndNorms(va, vb, my_len, d3);
+    // global coefficients: sum partials across the 2^(l+1) subcube
+    auto st2 = SubcubeScalarSum<T>(t, g, tag + 3, cube, me, l + 1, d3);
+    if (!st2.ok()) return st2;
+    double ca = d3[1] > 0 ? 1.0 - d3[0] / (2.0 * d3[1]) : 1.0;
+    double cb = d3[2] > 0 ? 1.0 - d3[0] / (2.0 * d3[2]) : 1.0;
+    ScaledAdd(data + my_start, ca, va, cb, vb, my_len);
+    seg_starts[l] = start;
+    seg_lens[l] = len;
+    start = my_start;
+    len = my_len;
+  }
+
+  // allgather doubling back (reverse order): exchange my combined segment
+  // with the level partner to rebuild its parent segment
+  for (int l = levels - 1; l >= 0; --l) {
+    int partner = me ^ (1 << l);
+    int peer = g.global(partner);
+    auto st = t.Send(peer, tag + 4, data + start, len * sizeof(T));
+    if (!st.ok()) return st;
+    st = t.Recv(peer, tag + 4, &buf);
+    if (!st.ok()) return st;
+    int64_t pstart = seg_starts[l], plen = seg_lens[l];
+    // partner's segment is the complement of mine within the parent
+    int64_t other_start = (start == pstart) ? pstart + len : pstart;
+    int64_t other_len = plen - len;
+    memcpy(data + other_start, buf.data(), other_len * sizeof(T));
+    start = pstart;
+    len = plen;
+  }
+
+  // return folded result to the extra ranks
+  if (me < extras) {
+    auto st = t.Send(g.global(me + pow2), tag + 1, data, nelem * sizeof(T));
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+}  // namespace
+
+Status AdasumAllreduce(Transport& t, const Group& g, int32_t tag, void* data,
+                       int64_t nelem, DataType dtype) {
+  switch (dtype) {
+    case DataType::kFloat32:
+      return VhddTyped<float>(t, g, tag, (float*)data, nelem);
+    case DataType::kFloat64:
+      return VhddTyped<double>(t, g, tag, (double*)data, nelem);
+    default:
+      return Status::Error(
+          "Adasum on the host path supports float32/float64 (cast 16-bit "
+          "gradients up, or use the XLA Adasum path)");
+  }
+}
+
+}  // namespace hvd

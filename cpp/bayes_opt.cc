@@ -1,0 +1,137 @@
+#include "bayes_opt.h"
+
+#include <algorithm>
+#include <cmath>
+
+namespace hvd {
+
+double GaussianProcess::Kernel(const std::vector<double>& a,
+                               const std::vector<double>& b) const {
+  double d2 = 0;
+  for (size_t i = 0; i < a.size(); ++i) {
+    double d = a[i] - b[i];
+    d2 += d * d;
+  }
+  return signal_var_ * std::exp(-d2 / (2 * length_scale_ * length_scale_));
+}
+
+void GaussianProcess::Fit(const std::vector<std::vector<double>>& x,
+                          const std::vector<double>& y, double noise) {
+  x_ = x;
+  int n = (int)x.size();
+  mean_ = 0;
+  for (double v : y) mean_ += v;
+  mean_ /= std::max(n, 1);
+  // normalize signal variance to data variance
+  double var = 0;
+  for (double v : y) var += (v - mean_) * (v - mean_);
+  signal_var_ = n > 1 ? std::max(var / (n - 1), 1e-12) : 1.0;
+
+  // K + noise*I, Cholesky factorization (reference: gaussian_process.cc)
+  std::vector<std::vector<double>> K(n, std::vector<double>(n));
+  for (int i = 0; i < n; ++i)
+    for (int j = 0; j < n; ++j)
+      K[i][j] = Kernel(x[i], x[j]) + (i == j ? noise * signal_var_ : 0.0);
+  chol_.assign(n, std::vector<double>(n, 0.0));
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j <= i; ++j) {
+      double s = K[i][j];
+      for (int k = 0; k < j; ++k) s -= chol_[i][k] * chol_[j][k];
+      if (i == j)
+        chol_[i][i] = std::sqrt(std::max(s, 1e-12));
+      else
+        chol_[i][j] = s / chol_[j][j];
+    }
+  }
+  // alpha = K^-1 (y - mean) via forward/back substitution
+  std::vector<double> z(n);
+  for (int i = 0; i < n; ++i) {
+    double s = y[i] - mean_;
+    for (int k = 0; k < i; ++k) s -= chol_[i][k] * z[k];
+    z[i] = s / chol_[i][i];
+  }
+  alpha_.assign(n, 0.0);
+  for (int i = n - 1; i >= 0; --i) {
+    double s = z[i];
+    for (int k = i + 1; k < n; ++k) s -= chol_[k][i] * alpha_[k];
+    alpha_[i] = s / chol_[i][i];
+  }
+}
+
+void GaussianProcess::Predict(const std::vector<double>& xs, double* mu,
+                              double* var) const {
+  int n = (int)x_.size();
+  if (n == 0) {
+    *mu = mean_;
+    *var = signal_var_;
+    return;
+  }
+  std::vector<double> k(n);
+  for (int i = 0; i < n; ++i) k[i] = Kernel(xs, x_[i]);
+  double m = mean_;
+  for (int i = 0; i < n; ++i) m += k[i] * alpha_[i];
+  // v = L^-1 k ; var = k(x,x) - v.v
+  std::vector<double> v(n);
+  for (int i = 0; i < n; ++i) {
+    double s = k[i];
+    for (int j = 0; j < i; ++j) s -= chol_[i][j] * v[j];
+    v[i] = s / chol_[i][i];
+  }
+  double vv = 0;
+  for (int i = 0; i < n; ++i) vv += v[i] * v[i];
+  *mu = m;
+  *var = std::max(Kernel(xs, xs) - vv, 1e-12);
+}
+
+BayesianOptimizer::BayesianOptimizer(int dims, uint64_t seed)
+    : dims_(dims), rng_(seed) {}
+
+void BayesianOptimizer::AddSample(const std::vector<double>& x, double y) {
+  x_.push_back(x);
+  y_.push_back(y);
+}
+
+std::vector<double> BayesianOptimizer::BestSample() const {
+  if (y_.empty()) return std::vector<double>(dims_, 0.5);
+  size_t best = 0;
+  for (size_t i = 1; i < y_.size(); ++i)
+    if (y_[i] > y_[best]) best = i;
+  return x_[best];
+}
+
+std::vector<double> BayesianOptimizer::NextSample() {
+  std::uniform_real_distribution<double> u(0.0, 1.0);
+  if (y_.size() < 3) {  // pure exploration until the GP has something
+    std::vector<double> x(dims_);
+    for (auto& v : x) v = u(rng_);
+    return x;
+  }
+  GaussianProcess gp;
+  gp.Fit(x_, y_);
+  double best_y = *std::max_element(y_.begin(), y_.end());
+  // expected improvement (reference: bayesian_optimization.cc EI), argmax
+  // over random candidates
+  std::vector<double> best_x(dims_, 0.5);
+  double best_ei = -1;
+  const double xi = 0.01;
+  for (int c = 0; c < 256; ++c) {
+    std::vector<double> x(dims_);
+    for (auto& v : x) v = u(rng_);
+    double mu, var;
+    gp.Predict(x, &mu, &var);
+    double sigma = std::sqrt(var);
+    double imp = mu - best_y - xi;
+    double zz = imp / sigma;
+    // EI = imp*Phi(z) + sigma*phi(z)
+    double Phi = 0.5 * std::erfc(-zz / std::sqrt(2.0));
+    double phi = std::exp(-0.5 * zz * zz) / std::sqrt(2 * M_PI);
+    double ei = imp * Phi + sigma * phi;
+    if (ei > best_ei) {
+      best_ei = ei;
+      best_x = x;
+    }
+  }
+  return best_x;
+}
+
+}  // namespace hvd

@@ -1,0 +1,54 @@
+// Gaussian-process Bayesian optimization for the autotuner.
+//
+// Reference: horovod/common/optim/gaussian_process.cc (RBF-kernel GP with
+// Cholesky solves) + bayesian_optimization.cc (expected-improvement
+// acquisition maximized over candidates), driving ParameterManager's
+// (fusion threshold, cycle time) search. Same design, dependency-free
+// (the reference pulls in Eigen + LBFGS; a candidate-grid argmax over EI
+// is ample for a 2-D space).
+#pragma once
+
+#include <cstdint>
+#include <random>
+#include <vector>
+
+namespace hvd {
+
+class GaussianProcess {
+ public:
+  // x: normalized points in [0,1]^d; y: scores (higher better)
+  void Fit(const std::vector<std::vector<double>>& x,
+           const std::vector<double>& y, double noise = 1e-6);
+  // posterior mean/variance at x*
+  void Predict(const std::vector<double>& xs, double* mu,
+               double* var) const;
+
+ private:
+  double Kernel(const std::vector<double>& a,
+                const std::vector<double>& b) const;
+  std::vector<std::vector<double>> x_;
+  std::vector<double> alpha_;               // K^-1 (y - mean)
+  std::vector<std::vector<double>> chol_;   // L of K + noise I
+  double mean_ = 0;
+  double length_scale_ = 0.3;
+  double signal_var_ = 1.0;
+};
+
+class BayesianOptimizer {
+ public:
+  explicit BayesianOptimizer(int dims, uint64_t seed = 17);
+  void AddSample(const std::vector<double>& x, double y);
+  // next point to evaluate: argmax expected improvement over random
+  // candidates (plus pure exploration until enough samples exist)
+  std::vector<double> NextSample();
+  std::vector<double> BestSample() const;
+  int num_samples() const { return (int)y_.size(); }
+
+ private:
+  int dims_;
+  std::mt19937_64 rng_;
+  std::vector<std::vector<double>> x_;
+  std::vector<double> y_;
+};
+
+}  // namespace hvd

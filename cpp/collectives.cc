@@ -187,6 +187,10 @@ void ScaleBuffer(void* data, int64_t n, DataType dt, double factor) {
 
 }  // namespace
 
+void ScaleBufferOp(void* data, int64_t n, DataType dt, double factor) {
+  ScaleBuffer(data, n, dt, factor);
+}
+
 Status RingAllreduce(Transport& t, const Group& g, int32_t tag, void* data,
                      int64_t nelem, DataType dtype, ReduceOp op,
                      double prescale, double postscale) {

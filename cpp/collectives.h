@@ -55,4 +55,13 @@ Status BitvectorAnd(Transport& t, const Group& g, int32_t tag,
 Status BitvectorOr(Transport& t, const Group& g, int32_t tag,
                    std::vector<uint8_t>* bits);
 
+// Adasum VHDD allreduce (cpp/adasum.cc; reference: adasum/adasum.h).
+// Uses tags [tag, tag+4].
+Status AdasumAllreduce(Transport& t, const Group& g, int32_t tag, void* data,
+                       int64_t nelem, DataType dtype);
+
+// Elementwise in-place scale (fp paths; exposed for the Adasum pre/post
+// scaling in the engine).
+void ScaleBufferOp(void* data, int64_t n, DataType dt, double factor);
+
 }  // namespace hvd

@@ -4,6 +4,7 @@
 #include <cstring>
 #include <sstream>
 
+#include "bayes_opt.h"
 #include "timeline.h"
 #include "wire.h"
 
@@ -129,18 +130,40 @@ std::string StallInspector::Check(double warn_seconds) {
 }
 
 // ---------------------------------------------------------------------------
-// ParameterManager — cyclic coordinate descent over a discrete grid
+// ParameterManager — GP/expected-improvement Bayesian optimization over
+// (log fusion threshold, log cycle time), scored by bytes/sec
+// (reference: parameter_manager.h + optim/bayesian_optimization.cc)
 // ---------------------------------------------------------------------------
 
 namespace {
-const int64_t kFusionGrid[] = {8 << 20, 32 << 20, 64 << 20, 128 << 20};
-const double kCycleGrid[] = {0.5, 1.0, 2.5, 5.0};
+// normalized [0,1] <-> parameter ranges (log scale)
+constexpr double kFusionLogMin = 20.0;   // 2^20 = 1 MB
+constexpr double kFusionLogMax = 28.0;   // 2^28 = 256 MB
+constexpr double kCycleLogMin = -1.0;    // 2^-1 = 0.5 ms
+constexpr double kCycleLogMax = 3.5;     // 2^3.5 ~= 11 ms
+
+int64_t DenormFusion(double u) {
+  return (int64_t)std::pow(
+      2.0, kFusionLogMin + u * (kFusionLogMax - kFusionLogMin));
+}
+double DenormCycle(double u) {
+  return std::pow(2.0, kCycleLogMin + u * (kCycleLogMax - kCycleLogMin));
+}
+double NormFusion(int64_t f) {
+  double l = std::log2((double)std::max<int64_t>(f, 1));
+  return std::min(1.0, std::max(0.0, (l - kFusionLogMin) /
+                                          (kFusionLogMax - kFusionLogMin)));
+}
+double NormCycle(double c) {
+  double l = std::log2(std::max(c, 1e-3));
+  return std::min(1.0, std::max(0.0, (l - kCycleLogMin) /
+                                          (kCycleLogMax - kCycleLogMin)));
+}
 }  // namespace
 
 void ParameterManager::Enable(int64_t init_fusion, double init_cycle) {
   enabled_ = true;
-  best_fusion_ = init_fusion;
-  best_cycle_ = init_cycle;
+  bo_ = std::make_shared<BayesianOptimizer>(2);
   window_start_ = std::chrono::steady_clock::now();
 }
 
@@ -152,29 +175,19 @@ bool ParameterManager::Tune(int64_t* fusion_bytes, double* cycle_ms) {
   double secs = std::chrono::duration<double>(now - window_start_).count();
   if (secs < 2.0) return false;  // sample window
   double score = bytes_acc_ / secs;
-  if (score > best_score_) {
-    best_score_ = score;
-    best_fusion_ = *fusion_bytes;
-    best_cycle_ = *cycle_ms;
-  }
+  bo_->AddSample({NormFusion(*fusion_bytes), NormCycle(*cycle_ms)}, score);
   bytes_acc_ = 0;
   window_start_ = now;
   samples_++;
-  // explore next grid point on the current coordinate
-  if (phase_ == 0) {
-    fusion_idx_ = (fusion_idx_ + 1) % 4;
-    *fusion_bytes = kFusionGrid[fusion_idx_];
-    if (fusion_idx_ == 0) phase_ = 1;
-  } else {
-    cycle_idx_ = (cycle_idx_ + 1) % 4;
-    *cycle_ms = kCycleGrid[cycle_idx_];
-    if (cycle_idx_ == 0) phase_ = 0;
-  }
-  if (samples_ > 16) {  // converge to best seen
-    *fusion_bytes = best_fusion_;
-    *cycle_ms = best_cycle_;
+  std::vector<double> x;
+  if (samples_ > 24) {  // converge to the best observed point
+    x = bo_->BestSample();
     enabled_ = false;
+  } else {
+    x = bo_->NextSample();
   }
+  *fusion_bytes = DenormFusion(x[0]);
+  *cycle_ms = DenormCycle(x[1]);
   return true;
 }
 
@@ -907,9 +920,17 @@ void Core::Execute(CoordDomain& d, const Response& r) {
       // element count: all same dtype; compute from bytes
       size_t esz = DataTypeSize(r.dtypes[0]);
       nelem = total / esz;
-      auto st = RingAllreduce(*transport_, d.group, dtag, fusion.data(),
-                              nelem, r.dtypes[0], r.op, r.prescale,
-                              r.postscale);
+      Status st;
+      if (r.op == ReduceOp::kAdasum && d.group.size() > 1) {
+        ScaleBufferOp(fusion.data(), nelem, r.dtypes[0], r.prescale);
+        st = AdasumAllreduce(*transport_, d.group, dtag, fusion.data(),
+                             nelem, r.dtypes[0]);
+        ScaleBufferOp(fusion.data(), nelem, r.dtypes[0], r.postscale);
+      } else {
+        st = RingAllreduce(*transport_, d.group, dtag, fusion.data(),
+                           nelem, r.dtypes[0], r.op, r.prescale,
+                           r.postscale);
+      }
       param_mgr_.Record(total);
       for (auto& s : slots) {
         if (!s.have) continue;

@@ -82,8 +82,12 @@ class StallInspector {
 };
 
 // Online autotune of cycle time & fusion threshold (reference:
-// horovod/common/parameter_manager.h — Bayesian opt; here a simple
-// cyclic coordinate search over a discrete grid, scored by bytes/sec).
+// horovod/common/parameter_manager.h driving the GP/EI Bayesian optimizer
+// in optim/bayesian_optimization.cc — same design in cpp/bayes_opt.{h,cc}).
+// Coordinator-only; the chosen fusion threshold is broadcast with each
+// response list so fusion grouping stays rank-identical.
+class BayesianOptimizer;
+
 class ParameterManager {
  public:
   void Enable(int64_t init_fusion, double init_cycle);
@@ -97,10 +101,7 @@ class ParameterManager {
   int64_t bytes_acc_ = 0;
   std::chrono::steady_clock::time_point window_start_;
   int samples_ = 0;
-  double best_score_ = 0;
-  int64_t best_fusion_ = 0;
-  double best_cycle_ = 0;
-  int fusion_idx_ = 0, cycle_idx_ = 0, phase_ = 0;
+  std::shared_ptr<BayesianOptimizer> bo_;
 };
 
 struct CoreConfig {

@@ -62,9 +62,12 @@ class XlaBackend(Backend):
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
         self._P = P
         self._NS = NamedSharding
+        # one device per process: eager contributions are host arrays, so
+        # replicating them over every local chip would just multiply H2D
+        # transfers; mesh-mode code paths use the full mesh instead
         nlocal = jax.local_device_count()
-        devs = np.asarray(jax.devices()).reshape(self.size, nlocal)
-        self._mesh = Mesh(devs, ("proc", "local"))
+        devs = np.asarray(jax.devices()).reshape(self.size, nlocal)[:, 0]
+        self._mesh = Mesh(devs, ("proc",))
         self._fn_cache = {}
 
     # -- helpers -------------------------------------------------------------
@@ -74,7 +77,8 @@ class XlaBackend(Backend):
         jax = self._jax
         sharding = self._NS(self._mesh, self._P("proc"))
         row = np.asarray(arr)[None]
-        shards = [jax.device_put(row, d) for d in jax.local_devices()]
+        my_dev = self._mesh.devices[self.rank]
+        shards = [jax.device_put(row, my_dev)]
         return jax.make_array_from_single_device_arrays(
             (self.size,) + np.asarray(arr).shape, sharding, shards)
 
@@ -124,16 +128,12 @@ class XlaBackend(Backend):
 
     # -- collectives ---------------------------------------------------------
     def allreduce_async(self, name, value, op, prescale=1.0, postscale=1.0):
-        arr = np.asarray(value)
-        if prescale != 1.0:
-            arr = arr * prescale
+        from horovod_tpu.ops.backend import _scale
+        arr = _scale(np.asarray(value), prescale)
         garr = self._to_global(arr)
         fn = self._collective("allreduce", op, arr.shape, arr.dtype)
-        out = self._local_view(fn(garr))
-        if op == ReduceOp.AVERAGE:
-            pass  # preduce already averaged (pmean)
-        if postscale != 1.0:
-            out = (out * postscale).astype(arr.dtype)
+        # AVERAGE is handled inside the collective (pmean)
+        out = _scale(self._local_view(fn(garr)), postscale)
         result = self._jnp.asarray(out) if not isinstance(value, np.ndarray) \
             else out
         return HvdHandle.done(result)
@@ -166,6 +166,10 @@ class XlaBackend(Backend):
         return HvdHandle.done(result)
 
     def broadcast_async(self, name, value, root_rank):
+        if not 0 <= int(root_rank) < self.size:
+            raise ValueError(
+                f"broadcast root_rank={root_rank} out of range for size "
+                f"{self.size}")
         arr = np.asarray(value)
         garr = self._to_global(arr)
         fn = self._collective("broadcast", ReduceOp.SUM, arr.shape,
@@ -185,6 +189,12 @@ class XlaBackend(Backend):
         splits = [int(s) for s in splits]
         if len(splits) != self.size:
             raise ValueError("alltoall splits must have one entry per rank")
+        if any(s < 0 for s in splits):
+            raise ValueError("alltoall splits must be non-negative")
+        if sum(splits) != arr.shape[0]:
+            raise ValueError(
+                f"alltoall splits sum ({sum(splits)}) must equal dim 0 "
+                f"({arr.shape[0]})")
         if len(set(splits)) == 1:
             # uniform: single fused XLA all_to_all
             rows = splits[0]

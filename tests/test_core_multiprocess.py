@@ -15,6 +15,7 @@ WORKER = os.path.join(os.path.dirname(__file__), "core_worker.py")
 HVD_WORKER = os.path.join(os.path.dirname(__file__), "hvd_worker.py")
 ERROR_WORKER = os.path.join(os.path.dirname(__file__), "error_worker.py")
 XLA_WORKER = os.path.join(os.path.dirname(__file__), "xla_worker.py")
+ADASUM_WORKER = os.path.join(os.path.dirname(__file__), "adasum_worker.py")
 
 
 def _free_port():
@@ -105,3 +106,18 @@ def test_xla_eager_backend(size):
     mesh) — the SPMD analog of the NCCL path."""
     _launch(size, timeout=480, worker=XLA_WORKER,
             extra_env={"HOROVOD_TPU_OPERATIONS": "XLA_EAGER"})
+
+
+@needs_core
+@pytest.mark.parametrize("size", [2, 3, 4])
+def test_adasum_vhdd(size):
+    """C++ VHDD Adasum vs the Python binary-tree oracle (incl. the
+    non-power-of-two fold path at size 3)."""
+    _launch(size, timeout=240, worker=ADASUM_WORKER)
+
+
+@needs_core
+def test_core_with_autotune():
+    """Autotune enabled: collectives stay correct while the coordinator's
+    GP tuner runs (coordinator-only; threshold broadcast with responses)."""
+    _launch(2, {"HVD_TPU_AUTOTUNE": "1", "HVD_TPU_CYCLE_TIME": "0.5"})
