@@ -52,6 +52,9 @@ int hvd_init() {
                                       "HOROVOD_STALL_CHECK_TIME_SECONDS",
                                       "60"));
   cfg.autotune = atoi(EnvOr("HVD_TPU_AUTOTUNE", "HOROVOD_AUTOTUNE", "0"));
+  cfg.disable_group_fusion = atoi(EnvOr("HVD_TPU_DISABLE_GROUP_FUSION",
+                                        "HOROVOD_DISABLE_GROUP_FUSION",
+                                        "0"));
   cfg.timeline_path = EnvOr("HVD_TPU_TIMELINE", "HOROVOD_TIMELINE", "");
   auto st = Core::Get().Init(cfg);
   if (!st.ok()) return SetError(st);
@@ -73,6 +76,19 @@ int hvd_enqueue_allreduce(const char* name, const void* in, void* out,
   return Core::Get().EnqueueAllreduce(domain, name, in, out,
                                       (DataType)dtype, sh, (ReduceOp)op,
                                       prescale, postscale);
+}
+
+int hvd_enqueue_grouped_allreduce(const char* name, const void* in,
+                                  void* out, int dtype, int ndim,
+                                  const int64_t* shape, int op,
+                                  double prescale, double postscale,
+                                  int domain, int group_id,
+                                  int group_size) {
+  std::vector<int64_t> sh(shape, shape + ndim);
+  return Core::Get().EnqueueAllreduce(domain, name, in, out,
+                                      (DataType)dtype, sh, (ReduceOp)op,
+                                      prescale, postscale, group_id,
+                                      group_size);
 }
 
 int hvd_enqueue_allgather(const char* name, const void* in, int dtype,

@@ -15,7 +15,8 @@ namespace {
 constexpr int kTagNegotiate = 0;  // worker -> coordinator request lists
 constexpr int kTagResponse = 1;   // coordinator -> worker response lists
 constexpr int kTagData = 2;       // collective payload (uses +1 too)
-constexpr int kTagBarrier = 6;
+constexpr int kTagAdasum = 8;     // VHDD channels [8, 12]
+constexpr int kTagBarrier = 13;
 
 int32_t DomTag(int domain, int channel) { return domain * 16 + channel; }
 
@@ -72,7 +73,7 @@ std::string ResponseCache::Key(const Request& r) {
   std::ostringstream os;
   os << r.name << '|' << (int)r.type << '|' << (int)r.dtype << '|'
      << (int)r.op << '|' << r.root_rank << '|' << r.prescale << '|'
-     << r.postscale;
+     << r.postscale << '|' << r.group_id << '|' << r.group_size;
   for (auto d : r.shape) os << ',' << d;
   return os.str();
 }
@@ -83,6 +84,8 @@ int ResponseCache::Lookup(const std::string& key) const {
 }
 
 int ResponseCache::Insert(const std::string& key, const Response& resp) {
+  auto it = index_.find(key);
+  if (it != index_.end()) return it->second;  // already cached
   if (entries_.size() >= capacity_) return -1;  // full: stop caching
   int bit = (int)entries_.size();
   entries_.emplace_back(key, resp);
@@ -301,7 +304,8 @@ void Core::Shutdown() {
 int Core::EnqueueAllreduce(int domain, const std::string& name,
                            const void* in, void* out, DataType dt,
                            const std::vector<int64_t>& shape, ReduceOp op,
-                           double prescale, double postscale) {
+                           double prescale, double postscale,
+                           int group_id, int group_size) {
   int h = NewHandle(nullptr);
   auto hs = GetHandle(h);
   TensorTableEntry e;
@@ -329,6 +333,8 @@ int Core::EnqueueAllreduce(int domain, const std::string& name,
   r.op = op;
   r.prescale = prescale;
   r.postscale = postscale;
+  r.group_id = group_id;
+  r.group_size = group_size;
   PushToDomain(domain, std::move(e), std::move(r));
   return h;
 }
@@ -593,7 +599,9 @@ void Core::HandleRequests(CoordDomain& d, int from_rank,
       // validate agreement (reference: ConstructResponse mismatch errors)
       const Request& first = slot.first;
       bool mismatch = first.dtype != r.dtype || first.type != r.type ||
-                      (int)first.op != (int)r.op;
+                      (int)first.op != (int)r.op ||
+                      first.group_id != r.group_id ||
+                      first.group_size != r.group_size;
       if (!mismatch && r.type == Request::kAllreduce &&
           first.shape != r.shape)
         mismatch = true;
@@ -663,12 +671,33 @@ std::vector<Response> Core::CollectReady(CoordDomain& d) {
   for (auto& kv : ready) {
     auto& r = kv.second;
     auto err = d.error_table_.find(r.name);
-    if (err != d.error_table_.end()) {
+    bool poisoned = r.group_id >= 0 &&
+                    d.poisoned_groups_.count(r.group_id) > 0;
+    if (err != d.error_table_.end() || poisoned) {
       Response resp;
       resp.type = Response::kError;
       resp.names = {r.name};
-      resp.error_message = err->second;
-      d.error_table_.erase(err);
+      resp.error_message = err != d.error_table_.end()
+                               ? err->second
+                               : "another member of this tensor group "
+                                 "failed";
+      if (err != d.error_table_.end()) d.error_table_.erase(err);
+      // error in a group: fail the held members too so no handle waits
+      // forever
+      if (r.group_id >= 0) {
+        d.poisoned_groups_.insert(r.group_id);
+        auto git = d.groups_.find(r.group_id);
+        if (git != d.groups_.end()) {
+          for (auto& held : git->second.second) {
+            Response e2;
+            e2.type = Response::kError;
+            e2.names = held.names;
+            e2.error_message = resp.error_message;
+            out.push_back(std::move(e2));
+          }
+          d.groups_.erase(git);
+        }
+      }
       out.push_back(std::move(resp));
       continue;
     }
@@ -681,6 +710,26 @@ std::vector<Response> Core::CollectReady(CoordDomain& d) {
     resp.op = r.op;
     resp.prescale = r.prescale;
     resp.postscale = r.postscale;
+    resp.group_id = r.group_id;
+    resp.group_size = r.group_size;
+    if (r.type == Request::kAllreduce && r.group_id >= 0) {
+      // hold back until the whole group is ready (group-COMPLETE
+      // negotiation; reference: GroupTable readiness,
+      // controller.cc:207-231). Fusion still bounds unit sizes.
+      auto& slot = d.groups_[r.group_id];
+      if (slot.first == 0) slot.first = r.group_size;
+      slot.second.push_back(std::move(resp));
+      if ((int)slot.second.size() >= slot.first && slot.first > 0) {
+        std::sort(slot.second.begin(), slot.second.end(),
+                  [](const Response& a, const Response& b) {
+                    return a.names[0] < b.names[0];
+                  });
+        for (auto& gr : slot.second) out.push_back(std::move(gr));
+        d.groups_.erase(r.group_id);
+        d.poisoned_groups_.erase(r.group_id);
+      }
+      continue;
+    }
     out.push_back(std::move(resp));
   }
 
@@ -712,6 +761,8 @@ std::vector<Response> Core::FuseResponses(
     std::ostringstream gk;
     gk << (int)s.dtypes[0] << '|' << (int)s.op << '|' << s.prescale << '|'
        << s.postscale;
+    if (cfg_.disable_group_fusion)
+      gk << "|g" << s.group_id;  // keep groups (and loose tensors) apart
     std::string key = gk.str();
     int64_t sz = DataTypeSize(s.dtypes[0]);
     for (auto dim : s.shapes[0]) sz *= dim;
@@ -749,6 +800,8 @@ std::string KeyFromSingleResponse(const hvd::Response& r) {
   q.prescale = r.prescale;
   q.postscale = r.postscale;
   q.root_rank = 0;
+  q.group_id = r.group_id;
+  q.group_size = r.group_size;
   return hvd::ResponseCache::Key(q);
 }
 }  // namespace
@@ -923,8 +976,8 @@ void Core::Execute(CoordDomain& d, const Response& r) {
       Status st;
       if (r.op == ReduceOp::kAdasum && d.group.size() > 1) {
         ScaleBufferOp(fusion.data(), nelem, r.dtypes[0], r.prescale);
-        st = AdasumAllreduce(*transport_, d.group, dtag, fusion.data(),
-                             nelem, r.dtypes[0]);
+        st = AdasumAllreduce(*transport_, d.group, DomTag(d.id, kTagAdasum),
+                             fusion.data(), nelem, r.dtypes[0]);
         ScaleBufferOp(fusion.data(), nelem, r.dtypes[0], r.postscale);
       } else {
         st = RingAllreduce(*transport_, d.group, dtag, fusion.data(),

@@ -16,6 +16,7 @@
 #include <list>
 #include <map>
 #include <memory>
+#include <set>
 #include <thread>
 #include <unordered_map>
 
@@ -106,6 +107,7 @@ class ParameterManager {
 
 struct CoreConfig {
   int rank = 0;
+  bool disable_group_fusion = false;
   int size = 1;
   std::string coord_addr = "127.0.0.1";
   int coord_port = 37592;
@@ -138,6 +140,13 @@ struct CoordDomain {
   std::unordered_map<int, std::vector<int>> bit_ready_;
   // coordinator: tensors whose ranks disagreed on dtype/shape/op
   std::unordered_map<std::string, std::string> error_table_;
+  // coordinator: group id -> (expected member count, ready singles held
+  // back until the whole group is ready) — reference: GroupTable,
+  // horovod/common/group_table.h:30-60
+  std::unordered_map<int, std::pair<int, std::vector<Response>>> groups_;
+  // groups with an errored member: remaining members error out instead of
+  // waiting forever
+  std::set<int> poisoned_groups_;
 };
 
 class Core {
@@ -156,7 +165,8 @@ class Core {
   int EnqueueAllreduce(int domain, const std::string& name, const void* in,
                        void* out, DataType dt,
                        const std::vector<int64_t>& shape, ReduceOp op,
-                       double prescale, double postscale);
+                       double prescale, double postscale,
+                       int group_id = -1, int group_size = 0);
   int EnqueueAllgather(int domain, const std::string& name, const void* in,
                        DataType dt, const std::vector<int64_t>& shape);
   int EnqueueBroadcast(int domain, const std::string& name, const void* in,

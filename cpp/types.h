@@ -90,6 +90,7 @@ struct Request {
   double prescale = 1.0;
   double postscale = 1.0;
   int32_t group_id = -1;
+  int32_t group_size = 0;
 };
 
 // Response: coordinator's instruction to execute a (possibly fused) op
@@ -109,6 +110,8 @@ struct Response {
   double prescale = 1.0;
   double postscale = 1.0;
   int32_t last_joined_rank = -1;
+  int32_t group_id = -1;
+  int32_t group_size = 0;
   // true when served from the response cache (receivers must not re-insert)
   bool from_cache = false;
 };

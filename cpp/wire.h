@@ -70,6 +70,7 @@ inline void EncodeRequest(Writer& w, const Request& r) {
   w.f64(r.prescale);
   w.f64(r.postscale);
   w.i32(r.group_id);
+  w.i32(r.group_size);
 }
 
 inline Request DecodeRequest(Reader& rd) {
@@ -84,6 +85,7 @@ inline Request DecodeRequest(Reader& rd) {
   r.prescale = rd.f64();
   r.postscale = rd.f64();
   r.group_id = rd.i32();
+  r.group_size = rd.i32();
   return r;
 }
 
@@ -102,6 +104,8 @@ inline void EncodeResponse(Writer& w, const Response& r) {
   w.f64(r.prescale);
   w.f64(r.postscale);
   w.i32(r.last_joined_rank);
+  w.i32(r.group_id);
+  w.i32(r.group_size);
 }
 
 inline Response DecodeResponse(Reader& rd) {
@@ -123,6 +127,8 @@ inline Response DecodeResponse(Reader& rd) {
   r.prescale = rd.f64();
   r.postscale = rd.f64();
   r.last_joined_rank = rd.i32();
+  r.group_id = rd.i32();
+  r.group_size = rd.i32();
   return r;
 }
 

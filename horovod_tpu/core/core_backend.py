@@ -44,6 +44,12 @@ def _load_lib():
             ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
             ctypes.c_int, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
             ctypes.c_double, ctypes.c_double, ctypes.c_int]
+        lib.hvd_enqueue_grouped_allreduce.restype = ctypes.c_int
+        lib.hvd_enqueue_grouped_allreduce.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.c_double, ctypes.c_double, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int]
         lib.hvd_enqueue_allgather.restype = ctypes.c_int
         lib.hvd_enqueue_allgather.argtypes = [
             ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
@@ -187,6 +193,8 @@ class CoreBackend(Backend):
             rank = self._lib.hvd_rank()
             size = self._lib.hvd_size()
             self._owns_core = True if owns_core is None else owns_core
+            self._group_counter = 0
+            self._group_lock = threading.Lock()
             # hvd.init(ranks=...) restriction: the "global" set is a subset
             # of the launched world (reference: init_multi_comm,
             # operations.cc:881-965). The core still spans the full world;
@@ -203,27 +211,52 @@ class CoreBackend(Backend):
                 rank = sub.rank
                 size = sub.size
                 super().__init__(rank, size)
+                self._group_counter = 0
+                self._group_lock = threading.Lock()
                 return
         else:
             self._owns_core = False
         super().__init__(rank, size)
         self._domain = domain
+        self._group_counter = 0
+        self._group_lock = threading.Lock()
 
     # -- collectives ---------------------------------------------------------
-    def allreduce_async(self, name, value, op, prescale=1.0, postscale=1.0):
+    def allreduce_async(self, name, value, op, prescale=1.0, postscale=1.0,
+                        group_id=-1, group_size=0):
         arr, back = _to_host(value)
         out = np.empty_like(arr)
         sh, nd = _shape_arg(arr.shape)
-        ch = self._lib.hvd_enqueue_allreduce(
-            name.encode(), arr.ctypes.data_as(ctypes.c_void_p),
-            out.ctypes.data_as(ctypes.c_void_p), _np_dtype_code(arr.dtype),
-            nd, sh, int(op), float(prescale), float(postscale), self._domain)
+        if group_id >= 0:
+            ch = self._lib.hvd_enqueue_grouped_allreduce(
+                name.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+                out.ctypes.data_as(ctypes.c_void_p),
+                _np_dtype_code(arr.dtype), nd, sh, int(op),
+                float(prescale), float(postscale), self._domain,
+                int(group_id), int(group_size))
+        else:
+            ch = self._lib.hvd_enqueue_allreduce(
+                name.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+                out.ctypes.data_as(ctypes.c_void_p),
+                _np_dtype_code(arr.dtype), nd, sh, int(op),
+                float(prescale), float(postscale), self._domain)
         _pin_buffers(ch, (arr, out))
         return CoreHandle(self._lib, ch, lambda: back(out))
 
     def grouped_allreduce_async(self, names, values, op,
                                 prescale=1.0, postscale=1.0):
-        handles = [self.allreduce_async(n, v, op, prescale, postscale)
+        # a registered group (reference: GroupTable): the coordinator holds
+        # the whole group back until every member is ready (group-complete
+        # negotiation; fusion still bounds unit sizes). The id counter is
+        # per-backend (per coordination domain) so sub-set usage on one
+        # rank can't skew another domain's sequence; as with names, all
+        # members of a domain must make grouped calls in the same order.
+        with self._group_lock:
+            self._group_counter += 1
+            gid = self._group_counter
+        handles = [self.allreduce_async(n, v, op, prescale, postscale,
+                                        group_id=gid,
+                                        group_size=len(values))
                    for n, v in zip(names, values)]
         agg = HvdHandle()
 

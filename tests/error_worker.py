@@ -30,7 +30,19 @@ def main():
         assert "duplicate" in str(e).lower(), e
     out = h1.wait(30)
     np.testing.assert_allclose(out, 2.0)
-    # 3) normal op still works after the errors
+    # 3) grouped allreduce with one mismatched member: the whole group
+    #    errors (poisoned-group path), no handle hangs
+    bad = np.ones(7 if rank == 0 else 9, np.float32)
+    h = be.grouped_allreduce_async(
+        ["g.ok", "g.bad"], [np.ones(4, np.float32), bad], ReduceOp.SUM)
+    try:
+        h.wait(30)
+        raise SystemExit(f"rank {rank}: grouped mismatch did NOT error")
+    except RuntimeError as e:
+        msg = str(e).lower()
+        assert "mismatched" in msg or "group" in msg, e
+
+    # 4) normal op still works after the errors
     out = be.allreduce_async("after", np.ones(3, np.float32),
                              ReduceOp.SUM).wait(30)
     np.testing.assert_allclose(out, 2.0)

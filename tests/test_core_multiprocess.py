@@ -121,3 +121,10 @@ def test_core_with_autotune():
     """Autotune enabled: collectives stay correct while the coordinator's
     GP tuner runs (coordinator-only; threshold broadcast with responses)."""
     _launch(2, {"HVD_TPU_AUTOTUNE": "1", "HVD_TPU_CYCLE_TIME": "0.5"})
+
+
+@needs_core
+def test_core_group_fusion_disabled():
+    """HOROVOD_DISABLE_GROUP_FUSION: grouped allreduces stay numerically
+    correct when groups are kept out of shared fusion units."""
+    _launch(2, {"HOROVOD_DISABLE_GROUP_FUSION": "1"})
