@@ -12,6 +12,10 @@ import os
 
 
 def _lib_path() -> str:
+    # HVD_TPU_CORE_LIB overrides (e.g. the `make tsan` ThreadSanitizer build)
+    override = os.environ.get("HVD_TPU_CORE_LIB")
+    if override:
+        return override
     return os.path.join(os.path.dirname(__file__), "libhvdcore.so")
 
 

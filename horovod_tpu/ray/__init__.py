@@ -108,3 +108,50 @@ class RayExecutor:
             for w in self._workers:
                 ray.kill(w)
             self._workers = []
+
+
+class RayHostDiscovery:
+    """Host discovery over a live Ray cluster for the elastic driver
+    (reference: ``RayHostDiscovery``, ``ray/elastic.py:38-88``): available
+    hosts are Ray nodes with enough free CPUs for a worker slot."""
+
+    def __init__(self, cpus_per_slot: int = 1) -> None:
+        self._ray = _require_ray()
+        self._cpus = cpus_per_slot
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        ray = self._ray
+        hosts: Dict[str, int] = {}
+        for node in ray.nodes():
+            if not node.get("Alive"):
+                continue
+            cpus = int(node.get("Resources", {}).get("CPU", 0))
+            slots = cpus // max(self._cpus, 1)
+            if slots > 0:
+                hosts[node["NodeManagerAddress"]] = slots
+        return hosts
+
+
+class ElasticRayExecutor:
+    """Elastic executor over Ray (reference: ``ElasticRayExecutor``,
+    ``ray/elastic.py:90-149``): the generation-based elastic driver with
+    Ray-node discovery; workers run the command via ssh to Ray nodes.
+    Gated on ray availability."""
+
+    def __init__(self, command, min_np: int = 1, max_np: Optional[int] = None,
+                 cpus_per_slot: int = 1, env: Optional[Dict[str, str]] = None,
+                 reset_limit: Optional[int] = None) -> None:
+        _require_ray()
+        self._discovery = RayHostDiscovery(cpus_per_slot)
+        self._command = command
+        self._min_np = min_np
+        self._max_np = max_np
+        self._env = env
+        self._reset_limit = reset_limit
+
+    def run(self) -> int:
+        from horovod_tpu.runner.elastic.driver import ElasticDriver
+        driver = ElasticDriver(self._discovery, self._command,
+                               min_np=self._min_np, max_np=self._max_np,
+                               env=self._env, reset_limit=self._reset_limit)
+        return driver.run()

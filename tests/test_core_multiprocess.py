@@ -128,3 +128,20 @@ def test_core_group_fusion_disabled():
     """HOROVOD_DISABLE_GROUP_FUSION: grouped allreduces stay numerically
     correct when groups are kept out of shared fusion units."""
     _launch(2, {"HOROVOD_DISABLE_GROUP_FUSION": "1"})
+
+
+TSAN_SO = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "horovod_tpu", "core",
+    "libhvdcore_tsan.so")
+
+
+@pytest.mark.skipif(not os.path.exists(TSAN_SO),
+                    reason="build with `make -C cpp tsan` to enable")
+def test_core_under_tsan():
+    """Race hunting: the full collective battery under ThreadSanitizer
+    (the reference ships no TSAN coverage — SURVEY.md §5)."""
+    # dlopen of a tsan-instrumented .so requires the runtime preloaded
+    _launch(2, {"HVD_TPU_CORE_LIB": TSAN_SO,
+                "LD_PRELOAD": "/lib/x86_64-linux-gnu/libtsan.so.2",
+                "TSAN_OPTIONS": "exitcode=66 halt_on_error=1"},
+            timeout=480)
