@@ -111,6 +111,7 @@ from horovod_tpu.train.optimizer import (  # noqa: F401
 )
 from horovod_tpu.train.compression import Compression  # noqa: F401
 from horovod_tpu.train.sync_batch_norm import SyncBatchNorm  # noqa: F401
+from horovod_tpu.train.checkpoint import Checkpointer  # noqa: F401
 from horovod_tpu.train import callbacks  # noqa: F401
 
 # Elastic worker API (reference: horovod.elastic)

@@ -1,0 +1,73 @@
+"""Checkpoint/resume for JAX training state.
+
+The reference has NO core checkpoint subsystem (SURVEY.md §5: elastic
+``State`` objects commit to host memory; Spark estimators write framework
+files through the Store). Here checkpointing is first-class and TPU-native:
+orbax writes sharded arrays directly from device memory (each host saves
+its shards — no gather), and restore places shards onto the current mesh,
+which is exactly what elastic re-meshing needs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+class Checkpointer:
+    """Thin orbax wrapper for (step → pytree) training state.
+
+    Usage::
+
+        ckpt = Checkpointer("/path/run1")
+        ckpt.save(step, {"params": params, "opt_state": opt_state})
+        state = ckpt.restore_latest(like={"params": params_shape, ...})
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+        import orbax.checkpoint as ocp
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                                 create=True))
+
+    def save(self, step: int, state: Any, wait: bool = False) -> None:
+        import orbax.checkpoint as ocp
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, step: int, like: Any = None) -> Any:
+        """Restore ``step``; ``like`` (a pytree of arrays or ShapeDtypeStruct
+        with shardings) places shards onto the current mesh."""
+        import orbax.checkpoint as ocp
+        if like is not None:
+            def abstractify(x):
+                if isinstance(x, jax.ShapeDtypeStruct):
+                    return x
+                if hasattr(x, "shape") and hasattr(x, "dtype"):
+                    return jax.ShapeDtypeStruct(
+                        x.shape, x.dtype,
+                        sharding=getattr(x, "sharding", None))
+                return x  # scalars / python leaves restore as stored
+            abstract = jax.tree_util.tree_map(abstractify, like)
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+        return self._mgr.restore(step)
+
+    def restore_latest(self, like: Any = None) -> Optional[Any]:
+        step = self.latest_step()
+        if step is None:
+            return None
+        return self.restore(step, like)
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
