@@ -55,6 +55,17 @@ int hvd_init() {
   cfg.disable_group_fusion = atoi(EnvOr("HVD_TPU_DISABLE_GROUP_FUSION",
                                         "HOROVOD_DISABLE_GROUP_FUSION",
                                         "0"));
+  cfg.hierarchical_allreduce = atoi(EnvOr("HVD_TPU_HIERARCHICAL_ALLREDUCE",
+                                          "HOROVOD_HIERARCHICAL_ALLREDUCE",
+                                          "0"));
+  cfg.local_rank = atoi(EnvOr("HVD_TPU_LOCAL_RANK", "HOROVOD_LOCAL_RANK",
+                              "0"));
+  cfg.local_size = atoi(EnvOr("HVD_TPU_LOCAL_SIZE", "HOROVOD_LOCAL_SIZE",
+                              "1"));
+  cfg.cross_rank = atoi(EnvOr("HVD_TPU_CROSS_RANK", "HOROVOD_CROSS_RANK",
+                              "0"));
+  cfg.cross_size = atoi(EnvOr("HVD_TPU_CROSS_SIZE", "HOROVOD_CROSS_SIZE",
+                              "1"));
   cfg.timeline_path = EnvOr("HVD_TPU_TIMELINE", "HOROVOD_TIMELINE", "");
   auto st = Core::Get().Init(cfg);
   if (!st.ok()) return SetError(st);

@@ -237,6 +237,46 @@ Status RingAllreduce(Transport& t, const Group& g, int32_t tag, void* data,
   return Status::OK();
 }
 
+Status HierarchicalAllreduce(Transport& t, const Group& local,
+                             const Group& cross, bool is_leader, int32_t tag,
+                             void* data, int64_t nelem, DataType dtype,
+                             ReduceOp op, double prescale, double postscale) {
+  ScaleBuffer(data, nelem, dtype, prescale);
+  size_t esz = DataTypeSize(dtype);
+  // 1) intra-host reduce to the local leader (local index 0)
+  if (local.size() > 1) {
+    if (local.my_index == 0) {
+      std::vector<uint8_t> buf;
+      for (int i = 1; i < local.size(); ++i) {
+        auto st = t.Recv(local.global(i), tag, &buf);
+        if (!st.ok()) return st;
+        Accumulate(data, buf.data(), nelem, dtype, op);
+      }
+    } else {
+      auto st = t.Send(local.global(0), tag, data, nelem * esz);
+      if (!st.ok()) return st;
+    }
+  }
+  // 2) cross-host ring among leaders
+  if (is_leader && cross.size() > 1) {
+    auto st = RingAllreduce(t, cross, tag + 1, data, nelem, dtype,
+                            op == ReduceOp::kAverage ? ReduceOp::kSum : op,
+                            1.0, 1.0);
+    if (!st.ok()) return st;
+  }
+  // 3) intra-host broadcast of the result
+  if (local.size() > 1) {
+    auto st = Broadcast(t, local, tag + 2, data, nelem * esz, 0);
+    if (!st.ok()) return st;
+  }
+  if (op == ReduceOp::kAverage) {
+    int total = local.size() * std::max(cross.size(), 1);
+    ScaleBuffer(data, nelem, dtype, 1.0 / total);
+  }
+  ScaleBuffer(data, nelem, dtype, postscale);
+  return Status::OK();
+}
+
 Status AllgatherV(Transport& t, const Group& g, int32_t tag,
                   const void* send, int64_t send_bytes,
                   std::vector<int64_t>* per_rank_bytes,

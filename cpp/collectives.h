@@ -55,6 +55,16 @@ Status BitvectorAnd(Transport& t, const Group& g, int32_t tag,
 Status BitvectorOr(Transport& t, const Group& g, int32_t tag,
                    std::vector<uint8_t>* bits);
 
+// Two-level hierarchical allreduce (reference:
+// NCCLHierarchicalAllreduce, nccl_operations.cc:233-420: intra-node
+// reduce to a leader, inter-node allreduce among leaders, intra-node
+// broadcast). Groups are derived from launcher-injected local/cross
+// topology. Uses tags [tag, tag+2].
+Status HierarchicalAllreduce(Transport& t, const Group& local,
+                             const Group& cross, bool is_leader, int32_t tag,
+                             void* data, int64_t nelem, DataType dtype,
+                             ReduceOp op, double prescale, double postscale);
+
 // Adasum VHDD allreduce (cpp/adasum.cc; reference: adasum/adasum.h).
 // Uses tags [tag, tag+4].
 Status AdasumAllreduce(Transport& t, const Group& g, int32_t tag, void* data,

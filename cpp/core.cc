@@ -270,6 +270,20 @@ Status Core::Init(const CoreConfig& cfg) {
     std::lock_guard<std::mutex> lk(domains_mu_);
     domains_[0] = std::move(global);
   }
+  // hierarchical allreduce topology (reference enables it only on
+  // homogeneous clusters — operations.cc:514-538)
+  hier_enabled_ = cfg.hierarchical_allreduce && cfg.local_size > 1 &&
+                  cfg.size == cfg.local_size * cfg.cross_size;
+  if (hier_enabled_) {
+    local_group_.ranks.clear();
+    for (int i = 0; i < cfg.local_size; ++i)
+      local_group_.ranks.push_back(cfg.cross_rank * cfg.local_size + i);
+    local_group_.my_index = cfg.local_rank;
+    cross_group_.ranks.clear();
+    for (int i = 0; i < cfg.cross_size; ++i)
+      cross_group_.ranks.push_back(i * cfg.local_size);
+    cross_group_.my_index = cfg.cross_rank;
+  }
   shutdown_requested_ = false;
   loop_done_ = false;
   initialized_ = true;
@@ -974,7 +988,15 @@ void Core::Execute(CoordDomain& d, const Response& r) {
       size_t esz = DataTypeSize(r.dtypes[0]);
       nelem = total / esz;
       Status st;
-      if (r.op == ReduceOp::kAdasum && d.group.size() > 1) {
+      if (hier_enabled_ && d.id == 0 && d.group.size() > 1 &&
+          r.op != ReduceOp::kAdasum) {
+        // two-level path: intra-host reduce -> cross-host ring among
+        // leaders -> intra-host broadcast
+        st = HierarchicalAllreduce(*transport_, local_group_, cross_group_,
+                                   cfg_.local_rank == 0, dtag,
+                                   fusion.data(), nelem, r.dtypes[0], r.op,
+                                   r.prescale, r.postscale);
+      } else if (r.op == ReduceOp::kAdasum && d.group.size() > 1) {
         ScaleBufferOp(fusion.data(), nelem, r.dtypes[0], r.prescale);
         st = AdasumAllreduce(*transport_, d.group, DomTag(d.id, kTagAdasum),
                              fusion.data(), nelem, r.dtypes[0]);

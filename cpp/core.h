@@ -108,6 +108,11 @@ class ParameterManager {
 struct CoreConfig {
   int rank = 0;
   bool disable_group_fusion = false;
+  bool hierarchical_allreduce = false;
+  int local_rank = 0;
+  int local_size = 1;
+  int cross_rank = 0;
+  int cross_size = 1;
   int size = 1;
   std::string coord_addr = "127.0.0.1";
   int coord_port = 37592;
@@ -224,6 +229,10 @@ class Core {
   std::mutex domains_mu_;
   std::map<int, std::unique_ptr<CoordDomain>> domains_;
   int next_domain_ = 1;
+  // hierarchical topology groups (valid when hier_enabled_)
+  bool hier_enabled_ = false;
+  Group local_group_;
+  Group cross_group_;
 
   struct HandleState {
     std::mutex mu;

@@ -26,19 +26,30 @@ def _free_port():
     return port
 
 
-def _launch(size, extra_env=None, timeout=240, worker=WORKER):
+def _launch(size, extra_env=None, timeout=240, worker=WORKER,
+            topology=None):
+    """topology=(local_size, cross_size) fakes a multi-host layout on
+    localhost (reference analog: elastic tests faking hosts)."""
     port = _free_port()
     procs = []
     for rank in range(size):
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)
+        if topology:
+            lsz, csz = topology
+            local_rank, cross_rank = rank % lsz, rank // lsz
+            local_sz = lsz
+        else:
+            local_rank, cross_rank, local_sz = rank, 0, size
         env.update({
             "HOROVOD_RANK": str(rank),
             "HOROVOD_SIZE": str(size),
             "HVD_TPU_COORD_ADDR": "127.0.0.1",
             "HVD_TPU_COORD_PORT": str(port),
-            "HOROVOD_LOCAL_RANK": str(rank),
-            "HOROVOD_LOCAL_SIZE": str(size),
+            "HOROVOD_LOCAL_RANK": str(local_rank),
+            "HOROVOD_LOCAL_SIZE": str(local_sz),
+            "HOROVOD_CROSS_RANK": str(cross_rank),
+            "HOROVOD_CROSS_SIZE": str(topology[1] if topology else 1),
         })
         env.update(extra_env or {})
         procs.append(subprocess.Popen(
@@ -145,3 +156,11 @@ def test_core_under_tsan():
                 "LD_PRELOAD": "/lib/x86_64-linux-gnu/libtsan.so.2",
                 "TSAN_OPTIONS": "exitcode=66 halt_on_error=1"},
             timeout=480)
+
+
+@needs_core
+def test_core_hierarchical_allreduce():
+    """HOROVOD_HIERARCHICAL_ALLREDUCE over a faked 2-host x 2-local
+    topology: intra-host reduce -> leader ring -> intra-host broadcast
+    (reference: NCCLHierarchicalAllreduce, nccl_operations.cc:233-420)."""
+    _launch(4, {"HOROVOD_HIERARCHICAL_ALLREDUCE": "1"}, topology=(2, 2))
