@@ -16,6 +16,7 @@ HVD_WORKER = os.path.join(os.path.dirname(__file__), "hvd_worker.py")
 ERROR_WORKER = os.path.join(os.path.dirname(__file__), "error_worker.py")
 XLA_WORKER = os.path.join(os.path.dirname(__file__), "xla_worker.py")
 ADASUM_WORKER = os.path.join(os.path.dirname(__file__), "adasum_worker.py")
+EQUIV_WORKER = os.path.join(os.path.dirname(__file__), "equiv_worker.py")
 
 
 def _free_port():
@@ -164,3 +165,11 @@ def test_core_hierarchical_allreduce():
     topology: intra-host reduce -> leader ring -> intra-host broadcast
     (reference: NCCLHierarchicalAllreduce, nccl_operations.cc:233-420)."""
     _launch(4, {"HOROVOD_HIERARCHICAL_ALLREDUCE": "1"}, topology=(2, 2))
+
+
+@needs_core
+@pytest.mark.parametrize("size", [2, 4])
+def test_distributed_equals_serial(size):
+    """DP training over the core must match single-process full-batch
+    training to float tolerance (equal shards => mean-of-means == mean)."""
+    _launch(size, timeout=360, worker=EQUIV_WORKER)

@@ -1,0 +1,57 @@
+"""Driver-contract tests: dryrun_multichip must compile+run at every device
+count the driver may choose, and entry() must produce a jittable forward."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_dryrun(n):
+    code = f"""
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count={n}"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+sys.path.insert(0, {REPO!r})
+from __graft_entry__ import dryrun_multichip
+dryrun_multichip({n})
+"""
+    rc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                        timeout=900, cwd=REPO)
+    assert rc.returncode == 0, rc.stdout.decode() + rc.stderr.decode()
+    assert b"[dryrun] OK" in rc.stdout
+
+
+@pytest.mark.parametrize("n", [2, 4, 16])
+def test_dryrun_device_counts(n):
+    # 8 is covered by running __graft_entry__.py directly elsewhere; cover
+    # the other driver-plausible counts
+    _run_dryrun(n)
+
+
+def test_entry_compiles_on_cpu():
+    code = f"""
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+sys.path.insert(0, {REPO!r})
+from __graft_entry__ import entry
+fn, args = entry()
+out = jax.jit(fn)(*args)
+print("entry loss:", float(out))
+assert float(out) > 0
+"""
+    rc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                        timeout=900, cwd=REPO)
+    assert rc.returncode == 0, rc.stdout.decode() + rc.stderr.decode()
