@@ -10,19 +10,26 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_dryrun(n):
-    code = f"""
+def _cpu_snippet(n_devices: int, tail: str) -> str:
+    """Shared env bootstrap for subprocess tests (kept in one place so a
+    future env requirement can't drift between snippets)."""
+    return f"""
 import os
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count={n}"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count={n_devices}"
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
 import jax
 jax.config.update("jax_platforms", "cpu")
 import sys
 sys.path.insert(0, {REPO!r})
+""" + tail
+
+
+def _run_dryrun(n):
+    code = _cpu_snippet(n, f"""
 from __graft_entry__ import dryrun_multichip
 dryrun_multichip({n})
-"""
+""")
     rc = subprocess.run([sys.executable, "-c", code], capture_output=True,
                         timeout=900, cwd=REPO)
     assert rc.returncode == 0, rc.stdout.decode() + rc.stderr.decode()
@@ -37,21 +44,14 @@ def test_dryrun_device_counts(n):
 
 
 def test_entry_compiles_on_cpu():
-    code = f"""
-import os
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    code = _cpu_snippet(1, """
 import jax
-jax.config.update("jax_platforms", "cpu")
-import sys
-sys.path.insert(0, {REPO!r})
 from __graft_entry__ import entry
 fn, args = entry()
 out = jax.jit(fn)(*args)
 print("entry loss:", float(out))
 assert float(out) > 0
-"""
+""")
     rc = subprocess.run([sys.executable, "-c", code], capture_output=True,
                         timeout=900, cwd=REPO)
     assert rc.returncode == 0, rc.stdout.decode() + rc.stderr.decode()
