@@ -1,0 +1,131 @@
+"""``horovod_tpu.keras`` — Keras-integrated callbacks and optimizer wrapper.
+
+Reference: ``horovod/keras`` + ``horovod/_keras/callbacks.py``
+(``BroadcastGlobalVariablesCallback`` :23, ``MetricAverageCallback`` :49,
+``LearningRateWarmupCallback`` :118). The framework-neutral logic lives in
+:mod:`horovod_tpu.train.callbacks`; these classes plug it into
+``model.fit``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from horovod_tpu.common.basics import rank, size  # noqa: F401
+from horovod_tpu.tensorflow import (  # noqa: F401
+    allreduce, allgather, broadcast, broadcast_variables, init, shutdown)
+from horovod_tpu.train import callbacks as _cb
+
+
+def DistributedOptimizer(optimizer, op=None, compression=None,
+                         backward_passes_per_step: int = 1):
+    """Keras-compatible wrapper: a dynamic SUBCLASS of the given optimizer's
+    class whose ``apply_gradients`` syncs gradients first (reference:
+    ``horovod/_keras/__init__.py create_distributed_optimizer`` — same
+    dynamic-subclass trick, required because ``model.compile`` validates
+    the optimizer's type)."""
+    from horovod_tpu.ops.reduce_op import Average
+    from horovod_tpu.train.compression import Compression
+    from horovod_tpu.tensorflow import _DistributedOptimizer
+
+    sync = _DistributedOptimizer(optimizer, op or Average,
+                                 compression or Compression.none,
+                                 backward_passes_per_step)
+    cls = optimizer.__class__
+
+    class _KerasDistributed(cls):
+        _hvd_sync = None
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            gv = list(grads_and_vars)
+            grads = self._hvd_sync._sync([g for g, _ in gv])
+            return super().apply_gradients(
+                list(zip(grads, [v for _, v in gv])), *args, **kwargs)
+
+    _KerasDistributed.__name__ = "Distributed" + cls.__name__
+    dist = _KerasDistributed.from_config(optimizer.get_config())
+    dist._hvd_sync = sync
+    return dist
+
+
+def _keras():
+    import tensorflow as tf
+    return tf.keras
+
+
+class BroadcastGlobalVariablesCallback:
+    """Broadcast model+optimizer variables from root at train begin
+    (reference: ``_keras/callbacks.py:23-47``)."""
+
+    def __new__(cls, root_rank: int = 0):
+        keras = _keras()
+
+        class _Impl(keras.callbacks.Callback):
+            def __init__(self, root):
+                super().__init__()
+                self._root = root
+                self._done = False
+
+            def on_batch_begin(self, batch, logs=None):
+                if self._done:
+                    return
+                broadcast_variables(self.model.variables, self._root)
+                if getattr(self.model, "optimizer", None) is not None and \
+                        hasattr(self.model.optimizer, "variables"):
+                    vars = self.model.optimizer.variables
+                    vars = vars() if callable(vars) else vars
+                    broadcast_variables(vars, self._root)
+                self._done = True
+
+        return _Impl(root_rank)
+
+
+class MetricAverageCallback:
+    """Average epoch metrics across workers (reference:
+    ``_keras/callbacks.py:49-93``)."""
+
+    def __new__(cls):
+        keras = _keras()
+        impl = _cb.MetricAverageCallback()
+
+        class _Impl(keras.callbacks.Callback):
+            def on_epoch_end(self, epoch, logs=None):
+                if logs:
+                    logs.update(impl.on_epoch_end(logs))
+
+        return _Impl()
+
+
+class LearningRateWarmupCallback:
+    """LR warmup from base lr to lr*size (reference:
+    ``_keras/callbacks.py:118-192``)."""
+
+    def __new__(cls, initial_lr: float, warmup_epochs: int = 5,
+                steps_per_epoch: Optional[int] = None, verbose: int = 0):
+        keras = _keras()
+        sched = _cb.LearningRateWarmupCallback(
+            initial_lr, warmup_epochs, steps_per_epoch or 1).schedule()
+
+        class _Impl(keras.callbacks.Callback):
+            def __init__(self):
+                super().__init__()
+                self._step = 0
+
+            def on_train_batch_begin(self, batch, logs=None):
+                lr = float(sched(self._step))
+                self._step += 1
+                opt = self.model.optimizer
+                if hasattr(opt, "learning_rate"):
+                    try:
+                        opt.learning_rate.assign(lr)
+                    except AttributeError:
+                        opt.learning_rate = lr
+
+        return _Impl()
+
+
+callbacks = type("callbacks", (), {
+    "BroadcastGlobalVariablesCallback": BroadcastGlobalVariablesCallback,
+    "MetricAverageCallback": MetricAverageCallback,
+    "LearningRateWarmupCallback": LearningRateWarmupCallback,
+})

@@ -1,0 +1,231 @@
+"""``horovod_tpu.tensorflow`` — drop-in surface for reference TF users.
+
+Reference: ``horovod/tensorflow/__init__.py`` (``hvd.allreduce`` :55-162,
+``broadcast_variables``/``broadcast_global_variables`` :284,
+``DistributedOptimizer`` :627, ``DistributedGradientTape`` :777) and
+``horovod/tensorflow/mpi_ops.py``. TF runs host-side (CPU) here — the TPU
+compute path is JAX — so this adapter carries a TF input/metrics pipeline's
+distribution layer while models migrate.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# identity / lifecycle re-exports (reference: tensorflow/mpi_ops.py)
+from horovod_tpu.common.basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, is_homogeneous, mpi_threads_supported,
+    mpi_built, gloo_built, nccl_built, ccl_built, cuda_built, rocm_built,
+    start_timeline, stop_timeline)
+from horovod_tpu.common.process_sets import (  # noqa: F401
+    ProcessSet, add_process_set, remove_process_set, global_process_set)
+from horovod_tpu.ops.reduce_op import (  # noqa: F401
+    Adasum, Average, Max, Min, Product, ReduceOp, Sum)
+from horovod_tpu.ops import collectives as _C
+from horovod_tpu.train.compression import Compression  # noqa: F401
+
+
+def _tf():
+    import tensorflow as tf
+    return tf
+
+
+def _to_np(tensor) -> np.ndarray:
+    if hasattr(tensor, "numpy"):
+        return tensor.numpy()
+    return np.asarray(tensor)
+
+
+def _from_np(arr, like):
+    tf = _tf()
+    return tf.constant(np.asarray(arr), dtype=like.dtype)
+
+
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, op: Optional[ReduceOp] = None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              process_set: ProcessSet = global_process_set):
+    """Reference: ``hvd.allreduce`` (``tensorflow/__init__.py:55-162``)."""
+    out = _C.allreduce(_to_np(tensor), average, name, op, prescale_factor,
+                       postscale_factor, process_set)
+    return _from_np(out, tensor)
+
+
+def grouped_allreduce(tensors, average: Optional[bool] = None,
+                      name: Optional[str] = None,
+                      op: Optional[ReduceOp] = None,
+                      process_set: ProcessSet = global_process_set):
+    outs = _C.grouped_allreduce([_to_np(t) for t in tensors], average, name,
+                                op, process_set=process_set)
+    return [_from_np(o, t) for o, t in zip(outs, tensors)]
+
+
+def allgather(tensor, name: Optional[str] = None,
+              process_set: ProcessSet = global_process_set):
+    return _from_np(_C.allgather(_to_np(tensor), name, process_set), tensor)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              process_set: ProcessSet = global_process_set):
+    return _from_np(_C.broadcast(_to_np(tensor), root_rank, name,
+                                 process_set), tensor)
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set: ProcessSet = global_process_set):
+    t, recv_splits = _C.alltoall(
+        _to_np(tensor),
+        None if splits is None else _to_np(splits), name, process_set)
+    tf = _tf()
+    return _from_np(t, tensor), tf.constant(np.asarray(recv_splits))
+
+
+def join(device: int = -1) -> int:
+    return _C.join(device)
+
+
+def barrier(process_set: ProcessSet = global_process_set) -> None:
+    _C.barrier(process_set)
+
+
+def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
+    from horovod_tpu.train.optimizer import broadcast_object as _bo
+    return _bo(obj, root_rank, name=name)
+
+
+# -- variable broadcast (reference: broadcast_variables /
+# broadcast_global_variables, tensorflow/__init__.py:270-300) ---------------
+
+def broadcast_variables(variables, root_rank: int = 0) -> None:
+    """In-place broadcast of tf.Variables from root."""
+    handles = [(v, _C.broadcast_async(_to_np(v), root_rank,
+                                      name=f"bv.{i}"))
+               for i, v in enumerate(variables)]
+    for v, h in handles:
+        v.assign(_from_np(h.wait(), v))
+
+
+def broadcast_global_variables(root_rank: int = 0) -> None:
+    """TF1-style global-variables broadcast (reference:
+    ``broadcast_global_variables``); in TF2 prefer
+    :func:`broadcast_variables` on ``model.variables``."""
+    tf = _tf()
+    if hasattr(tf.compat.v1, "global_variables"):
+        broadcast_variables(tf.compat.v1.global_variables(), root_rank)
+
+
+# -- DistributedGradientTape (reference: tensorflow/__init__.py:777-851) ----
+
+class _DistributedGradientTape:
+    def __init__(self, tape, op: ReduceOp = Average,
+                 compression=Compression.none,
+                 process_set: ProcessSet = global_process_set) -> None:
+        self._tape = tape
+        self._op = op
+        self._compression = compression
+        self._process_set = process_set
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        return self._allreduce_grads(grads)
+
+    def _allreduce_grads(self, grads):
+        flat: List[Tuple[int, np.ndarray, Any]] = []
+        for i, g in enumerate(grads):
+            if g is None:
+                continue
+            c, ctx = self._compression.compress(_to_np(g))
+            flat.append((i, np.asarray(c), ctx))
+        if size() <= 1 or not flat:
+            return grads
+        outs = _C.grouped_allreduce([f[1] for f in flat], op=self._op,
+                                    name="tfgrad",
+                                    process_set=self._process_set)
+        result = list(grads)
+        for (i, _, ctx), o in zip(flat, outs):
+            result[i] = _from_np(self._compression.decompress(
+                np.asarray(o), ctx), grads[i])
+        return result
+
+
+def DistributedGradientTape(gradtape, op: ReduceOp = Average,
+                            compression=Compression.none,
+                            process_set: ProcessSet = global_process_set):
+    """Reference factory (``tensorflow/__init__.py:777``)."""
+    return _DistributedGradientTape(gradtape, op, compression, process_set)
+
+
+# -- DistributedOptimizer (reference: tensorflow/__init__.py:453-627) -------
+
+class _DistributedOptimizer:
+    """Wraps a keras optimizer: gradients are averaged across workers
+    before ``apply_gradients`` (reference ``_DistributedOptimizer``)."""
+
+    def __init__(self, optimizer, op: ReduceOp = Average,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1,
+                 process_set: ProcessSet = global_process_set) -> None:
+        self._opt = optimizer
+        self._op = op
+        self._compression = compression
+        self._process_set = process_set
+        self.backward_passes_per_step = backward_passes_per_step
+        self._pass = 0
+        self._acc: Optional[list] = None
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    def _sync(self, grads):
+        if size() <= 1:
+            return grads
+        comp, ctxs = [], []
+        for g in grads:
+            c, ctx = self._compression.compress(_to_np(g))
+            comp.append(np.asarray(c))
+            ctxs.append(ctx)
+        outs = _C.grouped_allreduce(comp, op=self._op, name="tfopt",
+                                    process_set=self._process_set)
+        return [_from_np(self._compression.decompress(np.asarray(o), ctx), g)
+                for o, ctx, g in zip(outs, ctxs, grads)]
+
+    def apply_gradients(self, grads_and_vars, **kwargs):
+        gv = list(grads_and_vars)
+        grads = [g for g, _ in gv]
+        tvars = [v for _, v in gv]
+        # local accumulation for backward_passes_per_step (reference:
+        # LocalGradientAggregationHelper, tensorflow/gradient_aggregation.py)
+        if self.backward_passes_per_step > 1:
+            gn = [_to_np(g) for g in grads]
+            self._acc = gn if self._acc is None else \
+                [a + b for a, b in zip(self._acc, gn)]
+            self._pass += 1
+            if self._pass < self.backward_passes_per_step:
+                return None
+            grads = [_from_np(a / self.backward_passes_per_step, g)
+                     for a, g in zip(self._acc, grads)]
+            self._acc, self._pass = None, 0
+        grads = self._sync(grads)
+        return self._opt.apply_gradients(zip(grads, tvars), **kwargs)
+
+
+def DistributedOptimizer(optimizer, op: ReduceOp = Average,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         process_set: ProcessSet = global_process_set):
+    """Reference factory (``tensorflow/__init__.py:627``)."""
+    return _DistributedOptimizer(optimizer, op, compression,
+                                 backward_passes_per_step, process_set)

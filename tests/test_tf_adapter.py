@@ -1,0 +1,91 @@
+"""TF adapter tests (reference analog: test/parallel/test_tensorflow.py,
+single-process slice; the multi-process path shares the core backend)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+
+@pytest.fixture
+def tfhvd(hvd):
+    import horovod_tpu.tensorflow as tfhvd
+    return tfhvd
+
+
+def test_tf_allreduce(tfhvd):
+    x = tf.constant([1.0, 2.0, 3.0])
+    out = tfhvd.allreduce(x, op=tfhvd.Sum)
+    np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 3.0])
+
+
+def test_tf_allgather_broadcast_alltoall(tfhvd):
+    g = tfhvd.allgather(tf.eye(2))
+    assert g.shape == (2, 2)
+    b = tfhvd.broadcast(tf.constant([5.0]), root_rank=0)
+    np.testing.assert_allclose(b.numpy(), [5.0])
+    t, rs = tfhvd.alltoall(tf.constant([[1.0], [2.0]]))
+    assert t.shape == (2, 1)
+
+
+def test_tf_distributed_gradient_tape(tfhvd):
+    w = tf.Variable([[1.0], [2.0]])
+    x = tf.constant([[3.0, 4.0]])
+    with tfhvd.DistributedGradientTape(tf.GradientTape()) as tape:
+        y = tf.reduce_sum(tf.matmul(x, w))
+    (grad,) = tape.gradient(y, [w])
+    np.testing.assert_allclose(grad.numpy(), [[3.0], [4.0]])
+
+
+def test_tf_distributed_optimizer_trains(tfhvd):
+    tf.random.set_seed(0)
+    w = tf.Variable(tf.zeros((4, 1)))
+    x = tf.constant(np.random.RandomState(0).randn(16, 4).astype(np.float32))
+    target = tf.matmul(x, tf.constant([[1.0], [2.0], [3.0], [4.0]]))
+    opt = tfhvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=0.1))
+    losses = []
+    for _ in range(100):
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean((tf.matmul(x, w) - target) ** 2)
+        grads = tape.gradient(loss, [w])
+        opt.apply_gradients(zip(grads, [w]))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.05
+
+
+def test_tf_backward_passes_per_step(tfhvd):
+    w = tf.Variable([0.0])
+    opt = tfhvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=1.0),
+        backward_passes_per_step=2)
+    assert opt.apply_gradients([(tf.constant([1.0]), w)]) is None
+    np.testing.assert_allclose(w.numpy(), [0.0])  # accumulating
+    opt.apply_gradients([(tf.constant([3.0]), w)])
+    np.testing.assert_allclose(w.numpy(), [-2.0])  # mean(1,3) applied
+
+
+def test_tf_broadcast_variables(tfhvd):
+    v = tf.Variable([7.0, 8.0])
+    tfhvd.broadcast_variables([v], root_rank=0)
+    np.testing.assert_allclose(v.numpy(), [7.0, 8.0])
+
+
+def test_keras_callbacks_fit(tfhvd):
+    """hvd.keras callbacks plugged into model.fit (reference analog:
+    Keras callback tests)."""
+    import horovod_tpu.keras as khvd
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Dense(1, input_shape=(4,))])
+    opt = khvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=0.05))
+    model.compile(optimizer=opt, loss="mse", run_eagerly=True)
+    x = np.random.RandomState(0).randn(64, 4).astype(np.float32)
+    y = x @ np.asarray([[1.0], [2.0], [3.0], [4.0]], np.float32)
+    hist = model.fit(
+        x, y, epochs=2, batch_size=16, verbose=0,
+        callbacks=[khvd.callbacks.BroadcastGlobalVariablesCallback(0),
+                   khvd.callbacks.MetricAverageCallback(),
+                   khvd.callbacks.LearningRateWarmupCallback(
+                       0.05, warmup_epochs=1, steps_per_epoch=4)])
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
