@@ -1,0 +1,46 @@
+"""Worker stressing concurrent disjoint process sets (reference analog:
+test/parallel/test_process_sets_*): sets {0,1} and {2,3} run independent
+collectives at the same time over their own coordination domains."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np  # noqa: E402
+
+from horovod_tpu.core.core_backend import CoreBackend  # noqa: E402
+from horovod_tpu.ops.reduce_op import ReduceOp  # noqa: E402
+
+
+def main():
+    be = CoreBackend()
+    rank, size = be.rank, be.size
+    assert size == 4
+    # all ranks register both sets in the same order (ids stay aligned)
+    low = be.make_subset([0, 1])
+    high = be.make_subset([2, 3])
+    mine = low if rank < 2 else high
+    peer_base = 0 if rank < 2 else 2
+
+    # each set allreduces its own tensors concurrently with the other set
+    for it in range(10):
+        x = np.full((64,), float(rank + 1), np.float32)
+        out = mine.allreduce_async(f"ps.{it}", x, ReduceOp.SUM).wait(60)
+        expect = (peer_base + 1.0) + (peer_base + 2.0)
+        np.testing.assert_allclose(out, expect)
+        # interleave a global-set op to stress cross-domain cycles
+        g = be.allreduce_async(f"glob.{it}", np.ones(8, np.float32),
+                               ReduceOp.SUM).wait(60)
+        np.testing.assert_allclose(g, 4.0)
+
+    # ragged allgather within the subset
+    rows = mine.rank + 1
+    out = mine.allgather_async(
+        "ps.ag", np.full((rows, 2), float(rank), np.float32)).wait(60)
+    assert out.shape[0] == 3  # 1 + 2 rows
+    be.barrier()
+    be.shutdown()
+    print(f"psets worker {rank}: OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

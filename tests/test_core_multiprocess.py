@@ -17,6 +17,7 @@ ERROR_WORKER = os.path.join(os.path.dirname(__file__), "error_worker.py")
 XLA_WORKER = os.path.join(os.path.dirname(__file__), "xla_worker.py")
 ADASUM_WORKER = os.path.join(os.path.dirname(__file__), "adasum_worker.py")
 EQUIV_WORKER = os.path.join(os.path.dirname(__file__), "equiv_worker.py")
+PSETS_WORKER = os.path.join(os.path.dirname(__file__), "psets_worker.py")
 
 
 def _free_port():
@@ -173,3 +174,11 @@ def test_distributed_equals_serial(size):
     """DP training over the core must match single-process full-batch
     training to float tolerance (equal shards => mean-of-means == mean)."""
     _launch(size, timeout=360, worker=EQUIV_WORKER)
+
+
+@needs_core
+def test_concurrent_disjoint_process_sets():
+    """Two disjoint process sets run collectives concurrently with
+    interleaved global-set ops (reference analog:
+    test/parallel/test_process_sets_*)."""
+    _launch(4, worker=PSETS_WORKER)
