@@ -87,6 +87,32 @@ class DistributedState(NamedTuple):
     inner_state: Any
 
 
+def _host_callback_allreduce_tree(grads, op: ReduceOp,
+                                  process_set: ProcessSet,
+                                  compression: Compressor,
+                                  prescale: float, postscale: float):
+    """Cross-process sync from INSIDE jit (SURVEY.md §7 hard part (d)):
+    an ordered ``io_callback`` hands the gradient tree to the host backend
+    mid-program. jit traces once, so every process emits the identical
+    callback sequence — exactly the same-order contract the eager path
+    already relies on — and the C++ core negotiates/fuses as usual.
+    """
+    from jax.experimental import io_callback
+
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    shapes = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves]
+
+    def host(*flat):
+        tree = jax.tree_util.tree_unflatten(treedef, list(flat))
+        out = _eager_allreduce_tree(tree, op, process_set, compression,
+                                    prescale, postscale)
+        return tuple(np.asarray(x) for x in
+                     jax.tree_util.tree_leaves(out))
+
+    out_flat = io_callback(host, tuple(shapes), *leaves, ordered=True)
+    return jax.tree_util.tree_unflatten(treedef, list(out_flat))
+
+
 def DistributedGradTransform(op: ReduceOp = Average,
                              process_set: ProcessSet = global_process_set,
                              compression: Compressor = Compression.none,
@@ -99,6 +125,13 @@ def DistributedGradTransform(op: ReduceOp = Average,
     The moral equivalent of the reference's per-parameter allreduce hooks
     (``torch/optimizer.py:164-206``), but batched over the whole tree so the
     core can fuse one buffer per cycle instead of negotiating per-tensor.
+
+    Works in every execution regime:
+      * eager, size>1  → grouped host allreduce through the backend
+      * inside jit with a live mesh ``axis_name`` → in-graph collective
+      * inside jit, multi-process, no axis → ordered ``io_callback`` to the
+        host backend (the eager contract under compilation)
+      * size==1 → pre/postscale only
     """
 
     def init_fn(params):
@@ -108,8 +141,14 @@ def DistributedGradTransform(op: ReduceOp = Average,
     def update_fn(updates, state, params=None):
         del params
         if _is_traced(updates):
-            new = _traced_allreduce_tree(updates, op, axis_name,
-                                         prescale_factor, postscale_factor)
+            if axis_name is None and size() > 1:
+                new = _host_callback_allreduce_tree(
+                    updates, op, process_set, compression,
+                    prescale_factor, postscale_factor)
+            else:
+                new = _traced_allreduce_tree(updates, op, axis_name,
+                                             prescale_factor,
+                                             postscale_factor)
         elif size() == 1:
             new = _traced_allreduce_tree(updates, op, None,
                                          prescale_factor, postscale_factor)

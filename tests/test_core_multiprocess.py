@@ -18,6 +18,8 @@ XLA_WORKER = os.path.join(os.path.dirname(__file__), "xla_worker.py")
 ADASUM_WORKER = os.path.join(os.path.dirname(__file__), "adasum_worker.py")
 EQUIV_WORKER = os.path.join(os.path.dirname(__file__), "equiv_worker.py")
 PSETS_WORKER = os.path.join(os.path.dirname(__file__), "psets_worker.py")
+JIT_SYNC_WORKER = os.path.join(os.path.dirname(__file__),
+                               "jit_sync_worker.py")
 
 
 def _free_port():
@@ -182,3 +184,10 @@ def test_concurrent_disjoint_process_sets():
     interleaved global-set ops (reference analog:
     test/parallel/test_process_sets_*)."""
     _launch(4, worker=PSETS_WORKER)
+
+
+@needs_core
+def test_jitted_step_with_host_sync():
+    """Cross-process gradient sync INSIDE jax.jit via ordered io_callback
+    (SURVEY.md §7 hard part (d)); trajectory matches serial training."""
+    _launch(2, timeout=360, worker=JIT_SYNC_WORKER)
