@@ -15,12 +15,20 @@ TPU-native re-think of the reference's high-level API:
   ``broadcast_object`` (``horovod/torch/functions.py:29-266``) map to pytree
   broadcasts.
 
-Key semantic point: under global-SPMD ``jit`` (one program over the whole
-mesh), data-parallel gradient reduction is inserted by XLA automatically from
-shardings — the transform detects traced values and becomes the appropriate
-in-graph collective; in eager multi-process mode it calls the host backend
-(grouped, so the C++ core fuses the whole gradient set into large buffers,
-as the reference's fusion buffer does — ``fusion_buffer_manager.h:30-56``).
+Execution regimes of the gradient sync (``DistributedGradTransform``):
+
+* **global-SPMD jit** (one program over a global mesh, batch sharded):
+  XLA inserts the reduction from shardings — the transform is an identity
+  (modulo pre/post-scale). This is the default traced behavior.
+* **shard_map** with a live ``axis_name``: explicit in-graph ``psum/pmean``.
+* **eager multi-process**: grouped host allreduce through the backend
+  (the C++ core fuses the whole set into large buffers, as the reference's
+  fusion buffer does — ``fusion_buffer_manager.h:30-56``).
+* **per-process jit + host sync** (``host_sync_in_jit=True``): an ordered
+  ``io_callback`` hands gradients to the negotiating host core from inside
+  the compiled step — for programs jitted per process over LOCAL arrays
+  only. Requires the TCP core backend (device-data-plane backends would
+  re-enter the device from the callback).
 """
 
 from __future__ import annotations
@@ -96,8 +104,23 @@ def _host_callback_allreduce_tree(grads, op: ReduceOp,
     mid-program. jit traces once, so every process emits the identical
     callback sequence — exactly the same-order contract the eager path
     already relies on — and the C++ core negotiates/fuses as usual.
+
+    Only valid for PER-PROCESS jit over local arrays with the host (TCP
+    core) backend: under global-SPMD, GSPMD pins callbacks to device 0's
+    process (the others would never call in → deadlock), and device-data-
+    plane backends (XLA_EAGER) would re-enter the devices that are blocked
+    on this very callback.
     """
     from jax.experimental import io_callback
+
+    be = _require_init().backend
+    from horovod_tpu.core.core_backend import CoreBackend
+    if not isinstance(be, CoreBackend):
+        raise RuntimeError(
+            "host_sync_in_jit requires the TCP core backend; the "
+            f"{type(be).__name__} data plane cannot be driven from inside "
+            "a compiled program (unset HOROVOD_TPU_OPERATIONS, or use "
+            "global-SPMD sharding / an explicit axis_name instead)")
 
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     shapes = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves]
@@ -118,7 +141,8 @@ def DistributedGradTransform(op: ReduceOp = Average,
                              compression: Compressor = Compression.none,
                              axis_name: Optional[str] = None,
                              prescale_factor: float = 1.0,
-                             postscale_factor: float = 1.0
+                             postscale_factor: float = 1.0,
+                             host_sync_in_jit: bool = False
                              ) -> optax.GradientTransformation:
     """optax transform that synchronizes gradients across the process set.
 
@@ -126,12 +150,12 @@ def DistributedGradTransform(op: ReduceOp = Average,
     (``torch/optimizer.py:164-206``), but batched over the whole tree so the
     core can fuse one buffer per cycle instead of negotiating per-tensor.
 
-    Works in every execution regime:
-      * eager, size>1  → grouped host allreduce through the backend
-      * inside jit with a live mesh ``axis_name`` → in-graph collective
-      * inside jit, multi-process, no axis → ordered ``io_callback`` to the
-        host backend (the eager contract under compilation)
-      * size==1 → pre/postscale only
+    Regimes (see module docstring): eager multi-process → grouped host
+    allreduce; ``axis_name`` under shard_map → in-graph collective;
+    traced with no axis → identity by default (global-SPMD jit: XLA
+    reduces from shardings), or — with ``host_sync_in_jit=True`` and a
+    per-process jit over local arrays — an ordered ``io_callback`` into
+    the negotiating core.
     """
 
     def init_fn(params):
@@ -141,7 +165,7 @@ def DistributedGradTransform(op: ReduceOp = Average,
     def update_fn(updates, state, params=None):
         del params
         if _is_traced(updates):
-            if axis_name is None and size() > 1:
+            if host_sync_in_jit and axis_name is None and size() > 1:
                 new = _host_callback_allreduce_tree(
                     updates, op, process_set, compression,
                     prescale_factor, postscale_factor)
@@ -167,7 +191,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          backward_passes_per_step: int = 1,
                          axis_name: Optional[str] = None,
                          prescale_factor: float = 1.0,
-                         postscale_factor: float = 1.0
+                         postscale_factor: float = 1.0,
+                         host_sync_in_jit: bool = False
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with distributed gradient synchronization.
 
@@ -184,7 +209,7 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     else:
         sync = DistributedGradTransform(op, process_set, compression,
                                         axis_name, prescale_factor,
-                                        postscale_factor)
+                                        postscale_factor, host_sync_in_jit)
     chained = optax.chain(sync, optimizer)
     if backward_passes_per_step > 1:
         return optax.MultiSteps(chained,
@@ -196,15 +221,22 @@ def distributed_grad(fun: Callable, argnums=0, has_aux: bool = False,
                      op: ReduceOp = Average,
                      process_set: ProcessSet = global_process_set,
                      compression: Compressor = Compression.none,
-                     axis_name: Optional[str] = None) -> Callable:
+                     axis_name: Optional[str] = None,
+                     host_sync_in_jit: bool = False) -> Callable:
     """``jax.grad`` with cross-worker gradient reduction — the JAX analog of
-    ``DistributedGradientTape`` (``horovod/tensorflow/__init__.py:777-851``)."""
+    ``DistributedGradientTape`` (``horovod/tensorflow/__init__.py:777-851``).
+    Same regime routing as :func:`DistributedGradTransform`."""
     vg = jax.value_and_grad(fun, argnums=argnums, has_aux=has_aux)
 
     def wrapped(*args, **kwargs):
         value, grads = vg(*args, **kwargs)
         if _is_traced(grads):
-            grads = _traced_allreduce_tree(grads, op, axis_name, 1.0, 1.0)
+            if host_sync_in_jit and axis_name is None and size() > 1:
+                grads = _host_callback_allreduce_tree(
+                    grads, op, process_set, compression, 1.0, 1.0)
+            else:
+                grads = _traced_allreduce_tree(grads, op, axis_name, 1.0,
+                                               1.0)
         elif size() > 1:
             grads = _eager_allreduce_tree(grads, op, process_set, compression,
                                           1.0, 1.0)

@@ -29,7 +29,7 @@ def main():
     Ys = jnp.asarray(Y[rank * shard:(rank + 1) * shard])
 
     params = {"w": jnp.zeros((8, 2))}
-    tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1), host_sync_in_jit=True)
     st = tx.init(params)
 
     @jax.jit
