@@ -7,19 +7,53 @@ published 16-GPU ResNet-101 number — 1656.82 img/s total = 103.55
 img/s/GPU (``docs/benchmarks.rst:32-43``, 4×4 Pascal P100, batch 64) — the
 only absolute throughput the reference publishes.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Hardened for the driver contract:
+- the measurement runs in a CHILD process, so every retry gets a fresh JAX
+  (a failed backend init is cached for the life of a process);
+- bounded retry with backoff on TPU-backend init failure;
+- on persistent failure the parent prints ONE diagnostic JSON line (rc 0)
+  instead of a traceback, so the artifact always parses;
+- reports ``mfu`` computed from compiled-HLO FLOPs (fallback: analytic
+  ResNet-50 estimate) against the chip's peak bf16 FLOPs.
+
+stdout carries exactly one JSON line:
+{"metric", "value", "unit", "vs_baseline", "mfu", ...}.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
-
 REFERENCE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # docs/benchmarks.rst:32-43
 
+# Peak dense bf16 FLOPs per chip by device-kind substring (public specs).
+PEAK_BF16_FLOPS = (
+    ("v5 lite", 197e12), ("v5litepod", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12), ("v6", 918e12), ("v4", 275e12), ("v3", 123e12),
+    ("v2", 45e12),
+)
 
-def main() -> None:
+# ResNet-50 @224: ~4.09e9 MACs forward => 2x FLOPs, training ~3x forward.
+ANALYTIC_TRAIN_FLOPS_PER_IMG = 3 * 2 * 4.09e9
+
+ATTEMPTS = 3
+BACKOFFS_S = (10, 30)
+ATTEMPT_DEADLINE_S = 1500  # generous: a good run is ~2-3 min incl. compile
+
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for sub, peak in PEAK_BF16_FLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+def _child() -> None:
+    """Run the actual measurement; print the result JSON line to stdout."""
+    import numpy as np
     import jax
     import jax.numpy as jnp
     import optax
@@ -29,6 +63,10 @@ def main() -> None:
                                            make_resnet_train_step,
                                            batch_sharding)
 
+    def log(msg: str) -> None:
+        print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+    log(f"devices: {jax.devices()}")
     hvd.init()
     mesh = hvd.build_mesh(dp=-1)
     n_chips = int(np.prod(list(mesh.shape.values())))
@@ -54,10 +92,12 @@ def main() -> None:
     # host readback — jax.block_until_ready is unreliable on the axon
     # platform (returns before execution completes), so timing brackets use
     # float() readbacks.
+    log("compiling + warmup...")
     for _ in range(3):
         params, batch_stats, opt_state, loss = step(
             params, batch_stats, opt_state, images, labels)
     float(loss)
+    log("warmup done; timing...")
 
     iters = 20
     t0 = time.perf_counter()
@@ -69,13 +109,96 @@ def main() -> None:
 
     img_per_sec = B * iters / dt
     per_chip = img_per_sec / n_chips
+
+    # FLOPs PER DEVICE per step: cost_analysis() describes the post-SPMD-
+    # partition per-device executable; the analytic fallback divides the
+    # global-batch estimate by n_chips so both feed the same formula.
+    flops_per_device = None
+    flops_src = "hlo"
+    try:
+        cost = step.lower(params, batch_stats, opt_state, images,
+                          labels).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops_per_device = float(cost.get("flops", 0.0)) or None
+    except Exception as e:
+        log(f"cost_analysis unavailable ({e!r}); using analytic FLOPs")
+    if not flops_per_device:
+        flops_per_device = ANALYTIC_TRAIN_FLOPS_PER_IMG * B / n_chips
+        flops_src = "analytic"
+
+    peak = _peak_flops(jax.devices()[0].device_kind)
+    mfu = None
+    if peak:
+        mfu = round(flops_per_device * iters / dt / peak, 4)
+
     print(json.dumps({
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(per_chip / REFERENCE_IMG_PER_SEC_PER_DEVICE, 3),
-    }))
+        "mfu": mfu,
+        "flops_per_device_per_step": flops_per_device,
+        "flops_source": flops_src,
+        "n_chips": n_chips,
+        "device_kind": jax.devices()[0].device_kind,
+        "batch_per_chip": batch_per_chip,
+    }), flush=True)
+
+
+def _run_attempt():
+    """Run one child attempt; return (result_line | None, error_tail)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-u", os.path.abspath(__file__), "--child"],
+        stdout=subprocess.PIPE, stderr=sys.stderr, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    try:
+        out, _ = proc.communicate(timeout=ATTEMPT_DEADLINE_S)
+    except subprocess.TimeoutExpired:
+        # SIGTERM lets the PJRT client tear down its chip claim; never
+        # SIGKILL a process mid-claim (it wedges the relay lease).
+        proc.terminate()
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            pass  # abandon rather than SIGKILL
+        return None, f"attempt exceeded {ATTEMPT_DEADLINE_S}s deadline"
+    for line in reversed((out or "").strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+            if isinstance(parsed, dict) and "metric" in parsed:
+                return line, None
+        except ValueError:
+            continue
+    tail = (out or "").strip().splitlines()[-5:]
+    return None, f"child rc={proc.returncode}: " + " | ".join(tail)[-600:]
+
+
+def main() -> None:
+    errors = []
+    for i in range(ATTEMPTS):
+        line, err = _run_attempt()
+        if line is not None:
+            print(line, flush=True)
+            return
+        errors.append(f"attempt {i + 1}: {err}")
+        print(f"[bench] {errors[-1]}", file=sys.stderr, flush=True)
+        if i < ATTEMPTS - 1:
+            time.sleep(BACKOFFS_S[min(i, len(BACKOFFS_S) - 1)])
+    # Persistent failure: still emit one parseable JSON line, rc 0.
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": None,
+        "unit": "img/s/chip",
+        "vs_baseline": None,
+        "mfu": None,
+        "error": "; ".join(errors)[-800:],
+        "attempts": ATTEMPTS,
+    }), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        _child()
+    else:
+        main()

@@ -25,22 +25,30 @@ sys.path.insert(0, {REPO!r})
 """ + tail
 
 
-def _run_dryrun(n):
-    code = _cpu_snippet(n, f"""
-from __graft_entry__ import dryrun_multichip
-dryrun_multichip({n})
-""")
-    rc = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                        timeout=900, cwd=REPO)
-    assert rc.returncode == 0, rc.stdout.decode() + rc.stderr.decode()
-    assert b"[dryrun] OK" in rc.stdout
+def test_driver_call_path(capsys):
+    """EXACTLY what the driver does: import the module and call
+    dryrun_multichip(8) — no env bootstrap, no subprocess wrapper. The
+    function must self-bootstrap a forced-CPU child regardless of this
+    process's JAX state."""
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__
+        __graft_entry__.dryrun_multichip(8)
+    finally:
+        sys.path.remove(REPO)
+    assert "[dryrun] OK" in capsys.readouterr().out
 
 
 @pytest.mark.parametrize("n", [2, 4, 16])
 def test_dryrun_device_counts(n):
-    # 8 is covered by running __graft_entry__.py directly elsewhere; cover
-    # the other driver-plausible counts
-    _run_dryrun(n)
+    # the function self-bootstraps; call it directly at every
+    # driver-plausible device count
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__
+        __graft_entry__.dryrun_multichip(n)
+    finally:
+        sys.path.remove(REPO)
 
 
 def test_entry_compiles_on_cpu():
