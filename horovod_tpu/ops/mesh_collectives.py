@@ -42,8 +42,13 @@ def preduce(x: jax.Array, axis_name: str, op: ReduceOp = ReduceOp.SUM
     Dispatch mirrors the reference's reduce-op codes
     (``horovod_reduce_op_sum/average/...``, ``operations.cc:1132-1160``).
     """
-    if op in (ReduceOp.SUM, ReduceOp.ADASUM):
+    if op == ReduceOp.SUM:
         return lax.psum(x, axis_name)
+    if op == ReduceOp.ADASUM:
+        # Real VHDD-equivalent combine, not a plain sum (ADVICE r1): gather
+        # all contributions and fold with the Adasum scaled-add tree.
+        from horovod_tpu.ops.adasum import adasum_allreduce_along
+        return adasum_allreduce_along(x, axis_name)
     if op == ReduceOp.AVERAGE:
         return lax.pmean(x, axis_name)
     if op == ReduceOp.MIN:
@@ -106,11 +111,12 @@ def _cached_collective(kind: str, mesh: Mesh, axis_name: str,
     (``nccl_operations.h`` comm map keyed by process set + device map)."""
     if kind == "allreduce":
         def fn(x):
-            # PRODUCT uses all_gather+prod whose replication across the axis
-            # can't be statically inferred — disable the VMA check for it.
+            # PRODUCT and ADASUM use all_gather whose replication across the
+            # axis can't be statically inferred — disable the VMA check.
             @functools.partial(jax.shard_map, mesh=mesh,
                                in_specs=P(axis_name), out_specs=P(),
-                               check_vma=(op != ReduceOp.PRODUCT))
+                               check_vma=(op not in (ReduceOp.PRODUCT,
+                                                     ReduceOp.ADASUM)))
             def body(shard):
                 return preduce(shard[0], axis_name, op)
             return body(x)

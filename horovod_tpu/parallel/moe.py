@@ -89,7 +89,7 @@ def moe_layer_spmd(x: jax.Array, router_w: jax.Array,
     G, M = x.shape
     E = router_w.shape[1]
     if E % max(n, 1) != 0:
-        raise ValueError(f"n_experts ({E}) must divide the ep axis size ({n})")
+        raise ValueError(f"ep axis size ({n}) must divide n_experts ({E})")
     capacity = max(1, int(capacity_factor * k * G / E))
 
     logits = x @ router_w                                  # [G, E]

@@ -68,6 +68,31 @@ def _eager_allreduce_tree(grads, op: ReduceOp, process_set: ProcessSet,
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+_warned_traced_identity = False
+
+
+def _warn_traced_identity_once() -> None:
+    """The traced no-axis path is an identity, which is only correct under
+    single-program global-SPMD jit. A reference user who jits a PER-PROCESS
+    train step with size() > 1 would get silently divergent replicas — too
+    dangerous to leave undetected on a drop-in surface (ADVICE r1)."""
+    global _warned_traced_identity
+    if _warned_traced_identity:
+        return
+    _warned_traced_identity = True
+    import warnings
+    warnings.warn(
+        "horovod_tpu: gradient sync was traced with size() > 1 but no "
+        "axis_name and host_sync_in_jit=False. This is an IDENTITY: it is "
+        "correct only when the step is jitted once over a GLOBAL mesh "
+        "(global-SPMD, XLA reduces from shardings). If you are jitting a "
+        "per-process step over local arrays (the reference pattern), your "
+        "replicas will silently diverge — pass axis_name= under shard_map, "
+        "or host_sync_in_jit=True with the TCP core backend. See the "
+        "'Execution regimes' section of horovod_tpu.train.optimizer.",
+        UserWarning, stacklevel=4)
+
+
 def _traced_allreduce_tree(grads, op: ReduceOp, axis_name: Optional[str],
                            prescale: float, postscale: float):
     """Inside jit/shard_map: emit in-graph collectives.
@@ -79,6 +104,9 @@ def _traced_allreduce_tree(grads, op: ReduceOp, axis_name: Optional[str],
     in ``nccl_operations.cc:156-214``.
     """
     from horovod_tpu.ops.mesh_collectives import preduce
+
+    if axis_name is None and size() > 1:
+        _warn_traced_identity_once()
 
     def one(g):
         if prescale != 1.0:

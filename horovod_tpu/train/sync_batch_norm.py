@@ -19,6 +19,7 @@ from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 import flax.linen as nn
@@ -97,10 +98,17 @@ class SyncBatchNorm(nn.Module):
                 mean_sq = lax.pmean(mean_sq, live)
             var = mean_sq - jnp.square(mean)
             if not self.is_initializing():
+                # Running var gets the unbiased (n/(n-1)) estimate over the
+                # GLOBAL batch, matching reference torch SyncBatchNorm
+                # (sync_batch_norm.py:~190); the biased var still normalizes.
+                n = int(np.prod([x.shape[d] for d in red]))
+                for a in live:
+                    n *= lax.axis_size(a)
+                corr = n / (n - 1) if n > 1 else 1.0
                 ra_mean.value = (self.momentum * ra_mean.value
                                  + (1 - self.momentum) * mean)
                 ra_var.value = (self.momentum * ra_var.value
-                                + (1 - self.momentum) * var)
+                                + (1 - self.momentum) * var * corr)
 
         y = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + self.epsilon)
         y = y * scale + bias

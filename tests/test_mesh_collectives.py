@@ -45,6 +45,17 @@ def test_device_allreduce_ops(mesh):
         np_x.prod(0), rtol=1e-5)
 
 
+def test_device_allreduce_adasum_is_not_sum(mesh):
+    """ADASUM over a mesh axis must apply the VHDD scaled-add combine, not
+    silently psum (ADVICE r1)."""
+    from horovod_tpu.ops.adasum import adasum_tree_reduce
+    x = jnp.asarray(np.random.RandomState(1).randn(4, 8), jnp.float32)
+    out = mc.device_allreduce(x, mesh, "dp", ReduceOp.ADASUM)
+    expect = np.asarray(adasum_tree_reduce(x))
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5)
+    assert not np.allclose(np.asarray(out), np.asarray(x).sum(0))
+
+
 def test_device_allgather(mesh):
     x = jnp.arange(8.0).reshape(4, 2)
     out = mc.device_allgather(x, mesh, "dp")

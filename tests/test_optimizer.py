@@ -39,6 +39,24 @@ def test_distributed_optimizer_inside_jit(hvd):
     assert np.all(np.isfinite(np.asarray(p1["w"])))
 
 
+def test_traced_identity_warns_at_multi_process(hvd, monkeypatch):
+    """Traced sync with size()>1, no axis_name, no host sync is an identity
+    that silently diverges per-process jits — it must warn once (ADVICE r1)."""
+    import warnings
+    from horovod_tpu.train import optimizer as opt_mod
+    monkeypatch.setattr(opt_mod, "size", lambda: 2)
+    monkeypatch.setattr(opt_mod, "_warned_traced_identity", False)
+    tx = hvd_mod.DistributedGradTransform()
+    params = {"w": jnp.ones(3)}
+    state = tx.init(params)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        jax.jit(lambda g, s: tx.update(g, s))(params, state)
+        jax.jit(lambda g, s: tx.update(g, s))({"w": jnp.zeros(3)}, state)
+    msgs = [w for w in caught if "silently diverge" in str(w.message)]
+    assert len(msgs) == 1  # once, not per trace
+
+
 def test_grad_transform_shard_map_axis(hvd, mesh8):
     """Per-device grads synced with an explicit axis name inside shard_map —
     the chip-level DP path."""

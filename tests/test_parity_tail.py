@@ -49,6 +49,20 @@ def test_sync_batch_norm_module():
     assert np.all(np.isfinite(np.asarray(y2)))
 
 
+def test_sync_batch_norm_running_var_unbiased():
+    """Running var must carry the unbiased n/(n-1) estimate (reference torch
+    SyncBatchNorm applies the global-count correction; ADVICE r1)."""
+    from horovod_tpu.train.sync_batch_norm import SyncBatchNorm
+    m = SyncBatchNorm(axis_names=(), momentum=0.0)  # ra_var = this batch's
+    x = jnp.asarray(np.random.RandomState(2).randn(16, 4), jnp.float32)
+    variables = m.init(jax.random.PRNGKey(0), x)
+    _, mut = m.apply(variables, x, mutable=["batch_stats"])
+    n = x.shape[0]
+    expect = np.asarray(x).var(0) * n / (n - 1)  # unbiased
+    np.testing.assert_allclose(np.asarray(mut["batch_stats"]["var"]),
+                               expect, rtol=1e-5)
+
+
 # -- BERT --------------------------------------------------------------------
 
 def _tiny_bert():
