@@ -293,8 +293,15 @@ class CoreBackend(Backend):
         arr, back = _to_host(value)
         out = np.array(arr, copy=True)
         sh, nd = _shape_arg(arr.shape)
-        # root_rank is relative to the process set; core wants global rank
-        globl = self._global_rank_of(root_rank)
+        # root_rank is the GLOBAL rank at the API boundary, matching the
+        # reference (operations.cc:1560-1592 converts global → set rank
+        # internally); the C++ core wants the global rank directly.
+        ranks = getattr(self, "_ranks", None)
+        globl = int(root_rank)
+        if ranks is not None and globl not in ranks:
+            raise ValueError(
+                f"broadcast root_rank={root_rank} is not a member of "
+                f"process set {ranks}")
         ch = self._lib.hvd_enqueue_broadcast(
             name.encode(), arr.ctypes.data_as(ctypes.c_void_p),
             out.ctypes.data_as(ctypes.c_void_p), globl,
@@ -364,9 +371,3 @@ class CoreBackend(Backend):
             self._lib.hvd_shutdown()
         elif self._domain != 0:
             self._lib.hvd_remove_process_set(self._domain)
-
-    def _global_rank_of(self, set_rank: int) -> int:
-        ranks = getattr(self, "_ranks", None)
-        if ranks is None:
-            return set_rank
-        return ranks[set_rank]

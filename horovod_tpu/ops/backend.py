@@ -131,18 +131,22 @@ class Backend(abc.ABC):
         pass
 
 
+def check_scale_dtype(dtype, factor: float) -> None:
+    """Reject fractional pre/postscale on integral tensors (the reference
+    rejects non-float scaling); shared by the single and grouped paths."""
+    if factor != 1.0 and np.issubdtype(dtype, np.integer) \
+            and float(factor) != int(factor):
+        raise ValueError(
+            f"prescale/postscale factor {factor} is fractional but the "
+            f"tensor dtype is integral ({dtype}); cast to float first "
+            "(matches the reference rejecting non-float scaling).")
+
+
 def _scale(arr, factor: float):
     if factor == 1.0:
         return arr
-    if np.issubdtype(np.asarray(arr).dtype, np.integer) \
-            and float(factor) != int(factor):
-        raise ValueError(
-            f"prescale/postscale factor {factor} is fractional but the tensor "
-            f"dtype is integral ({np.asarray(arr).dtype}); cast to float "
-            "first (matches the reference rejecting non-float scaling).")
-    if isinstance(arr, np.ndarray):
-        return (arr * factor).astype(arr.dtype)
-    return (arr * factor).astype(arr.dtype)
+    check_scale_dtype(np.asarray(arr).dtype, factor)
+    return (arr * factor).astype(np.asarray(arr).dtype)
 
 
 class LocalBackend(Backend):

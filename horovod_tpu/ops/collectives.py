@@ -128,6 +128,8 @@ def allgather(value, name: Optional[str] = None,
 
 def broadcast_async(value, root_rank: int, name: Optional[str] = None,
                     process_set: ProcessSet = global_process_set) -> HvdHandle:
+    """``root_rank`` is the GLOBAL rank, also under process sets (reference:
+    ``operations.cc:1560-1592`` converts global → set-relative internally)."""
     be = _backend_for(process_set)
     return be.broadcast_async(_auto_name("broadcast", name), value, root_rank)
 

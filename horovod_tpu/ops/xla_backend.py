@@ -7,6 +7,19 @@ via jitted XLA ops over the pod slice") for the EAGER path: per-process
 arrays become shards of a global array and one cached jitted shard_map
 program moves the bytes — no host round-trip through the TCP rings.
 
+Async contract (reference: enqueue + ``handle_manager`` callbacks,
+``horovod/torch/mpi_ops_v2.cc:89-127``): every ``*_async`` returns
+immediately with a pending :class:`HvdHandle`; a dedicated dispatch thread
+executes submissions in FIFO order and completes the handles. The FIFO is
+shared across the global set and every process set, so each process has a
+single total submission order (members of overlapping sets must submit the
+shared sets' ops in a consistent order — the same-order contract).
+
+Fusion (reference: ``nccl_operations.cc:156-214`` fuse→reduce→unfuse):
+``grouped_allreduce_async`` compiles ONE program that concatenates the
+group per dtype, reduces each fused buffer with a single collective, and
+splits the results — N tensors, one collective launch per dtype.
+
 Contract: every member process must issue the same collectives in the same
 order (the standard data-parallel training pattern, and exactly what the
 reference's response cache converges to in steady state). For dynamically
@@ -19,12 +32,13 @@ from __future__ import annotations
 
 import functools
 import os
+import queue
 import threading
-from typing import Optional, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 
-from horovod_tpu.ops.backend import Backend, HvdHandle
+from horovod_tpu.ops.backend import Backend, HvdHandle, _scale
 from horovod_tpu.ops.reduce_op import ReduceOp
 
 _DIST_LOCK = threading.Lock()
@@ -44,36 +58,60 @@ def _ensure_jax_distributed(coord_addr: str, port: int, size: int,
         _DIST_INITIALIZED = True
 
 
-class XlaBackend(Backend):
-    def __init__(self, state) -> None:
-        import jax
-        coord = os.environ.get("HVD_TPU_COORD_ADDR", "127.0.0.1")
-        base = int(os.environ.get("HVD_TPU_COORD_PORT", "37592"))
-        xla_port = int(os.environ.get("HVD_TPU_XLA_COORD_PORT",
-                                      str(base + 1)))
-        _ensure_jax_distributed(coord, xla_port, state.launched_size,
-                                state.launched_rank
-                                if state.launched_rank is not None
-                                else state.rank)
-        super().__init__(jax.process_index(), jax.process_count())
-        self._jax = jax
+class _Dispatcher:
+    """FIFO dispatch thread completing pending handles (the reference's
+    background-loop + finalizer-thread role, ``gpu_operations.h:100-137``)."""
+
+    def __init__(self) -> None:
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="hvd-xla-dispatch")
+        self._thread.start()
+
+    def submit(self, fn) -> HvdHandle:
+        h = HvdHandle()
+        self._q.put((fn, h))
+        return h
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, h = item
+            try:
+                h._set_result(fn())
+            except BaseException as e:  # complete the handle, keep looping
+                h._set_error(e)
+
+    def shutdown(self) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=30)
+
+
+class _XlaGroup:
+    """Collective programs over one process group (global set or a process
+    set): a 'proc' mesh with one device per member process and a compiled-
+    program cache keyed like the reference's per-set NCCL comm cache
+    (``nccl_operations.cc:65-107``)."""
+
+    def __init__(self, jax_mod, devices, group_rank: int) -> None:
         import jax.numpy as jnp
-        self._jnp = jnp
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        self._jax = jax_mod
+        self._jnp = jnp
         self._P = P
         self._NS = NamedSharding
-        # one device per process: eager contributions are host arrays, so
-        # replicating them over every local chip would just multiply H2D
-        # transfers; mesh-mode code paths use the full mesh instead
-        nlocal = jax.local_device_count()
-        devs = np.asarray(jax.devices()).reshape(self.size, nlocal)[:, 0]
-        self._mesh = Mesh(devs, ("proc",))
+        self._mesh = Mesh(np.asarray(devices), ("proc",))
+        self.rank = group_rank
+        self.size = len(devices)
         self._fn_cache = {}
+        self._ragged_ok: Optional[bool] = None
 
-    # -- helpers -------------------------------------------------------------
-    def _to_global(self, arr: np.ndarray):
+    # -- data movement -------------------------------------------------------
+    def to_global(self, arr: np.ndarray):
         """Per-process contribution → global array [size, ...] sharded over
-        'proc' (replicated over local devices)."""
+        'proc'."""
         jax = self._jax
         sharding = self._NS(self._mesh, self._P("proc"))
         row = np.asarray(arr)[None]
@@ -82,10 +120,11 @@ class XlaBackend(Backend):
         return jax.make_array_from_single_device_arrays(
             (self.size,) + np.asarray(arr).shape, sharding, shards)
 
-    def _local_view(self, garr) -> np.ndarray:
+    def local_view(self, garr) -> np.ndarray:
         return np.asarray(garr.addressable_shards[0].data)
 
-    def _collective(self, kind: str, op: ReduceOp, shape, dtype, extra=()):
+    # -- compiled programs ---------------------------------------------------
+    def collective(self, kind: str, op: ReduceOp, shape, dtype, extra=()):
         key = (kind, op, tuple(shape), str(dtype), tuple(extra))
         fn = self._fn_cache.get(key)
         if fn is not None:
@@ -126,68 +165,231 @@ class XlaBackend(Backend):
         self._fn_cache[key] = fn
         return fn
 
-    # -- collectives ---------------------------------------------------------
-    def allreduce_async(self, name, value, op, prescale=1.0, postscale=1.0):
-        from horovod_tpu.ops.backend import _scale
-        arr = _scale(np.asarray(value), prescale)
-        garr = self._to_global(arr)
-        fn = self._collective("allreduce", op, arr.shape, arr.dtype)
-        # AVERAGE is handled inside the collective (pmean)
-        out = _scale(self._local_view(fn(garr)), postscale)
-        result = self._jnp.asarray(out) if not isinstance(value, np.ndarray) \
+    def grouped_allreduce_program(self, op: ReduceOp, shapes, dtypes,
+                                  prescale: float, postscale: float):
+        """ONE program for the whole group: concat per dtype → a single
+        collective per fused buffer → split (the fusion contract,
+        reference ``nccl_operations.cc:170-211`` fuse→reduce→unfuse)."""
+        key = ("grouped", op, tuple(map(tuple, shapes)),
+               tuple(str(d) for d in dtypes), float(prescale),
+               float(postscale))
+        fn = self._fn_cache.get(key)
+        if fn is not None:
+            return fn
+        jax, jnp, P = self._jax, self._jnp, self._P
+        from horovod_tpu.ops.mesh_collectives import preduce
+
+        n = len(shapes)
+        by_dtype: dict = {}
+        for i, d in enumerate(dtypes):
+            by_dtype.setdefault(str(d), []).append(i)
+
+        from horovod_tpu.ops.reduce_op import ReduceOp as _R
+
+        @functools.partial(jax.shard_map, mesh=self._mesh,
+                           in_specs=tuple(P("proc") for _ in range(n)),
+                           out_specs=tuple(P() for _ in range(n)),
+                           check_vma=False)
+        def body(*xs):
+            outs: List = [None] * n
+            for _, idxs in sorted(by_dtype.items()):
+                flats = [xs[i][0].reshape(-1) for i in idxs]
+                fused = flats[0] if len(flats) == 1 else \
+                    jnp.concatenate(flats)
+                if prescale != 1.0:
+                    fused = (fused * prescale).astype(fused.dtype)
+                if op == _R.ADASUM:
+                    # one gather for the fused buffer, but PER-TENSOR
+                    # scaled-add coefficients — the reference computes
+                    # per-layer dots inside the fused buffer
+                    # (adasum.h tensor_counts), as does the C++ core
+                    from horovod_tpu.ops.adasum import adasum_tree_reduce
+                    gathered = jax.lax.all_gather(fused, "proc")
+                    off = 0
+                    parts = []
+                    for i in idxs:
+                        sz = int(np.prod(shapes[i], dtype=np.int64))
+                        parts.append(adasum_tree_reduce(
+                            jax.lax.dynamic_slice_in_dim(gathered, off, sz,
+                                                         axis=1)))
+                        off += sz
+                    fused = jnp.concatenate(parts)
+                else:
+                    fused = preduce(fused, "proc", op)
+                if postscale != 1.0:
+                    fused = (fused * postscale).astype(fused.dtype)
+                off = 0
+                for i in idxs:
+                    sz = int(np.prod(shapes[i], dtype=np.int64))
+                    outs[i] = jax.lax.dynamic_slice_in_dim(
+                        fused, off, sz).reshape(shapes[i])
+                    off += sz
+            return tuple(outs)
+
+        fn = jax.jit(body)
+        self._fn_cache[key] = fn
+        return fn
+
+    def ragged_alltoall_supported(self) -> bool:
+        """Capability probe: ``lax.ragged_all_to_all`` lowers on TPU but not
+        on all platforms (notably XLA:CPU) — compile-check a tiny instance
+        once and cache the verdict."""
+        if self._ragged_ok is None:
+            jax, jnp, P = self._jax, self._jnp, self._P
+            try:
+                zeros = np.zeros(self.size, np.int32)
+
+                @functools.partial(jax.shard_map, mesh=self._mesh,
+                                   in_specs=P("proc"), out_specs=P("proc"),
+                                   check_vma=False)
+                def probe(x):
+                    loc = x[0]
+                    return jax.lax.ragged_all_to_all(
+                        loc, jnp.zeros_like(loc),
+                        jnp.asarray(zeros), jnp.asarray(zeros),
+                        jnp.asarray(zeros), jnp.asarray(zeros),
+                        axis_name="proc")[None]
+
+                x = jnp.zeros((self.size, 4), jnp.float32)
+                jax.jit(probe).lower(x).compile()
+                self._ragged_ok = True
+            except Exception:
+                self._ragged_ok = False
+        return self._ragged_ok
+
+    def ragged_alltoall_program(self, pad_send: int, pad_recv: int,
+                                trailing, dtype):
+        """Device-side uneven alltoall via ``lax.ragged_all_to_all``.
+
+        SPMD requires every participant to run the IDENTICAL program, so
+        per-rank split counts must not leak into shapes: operands are
+        padded to the global max send/recv totals (host-known from the
+        exchanged split table) and the per-rank offset/size vectors travel
+        as runtime inputs sharded over 'proc'. Cache key = the bounds, not
+        the table — steady-state MoE loads with varying routing reuse one
+        executable."""
+        key = ("ragged", int(pad_send), int(pad_recv), tuple(trailing),
+               str(dtype))
+        fn = self._fn_cache.get(key)
+        if fn is not None:
+            return fn
+        jax, jnp, P = self._jax, self._jnp, self._P
+
+        @functools.partial(
+            jax.shard_map, mesh=self._mesh,
+            in_specs=(P("proc"), P("proc"), P("proc"), P("proc"), P("proc")),
+            out_specs=P("proc"), check_vma=False)
+        def body(x, in_off, send_sz, out_off, recv_sz):
+            loc = x[0]
+            out = jnp.zeros((pad_recv,) + tuple(trailing), dtype)
+            out = jax.lax.ragged_all_to_all(
+                loc, out, in_off[0], send_sz[0], out_off[0], recv_sz[0],
+                axis_name="proc")
+            return out[None]
+
+        fn = jax.jit(body)
+        self._fn_cache[key] = fn
+        return fn
+
+
+class XlaBackend(Backend):
+    def __init__(self, state) -> None:
+        import jax
+        coord = os.environ.get("HVD_TPU_COORD_ADDR", "127.0.0.1")
+        base = int(os.environ.get("HVD_TPU_COORD_PORT", "37592"))
+        xla_port = int(os.environ.get("HVD_TPU_XLA_COORD_PORT",
+                                      str(base + 1)))
+        _ensure_jax_distributed(coord, xla_port, state.launched_size,
+                                state.launched_rank
+                                if state.launched_rank is not None
+                                else state.rank)
+        super().__init__(jax.process_index(), jax.process_count())
+        self._jax = jax
+        import jax.numpy as jnp
+        self._jnp = jnp
+        # one device per process: eager contributions are host arrays, so
+        # replicating them over every local chip would just multiply H2D
+        # transfers; mesh-mode code paths use the full mesh instead
+        nlocal = jax.local_device_count()
+        self._proc_devices = \
+            np.asarray(jax.devices()).reshape(self.size, nlocal)[:, 0]
+        self._group = _XlaGroup(jax, self._proc_devices, self.rank)
+        self._disp = _Dispatcher()
+
+    # -- async submission ----------------------------------------------------
+    def _submit(self, fn) -> HvdHandle:
+        return self._disp.submit(fn)
+
+    def _wrap(self, value, out):
+        return self._jnp.asarray(out) if not isinstance(value, np.ndarray) \
             else out
-        return HvdHandle.done(result)
 
-    def grouped_allreduce_async(self, names, values, op,
-                                prescale=1.0, postscale=1.0):
-        outs = [self.allreduce_async(n, v, op, prescale, postscale).wait()
-                for n, v in zip(names, values)]
-        return HvdHandle.done(outs)
+    # -- synchronous bodies (run on the dispatch thread; internal sub-ops
+    #    call these directly so a submission never waits on the queue) ------
+    def _allreduce(self, group: _XlaGroup, value, op, prescale, postscale):
+        arr = _scale(np.asarray(value), prescale)
+        garr = group.to_global(arr)
+        fn = group.collective("allreduce", op, arr.shape, arr.dtype)
+        # AVERAGE / ADASUM are handled inside the collective
+        out = _scale(group.local_view(fn(garr)), postscale)
+        return self._wrap(value, out)
 
-    def allgather_async(self, name, value):
+    def _grouped_allreduce(self, group: _XlaGroup, values, op,
+                           prescale, postscale):
+        from horovod_tpu.ops.backend import check_scale_dtype
+        arrs = [np.asarray(v) for v in values]
+        for a in arrs:  # same contract as _scale() on the single path
+            check_scale_dtype(a.dtype, prescale)
+            check_scale_dtype(a.dtype, postscale)
+        shapes = [a.shape for a in arrs]
+        dtypes = [a.dtype for a in arrs]
+        fn = group.grouped_allreduce_program(op, shapes, dtypes,
+                                             prescale, postscale)
+        garrs = [group.to_global(a) for a in arrs]
+        outs = fn(*garrs)
+        return [self._wrap(v, group.local_view(o))
+                for v, o in zip(values, outs)]
+
+    def _allgather(self, group: _XlaGroup, name, value):
         arr = np.asarray(value)
         # ragged dim 0: pad to the max (sizes exchanged via an allreduce)
-        sizes = np.zeros(self.size, np.int64)
-        sizes[self.rank] = arr.shape[0]
-        sizes = np.asarray(self.allreduce_async(
-            f"{name}.sizes", sizes, ReduceOp.SUM).wait()).astype(np.int64)
+        sizes = np.zeros(group.size, np.int64)
+        sizes[group.rank] = arr.shape[0]
+        sizes = np.asarray(self._allreduce(
+            group, sizes, ReduceOp.SUM, 1.0, 1.0)).astype(np.int64)
         max_rows = int(sizes.max())
         padded = np.zeros((max_rows,) + arr.shape[1:], arr.dtype)
         padded[:arr.shape[0]] = arr
-        garr = self._to_global(padded)
-        fn = self._collective("allgather", ReduceOp.SUM, padded.shape,
+        garr = group.to_global(padded)
+        fn = group.collective("allgather", ReduceOp.SUM, padded.shape,
                               padded.dtype)
-        full = self._local_view(fn(garr))  # [size*max_rows, ...]
+        full = group.local_view(fn(garr))  # [size*max_rows, ...]
         chunks = [full[i * max_rows:i * max_rows + int(sizes[i])]
-                  for i in range(self.size)]
+                  for i in range(group.size)]
         out = np.concatenate(chunks, axis=0)
-        result = self._jnp.asarray(out) if not isinstance(value, np.ndarray) \
-            else out
-        return HvdHandle.done(result)
+        return self._wrap(value, out)
 
-    def broadcast_async(self, name, value, root_rank):
-        if not 0 <= int(root_rank) < self.size:
+    def _broadcast(self, group: _XlaGroup, value, root_rank):
+        if not 0 <= int(root_rank) < group.size:
             raise ValueError(
                 f"broadcast root_rank={root_rank} out of range for size "
-                f"{self.size}")
+                f"{group.size}")
         arr = np.asarray(value)
-        garr = self._to_global(arr)
-        fn = self._collective("broadcast", ReduceOp.SUM, arr.shape,
+        garr = group.to_global(arr)
+        fn = group.collective("broadcast", ReduceOp.SUM, arr.shape,
                               arr.dtype, (int(root_rank),))
-        out = self._local_view(fn(garr))
-        result = self._jnp.asarray(out) if not isinstance(value, np.ndarray) \
-            else out
-        return HvdHandle.done(result)
+        out = group.local_view(fn(garr))
+        return self._wrap(value, out)
 
-    def alltoall_async(self, name, value, splits=None):
+    def _alltoall(self, group: _XlaGroup, name, value, splits):
         arr = np.asarray(value)
         if splits is None:
-            if arr.shape[0] % self.size != 0:
+            if arr.shape[0] % group.size != 0:
                 raise ValueError("alltoall without splits requires dim 0 "
-                                 f"divisible by size ({self.size})")
-            splits = [arr.shape[0] // self.size] * self.size
+                                 f"divisible by size ({group.size})")
+            splits = [arr.shape[0] // group.size] * group.size
         splits = [int(s) for s in splits]
-        if len(splits) != self.size:
+        if len(splits) != group.size:
             raise ValueError("alltoall splits must have one entry per rank")
         if any(s < 0 for s in splits):
             raise ValueError("alltoall splits must be non-negative")
@@ -198,46 +400,178 @@ class XlaBackend(Backend):
         if len(set(splits)) == 1:
             # uniform: single fused XLA all_to_all
             rows = splits[0]
-            blocks = arr.reshape((self.size, rows) + arr.shape[1:])
-            garr = self._to_global(blocks)
-            fn = self._collective("alltoall", ReduceOp.SUM, blocks.shape,
+            blocks = arr.reshape((group.size, rows) + arr.shape[1:])
+            garr = group.to_global(blocks)
+            fn = group.collective("alltoall", ReduceOp.SUM, blocks.shape,
                                   blocks.dtype)
-            out = self._local_view(fn(garr)).reshape(
-                (self.size * rows,) + arr.shape[1:])
-            recv = np.asarray([rows] * self.size, np.int32)
-        else:
-            # uneven: exchange split tables, then allgather + slice (the
-            # correctness path; ragged_all_to_all is a future optimization)
-            table = np.zeros((self.size, self.size), np.int64)
-            table[self.rank] = splits
-            table = np.asarray(self.allreduce_async(
-                f"{name}.splits", table, ReduceOp.SUM).wait())
-            gathered = np.asarray(self.allgather_async(
-                f"{name}.data", arr).wait())
-            row_offsets = np.concatenate(
-                [[0], np.cumsum(table.sum(1))])[:-1]
-            pieces = []
-            recv = []
-            for src in range(self.size):
-                start = row_offsets[src] + table[src, :self.rank].sum()
-                n = table[src, self.rank]
-                pieces.append(gathered[int(start):int(start + n)])
-                recv.append(int(n))
-            out = np.concatenate(pieces, axis=0)
-            recv = np.asarray(recv, np.int32)
-        result = self._jnp.asarray(out) if not isinstance(value, np.ndarray) \
-            else out
-        return HvdHandle.done((result, recv))
+            out = group.local_view(fn(garr)).reshape(
+                (group.size * rows,) + arr.shape[1:])
+            recv = np.asarray([rows] * group.size, np.int32)
+            return self._wrap(value, out), recv
+
+        # uneven: exchange the split table first (host allreduce)
+        table = np.zeros((group.size, group.size), np.int64)
+        table[group.rank] = splits
+        table = np.asarray(self._allreduce(
+            group, table, ReduceOp.SUM, 1.0, 1.0))
+        recv = table[:, group.rank].astype(np.int32)
+
+        if group.ragged_alltoall_supported():
+            # device-side ragged exchange (TPU). One executable for every
+            # rank: pad to the table's global max send/recv totals and feed
+            # the per-rank offset vectors as sharded runtime inputs.
+            n = group.size
+            pad_send = int(table.sum(axis=1).max())
+            pad_recv = int(table.sum(axis=0).max())
+            fn = group.ragged_alltoall_program(pad_send, pad_recv,
+                                               arr.shape[1:], arr.dtype)
+            padded = np.zeros((pad_send,) + arr.shape[1:], arr.dtype)
+            padded[:arr.shape[0]] = arr
+            in_off = np.concatenate(
+                [[0], np.cumsum(table[group.rank])[:-1]]).astype(np.int32)
+            send_sz = table[group.rank].astype(np.int32)
+            # out_off[i]: where MY block starts inside receiver i's output
+            # (sender-side knowledge of receiver placement; receivers order
+            # blocks by source rank)
+            out_off = np.asarray(
+                [table[:group.rank, dst].sum() for dst in range(n)],
+                np.int32)
+            recv_sz = table[:, group.rank].astype(np.int32)
+            garr = group.to_global(padded)
+            out = group.local_view(fn(
+                garr, group.to_global(in_off), group.to_global(send_sz),
+                group.to_global(out_off), group.to_global(recv_sz)))
+            total_recv = int(table[:, group.rank].sum())
+            return self._wrap(value, out[:total_recv]), recv
+
+        # portable path: pad each destination block to the global max split
+        # and run ONE uniform all_to_all — O(size·max_split) traffic, not
+        # the O(size·total) of allgather-everything.
+        pad = int(table.max())
+        blocks = np.zeros((group.size, pad) + arr.shape[1:], arr.dtype)
+        off = 0
+        for dst in range(group.size):
+            blocks[dst, :splits[dst]] = arr[off:off + splits[dst]]
+            off += splits[dst]
+        garr = group.to_global(blocks)
+        fn = group.collective("alltoall", ReduceOp.SUM, blocks.shape,
+                              blocks.dtype)
+        full = group.local_view(fn(garr)).reshape(
+            (group.size, pad) + arr.shape[1:])
+        out = np.concatenate(
+            [full[src, :int(table[src, group.rank])]
+             for src in range(group.size)], axis=0)
+        return self._wrap(value, out), recv
+
+    # -- public async API ----------------------------------------------------
+    def allreduce_async(self, name, value, op, prescale=1.0, postscale=1.0):
+        return self._submit(lambda: self._allreduce(
+            self._group, value, op, prescale, postscale))
+
+    def grouped_allreduce_async(self, names, values, op,
+                                prescale=1.0, postscale=1.0):
+        return self._submit(lambda: self._grouped_allreduce(
+            self._group, list(values), op, prescale, postscale))
+
+    def allgather_async(self, name, value):
+        return self._submit(lambda: self._allgather(self._group, name, value))
+
+    def broadcast_async(self, name, value, root_rank):
+        return self._submit(lambda: self._broadcast(
+            self._group, value, root_rank))
+
+    def alltoall_async(self, name, value, splits=None):
+        return self._submit(lambda: self._alltoall(
+            self._group, name, value, splits))
 
     def barrier(self) -> None:
-        self.allreduce_async("__barrier__", np.zeros(1, np.float32),
-                             ReduceOp.SUM).wait()
+        self._submit(lambda: self._allreduce(
+            self._group, np.zeros(1, np.float32), ReduceOp.SUM,
+            1.0, 1.0)).wait()
+
+    def make_subset(self, ranks: Sequence[int]):
+        """Per-set sub-mesh + program cache (reference: per-set NCCL comms,
+        ``nccl_operations.cc:65-107``). Shares this backend's dispatch
+        thread so each process keeps ONE total submission order."""
+        ranks = sorted(set(int(r) for r in ranks))
+        if any(not 0 <= r < self.size for r in ranks):
+            raise ValueError(f"process-set ranks {ranks} out of range for "
+                             f"world size {self.size}")
+        return _XlaSubsetBackend(self, ranks)
+
+    def shutdown(self) -> None:
+        self._disp.shutdown()
+        # jax.distributed teardown happens at process exit
+
+
+class _XlaSubsetBackend(Backend):
+    """Process-set view over the parent XLA backend: same dispatch thread,
+    own sub-mesh and compiled-program cache. Non-members hold a handle whose
+    collectives raise (reference: non-member submissions are rejected,
+    ``process_set.h:26-81``)."""
+
+    def __init__(self, parent: XlaBackend, ranks: List[int]) -> None:
+        self._parent = parent
+        self._ranks = ranks
+        my = parent.rank
+        set_rank = ranks.index(my) if my in ranks else -1
+        super().__init__(set_rank, len(ranks))
+        self._group = None
+        if set_rank >= 0:
+            devices = parent._proc_devices[ranks]
+            self._group = _XlaGroup(parent._jax, devices, set_rank)
+
+    def _require_member(self) -> _XlaGroup:
+        if self._group is None:
+            raise RuntimeError(
+                f"process {self._parent.rank} is not a member of process set "
+                f"{self._ranks} and cannot submit collectives to it")
+        return self._group
+
+    def allreduce_async(self, name, value, op, prescale=1.0, postscale=1.0):
+        g = self._require_member()
+        return self._parent._submit(lambda: self._parent._allreduce(
+            g, value, op, prescale, postscale))
+
+    def grouped_allreduce_async(self, names, values, op,
+                                prescale=1.0, postscale=1.0):
+        g = self._require_member()
+        return self._parent._submit(lambda: self._parent._grouped_allreduce(
+            g, list(values), op, prescale, postscale))
+
+    def allgather_async(self, name, value):
+        g = self._require_member()
+        return self._parent._submit(lambda: self._parent._allgather(
+            g, name, value))
+
+    def broadcast_async(self, name, value, root_rank):
+        """``root_rank`` is the GLOBAL rank (reference semantics,
+        ``core_backend.broadcast_async`` does the same translation)."""
+        g = self._require_member()
+        if int(root_rank) in self._ranks:
+            set_root = self._ranks.index(int(root_rank))
+        else:
+            raise ValueError(
+                f"broadcast root_rank={root_rank} is not a member of "
+                f"process set {self._ranks}")
+        return self._parent._submit(lambda: self._parent._broadcast(
+            g, value, set_root))
+
+    def alltoall_async(self, name, value, splits=None):
+        g = self._require_member()
+        return self._parent._submit(lambda: self._parent._alltoall(
+            g, name, value, splits))
+
+    def barrier(self) -> None:
+        g = self._require_member()
+        self._parent._submit(lambda: self._parent._allreduce(
+            g, np.zeros(1, np.float32), ReduceOp.SUM, 1.0, 1.0)).wait()
 
     def make_subset(self, ranks: Sequence[int]):
         raise NotImplementedError(
-            "process sets over the XLA eager backend are not supported yet; "
-            "use the TCP core backend (unset HVD_TPU_OPERATIONS) for "
-            "process-set workloads")
+            "nested process sets are not supported; create sets from the "
+            "global backend (matches the reference, which registers all "
+            "sets against the global table)")
 
     def shutdown(self) -> None:
-        pass  # jax.distributed teardown happens at process exit
+        pass  # the dispatch thread belongs to the parent
