@@ -553,13 +553,35 @@ int Core::AddProcessSet(const std::vector<int>& ranks) {
                           : (int)(it - d->group.ranks.begin());
   d->cache.reset(new ResponseCache(cfg_.cache_capacity));
   d->joined_ranks.assign(d->group.ranks.size(), false);
+  // Multi-process: the set stays INACTIVE (no lockstep negotiation rounds)
+  // until the domain-0 coordinator confirms every rank registered it; a
+  // member cycling a set its peers don't know yet would withhold its
+  // domain-0 traffic and deadlock the whole mesh (reference coordinates
+  // dynamic registration through the background thread the same way,
+  // operations.cc:587-623). Submissions queue and run on activation.
+  d->active = cfg_.size <= 1;
+  d->registered_at = std::chrono::steady_clock::now();
   domains_[id] = std::move(d);
   return id;
 }
 
 void Core::RemoveProcessSet(int id) {
   std::lock_guard<std::mutex> lk(domains_mu_);
-  if (id != 0) domains_.erase(id);
+  if (id == 0) return;
+  auto it = domains_.find(id);
+  if (it == domains_.end()) return;
+  if (cfg_.size <= 1) {
+    domains_.erase(it);
+    return;
+  }
+  // Multi-process: ALWAYS go through retire consensus — even for a
+  // still-inactive set. Erasing an inactive set locally races the
+  // activation broadcast (this rank may already have announced it; the
+  // coordinator could activate it this very cycle, and peers would then
+  // block on a member that no longer has the domain). Retiring stops the
+  // announcements, so an inactive set simply never activates and is erased
+  // everywhere once every rank votes.
+  it->second->retiring = true;
 }
 
 int Core::last_join_rank(int domain) {
@@ -820,13 +842,64 @@ std::string KeyFromSingleResponse(const hvd::Response& r) {
 }
 }  // namespace
 
+namespace {
+uint64_t HashRanks(const std::vector<int>& ranks) {
+  uint64_t h = 1469598103934665603ull;  // FNV-1a
+  for (int r : ranks) {
+    h ^= (uint64_t)(uint32_t)r;
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+}  // namespace
+
+void Core::ApplyDomainLifecycle(const std::vector<int32_t>& activate,
+                                const std::vector<int32_t>& retired) {
+  std::lock_guard<std::mutex> lk(domains_mu_);
+  for (auto id : activate) {
+    auto it = domains_.find(id);
+    if (it != domains_.end()) it->second->active = true;
+  }
+  for (auto id : retired) {
+    auto it = domains_.find(id);
+    if (it != domains_.end()) {
+      it->second->queue.FinalizeAllWithError(
+          Status::Aborted("process set removed"));
+      domains_.erase(it);
+    }
+  }
+}
+
 bool Core::RunOnce() {
   bool want_shutdown = shutdown_requested_.load();
 
   std::vector<int> domain_ids;
+  std::vector<wire::DomainAnnounce> my_announce;
+  std::vector<int32_t> my_retire;
   {
     std::lock_guard<std::mutex> lk(domains_mu_);
-    for (auto& kv : domains_) domain_ids.push_back(kv.first);
+    for (auto& kv : domains_) {
+      domain_ids.push_back(kv.first);
+      CoordDomain* cd = kv.second.get();
+      if (cd->retiring) {
+        my_retire.push_back(kv.first);
+      } else if (!cd->active) {
+        wire::DomainAnnounce a;
+        a.id = kv.first;
+        a.ranks_hash = HashRanks(cd->group.ranks);
+        my_announce.push_back(a);
+        if (!cd->inactive_warned && cd->queue.pending() > 0 &&
+            std::chrono::duration<double>(std::chrono::steady_clock::now() -
+                                          cd->registered_at)
+                    .count() > cfg_.stall_warning_secs) {
+          fprintf(stderr,
+                  "[hvdcore] WARNING: collectives pending on process set %d "
+                  "which not all ranks have registered after %.0fs\n",
+                  kv.first, cfg_.stall_warning_secs);
+          cd->inactive_warned = true;
+        }
+      }
+    }
   }
 
   bool got_shutdown_response = false;
@@ -835,8 +908,12 @@ bool Core::RunOnce() {
     {
       std::lock_guard<std::mutex> lk(domains_mu_);
       auto it = domains_.find(id);
-      if (it == domains_.end()) continue;
+      if (it == domains_.end()) continue;  // retired during this cycle
       d = it->second.get();
+      // re-read under the lock: the domain-0 phase of THIS cycle may have
+      // just activated it (every rank then activates in the same cycle, so
+      // all members enter its first negotiate round together)
+      if (!d->active) continue;
     }
     if (d->group.my_index < 0) continue;  // not a member
 
@@ -870,6 +947,28 @@ bool Core::RunOnce() {
       // gather (lockstep cycle; reference: MPIController::RecvReadyTensors)
       HandleRequests(*d, cfg_.rank, misses);
       HandleCacheBits(*d, cfg_.rank, my_bits);
+      auto note_announce = [&](int from,
+                               const std::vector<wire::DomainAnnounce>& as) {
+        for (auto& a : as) {
+          auto& c = announce_table_[a.id];
+          if (c.ranks.empty()) c.ranks_hash = a.ranks_hash;
+          if (c.ranks_hash != a.ranks_hash && !c.mismatch_warned) {
+            fprintf(stderr,
+                    "[hvdcore] ERROR: ranks disagree on the member list of "
+                    "process set %d; the set will never activate\n",
+                    a.id);
+            c.mismatch_warned = true;
+          }
+          c.ranks.insert(from);
+        }
+      };
+      auto note_retire = [&](int from, const std::vector<int32_t>& rs) {
+        for (auto r : rs) retire_table_[r].insert(from);
+      };
+      if (id == 0) {
+        note_announce(cfg_.rank, my_announce);
+        note_retire(cfg_.rank, my_retire);
+      }
       int shutdown_votes = want_shutdown ? 1 : 0;
       for (int i = 1; i < d->group.size(); ++i) {
         std::vector<uint8_t> buf;
@@ -878,10 +977,41 @@ bool Core::RunOnce() {
         if (!st.ok()) return false;
         bool sd;
         std::vector<int32_t> bits;
-        auto rl = wire::DecodeRequestList(buf.data(), buf.size(), &sd, &bits);
+        std::vector<wire::DomainAnnounce> ann;
+        std::vector<int32_t> ret;
+        auto rl = wire::DecodeRequestList(buf.data(), buf.size(), &sd, &bits,
+                                          &ann, &ret);
         if (sd) shutdown_votes++;
+        if (id == 0) {
+          note_announce(d->group.global(i), ann);
+          note_retire(d->group.global(i), ret);
+        }
         HandleRequests(*d, d->group.global(i), rl);
         HandleCacheBits(*d, d->group.global(i), bits);
+      }
+      // registration/retire consensus (domain 0 only): a set goes live —
+      // on every rank in THIS cycle — once all ranks announced it
+      std::vector<int32_t> activate, retired;
+      if (id == 0) {
+        for (auto it = announce_table_.begin();
+             it != announce_table_.end();) {
+          if (!it->second.mismatch_warned &&
+              (int)it->second.ranks.size() >= cfg_.size) {
+            activate.push_back(it->first);
+            it = announce_table_.erase(it);
+          } else {
+            ++it;
+          }
+        }
+        for (auto it = retire_table_.begin(); it != retire_table_.end();) {
+          if ((int)it->second.size() >= cfg_.size) {
+            retired.push_back(it->first);
+            announce_table_.erase(it->first);  // drop a half-done activation
+            it = retire_table_.erase(it);
+          } else {
+            ++it;
+          }
+        }
       }
       singles = CollectReady(*d);
       if (id == 0 && shutdown_votes == d->group.size()) {
@@ -889,20 +1019,24 @@ bool Core::RunOnce() {
         sd.type = Response::kShutdown;
         singles.push_back(sd);
       }
-      auto payload = wire::EncodeResponseList(singles,
-                                              cfg_.fusion_threshold);
+      auto payload = wire::EncodeResponseList(singles, cfg_.fusion_threshold,
+                                              activate, retired);
       for (int i = 1; i < d->group.size(); ++i) {
         auto st = transport_->Send(d->group.global(i),
                                    DomTag(id, kTagResponse), payload.data(),
                                    payload.size());
         if (!st.ok()) return false;
       }
+      if (id == 0) ApplyDomainLifecycle(activate, retired);
       // stall check (reference: controller.cc:132-143)
       auto warn = d->stall.Check(cfg_.stall_warning_secs);
       if (!warn.empty()) fprintf(stderr, "[hvdcore] STALL WARNING:\n%s",
                                  warn.c_str());
     } else {
-      auto payload = wire::EncodeRequestList(misses, want_shutdown, my_bits);
+      auto payload = wire::EncodeRequestList(
+          misses, want_shutdown, my_bits,
+          id == 0 ? my_announce : std::vector<wire::DomainAnnounce>{},
+          id == 0 ? my_retire : std::vector<int32_t>{});
       auto st = transport_->Send(coord, DomTag(id, kTagNegotiate),
                                  payload.data(), payload.size());
       if (!st.ok()) return false;
@@ -910,8 +1044,11 @@ bool Core::RunOnce() {
       st = transport_->Recv(coord, DomTag(id, kTagResponse), &buf);
       if (!st.ok()) return false;
       int64_t coord_threshold = cfg_.fusion_threshold;
+      std::vector<int32_t> activate, retired;
       singles = wire::DecodeResponseList(buf.data(), buf.size(),
-                                         &coord_threshold);
+                                         &coord_threshold, &activate,
+                                         &retired);
+      if (id == 0) ApplyDomainLifecycle(activate, retired);
       // adopt the coordinator's threshold so FuseResponses groups
       // identically on every rank (autotune is coordinator-only)
       cfg_.fusion_threshold = coord_threshold;

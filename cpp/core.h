@@ -135,6 +135,13 @@ struct CoordDomain {
   TensorQueue queue;
   std::unique_ptr<ResponseCache> cache;
   StallInspector stall;
+  // Process-set lifecycle: added sets are INACTIVE (no lockstep traffic)
+  // until the domain-0 coordinator confirms every rank registered them
+  // (deadlock-free dynamic registration; reference operations.cc:587-623).
+  bool active = true;
+  bool retiring = false;
+  bool inactive_warned = false;
+  std::chrono::steady_clock::time_point registered_at;
   bool joined = false;             // this rank has submitted Join
   int join_count = 0;              // coordinator: ranks joined (cumulative)
   std::vector<bool> joined_ranks;
@@ -216,6 +223,10 @@ class Core {
   // every rank
   std::vector<Response> FuseResponses(const std::vector<Response>& singles);
   void Execute(CoordDomain& d, const Response& r);
+  // activate / erase domains on domain-0 consensus (deadlock-free dynamic
+  // process-set registration; see CoordDomain::active)
+  void ApplyDomainLifecycle(const std::vector<int32_t>& activate,
+                            const std::vector<int32_t>& retired);
 
   CoreConfig cfg_;
   std::atomic<bool> initialized_{false};
@@ -229,6 +240,14 @@ class Core {
   std::mutex domains_mu_;
   std::map<int, std::unique_ptr<CoordDomain>> domains_;
   int next_domain_ = 1;
+  // domain-0 coordinator: registration/retire consensus per domain id
+  struct Consensus {
+    uint64_t ranks_hash = 0;
+    std::set<int> ranks;
+    bool mismatch_warned = false;
+  };
+  std::map<int, Consensus> announce_table_;
+  std::map<int, std::set<int>> retire_table_;
   // hierarchical topology groups (valid when hier_enabled_)
   bool hier_enabled_ = false;
   Group local_group_;

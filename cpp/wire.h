@@ -132,13 +132,34 @@ inline Response DecodeResponse(Reader& rd) {
   return r;
 }
 
+// Process-set registration announcement piggybacked on domain-0 negotiate
+// messages: (domain id, hash of the member-rank list). New domains stay
+// INACTIVE until the domain-0 coordinator has seen every rank announce them
+// (reference: dynamic process-set registration is coordinated through the
+// background thread, operations.cc:587-623) — without this, a member that
+// starts the lockstep negotiation of a fresh set before a peer registered
+// it deadlocks the whole cycle.
+struct DomainAnnounce {
+  int32_t id = 0;
+  uint64_t ranks_hash = 0;
+};
+
 inline std::vector<uint8_t> EncodeRequestList(
     const std::vector<Request>& reqs, bool shutdown,
-    const std::vector<int32_t>& cache_bits) {
+    const std::vector<int32_t>& cache_bits,
+    const std::vector<DomainAnnounce>& announce = {},
+    const std::vector<int32_t>& retire = {}) {
   Writer w;
   w.u8(shutdown ? 1 : 0);
   w.i32((int32_t)cache_bits.size());
   for (auto b : cache_bits) w.i32(b);
+  w.i32((int32_t)announce.size());
+  for (auto& a : announce) {
+    w.i32(a.id);
+    w.i64((int64_t)a.ranks_hash);
+  }
+  w.i32((int32_t)retire.size());
+  for (auto r : retire) w.i32(r);
   w.i32((int32_t)reqs.size());
   for (auto& r : reqs) EncodeRequest(w, r);
   return std::move(w.buf);
@@ -146,12 +167,26 @@ inline std::vector<uint8_t> EncodeRequestList(
 
 inline std::vector<Request> DecodeRequestList(
     const uint8_t* p, size_t n, bool* shutdown,
-    std::vector<int32_t>* cache_bits) {
+    std::vector<int32_t>* cache_bits,
+    std::vector<DomainAnnounce>* announce = nullptr,
+    std::vector<int32_t>* retire = nullptr) {
   Reader rd(p, n);
   *shutdown = rd.u8() != 0;
   int32_t nb = rd.i32();
   cache_bits->resize(nb);
   for (auto& b : *cache_bits) b = rd.i32();
+  int32_t na = rd.i32();
+  for (int i = 0; i < na; ++i) {
+    DomainAnnounce a;
+    a.id = rd.i32();
+    a.ranks_hash = (uint64_t)rd.i64();
+    if (announce) announce->push_back(a);
+  }
+  int32_t nr = rd.i32();
+  for (int i = 0; i < nr; ++i) {
+    int32_t r = rd.i32();
+    if (retire) retire->push_back(r);
+  }
   int32_t cnt = rd.i32();
   std::vector<Request> reqs(cnt);
   for (auto& r : reqs) r = DecodeRequest(rd);
@@ -159,18 +194,36 @@ inline std::vector<Request> DecodeRequestList(
 }
 
 inline std::vector<uint8_t> EncodeResponseList(
-    const std::vector<Response>& rs, int64_t fusion_threshold) {
+    const std::vector<Response>& rs, int64_t fusion_threshold,
+    const std::vector<int32_t>& activate = {},
+    const std::vector<int32_t>& retired = {}) {
   Writer w;
   w.i64(fusion_threshold);  // coordinator's (possibly autotuned) value
+  w.i32((int32_t)activate.size());
+  for (auto a : activate) w.i32(a);
+  w.i32((int32_t)retired.size());
+  for (auto r : retired) w.i32(r);
   w.i32((int32_t)rs.size());
   for (auto& r : rs) EncodeResponse(w, r);
   return std::move(w.buf);
 }
 
-inline std::vector<Response> DecodeResponseList(const uint8_t* p, size_t n,
-                                                int64_t* fusion_threshold) {
+inline std::vector<Response> DecodeResponseList(
+    const uint8_t* p, size_t n, int64_t* fusion_threshold,
+    std::vector<int32_t>* activate = nullptr,
+    std::vector<int32_t>* retired = nullptr) {
   Reader rd(p, n);
   *fusion_threshold = rd.i64();
+  int32_t na = rd.i32();
+  for (int i = 0; i < na; ++i) {
+    int32_t v = rd.i32();
+    if (activate) activate->push_back(v);
+  }
+  int32_t nr = rd.i32();
+  for (int i = 0; i < nr; ++i) {
+    int32_t v = rd.i32();
+    if (retired) retired->push_back(v);
+  }
   int32_t cnt = rd.i32();
   std::vector<Response> rs(cnt);
   for (auto& r : rs) r = DecodeResponse(rd);
