@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import os
 import pickle
-import tempfile
 from typing import Any, Callable, Dict, Optional
 
 import numpy as np
@@ -84,8 +83,16 @@ class State:
         raise NotImplementedError
 
 
-def _ckpt_path(name: str) -> str:
-    base = os.environ.get("HVD_ELASTIC_CKPT", tempfile.gettempdir())
+def _ckpt_path(name: str) -> Optional[str]:
+    """Generation-restart persistence path — ONLY when the elastic driver
+    manages this job (it exports a per-job ``HVD_ELASTIC_CKPT``,
+    ``runner/elastic/driver.py``). Without a driver there is no restart
+    mechanism to resume from, and persisting to a shared tempdir would let
+    a later unrelated job silently adopt stale state — so standalone
+    ObjectStates stay host-memory-only, like the reference's."""
+    base = os.environ.get("HVD_ELASTIC_CKPT")
+    if not base:
+        return None
     os.makedirs(base, exist_ok=True)
     return os.path.join(base, f"hvd_state_{name}.pkl")
 
@@ -112,7 +119,7 @@ class ObjectState(State):
 
     def _maybe_load(self) -> bool:
         path = _ckpt_path(self._name)
-        if not os.path.exists(path):
+        if path is None or not os.path.exists(path):
             return False
         try:
             with open(path, "rb") as f:
@@ -128,11 +135,12 @@ class ObjectState(State):
 
     def save(self) -> None:
         self._snapshot()
-        if rank() == 0:
-            tmp = _ckpt_path(self._name) + ".tmp"
+        path = _ckpt_path(self._name)
+        if rank() == 0 and path is not None:
+            tmp = path + ".tmp"
             with open(tmp, "wb") as f:
                 pickle.dump(self._saved, f)
-            os.replace(tmp, _ckpt_path(self._name))
+            os.replace(tmp, path)
 
     def restore(self) -> None:
         for k, v in self._saved.items():

@@ -229,3 +229,7 @@ def DistributedOptimizer(optimizer, op: ReduceOp = Average,
     """Reference factory (``tensorflow/__init__.py:627``)."""
     return _DistributedOptimizer(optimizer, op, compression,
                                  backward_passes_per_step, process_set)
+
+
+from horovod_tpu.tensorflow.sync_batch_norm import (  # noqa: E402,F401
+    SyncBatchNormalization)

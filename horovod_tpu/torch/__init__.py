@@ -137,7 +137,43 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
             torch.from_numpy(np.asarray(recv_splits)))
 
 
+def sparse_allreduce_async(tensor, name: str, op: ReduceOp = None):
+    """Allgather-based allreduce of a sparse COO tensor (reference:
+    ``sparse_allreduce_async``, ``torch/mpi_ops.py:515-535``).
+
+    Gathers every rank's indices and values; duplicate coordinates sum on
+    coalesce, so the rebuilt sparse tensor is the elementwise reduction.
+    Returns a zero-arg callable that completes the op (the reference's
+    deferred-handle contract, consumed by the optimizer's synchronize).
+    """
+    torch = _torch()
+    op = Average if op is None else op
+    t = tensor.coalesce() if not tensor.is_coalesced() else tensor
+    # dim 0 is the gather axis, so indices go [nnz, sparse_dim]
+    idx_h = allgather_async(t._indices().transpose(0, 1).contiguous(),
+                            name=f"{name}.indices")
+    val_h = allgather_async(t._values(), name=f"{name}.values")
+
+    def handle():
+        values = val_h.wait()
+        indices = idx_h.wait()
+        if op == Average:
+            values = values / size()
+        if indices.numel() == 0 or values.numel() == 0:
+            return torch.sparse_coo_tensor(
+                torch.zeros((t.sparse_dim(), 0), dtype=torch.long),
+                torch.zeros((0,) + t.shape[t.sparse_dim():],
+                            dtype=t.dtype), t.size()).coalesce()
+        return torch.sparse_coo_tensor(
+            indices.transpose(0, 1).to(torch.long), values,
+            t.size()).coalesce()
+
+    return handle
+
+
 def synchronize(handle):
+    if callable(handle) and not hasattr(handle, "wait"):
+        return handle()  # sparse_allreduce_async deferred handle
     return handle.wait()
 
 
@@ -201,7 +237,7 @@ class _DistributedOptimizer:
         self._op = op
         self._process_set = process_set
         self.backward_passes_per_step = backward_passes_per_step
-        self._pass_count = 0
+        self._synchronized = False
         if named_parameters is not None:
             self._names = {id(p): n for n, p in named_parameters}
         else:
@@ -215,7 +251,9 @@ class _DistributedOptimizer:
 
     def synchronize(self) -> None:
         """Allreduce all gradients now (reference: ``synchronize``,
-        ``optimizer.py:249-292``)."""
+        ``optimizer.py:249-292``). With ``backward_passes_per_step = k``,
+        the accumulated gradients are additionally scaled by ``1/k`` (the
+        reference's TF aggregation helper divides the same way)."""
         params, names = [], []
         for i, group in enumerate(self._opt.param_groups):
             for j, p in enumerate(group["params"]):
@@ -223,26 +261,56 @@ class _DistributedOptimizer:
                     params.append(p)
                     names.append(self._param_name(p, i, j))
         if size() <= 1 or not params:
+            # keep the 1/k scale at EVERY world size so training dynamics
+            # don't silently change between 1 and N processes
+            if self.backward_passes_per_step > 1:
+                for p in params:
+                    p.grad.div_(self.backward_passes_per_step)
+            self._synchronized = True
             return
         compressed, ctxs = [], []
         for p in params:
             c, ctx = self._compression.compress(_to_np(p.grad))
             compressed.append(np.asarray(c))
             ctxs.append(ctx)
-        outs = _C.grouped_allreduce(compressed, op=self._op,
-                                    name="torchgrad." + names[0],
-                                    process_set=self._process_set)
+        outs = _C.grouped_allreduce(
+            compressed, op=self._op, name="torchgrad." + names[0],
+            prescale_factor=1.0 / self.backward_passes_per_step,
+            process_set=self._process_set)
         for p, o, ctx in zip(params, outs, ctxs):
             o = self._compression.decompress(np.asarray(o), ctx)
             p.grad.copy_(_from_np(np.asarray(o), p.grad))
+        self._synchronized = True
+
+    def skip_synchronize(self):
+        """Context manager marking gradients as already synchronized
+        (reference: ``skip_synchronize``, ``torch/optimizer.py:294-312``).
+        Kept for drop-in parity; this adapter's ``step()`` already skips
+        the sync when ``synchronize()`` ran since the last step."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            self._synchronized = True
+            yield
+        return cm()
 
     def step(self, closure=None):
-        self._pass_count += 1
-        if self._pass_count >= self.backward_passes_per_step:
-            self._pass_count = 0
+        """Synchronize (unless already done since the last step) and apply.
+
+        One ``step()`` call ends a ``backward_passes_per_step``-backward
+        accumulation cycle: the reference counts *backward passes* via
+        autograd hooks and delays the allreduce until k have run; this
+        adapter has no hooks, so the k-th backward is recognized by the
+        user calling ``step()`` — the accumulated grads are synced (scaled
+        by 1/k) and the wrapped optimizer always steps. A manual
+        ``synchronize()`` (e.g. for gradient clipping) is NOT repeated here
+        — where the reference warns and re-syncs unless wrapped in
+        ``skip_synchronize()``, this adapter just skips the second sync."""
+        if not self._synchronized:
             self.synchronize()
-            return self._opt.step(closure)
-        return None
+        self._synchronized = False
+        return self._opt.step(closure)
 
     def zero_grad(self, *args: Any, **kwargs: Any):
         return self._opt.zero_grad(*args, **kwargs)
@@ -256,3 +324,8 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     """Factory (reference: ``DistributedOptimizer``, ``optimizer.py:506``)."""
     return _DistributedOptimizer(optimizer, named_parameters, compression,
                                  backward_passes_per_step, op, process_set)
+
+
+# elastic surface: hvd.elastic.ElasticSampler / TorchState / run
+# (reference: horovod/torch/elastic/{sampler,state}.py)
+from horovod_tpu.torch import elastic  # noqa: E402,F401

@@ -65,6 +65,25 @@ def test_tf_backward_passes_per_step(tfhvd):
     np.testing.assert_allclose(w.numpy(), [-2.0])  # mean(1,3) applied
 
 
+def test_tf_sync_batch_norm(tfhvd):
+    """TF-side SyncBatchNormalization (reference:
+    tensorflow/sync_batch_norm.py): normalizes with batch moments in
+    training, tracks unbiased running variance, uses running stats in eval."""
+    rng = np.random.RandomState(0)
+    x = tf.constant(rng.randn(16, 4).astype(np.float32))
+    layer = tfhvd.SyncBatchNormalization(momentum=0.0)
+    y = layer(x, training=True)
+    np.testing.assert_allclose(np.asarray(y).mean(0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y).std(0), 1.0, atol=1e-2)
+    n = x.shape[0]
+    np.testing.assert_allclose(
+        layer.moving_variance.numpy(),
+        np.asarray(x).var(0) * n / (n - 1), rtol=1e-5)
+    # eval path uses the running stats
+    y2 = layer(x, training=False)
+    assert np.all(np.isfinite(np.asarray(y2)))
+
+
 def test_tf_broadcast_variables(tfhvd):
     v = tf.Variable([7.0, 8.0])
     tfhvd.broadcast_variables([v], root_rank=0)
