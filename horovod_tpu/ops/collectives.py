@@ -26,8 +26,26 @@ import jax.numpy as jnp
 
 from horovod_tpu.common.basics import _require_init
 from horovod_tpu.common.process_sets import ProcessSet, global_process_set
-from horovod_tpu.ops.backend import Backend, HvdHandle
+from horovod_tpu.ops.backend import Backend, HvdHandle, check_scale_dtype
 from horovod_tpu.ops.reduce_op import Adasum, Average, ReduceOp, Sum
+
+
+def _check_scales(values, prescale: float, postscale: float,
+                  op: Optional[ReduceOp] = None) -> None:
+    """Front-door validation so every backend rejects fractional scaling of
+    integral tensors identically (the C++ core would otherwise truncate).
+    AVERAGE is the same fractional 1/size postscale, so it is held to the
+    same rule (the reference's torch path also errors: integer ``div_``)."""
+    if prescale == 1.0 and postscale == 1.0 and op != ReduceOp.AVERAGE:
+        return
+    for v in values:
+        dt = np.dtype(getattr(v, "dtype", None) or np.asarray(v).dtype)
+        if op == ReduceOp.AVERAGE and np.issubdtype(dt, np.integer):
+            raise ValueError(
+                f"allreduce(op=Average) on an integral tensor ({dt}) would "
+                "truncate; use op=Sum and divide, or cast to float first.")
+        check_scale_dtype(dt, prescale)
+        check_scale_dtype(dt, postscale)
 
 _name_counter = [0]
 
@@ -63,6 +81,7 @@ def allreduce_async(value, average: Optional[bool] = None,
                     postscale_factor: float = 1.0,
                     process_set: ProcessSet = global_process_set) -> HvdHandle:
     op = _check_op(op, average)
+    _check_scales([value], prescale_factor, postscale_factor, op)
     be = _backend_for(process_set)
     st = _require_init()
     name = _auto_name("allreduce", name)
@@ -91,6 +110,7 @@ def grouped_allreduce_async(values: Sequence, average: Optional[bool] = None,
     grouping guarantees the tensors fuse into one collective
     (``GroupTable``, ``horovod/common/group_table.h:30-60``)."""
     op = _check_op(op, average)
+    _check_scales(values, prescale_factor, postscale_factor, op)
     be = _backend_for(process_set)
     base = _auto_name("grouped_allreduce", name)
     names = [f"{base}.{i}" for i in range(len(values))]

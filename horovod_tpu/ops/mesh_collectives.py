@@ -57,8 +57,9 @@ def preduce(x: jax.Array, axis_name: str, op: ReduceOp = ReduceOp.SUM
         return lax.pmax(x, axis_name)
     if op == ReduceOp.PRODUCT:
         # No hardware pprod; log-space would lose sign — use all_gather+prod.
+        # Pin the accumulator dtype: jnp.prod would promote int32 -> int64.
         g = lax.all_gather(x, axis_name)
-        return jnp.prod(g, axis=0)
+        return jnp.prod(g, axis=0, dtype=x.dtype)
     raise ValueError(f"Unsupported reduce op: {op}")
 
 
@@ -80,7 +81,8 @@ def pbroadcast(x: jax.Array, axis_name: str, root: int = 0) -> jax.Array:
     (reference: ``EnqueueTensorBroadcast``, ``operations.cc:1560-1626``)."""
     idx = lax.axis_index(axis_name)
     masked = jnp.where(idx == root, x, jnp.zeros_like(x))
-    return lax.psum(masked, axis_name)
+    # psum promotes bool -> int; cast back to the input dtype
+    return lax.psum(masked, axis_name).astype(x.dtype)
 
 
 def palltoall(x: jax.Array, axis_name: str, split_axis: int = 0,

@@ -152,7 +152,8 @@ class _XlaGroup:
                 idx = jax.lax.axis_index("proc")
                 masked = jnp.where(idx == root, x[0],
                                    jnp.zeros_like(x[0]))
-                return jax.lax.psum(masked, "proc")
+                # psum promotes bool -> int; cast back to the input dtype
+                return jax.lax.psum(masked, "proc").astype(x.dtype)
         elif kind == "alltoall":
             @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("proc"),
                                out_specs=P("proc"), check_vma=False)

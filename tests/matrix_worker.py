@@ -1,0 +1,218 @@
+"""Collective numerics matrix worker — the depth analog of the reference's
+test/parallel suite (test_torch.py / test_tensorflow.py): every supported
+dtype x shape class (scalar / empty / odd / fusion-threshold-crossing) x
+op x process set, asserting EXACT numerics and dtype preservation.
+
+Backend-agnostic: run under the TCP core (default) or the XLA data plane
+(HOROVOD_TPU_OPERATIONS=XLA_EAGER). Launched by test_core_multiprocess.py.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)  # int64/f64 must round-trip
+
+import numpy as np  # noqa: E402
+import ml_dtypes  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+BF16 = np.dtype(ml_dtypes.bfloat16)
+
+INT_DTYPES = [np.dtype(np.uint8), np.dtype(np.int8), np.dtype(np.int32),
+              np.dtype(np.int64)]
+FLOAT_DTYPES = [np.dtype(np.float16), BF16, np.dtype(np.float32),
+                np.dtype(np.float64)]
+ALL_NUMERIC = INT_DTYPES + FLOAT_DTYPES
+
+# shape classes: scalar, empty, single-element, odd, >512B (fusion-crossing
+# for f32 when HVD_TPU_FUSION_THRESHOLD=512)
+SHAPES = [(), (0,), (1,), (7, 3), (256,)]
+
+
+def gen(dtype, shape, rank, base=1, mod=5):
+    """Small exact values: <= mod+size, exactly representable everywhere."""
+    n = int(np.prod(shape, dtype=np.int64))
+    v = (np.arange(n, dtype=np.int64) % mod) + rank + base
+    return v.reshape(shape).astype(dtype)
+
+
+def stack_all(dtype, shape, size, **kw):
+    return np.stack([gen(dtype, shape, r, **kw).astype(np.float64)
+                     for r in range(size)])
+
+
+def check(out, expect, dtype, msg):
+    out = np.asarray(out)
+    assert out.dtype == dtype, f"{msg}: dtype {out.dtype} != {dtype}"
+    np.testing.assert_array_equal(
+        out.astype(np.float64), expect.astype(np.float64), err_msg=msg)
+
+
+def main():
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    hvd.init()
+    assert hvd.rank() == rank and hvd.size() == size
+
+    # 1) SUM allreduce: every numeric dtype x every shape class
+    for dt in ALL_NUMERIC:
+        for shape in SHAPES:
+            x = gen(dt, shape, rank)
+            out = hvd.allreduce(x, op=hvd.Sum, name=f"s.{dt}.{shape}")
+            expect = stack_all(dt, shape, size).sum(0).astype(dt)
+            check(out, expect, dt, f"sum {dt} {shape}")
+
+    # 2) AVERAGE: float dtypes; sum of (rank+1) -> mean (size+1)/2, exact
+    #    in every binary float format for size <= 4
+    for dt in FLOAT_DTYPES:
+        x = np.full((6,), float(rank + 1), dt)
+        out = hvd.allreduce(x, op=hvd.Average, name=f"a.{dt}")
+        check(out, np.full((6,), (size + 1) / 2.0), dt, f"avg {dt}")
+
+    # 3) MIN / MAX: all numeric dtypes, odd shape
+    for dt in ALL_NUMERIC:
+        x = gen(dt, (7, 3), rank)
+        st = stack_all(dt, (7, 3), size)
+        mn = hvd.allreduce(x, op=hvd.Min, name=f"mn.{dt}")
+        mx = hvd.allreduce(x, op=hvd.Max, name=f"mx.{dt}")
+        check(mn, st.min(0).astype(dt), dt, f"min {dt}")
+        check(mx, st.max(0).astype(dt), dt, f"max {dt}")
+
+    # 4) PRODUCT: values in {1, 2} keep everything exact
+    for dt in (np.dtype(np.int32), np.dtype(np.float32),
+               np.dtype(np.float64)):
+        x = gen(dt, (9,), rank, base=1, mod=2).astype(np.float64)
+        x = np.where(x > 1.5, 2.0, 1.0).astype(dt)
+        st = np.stack([np.where(
+            gen(dt, (9,), r, base=1, mod=2).astype(np.float64) > 1.5,
+            2.0, 1.0) for r in range(size)])
+        out = hvd.allreduce(x, op=hvd.Product, name=f"p.{dt}")
+        check(out, st.prod(0).astype(dt), dt, f"prod {dt}")
+
+    # 5) bool: MIN == logical AND, MAX == logical OR
+    xb = ((np.arange(8) + rank) % 2).astype(np.bool_)
+    stb = np.stack([((np.arange(8) + r) % 2).astype(np.bool_)
+                    for r in range(size)])
+    check(hvd.allreduce(xb, op=hvd.Min, name="b.min"),
+          stb.min(0), np.dtype(np.bool_), "bool min")
+    check(hvd.allreduce(xb, op=hvd.Max, name="b.max"),
+          stb.max(0), np.dtype(np.bool_), "bool max")
+
+    # 6) pre/postscale: integral factors on ints OK, fractional must raise
+    xf = gen(np.float32, (5,), rank)
+    out = hvd.allreduce(xf, op=hvd.Sum, name="sc.f",
+                        prescale_factor=2.0, postscale_factor=0.5)
+    check(out, stack_all(np.float32, (5,), size).sum(0), np.dtype(np.float32),
+          "scaled f32")
+    xi = gen(np.int32, (5,), rank)
+    out = hvd.allreduce(xi, op=hvd.Sum, name="sc.i", prescale_factor=2.0)
+    check(out, stack_all(np.int32, (5,), size).sum(0) * 2,
+          np.dtype(np.int32), "prescaled i32")
+    for call in (lambda: hvd.allreduce(xi, op=hvd.Sum, name="sc.bad",
+                                       prescale_factor=0.5),
+                 lambda: hvd.grouped_allreduce([xi], op=hvd.Sum,
+                                               name="sc.badg",
+                                               prescale_factor=0.5)):
+        try:
+            call()
+            raise AssertionError("fractional int scale must raise")
+        except ValueError:
+            pass
+
+    # 7) grouped mixed dtypes incl. scalar and empty members
+    vals = [gen(np.float32, (7,), rank), gen(np.int32, (3, 2), rank),
+            gen(BF16, (5,), rank), gen(np.float32, (), rank),
+            gen(np.float32, (0,), rank)]
+    outs = hvd.grouped_allreduce(vals, op=hvd.Sum, name="grp")
+    for v, o, dt, shape in zip(
+            vals, outs,
+            [np.dtype(np.float32), np.dtype(np.int32), BF16,
+             np.dtype(np.float32), np.dtype(np.float32)],
+            [(7,), (3, 2), (5,), (), (0,)]):
+        check(o, stack_all(dt, shape, size).sum(0).astype(dt), dt,
+              f"grouped {dt} {shape}")
+
+    # 8) many-tensor group crossing the fusion threshold several times
+    many = [gen(np.float32, (64,), rank, base=i) for i in range(8)]
+    outs = hvd.grouped_allreduce(many, op=hvd.Sum, name="grp.many")
+    for i, o in enumerate(outs):
+        expect = np.stack([gen(np.float32, (64,), r, base=i).astype(
+            np.float64) for r in range(size)]).sum(0)
+        check(o, expect, np.dtype(np.float32), f"grp.many[{i}]")
+
+    # 9) ragged allgather: rank r contributes r rows (rank 0: zero rows)
+    for dt in (np.dtype(np.float32), np.dtype(np.int64)):
+        mine = np.full((rank, 2), rank + 1, dt)
+        out = hvd.allgather(mine, name=f"ag.{dt}")
+        expect = np.concatenate([np.full((r, 2), r + 1, np.float64)
+                                 for r in range(size)], axis=0)
+        check(out, expect, dt, f"allgather {dt}")
+    # bool allgather with equal rows
+    out = hvd.allgather(((np.arange(4) + rank) % 2).astype(np.bool_),
+                        name="ag.bool")
+    expect = np.concatenate([((np.arange(4) + r) % 2).astype(np.bool_)
+                             for r in range(size)])
+    check(out, expect, np.dtype(np.bool_), "allgather bool")
+
+    # 10) broadcast: first/last roots, several dtypes, incl. scalar
+    for root in (0, size - 1):
+        for dt, shape in ((np.dtype(np.float16), (5,)),
+                          (np.dtype(np.int64), ()),
+                          (np.dtype(np.bool_), (4,))):
+            x = gen(dt, shape, rank) if dt != np.bool_ else \
+                ((np.arange(4) + rank) % 2).astype(np.bool_)
+            out = hvd.broadcast(x, root_rank=root,
+                                name=f"bc.{root}.{dt}.{len(shape)}")
+            expect = (gen(dt, shape, root) if dt != np.bool_ else
+                      ((np.arange(4) + root) % 2).astype(np.bool_))
+            check(out, expect.astype(np.float64), dt, f"bcast {root} {dt}")
+
+    # 11) alltoall with zero splits: rank r sends i rows (value r*100+i)
+    #     to rank i; rank r receives r rows from every peer
+    splits = list(range(size))
+    send = np.concatenate(
+        [np.full((i, 2), rank * 100 + i, np.float32) for i in range(size)]
+    ) if sum(splits) else np.zeros((0, 2), np.float32)
+    out, recv = hvd.alltoall(send, splits=splits, name="a2a.zero")
+    expect = np.concatenate(
+        [np.full((rank, 2), r * 100 + rank, np.float32)
+         for r in range(size)]) if rank else np.zeros((0, 2), np.float32)
+    check(out, expect, np.dtype(np.float32), "alltoall zero-splits")
+    assert list(np.asarray(recv)) == [rank] * size
+
+    # 12) reducescatter over dim 0
+    for dt in (np.dtype(np.float32), np.dtype(np.int32)):
+        x = gen(dt, (size * 2, 3), rank)
+        out = hvd.reducescatter(x, op=hvd.Sum, name=f"rs.{dt}")
+        full = stack_all(dt, (size * 2, 3), size).sum(0)
+        check(out, full[rank * 2:(rank + 1) * 2], dt, f"rs {dt}")
+
+    # 13) the same core ops inside a process set
+    if size >= 2:
+        ps = hvd.add_process_set([0, 1])
+        if rank < 2:
+            x = gen(np.float32, (6,), rank)
+            out = hvd.allreduce(x, op=hvd.Sum, name="ps.sum", process_set=ps)
+            expect = stack_all(np.float32, (6,), 2).sum(0)
+            check(out, expect, np.dtype(np.float32), "ps sum")
+            g = hvd.allgather(np.full((rank + 1, 2), rank, np.int32),
+                              name="ps.ag", process_set=ps)
+            expect = np.concatenate([np.full((r + 1, 2), r, np.int64)
+                                     for r in range(2)])
+            check(g, expect, np.dtype(np.int32), "ps allgather")
+
+    hvd.barrier()
+    hvd.shutdown()
+    print(f"matrix worker {rank}: OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,9 +1,47 @@
 """Eager collective semantics, single-process (reference analog:
-test/parallel/test_torch.py collective tests degeneratet to one rank)."""
+test/parallel/test_torch.py collective tests degenerated to one rank).
+The multi-rank depth matrix lives in matrix_worker.py, launched by
+test_core_multiprocess.py over both backends."""
 
 import numpy as np
 import pytest
 import jax.numpy as jnp
+
+import ml_dtypes
+
+DTYPES = [np.uint8, np.int8, np.int32, np.int64, np.float16,
+          ml_dtypes.bfloat16, np.float32, np.float64, np.bool_]
+SHAPES = [(), (0,), (1,), (7, 3), (256,)]
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("shape", SHAPES)
+def test_allreduce_dtype_shape_identity(hvd, dtype, shape):
+    """Size-1 allreduce is identity for every dtype x shape class, and the
+    result dtype must match the input dtype exactly."""
+    n = int(np.prod(shape, dtype=np.int64))
+    x = (np.arange(n, dtype=np.int64) % 2).reshape(shape).astype(dtype)
+    for op in (hvd.Sum, hvd.Min, hvd.Max):
+        out = np.asarray(hvd.allreduce(x, op=op))
+        assert out.dtype == np.dtype(dtype), (op, out.dtype)
+        np.testing.assert_array_equal(out, x)
+
+
+@pytest.mark.parametrize("dtype", [np.float16, ml_dtypes.bfloat16,
+                                   np.float32, np.float64])
+def test_allreduce_average_identity_floats(hvd, dtype):
+    x = np.arange(6, dtype=np.float64).astype(dtype)
+    out = np.asarray(hvd.allreduce(x, op=hvd.Average))
+    assert out.dtype == np.dtype(dtype)
+    np.testing.assert_array_equal(out, x)
+
+
+def test_fractional_int_scale_rejected(hvd):
+    x = np.arange(4, dtype=np.int32)
+    with pytest.raises(ValueError):
+        hvd.allreduce(x, op=hvd.Sum, prescale_factor=0.5)
+    with pytest.raises(ValueError):
+        hvd.grouped_allreduce([x], op=hvd.Sum, prescale_factor=0.5)
 
 
 def test_allreduce_identity(hvd):

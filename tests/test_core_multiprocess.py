@@ -20,6 +20,7 @@ EQUIV_WORKER = os.path.join(os.path.dirname(__file__), "equiv_worker.py")
 PSETS_WORKER = os.path.join(os.path.dirname(__file__), "psets_worker.py")
 JIT_SYNC_WORKER = os.path.join(os.path.dirname(__file__),
                                "jit_sync_worker.py")
+MATRIX_WORKER = os.path.join(os.path.dirname(__file__), "matrix_worker.py")
 
 
 def _free_port():
@@ -203,6 +204,22 @@ def test_process_sets_on_xla_backend():
     programs (VERDICT r1 #3; reference analog: per-set NCCL comms,
     nccl_operations.cc:65-107)."""
     _launch(4, worker=PSETS_WORKER, timeout=600,
+            extra_env={"HOROVOD_TPU_OPERATIONS": "XLA_EAGER"})
+
+
+@needs_core
+@pytest.mark.parametrize("size", [2, 4])
+def test_numerics_matrix_core(size):
+    """Full dtype x shape x op x process-set sweep on the TCP core with a
+    small fusion threshold so large entries cross it (the depth the
+    reference invests in test/parallel/test_torch.py)."""
+    _launch(size, timeout=480, worker=MATRIX_WORKER,
+            extra_env={"HVD_TPU_FUSION_THRESHOLD": "512"})
+
+
+def test_numerics_matrix_xla():
+    """The same sweep over the XLA eager data plane."""
+    _launch(2, timeout=900, worker=MATRIX_WORKER,
             extra_env={"HOROVOD_TPU_OPERATIONS": "XLA_EAGER"})
 
 
