@@ -83,8 +83,9 @@ void GaussianProcess::Predict(const std::vector<double>& xs, double* mu,
   *var = std::max(Kernel(xs, xs) - vv, 1e-12);
 }
 
-BayesianOptimizer::BayesianOptimizer(int dims, uint64_t seed)
-    : dims_(dims), rng_(seed) {}
+BayesianOptimizer::BayesianOptimizer(int dims, uint64_t seed,
+                                     double gp_noise)
+    : dims_(dims), rng_(seed), gp_noise_(gp_noise) {}
 
 void BayesianOptimizer::AddSample(const std::vector<double>& x, double y) {
   x_.push_back(x);
@@ -107,7 +108,7 @@ std::vector<double> BayesianOptimizer::NextSample() {
     return x;
   }
   GaussianProcess gp;
-  gp.Fit(x_, y_);
+  gp.Fit(x_, y_, gp_noise_);
   double best_y = *std::max_element(y_.begin(), y_.end());
   // expected improvement (reference: bayesian_optimization.cc EI), argmax
   // over random candidates

@@ -36,7 +36,8 @@ class GaussianProcess {
 
 class BayesianOptimizer {
  public:
-  explicit BayesianOptimizer(int dims, uint64_t seed = 17);
+  explicit BayesianOptimizer(int dims, uint64_t seed = 17,
+                             double gp_noise = 1e-6);
   void AddSample(const std::vector<double>& x, double y);
   // next point to evaluate: argmax expected improvement over random
   // candidates (plus pure exploration until enough samples exist)
@@ -47,6 +48,7 @@ class BayesianOptimizer {
  private:
   int dims_;
   std::mt19937_64 rng_;
+  double gp_noise_;
   std::vector<std::vector<double>> x_;
   std::vector<double> y_;
 };

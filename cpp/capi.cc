@@ -2,6 +2,7 @@
 // horovod/common/operations.cc:869-1260 C API + basics.py ctypes wrapper).
 #include <cstdlib>
 #include <cstring>
+#include <sstream>
 #include <string>
 #include <vector>
 
@@ -31,9 +32,7 @@ const char* EnvOr(const char* a, const char* b, const char* dflt) {
 
 }  // namespace
 
-extern "C" {
-
-int hvd_init() {
+CoreConfig ParseEnvConfig() {
   CoreConfig cfg;
   cfg.rank = atoi(EnvOr("HVD_TPU_RANK", "HOROVOD_RANK", "0"));
   cfg.size = atoi(EnvOr("HVD_TPU_SIZE", "HOROVOD_SIZE", "1"));
@@ -67,9 +66,66 @@ int hvd_init() {
   cfg.cross_size = atoi(EnvOr("HVD_TPU_CROSS_SIZE", "HOROVOD_CROSS_SIZE",
                               "1"));
   cfg.timeline_path = EnvOr("HVD_TPU_TIMELINE", "HOROVOD_TIMELINE", "");
-  auto st = Core::Get().Init(cfg);
+  cfg.timeline_mark_cycles = atoi(EnvOr("HVD_TPU_TIMELINE_MARK_CYCLES",
+                                        "HOROVOD_TIMELINE_MARK_CYCLES",
+                                        "0"));
+  cfg.stall_shutdown_secs =
+      atof(EnvOr("HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS",
+                 "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "0"));
+  if (atoi(EnvOr("HVD_TPU_STALL_CHECK_DISABLE",
+                 "HOROVOD_STALL_CHECK_DISABLE", "0")))
+    cfg.stall_warning_secs = 1e18;  // effectively disabled
+  cfg.autotune_warmup_samples =
+      atoi(EnvOr("HVD_TPU_AUTOTUNE_WARMUP_SAMPLES",
+                 "HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "3"));
+  cfg.autotune_max_samples =
+      atoi(EnvOr("HVD_TPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES",
+                 "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", "24"));
+  cfg.autotune_gp_noise =
+      atof(EnvOr("HVD_TPU_AUTOTUNE_GAUSSIAN_PROCESS_NOISE",
+                 "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", "1e-6"));
+  cfg.rendezvous_timeout_secs =
+      atof(EnvOr("HVD_TPU_GLOO_TIMEOUT_SECONDS",
+                 "HOROVOD_GLOO_TIMEOUT_SECONDS", "30"));
+  cfg.thread_affinity = atoi(EnvOr("HVD_TPU_THREAD_AFFINITY",
+                                   "HOROVOD_THREAD_AFFINITY", "-1"));
+  return cfg;
+}
+
+extern "C" {
+
+int hvd_init() {
+  auto st = Core::Get().Init(ParseEnvConfig());
   if (!st.ok()) return SetError(st);
   return 0;
+}
+
+// Parsed-config dump for knob round-trip tests (key=value lines),
+// serialized from the SAME parser hvd_init uses so the test exercises the
+// engine's real env handling.
+static std::string g_cfg_dump;
+const char* hvd_cfg_dump() {
+  CoreConfig c = ParseEnvConfig();
+  std::ostringstream os;
+  os << "fusion_threshold=" << c.fusion_threshold
+     << "\ncycle_time_ms=" << c.cycle_time_ms
+     << "\ncache_capacity=" << c.cache_capacity
+     << "\nstall_warning_secs=" << c.stall_warning_secs
+     << "\nstall_shutdown_secs=" << c.stall_shutdown_secs
+     << "\nautotune=" << (c.autotune ? 1 : 0)
+     << "\nautotune_warmup_samples=" << c.autotune_warmup_samples
+     << "\nautotune_max_samples=" << c.autotune_max_samples
+     << "\nautotune_gp_noise=" << c.autotune_gp_noise
+     << "\nrendezvous_timeout_secs=" << c.rendezvous_timeout_secs
+     << "\nthread_affinity=" << c.thread_affinity
+     << "\ntimeline=" << c.timeline_path
+     << "\ntimeline_mark_cycles=" << (c.timeline_mark_cycles ? 1 : 0)
+     << "\nhierarchical_allreduce="
+     << (c.hierarchical_allreduce ? 1 : 0)
+     << "\ndisable_group_fusion=" << (c.disable_group_fusion ? 1 : 0)
+     << "\n";
+  g_cfg_dump = os.str();
+  return g_cfg_dump.c_str();
 }
 
 void hvd_shutdown() { Core::Get().Shutdown(); }

@@ -1,5 +1,8 @@
 #include "core.h"
 
+#include <pthread.h>
+#include <unistd.h>
+
 #include <algorithm>
 #include <cstring>
 #include <sstream>
@@ -132,6 +135,19 @@ std::string StallInspector::Check(double warn_seconds) {
   return os.str();
 }
 
+std::vector<std::string> StallInspector::FatallyStalled(
+    double shutdown_seconds) {
+  std::vector<std::string> out;
+  if (shutdown_seconds <= 0) return out;
+  auto now = std::chrono::steady_clock::now();
+  for (auto& kv : pending_) {
+    double waited =
+        std::chrono::duration<double>(now - kv.second.first_seen).count();
+    if (waited > shutdown_seconds) out.push_back(kv.first);
+  }
+  return out;
+}
+
 // ---------------------------------------------------------------------------
 // ParameterManager — GP/expected-improvement Bayesian optimization over
 // (log fusion threshold, log cycle time), scored by bytes/sec
@@ -164,9 +180,14 @@ double NormCycle(double c) {
 }
 }  // namespace
 
-void ParameterManager::Enable(int64_t init_fusion, double init_cycle) {
+void ParameterManager::Enable(int64_t init_fusion, double init_cycle,
+                              int warmup_samples, int max_samples,
+                              double gp_noise) {
   enabled_ = true;
-  bo_ = std::make_shared<BayesianOptimizer>(2);
+  warmup_samples_ = warmup_samples;
+  max_samples_ = max_samples;
+  gp_noise_ = gp_noise;
+  bo_ = std::make_shared<BayesianOptimizer>(2, 17, gp_noise_);
   window_start_ = std::chrono::steady_clock::now();
 }
 
@@ -176,14 +197,17 @@ bool ParameterManager::Tune(int64_t* fusion_bytes, double* cycle_ms) {
   if (!enabled_) return false;
   auto now = std::chrono::steady_clock::now();
   double secs = std::chrono::duration<double>(now - window_start_).count();
-  if (secs < 2.0) return false;  // sample window
+  if (secs < 2.0) return false;  // scoring window (seconds)
   double score = bytes_acc_ / secs;
-  bo_->AddSample({NormFusion(*fusion_bytes), NormCycle(*cycle_ms)}, score);
   bytes_acc_ = 0;
   window_start_ = now;
   samples_++;
+  // discard warmup samples (reference: AUTOTUNE_WARMUP_SAMPLES) so
+  // startup transients don't poison the GP
+  if (samples_ <= warmup_samples_) return false;
+  bo_->AddSample({NormFusion(*fusion_bytes), NormCycle(*cycle_ms)}, score);
   std::vector<double> x;
-  if (samples_ > 24) {  // converge to the best observed point
+  if (samples_ > warmup_samples_ + max_samples_) {  // converge to best
     x = bo_->BestSample();
     enabled_ = false;
   } else {
@@ -252,12 +276,15 @@ Status Core::Init(const CoreConfig& cfg) {
   if (initialized_) return Status::OK();
   cfg_ = cfg;
   transport_.reset(
-      new Transport(cfg.rank, cfg.size, cfg.coord_addr, cfg.coord_port));
+      new Transport(cfg.rank, cfg.size, cfg.coord_addr, cfg.coord_port,
+                    cfg.rendezvous_timeout_secs));
   auto st = transport_->Init();
   if (!st.ok()) return st;
   timeline_.reset(new Timeline(cfg.rank, cfg.timeline_path));
   if (cfg.autotune)
-    param_mgr_.Enable(cfg.fusion_threshold, cfg.cycle_time_ms);
+    param_mgr_.Enable(cfg.fusion_threshold, cfg.cycle_time_ms,
+                      cfg.autotune_warmup_samples,
+                      cfg.autotune_max_samples, cfg.autotune_gp_noise);
 
   auto global = std::unique_ptr<CoordDomain>(new CoordDomain());
   global->id = 0;
@@ -593,6 +620,14 @@ int Core::last_join_rank(int domain) {
 // -- background loop (reference: BackgroundThreadLoop / RunLoopOnce) --------
 
 void Core::Loop() {
+  if (cfg_.thread_affinity >= 0) {
+    // pin the background loop (reference: HOROVOD_THREAD_AFFINITY)
+    cpu_set_t cpus;
+    CPU_ZERO(&cpus);
+    long ncpu = sysconf(_SC_NPROCESSORS_ONLN);
+    CPU_SET(cfg_.thread_affinity % std::max(1L, ncpu), &cpus);
+    pthread_setaffinity_np(pthread_self(), sizeof(cpus), &cpus);
+  }
   while (RunOnce()) {
     std::this_thread::sleep_for(
         std::chrono::duration<double, std::milli>(cfg_.cycle_time_ms));
@@ -686,7 +721,15 @@ std::vector<Response> Core::CollectReady(CoordDomain& d) {
   for (int b : ready_bits) {
     Response resp = d.cache->Get(b);
     resp.from_cache = true;
+    d.stall.RemoveReady(resp.names.empty() ? "" : resp.names[0]);
     out.push_back(std::move(resp));
+  }
+  // partial cache bits are stalls too: without this, a cached tensor one
+  // rank stops submitting would evade the stall inspector entirely
+  for (auto& kv : d.bit_ready_) {
+    const Response& r = d.cache->Get(kv.first);
+    if (!r.names.empty())
+      d.stall.RecordPending(r.names[0], kv.second, d.group.size());
   }
 
   // 2) negotiated tensors
@@ -872,6 +915,8 @@ void Core::ApplyDomainLifecycle(const std::vector<int32_t>& activate,
 
 bool Core::RunOnce() {
   bool want_shutdown = shutdown_requested_.load();
+  if (timeline_ && timeline_->enabled() && cfg_.timeline_mark_cycles)
+    timeline_->Instant("CYCLE_START");  // HOROVOD_TIMELINE_MARK_CYCLES
 
   std::vector<int> domain_ids;
   std::vector<wire::DomainAnnounce> my_announce;
@@ -1014,6 +1059,32 @@ bool Core::RunOnce() {
         }
       }
       singles = CollectReady(*d);
+      // fatally stalled tensors (some ranks never submitted) error out to
+      // their waiters instead of hanging forever (reference:
+      // HOROVOD_STALL_SHUTDOWN_TIME_SECONDS; surfaced here as a per-tensor
+      // HorovodInternalError so elastic recovery can engage)
+      for (auto& name : d->stall.FatallyStalled(cfg_.stall_shutdown_secs)) {
+        d->ready_table_.erase(name);
+        // the stalled submission may be a partial CACHE BIT
+        for (auto it2 = d->bit_ready_.begin();
+             it2 != d->bit_ready_.end();) {
+          const Response& cr = d->cache->Get(it2->first);
+          if (!cr.names.empty() && cr.names[0] == name)
+            it2 = d->bit_ready_.erase(it2);
+          else
+            ++it2;
+        }
+        d->stall.RemoveReady(name);
+        Response e;
+        e.type = Response::kError;
+        e.names = {name};
+        e.error_message =
+            "tensor '" + name + "' stalled beyond "
+            "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS (" +
+            std::to_string((int)cfg_.stall_shutdown_secs) +
+            "s): one or more ranks never submitted it";
+        singles.push_back(std::move(e));
+      }
       if (id == 0 && shutdown_votes == d->group.size()) {
         Response sd;
         sd.type = Response::kShutdown;

@@ -72,6 +72,9 @@ class StallInspector {
   void RemoveReady(const std::string& name);
   // returns warning string if stalled tensors exist past the threshold
   std::string Check(double warn_seconds);
+  // names stalled past the (stricter) shutdown threshold; caller errors
+  // them out (reference: STALL_SHUTDOWN_TIME aborts, stall_inspector.h)
+  std::vector<std::string> FatallyStalled(double shutdown_seconds);
 
  private:
   struct Info {
@@ -91,7 +94,9 @@ class BayesianOptimizer;
 
 class ParameterManager {
  public:
-  void Enable(int64_t init_fusion, double init_cycle);
+  void Enable(int64_t init_fusion, double init_cycle,
+              int warmup_samples = 3, int max_samples = 24,
+              double gp_noise = 1e-6);
   bool enabled() const { return enabled_; }
   void Record(int64_t bytes);
   // maybe update params; returns true if changed
@@ -102,6 +107,9 @@ class ParameterManager {
   int64_t bytes_acc_ = 0;
   std::chrono::steady_clock::time_point window_start_;
   int samples_ = 0;
+  int warmup_samples_ = 3;
+  int max_samples_ = 24;
+  double gp_noise_ = 1e-6;
   std::shared_ptr<BayesianOptimizer> bo_;
 };
 
@@ -121,7 +129,19 @@ struct CoreConfig {
   size_t cache_capacity = 1024;
   bool cache_enabled = true;
   double stall_warning_secs = 60.0;
+  // > 0: fatally stalled tensors are errored out to their waiters
+  // (reference: HOROVOD_STALL_SHUTDOWN_TIME_SECONDS shuts the job down;
+  // here the error surfaces as HorovodInternalError so elastic can react)
+  double stall_shutdown_secs = 0.0;
   bool autotune = false;
+  // reference semantics: discard this many scoring samples before the
+  // optimizer starts learning (AUTOTUNE_WARMUP_SAMPLES)
+  int autotune_warmup_samples = 3;
+  int autotune_max_samples = 24;       // BAYES_OPT_MAX_SAMPLES analog
+  double autotune_gp_noise = 1e-6;     // GAUSSIAN_PROCESS_NOISE analog
+  double rendezvous_timeout_secs = 30.0;  // GLOO_TIMEOUT_SECONDS analog
+  int thread_affinity = -1;            // pin background loop to this CPU
+  bool timeline_mark_cycles = false;
   std::string timeline_path;
 };
 

@@ -75,9 +75,10 @@ void SetNoDelay(int fd) {
 }  // namespace
 
 Transport::Transport(int rank, int size, const std::string& coord_addr,
-                     int coord_port)
+                     int coord_port, double connect_timeout_secs)
     : rank_(rank), size_(size), coord_addr_(coord_addr),
-      coord_port_(coord_port) {
+      coord_port_(coord_port),
+      connect_timeout_secs_(connect_timeout_secs) {
   peer_fds_.assign(size, -1);
   inbox_.resize(size);
   dead_.assign(size, false);
@@ -93,10 +94,14 @@ Status Transport::ConnectTo(const std::string& host, int port, int* fd_out) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(port);
-  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    close(fd);
     return Status::Error("bad address: " + host);
-  // retry loop: peers may not be listening yet
-  for (int attempt = 0; attempt < 600; ++attempt) {
+  }
+  // retry loop: peers may not be listening yet. Deadline = the
+  // HOROVOD_GLOO_TIMEOUT_SECONDS-equivalent knob.
+  int attempts = std::max(1, (int)(connect_timeout_secs_ * 10));
+  for (int attempt = 0; attempt < attempts; ++attempt) {
     if (connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0) {
       SetNoDelay(fd);
       *fd_out = fd;
@@ -106,8 +111,10 @@ Status Transport::ConnectTo(const std::string& host, int port, int* fd_out) {
     usleep(100 * 1000);
     fd = ::socket(AF_INET, SOCK_STREAM, 0);
   }
+  close(fd);
   return Status::Error("could not connect to " + host + ":" +
-                       std::to_string(port));
+                       std::to_string(port) + " within " +
+                       std::to_string((int)connect_timeout_secs_) + "s");
 }
 
 Status Transport::Init() {

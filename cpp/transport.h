@@ -28,8 +28,10 @@ struct Frame {
 class Transport {
  public:
   // rank/size/coordinator address resolved from env by the caller.
+  // connect_timeout_secs: how long rendezvous/mesh connects retry before
+  // giving up (reference knob: HOROVOD_GLOO_TIMEOUT_SECONDS, default 30).
   Transport(int rank, int size, const std::string& coord_addr,
-            int coord_port);
+            int coord_port, double connect_timeout_secs = 30.0);
   ~Transport();
 
   Status Init();            // rendezvous + full mesh
@@ -50,6 +52,7 @@ class Transport {
   int rank_, size_;
   std::string coord_addr_;
   int coord_port_;
+  double connect_timeout_secs_;
   int listen_fd_ = -1;
   std::vector<int> peer_fds_;                 // index = peer rank
   std::vector<std::unique_ptr<std::mutex>> send_mu_;

@@ -71,6 +71,8 @@ class Config:
     autotune_log: str = ""
     autotune_warmup_samples: int = 3
     autotune_steps_per_sample: int = 10
+    autotune_bayes_opt_max_samples: int = 24
+    autotune_gaussian_process_noise: float = 1e-6
     # Timeline (reference: timeline.h:48-183)
     timeline: str = ""
     timeline_mark_cycles: bool = False
@@ -89,8 +91,13 @@ class Config:
     disable_group_fusion: bool = False
     # Compression
     compression_fp16_on_tpu: bool = True
+    # Transport (reference: HOROVOD_GLOO_TIMEOUT_SECONDS)
+    gloo_timeout_seconds: float = 30.0
+    # Background-thread CPU pinning (reference: HOROVOD_THREAD_AFFINITY)
+    thread_affinity: int = -1
     # Misc
     log_level: str = "WARNING"
+    log_hide_timestamp: bool = False
     rendezvous_addr: str = ""
     rendezvous_port: int = 0
 
@@ -110,6 +117,12 @@ class Config:
                 "AUTOTUNE_WARMUP_SAMPLES", d.autotune_warmup_samples),
             autotune_steps_per_sample=env_int(
                 "AUTOTUNE_STEPS_PER_SAMPLE", d.autotune_steps_per_sample),
+            autotune_bayes_opt_max_samples=env_int(
+                "AUTOTUNE_BAYES_OPT_MAX_SAMPLES",
+                d.autotune_bayes_opt_max_samples),
+            autotune_gaussian_process_noise=env_float(
+                "AUTOTUNE_GAUSSIAN_PROCESS_NOISE",
+                d.autotune_gaussian_process_noise),
             timeline=env_str("TIMELINE"),
             timeline_mark_cycles=env_bool("TIMELINE_MARK_CYCLES"),
             stall_check_disable=env_bool("STALL_CHECK_DISABLE"),
@@ -124,7 +137,12 @@ class Config:
             disable_group_fusion=env_bool("DISABLE_GROUP_FUSION"),
             compression_fp16_on_tpu=env_bool(
                 "COMPRESSION_FP16_ON_TPU", d.compression_fp16_on_tpu),
+            gloo_timeout_seconds=env_float("GLOO_TIMEOUT_SECONDS",
+                                           d.gloo_timeout_seconds),
+            thread_affinity=env_int("THREAD_AFFINITY", d.thread_affinity),
             log_level=env_str("LOG_LEVEL", d.log_level).upper(),
+            log_hide_timestamp=env_bool("LOG_HIDE_TIME",
+                                        d.log_hide_timestamp),
             rendezvous_addr=env_str("RENDEZVOUS_ADDR",
                                     os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR", "")),
             rendezvous_port=env_int("RENDEZVOUS_PORT", d.rendezvous_port),

@@ -18,10 +18,17 @@ def get_logger() -> logging.Logger:
         if not logger.handlers:
             h = logging.StreamHandler(sys.stderr)
             rank = os.environ.get("HOROVOD_RANK", os.environ.get("HVD_TPU_RANK", "?"))
+            # HOROVOD_LOG_HIDE_TIME drops the timestamp (reference knob)
+            ts = "" if get_config().log_hide_timestamp else "[%(asctime)s] "
             h.setFormatter(logging.Formatter(
-                f"[%(asctime)s] [hvd-tpu] [rank {rank}] %(levelname)s: %(message)s"))
+                f"{ts}[hvd-tpu] [rank {rank}] %(levelname)s: %(message)s"))
             logger.addHandler(h)
-        level = getattr(logging, get_config().log_level, logging.WARNING)
+        name = get_config().log_level
+        if name == "TRACE":  # python logging has no TRACE tier
+            name = "DEBUG"
+        elif name == "FATAL":
+            name = "CRITICAL"
+        level = getattr(logging, name, logging.WARNING)
         logger.setLevel(level)
         _LOGGER = logger
     return _LOGGER

@@ -17,3 +17,16 @@ def core_backend_or_raise(state):
             "Build it with `python setup.py build_ext` or run single-process.")
     from horovod_tpu.core.core_backend import CoreBackend
     return CoreBackend(state)
+
+
+def core_config_dump() -> dict:
+    """Parsed env-knob values as seen by the C++ core (key=value map) —
+    lets tests assert the env round-trips into the engine without booting
+    a full multi-process world."""
+    from horovod_tpu.core.core_backend import _load_lib
+    text = _load_lib().hvd_cfg_dump().decode()
+    out = {}
+    for line in text.strip().splitlines():
+        k, _, v = line.partition("=")
+        out[k] = v
+    return out

@@ -37,6 +37,7 @@ def _load_lib():
         lib = ctypes.CDLL(_lib_path())
         lib.hvd_init.restype = ctypes.c_int
         lib.hvd_last_error.restype = ctypes.c_char_p
+        lib.hvd_cfg_dump.restype = ctypes.c_char_p
         lib.hvd_rank.restype = ctypes.c_int
         lib.hvd_size.restype = ctypes.c_int
         lib.hvd_enqueue_allreduce.restype = ctypes.c_int

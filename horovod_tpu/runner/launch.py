@@ -40,13 +40,39 @@ def parse_args(argv: List[str]) -> argparse.Namespace:
                         "elastic mode")
     p.add_argument("--reset-limit", type=int, default=None,
                    help="max elastic relaunch generations before giving up")
-    # knobs mirrored to env (reference: config_parser.py)
+    # knobs mirrored to env (reference: config_parser.py — full set; see
+    # docs/KNOBS.md for the table)
     p.add_argument("--fusion-threshold-mb", type=float, default=None)
     p.add_argument("--cycle-time-ms", type=float, default=None)
     p.add_argument("--cache-capacity", type=int, default=None)
-    p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--hierarchical-allreduce", action="store_true")
+    p.add_argument("--hierarchical-allgather", action="store_true")
     p.add_argument("--autotune", action="store_true")
-    p.add_argument("--stall-timeout-seconds", type=float, default=None)
+    p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--autotune-warmup-samples", type=int, default=None)
+    p.add_argument("--autotune-steps-per-sample", type=int, default=None)
+    p.add_argument("--autotune-bayes-opt-max-samples", type=int,
+                   default=None)
+    p.add_argument("--autotune-gaussian-process-noise", type=float,
+                   default=None)
+    p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--no-stall-check", action="store_true")
+    p.add_argument("--stall-warning-timeout-seconds", type=float,
+                   default=None)
+    p.add_argument("--stall-shutdown-timeout-seconds", type=float,
+                   default=None)
+    # back-compat alias for the r1 flag name
+    p.add_argument("--stall-timeout-seconds", type=float, default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--gloo-timeout-seconds", type=float, default=None,
+                   help="rendezvous/mesh connect deadline")
+    p.add_argument("--thread-affinity", type=int, default=None,
+                   help="pin the core background thread to this CPU")
+    p.add_argument("--log-level", default=None,
+                   choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
+                            "FATAL"])
+    p.add_argument("--log-hide-timestamp", action="store_true")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="program and args to run on every worker")
     args = p.parse_args(argv)
@@ -60,20 +86,40 @@ def parse_args(argv: List[str]) -> argparse.Namespace:
 def knobs_to_env(args: argparse.Namespace) -> Dict[str, str]:
     """CLI knob → env mirror (reference: ``config_parser.set_env_from_args``)."""
     env: Dict[str, str] = {}
-    if args.fusion_threshold_mb is not None:
-        env["HOROVOD_FUSION_THRESHOLD"] = str(
-            int(args.fusion_threshold_mb * 1024 * 1024))
-    if args.cycle_time_ms is not None:
-        env["HOROVOD_CYCLE_TIME"] = str(args.cycle_time_ms)
-    if args.cache_capacity is not None:
-        env["HOROVOD_CACHE_CAPACITY"] = str(args.cache_capacity)
-    if args.timeline_filename:
-        env["HOROVOD_TIMELINE"] = args.timeline_filename
-    if args.autotune:
-        env["HOROVOD_AUTOTUNE"] = "1"
-    if args.stall_timeout_seconds is not None:
-        env["HOROVOD_STALL_CHECK_TIME_SECONDS"] = str(
-            args.stall_timeout_seconds)
+
+    def put(flag_value, name, convert=str):
+        if flag_value is not None and flag_value is not False:
+            env[name] = "1" if flag_value is True else convert(flag_value)
+
+    put(None if args.fusion_threshold_mb is None
+        else int(args.fusion_threshold_mb * 1024 * 1024),
+        "HOROVOD_FUSION_THRESHOLD")
+    put(args.cycle_time_ms, "HOROVOD_CYCLE_TIME")
+    put(args.cache_capacity, "HOROVOD_CACHE_CAPACITY")
+    put(args.hierarchical_allreduce, "HOROVOD_HIERARCHICAL_ALLREDUCE")
+    put(args.hierarchical_allgather, "HOROVOD_HIERARCHICAL_ALLGATHER")
+    put(args.autotune, "HOROVOD_AUTOTUNE")
+    put(args.autotune_log_file, "HOROVOD_AUTOTUNE_LOG")
+    put(args.autotune_warmup_samples, "HOROVOD_AUTOTUNE_WARMUP_SAMPLES")
+    put(args.autotune_steps_per_sample,
+        "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE")
+    put(args.autotune_bayes_opt_max_samples,
+        "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES")
+    put(args.autotune_gaussian_process_noise,
+        "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE")
+    put(args.timeline_filename or None, "HOROVOD_TIMELINE")
+    put(args.timeline_mark_cycles, "HOROVOD_TIMELINE_MARK_CYCLES")
+    put(args.no_stall_check, "HOROVOD_STALL_CHECK_DISABLE")
+    put(args.stall_warning_timeout_seconds
+        if args.stall_warning_timeout_seconds is not None
+        else args.stall_timeout_seconds,
+        "HOROVOD_STALL_CHECK_TIME_SECONDS")
+    put(args.stall_shutdown_timeout_seconds,
+        "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS")
+    put(args.gloo_timeout_seconds, "HOROVOD_GLOO_TIMEOUT_SECONDS")
+    put(args.thread_affinity, "HOROVOD_THREAD_AFFINITY")
+    put(args.log_level, "HOROVOD_LOG_LEVEL")
+    put(args.log_hide_timestamp, "HOROVOD_LOG_HIDE_TIME")
     return env
 
 

@@ -1,0 +1,46 @@
+"""Stall-shutdown worker: rank 0 submits a tensor rank 1 never does.
+With HOROVOD_STALL_SHUTDOWN_TIME_SECONDS set, rank 0's wait must fail with
+a clear stall error instead of hanging (reference:
+stall_inspector.h shutdown path; here surfaced per-tensor as
+HorovodInternalError). Afterwards the domain keeps working.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from horovod_tpu.core.core_backend import CoreBackend  # noqa: E402
+from horovod_tpu.ops.reduce_op import ReduceOp  # noqa: E402
+
+
+def main():
+    be = CoreBackend()
+    rank = be.rank
+    if rank == 0:
+        h = be.allreduce_async("lonely", np.ones(4, np.float32),
+                               ReduceOp.SUM)
+        try:
+            h.wait(60)
+            raise AssertionError("expected a stall-shutdown error")
+        except RuntimeError as e:
+            assert "stalled beyond" in str(e), e
+    else:
+        # submit the recovery tensor before rank 0's stall error fires
+        # (shutdown is 4s; rank 0 joins at ~4s, well inside the window)
+        time.sleep(3)
+    # the domain must still be usable after the stall error
+    out = be.allreduce_async("after", np.full(3, float(rank + 1),
+                                              np.float32),
+                             ReduceOp.SUM).wait(60)
+    np.testing.assert_allclose(out, 3.0)
+    be.barrier()
+    be.shutdown()
+    print(f"stall worker {rank}: OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

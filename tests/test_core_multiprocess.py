@@ -21,6 +21,7 @@ PSETS_WORKER = os.path.join(os.path.dirname(__file__), "psets_worker.py")
 JIT_SYNC_WORKER = os.path.join(os.path.dirname(__file__),
                                "jit_sync_worker.py")
 MATRIX_WORKER = os.path.join(os.path.dirname(__file__), "matrix_worker.py")
+STALL_WORKER = os.path.join(os.path.dirname(__file__), "stall_worker.py")
 
 
 def _free_port():
@@ -107,6 +108,16 @@ def test_hvd_full_stack(size):
     """Public hvd API over the core with jax-cpu arrays."""
     # generous timeout: N jax processes compiling on this 1-core box
     _launch(size, timeout=480, worker=HVD_WORKER)
+
+
+@needs_core
+def test_stall_shutdown_errors_waiters():
+    """HOROVOD_STALL_SHUTDOWN_TIME_SECONDS: a tensor some ranks never
+    submit errors out to its waiters instead of hanging, and the domain
+    stays usable (reference: stall shutdown, test/integration/test_stall)."""
+    _launch(2, {"HOROVOD_STALL_CHECK_TIME_SECONDS": "1",
+                "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "4"},
+            timeout=180, worker=STALL_WORKER)
 
 
 @needs_core

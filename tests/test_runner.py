@@ -67,6 +67,78 @@ def test_parse_args_and_knobs():
         ("localhost", 4)]
 
 
+def test_full_knob_set_mirrors_to_env():
+    """Every reference config_parser knob reaches the workers' env
+    (docs/KNOBS.md table; reference: config_parser.set_env_from_args)."""
+    args = parse_args([
+        "-np", "2",
+        "--fusion-threshold-mb", "8", "--cycle-time-ms", "0.5",
+        "--cache-capacity", "2048",
+        "--hierarchical-allreduce", "--hierarchical-allgather",
+        "--autotune", "--autotune-log-file", "/tmp/at.log",
+        "--autotune-warmup-samples", "5",
+        "--autotune-steps-per-sample", "20",
+        "--autotune-bayes-opt-max-samples", "40",
+        "--autotune-gaussian-process-noise", "1e-5",
+        "--timeline-filename", "/tmp/tl.json", "--timeline-mark-cycles",
+        "--no-stall-check",
+        "--stall-warning-timeout-seconds", "30",
+        "--stall-shutdown-timeout-seconds", "120",
+        "--gloo-timeout-seconds", "45",
+        "--thread-affinity", "0",
+        "--log-level", "DEBUG", "--log-hide-timestamp",
+        "python", "t.py"])
+    env = knobs_to_env(args)
+    assert env == {
+        "HOROVOD_FUSION_THRESHOLD": str(8 * 1024 * 1024),
+        "HOROVOD_CYCLE_TIME": "0.5",
+        "HOROVOD_CACHE_CAPACITY": "2048",
+        "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+        "HOROVOD_HIERARCHICAL_ALLGATHER": "1",
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_LOG": "/tmp/at.log",
+        "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "5",
+        "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": "20",
+        "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES": "40",
+        "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE": "1e-05",
+        "HOROVOD_TIMELINE": "/tmp/tl.json",
+        "HOROVOD_TIMELINE_MARK_CYCLES": "1",
+        "HOROVOD_STALL_CHECK_DISABLE": "1",
+        "HOROVOD_STALL_CHECK_TIME_SECONDS": "30.0",
+        "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "120.0",
+        "HOROVOD_GLOO_TIMEOUT_SECONDS": "45.0",
+        "HOROVOD_THREAD_AFFINITY": "0",
+        "HOROVOD_LOG_LEVEL": "DEBUG",
+        "HOROVOD_LOG_HIDE_TIME": "1",
+    }
+
+
+def test_env_round_trips_into_core(monkeypatch):
+    """Env knobs must reach the C++ engine's parsed config (KNOBS.md
+    'Consumed by: C++ core' rows)."""
+    pytest.importorskip("horovod_tpu.core.core_backend")
+    from horovod_tpu.core import core_available
+    if not core_available():
+        pytest.skip("libhvdcore.so not built")
+    from horovod_tpu.core.bindings import core_config_dump
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "123456")
+    monkeypatch.setenv("HVD_TPU_CYCLE_TIME", "7.5")   # alias wins
+    monkeypatch.setenv("HOROVOD_CYCLE_TIME", "9.9")
+    monkeypatch.setenv("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "90")
+    monkeypatch.setenv("HOROVOD_GLOO_TIMEOUT_SECONDS", "12")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", "33")
+    monkeypatch.setenv("HOROVOD_THREAD_AFFINITY", "2")
+    monkeypatch.setenv("HOROVOD_TIMELINE_MARK_CYCLES", "1")
+    cfg = core_config_dump()
+    assert cfg["fusion_threshold"] == "123456"
+    assert cfg["cycle_time_ms"] == "7.5"
+    assert cfg["stall_shutdown_secs"] == "90"
+    assert cfg["rendezvous_timeout_secs"] == "12"
+    assert cfg["autotune_max_samples"] == "33"
+    assert cfg["thread_affinity"] == "2"
+    assert cfg["timeline_mark_cycles"] == "1"
+
+
 def test_parse_args_requires_command(capsys):
     with pytest.raises(SystemExit):
         parse_args(["-np", "2"])
