@@ -1064,15 +1064,22 @@ bool Core::RunOnce() {
       // HOROVOD_STALL_SHUTDOWN_TIME_SECONDS; surfaced here as a per-tensor
       // HorovodInternalError so elastic recovery can engage)
       for (auto& name : d->stall.FatallyStalled(cfg_.stall_shutdown_secs)) {
-        d->ready_table_.erase(name);
+        int group_id = -1;
+        auto rit = d->ready_table_.find(name);
+        if (rit != d->ready_table_.end()) {
+          group_id = rit->second.first.group_id;
+          d->ready_table_.erase(rit);
+        }
         // the stalled submission may be a partial CACHE BIT
         for (auto it2 = d->bit_ready_.begin();
              it2 != d->bit_ready_.end();) {
           const Response& cr = d->cache->Get(it2->first);
-          if (!cr.names.empty() && cr.names[0] == name)
+          if (!cr.names.empty() && cr.names[0] == name) {
+            group_id = cr.group_id;
             it2 = d->bit_ready_.erase(it2);
-          else
+          } else {
             ++it2;
+          }
         }
         d->stall.RemoveReady(name);
         Response e;
@@ -1083,6 +1090,22 @@ bool Core::RunOnce() {
             "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS (" +
             std::to_string((int)cfg_.stall_shutdown_secs) +
             "s): one or more ranks never submitted it";
+        // a stalled GROUP member must fail its held siblings too (same
+        // contract as the negotiated-error path: no handle waits forever)
+        if (group_id >= 0) {
+          d->poisoned_groups_.insert(group_id);
+          auto git = d->groups_.find(group_id);
+          if (git != d->groups_.end()) {
+            for (auto& held : git->second.second) {
+              Response e2;
+              e2.type = Response::kError;
+              e2.names = held.names;
+              e2.error_message = e.error_message;
+              singles.push_back(std::move(e2));
+            }
+            d->groups_.erase(git);
+          }
+        }
         singles.push_back(std::move(e));
       }
       if (id == 0 && shutdown_votes == d->group.size()) {

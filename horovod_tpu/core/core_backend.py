@@ -100,17 +100,21 @@ def _np_dtype_code(dtype) -> int:
 
 
 def _to_host(value):
-    """Return (contiguous numpy array, reconstruct_fn)."""
+    """Return (contiguous numpy array, reconstruct_fn).
+
+    np.ascontiguousarray promotes 0-d to (1,); reshape back so scalar
+    tensors keep their shape through the collective (a scalar optimizer
+    slot like SGD/iteration must broadcast back as a scalar)."""
+    base = np.asarray(value)
     try:
         import jax
         if isinstance(value, jax.Array):
-            arr = np.asarray(value)
             import jax.numpy as jnp
-            return np.ascontiguousarray(arr), lambda a: jnp.asarray(a)
+            return (np.ascontiguousarray(base).reshape(base.shape),
+                    lambda a: jnp.asarray(a))
     except ImportError:
         pass
-    arr = np.ascontiguousarray(np.asarray(value))
-    return arr, lambda a: a
+    return np.ascontiguousarray(base).reshape(base.shape), lambda a: a
 
 
 def _shape_arg(shape):

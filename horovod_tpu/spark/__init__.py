@@ -17,6 +17,12 @@ import os
 from typing import Any, Callable, List, Optional
 
 from horovod_tpu.spark.store import FilesystemStore, LocalStore, Store  # noqa: F401
+from horovod_tpu.spark.estimator import (  # noqa: F401
+    HorovodEstimator, HorovodModel)
+from horovod_tpu.spark.keras_estimator import (  # noqa: F401
+    KerasEstimator, KerasModel)
+from horovod_tpu.spark.torch_estimator import (  # noqa: F401
+    TorchEstimator, TorchModel)
 
 
 def _require_pyspark():

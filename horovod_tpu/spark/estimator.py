@@ -1,0 +1,190 @@
+"""Spark ML estimator API: fit a model on a DataFrame via distributed
+training, get back a model that transforms DataFrames.
+
+Reference: ``horovod/spark/common/estimator.py:25-110`` (HorovodEstimator /
+HorovodModel and their Params) with the Keras/Torch backends
+(``spark/keras/estimator.py``, ``spark/torch/estimator.py``). TPU-native
+redesign: data is materialized through the :class:`Store` as parquet,
+training runs under the horovod_tpu launcher (``runner.run`` locally, the
+Spark barrier runner on a cluster), and each worker reads its shard by
+rank — no Petastorm dependency.
+
+DataFrame duck-typing: anything with ``toPandas()`` (a Spark DataFrame) or
+a pandas DataFrame directly, so the estimators are fully usable and
+testable without a Spark session.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from horovod_tpu.spark.store import LocalStore, Store
+
+
+def _to_pandas(df):
+    if hasattr(df, "toPandas"):
+        return df.toPandas()
+    return df
+
+
+class Params:
+    """Getter/setter param surface (reference: the Params mixins in
+    ``spark/common/params.py`` — ``setX``/``getX`` returning self)."""
+
+    _param_names: Sequence[str] = ()
+
+    def _init_params(self, values: Dict[str, Any]) -> None:
+        for k in self._param_names:
+            setattr(self, "_" + k, values.get(k))
+
+    def __getattr__(self, item):
+        # setEpochs / getEpochs style accessors, generated from param names
+        if item.startswith(("set", "get")) and len(item) > 3:
+            name = item[3].lower() + item[4:]
+            # translate camelCase -> snake_case
+            snake = "".join("_" + c.lower() if c.isupper() else c
+                            for c in name)
+            if snake in self._param_names:
+                if item.startswith("set"):
+                    def setter(value):
+                        setattr(self, "_" + snake, value)
+                        return self
+                    return setter
+                return lambda: getattr(self, "_" + snake)
+        raise AttributeError(item)
+
+
+class HorovodModel(Params):
+    """Trained model wrapper (reference: ``HorovodModel``,
+    ``spark/common/estimator.py:79-110``)."""
+
+    _param_names = ("model", "feature_cols", "label_cols", "output_cols",
+                    "run_id")
+
+    def __init__(self, **kwargs) -> None:
+        self._init_params(kwargs)
+
+    def _predict_batch(self, X: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def transform(self, df):
+        """Append prediction columns to the DataFrame (reference:
+        ``HorovodModel.transform``). Returns a pandas DataFrame."""
+        pdf = _to_pandas(df).copy()
+        X = np.stack([pdf[c].to_numpy(dtype=np.float32)
+                      for c in self._feature_cols], axis=1)
+        preds = np.asarray(self._predict_batch(X))
+        out_cols = self._output_cols or \
+            [f"{c}__output" for c in self._label_cols]
+        if preds.ndim == 1:
+            preds = preds[:, None]
+        for i, c in enumerate(out_cols):
+            pdf[c] = preds[:, i] if preds.shape[1] > i else preds[:, -1]
+        return pdf
+
+
+class HorovodEstimator(Params):
+    """Distributed-training estimator (reference: ``HorovodEstimator``,
+    ``spark/common/estimator.py:25-78``)."""
+
+    _param_names = ("num_proc", "model", "store", "optimizer", "loss",
+                    "metrics", "feature_cols", "label_cols", "validation",
+                    "batch_size", "epochs", "verbose", "run_id",
+                    "callbacks", "custom_objects", "shuffle",
+                    "learning_rate")
+
+    def __init__(self, **kwargs) -> None:
+        defaults = dict(num_proc=1, metrics=[], validation=None,
+                        batch_size=32, epochs=1, verbose=1, shuffle=True,
+                        callbacks=[], custom_objects={},
+                        learning_rate=1e-3)
+        defaults.update(kwargs)
+        self._init_params(defaults)
+        if self._store is None:
+            self._store = LocalStore.create(
+                os.path.join(os.path.expanduser("~"), ".hvd_tpu_store"))
+
+    # -- backend hooks -------------------------------------------------------
+    def _save_model_spec(self, ckpt_dir: str) -> None:
+        raise NotImplementedError
+
+    def _make_remote_fn(self, ckpt_dir: str, train_path: str,
+                        val_path: str) -> Callable:
+        raise NotImplementedError
+
+    def _load_trained_model(self, ckpt_dir: str) -> HorovodModel:
+        raise NotImplementedError
+
+    # -- fit -----------------------------------------------------------------
+    def fit(self, df) -> HorovodModel:
+        """Materialize data through the Store, train under the launcher,
+        return the trained model (reference: ``Estimator.fit``)."""
+        run_id = self._run_id or f"run_{uuid.uuid4().hex[:8]}"
+        self._run_id = run_id
+        store: Store = self._store
+        pdf = _to_pandas(df)
+        if self._shuffle:
+            pdf = pdf.sample(frac=1.0, random_state=0).reset_index(
+                drop=True)
+        val_pdf = None
+        if isinstance(self._validation, float) and self._validation > 0:
+            n_val = max(1, int(len(pdf) * self._validation))
+            val_pdf, pdf = pdf.iloc[:n_val], pdf.iloc[n_val:]
+        elif isinstance(self._validation, str):
+            mask = pdf[self._validation].astype(bool)
+            val_pdf, pdf = pdf[mask], pdf[~mask]
+
+        train_path = store.get_train_data_path(run_id)
+        val_path = store.get_val_data_path(run_id)
+        os.makedirs(train_path, exist_ok=True)
+        pdf.reset_index(drop=True).to_parquet(
+            os.path.join(train_path, "data.parquet"))
+        if val_pdf is not None and len(val_pdf):
+            os.makedirs(val_path, exist_ok=True)
+            val_pdf.reset_index(drop=True).to_parquet(
+                os.path.join(val_path, "data.parquet"))
+        else:
+            val_path = ""
+
+        ckpt_dir = store.get_checkpoint_path(run_id)
+        os.makedirs(ckpt_dir, exist_ok=True)
+        self._save_model_spec(ckpt_dir)
+
+        remote = self._make_remote_fn(ckpt_dir, train_path, val_path)
+        in_spark = False
+        try:
+            from pyspark.sql import SparkSession
+            in_spark = SparkSession.getActiveSession() is not None
+        except Exception:
+            pass
+        if in_spark:
+            from horovod_tpu.spark import run as spark_run
+            histories = spark_run(remote, num_proc=self._num_proc)
+        else:
+            from horovod_tpu.runner import run as local_run
+            histories = local_run(remote, np=self._num_proc)
+
+        model = self._load_trained_model(ckpt_dir)
+        model.history = histories[0]
+        return model
+
+
+def read_shard(data_path: str, rank: int, size: int):
+    """Worker-side shard read: rows [rank::size] of the materialized
+    parquet (the reference partitions Petastorm row groups per rank)."""
+    import pandas as pd
+    pdf = pd.read_parquet(os.path.join(data_path, "data.parquet"))
+    return pdf.iloc[rank::size].reset_index(drop=True)
+
+
+def xy_arrays(pdf, feature_cols: Sequence[str], label_cols: Sequence[str]):
+    X = np.stack([pdf[c].to_numpy(dtype=np.float32)
+                  for c in feature_cols], axis=1)
+    Y = np.stack([pdf[c].to_numpy(dtype=np.float32)
+                  for c in label_cols], axis=1)
+    return X, Y

@@ -1,0 +1,134 @@
+"""Torch backend for the Spark estimator API.
+
+Reference: ``horovod/spark/torch/estimator.py`` (506 LoC: TorchEstimator
+serializing the model/optimizer/loss, remote.py loop with hvd.torch) —
+rebuilt on this package's torch adapter: the model travels as a pickled
+module + state_dict through the Store, each worker wraps its optimizer in
+``horovod_tpu.torch.DistributedOptimizer`` and trains its rank's shard,
+and rank 0 checkpoints the final state back to the Store.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Callable
+
+import numpy as np
+
+from horovod_tpu.spark.estimator import (HorovodEstimator, HorovodModel,
+                                         read_shard, xy_arrays)
+
+
+class TorchModel(HorovodModel):
+    """Reference: ``TorchModel`` (``spark/torch/estimator.py``)."""
+
+    def _predict_batch(self, X: np.ndarray) -> np.ndarray:
+        import torch
+        self._model.eval()
+        with torch.no_grad():
+            return self._model(torch.from_numpy(X)).numpy()
+
+
+class TorchEstimator(HorovodEstimator):
+    """Reference: ``TorchEstimator`` (``spark/torch/estimator.py``).
+
+    ``model`` is a ``torch.nn.Module``; ``optimizer`` an optimizer NAME
+    from ``torch.optim`` (e.g. ``"SGD"``); ``loss`` a callable
+    ``loss(pred, target)`` or a ``torch.nn`` loss name (e.g.
+    ``"MSELoss"``).
+    """
+
+    def _save_model_spec(self, ckpt_dir: str) -> None:
+        with open(os.path.join(ckpt_dir, "initial.pkl"), "wb") as f:
+            pickle.dump(self._model, f)
+        loss_value = self._loss if self._loss is not None else "MSELoss"
+        loss = loss_value if isinstance(loss_value, str) else None
+        with open(os.path.join(ckpt_dir, "loss.pkl"), "wb") as f:
+            pickle.dump(loss_value if loss is None else None, f)
+        with open(os.path.join(ckpt_dir, "train_spec.json"), "w") as f:
+            json.dump(dict(optimizer=self._optimizer or "SGD",
+                           learning_rate=self._learning_rate,
+                           loss_name=loss,
+                           feature_cols=list(self._feature_cols),
+                           label_cols=list(self._label_cols),
+                           batch_size=self._batch_size,
+                           epochs=self._epochs,
+                           verbose=self._verbose), f)
+
+    def _make_remote_fn(self, ckpt_dir: str, train_path: str,
+                        val_path: str) -> Callable:
+        def remote_train():
+            import torch
+            import horovod_tpu.torch as thvd
+            import horovod_tpu as hvd
+
+            with open(os.path.join(ckpt_dir, "train_spec.json")) as f:
+                spec = json.load(f)
+            with open(os.path.join(ckpt_dir, "initial.pkl"), "rb") as f:
+                model = pickle.load(f)
+            if spec["loss_name"]:
+                loss_fn = getattr(torch.nn, spec["loss_name"])()
+            else:
+                with open(os.path.join(ckpt_dir, "loss.pkl"), "rb") as f:
+                    loss_fn = pickle.load(f)
+            opt_cls = getattr(torch.optim, spec["optimizer"])
+            opt = thvd.DistributedOptimizer(
+                opt_cls(model.parameters(),
+                        lr=spec["learning_rate"] * hvd.size()),
+                named_parameters=model.named_parameters())
+            thvd.broadcast_parameters(model.state_dict(), root_rank=0)
+            thvd.broadcast_optimizer_state(opt, root_rank=0)
+
+            pdf = read_shard(train_path, hvd.rank(), hvd.size())
+            X, Y = xy_arrays(pdf, spec["feature_cols"], spec["label_cols"])
+            X_t = torch.from_numpy(X)
+            Y_t = torch.from_numpy(Y)
+            val = None
+            if val_path:
+                vX, vY = xy_arrays(read_shard(val_path, 0, 1),
+                                   spec["feature_cols"],
+                                   spec["label_cols"])
+                val = (torch.from_numpy(vX), torch.from_numpy(vY))
+            bs = spec["batch_size"]
+            history = {"loss": []}
+            if val is not None:
+                history["val_loss"] = []
+            for epoch in range(spec["epochs"]):
+                model.train()
+                losses = []
+                for i in range(0, len(X_t), bs):
+                    opt.zero_grad()
+                    loss = loss_fn(model(X_t[i:i + bs]), Y_t[i:i + bs])
+                    loss.backward()
+                    opt.step()
+                    losses.append(float(loss.detach()))
+                mean = float(np.mean(losses)) if losses else float("nan")
+                # epoch metric averaged across workers (reference:
+                # remote.py metric aggregation)
+                mean = float(np.asarray(thvd.allreduce(
+                    torch.tensor([mean]), op=thvd.Average,
+                    name=f"ep.{epoch}"))[0])
+                history["loss"].append(mean)
+                if val is not None:
+                    model.eval()
+                    with torch.no_grad():
+                        vloss = float(loss_fn(model(val[0]), val[1]))
+                    history["val_loss"].append(vloss)
+                if spec["verbose"] and hvd.rank() == 0:
+                    print(f"[torch-estimator] epoch {epoch}: loss={mean}",
+                          flush=True)
+            if hvd.rank() == 0:
+                with open(os.path.join(ckpt_dir, "final.pkl"), "wb") as f:
+                    pickle.dump(model, f)
+            return history
+
+        return remote_train
+
+    def _load_trained_model(self, ckpt_dir: str) -> TorchModel:
+        with open(os.path.join(ckpt_dir, "final.pkl"), "rb") as f:
+            model = pickle.load(f)
+        return TorchModel(model=model, feature_cols=self._feature_cols,
+                          label_cols=self._label_cols,
+                          run_id=self._run_id)

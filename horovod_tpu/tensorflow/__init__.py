@@ -185,6 +185,10 @@ class _DistributedOptimizer:
         self.backward_passes_per_step = backward_passes_per_step
         self._pass = 0
         self._acc: Optional[list] = None
+        # graph-mode aggregation state (reference:
+        # tensorflow/gradient_aggregation.py LocalGradientAggregationHelper)
+        self._agg_vars: Optional[list] = None
+        self._agg_counter = None
 
     def __getattr__(self, item):
         return getattr(self._opt, item)
@@ -192,15 +196,31 @@ class _DistributedOptimizer:
     def _sync(self, grads):
         if size() <= 1:
             return grads
-        comp, ctxs = [], []
-        for g in grads:
-            c, ctx = self._compression.compress(_to_np(g))
-            comp.append(np.asarray(c))
-            ctxs.append(ctx)
-        outs = _C.grouped_allreduce(comp, op=self._op, name="tfopt",
-                                    process_set=self._process_set)
-        return [_from_np(self._compression.decompress(np.asarray(o), ctx), g)
-                for o, ctx, g in zip(outs, ctxs, grads)]
+        tf = _tf()
+
+        def do(*gs):
+            comp, ctxs = [], []
+            for g in gs:
+                c, ctx = self._compression.compress(np.asarray(g))
+                comp.append(np.asarray(c))
+                ctxs.append(ctx)
+            outs = _C.grouped_allreduce(comp, op=self._op, name="tfopt",
+                                        process_set=self._process_set)
+            return [np.asarray(self._compression.decompress(
+                np.asarray(o), ctx)) for o, ctx in zip(outs, ctxs)]
+
+        if tf.executing_eagerly():
+            outs = do(*[_to_np(g) for g in grads])
+            return [_from_np(o, g) for o, g in zip(outs, grads)]
+        # graph mode (keras compiles train_step into a tf.function):
+        # py_function runs the host allreduce eagerly inside the graph
+        flat = tf.py_function(do, list(grads),
+                              [g.dtype for g in grads])
+        if not isinstance(flat, (list, tuple)):
+            flat = [flat]
+        for o, g in zip(flat, grads):
+            o.set_shape(g.shape)
+        return list(flat)
 
     def apply_gradients(self, grads_and_vars, **kwargs):
         gv = list(grads_and_vars)
@@ -209,6 +229,10 @@ class _DistributedOptimizer:
         # local accumulation for backward_passes_per_step (reference:
         # LocalGradientAggregationHelper, tensorflow/gradient_aggregation.py)
         if self.backward_passes_per_step > 1:
+            tf = _tf()
+            if not tf.executing_eagerly():
+                return self._graph_accumulate_apply(tf, grads, tvars,
+                                                    kwargs)
             gn = [_to_np(g) for g in grads]
             self._acc = gn if self._acc is None else \
                 [a + b for a, b in zip(self._acc, gn)]
@@ -220,6 +244,37 @@ class _DistributedOptimizer:
             self._acc, self._pass = None, 0
         grads = self._sync(grads)
         return self._opt.apply_gradients(zip(grads, tvars), **kwargs)
+
+    def _graph_accumulate_apply(self, tf, grads, tvars, kwargs):
+        """tf.function-compatible accumulation: aggregation variables +
+        tf.cond applying every k-th call (reference:
+        ``gradient_aggregation.py`` graph-mode helper)."""
+        k = self.backward_passes_per_step
+        if self._agg_vars is None:
+            with tf.init_scope():
+                self._agg_vars = [
+                    tf.Variable(tf.zeros(g.shape, g.dtype),
+                                trainable=False) for g in grads]
+                self._agg_counter = tf.Variable(0, dtype=tf.int64,
+                                                trainable=False)
+        assigns = [v.assign_add(g)
+                   for v, g in zip(self._agg_vars, grads)]
+        with tf.control_dependencies(assigns):
+            count = self._agg_counter.assign_add(1)
+
+        def apply_now():
+            avg = [tf.cast(v.read_value(), g.dtype) / float(k)
+                   for v, g in zip(self._agg_vars, grads)]
+            synced = self._sync(avg)
+            self._opt.apply_gradients(zip(synced, tvars), **kwargs)
+            resets = [v.assign(tf.zeros_like(v)) for v in self._agg_vars]
+            with tf.control_dependencies(resets):
+                return tf.constant(True)
+
+        def skip():
+            return tf.constant(False)
+
+        return tf.cond(tf.equal(count % k, 0), apply_now, skip)
 
 
 def DistributedOptimizer(optimizer, op: ReduceOp = Average,

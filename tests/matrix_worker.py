@@ -52,6 +52,11 @@ def stack_all(dtype, shape, size, **kw):
 def check(out, expect, dtype, msg):
     out = np.asarray(out)
     assert out.dtype == dtype, f"{msg}: dtype {out.dtype} != {dtype}"
+    expect = np.asarray(expect)
+    # shape must match EXACTLY (assert_array_equal would broadcast a
+    # (1,) result against a () expectation — the r2 scalar-shape bug)
+    assert out.shape == expect.shape, \
+        f"{msg}: shape {out.shape} != {expect.shape}"
     np.testing.assert_array_equal(
         out.astype(np.float64), expect.astype(np.float64), err_msg=msg)
 

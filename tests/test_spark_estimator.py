@@ -1,0 +1,86 @@
+"""Spark estimator API tests (reference analog: test/integration/
+test_spark.py estimator tests). pyspark is not in this image, so the
+DataFrame boundary is exercised with pandas (the estimators duck-type
+``toPandas``) and training runs under the local launcher — the same code
+path a Spark cluster takes after the barrier-job handshake."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from horovod_tpu.core import core_available
+from horovod_tpu.spark import (HorovodEstimator, KerasEstimator, LocalStore,
+                               TorchEstimator)
+
+needs_core = pytest.mark.skipif(not core_available(),
+                                reason="libhvdcore.so not built")
+
+
+def _regression_df(n=80, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d).astype(np.float32)
+    y = X @ w + 0.01 * rng.randn(n).astype(np.float32)
+    df = pd.DataFrame({f"x{i}": X[:, i] for i in range(d)})
+    df["y"] = y
+    return df
+
+
+def test_params_surface(tmp_path):
+    """Reference-style setX/getX accessors returning self."""
+    est = TorchEstimator(feature_cols=["a"], label_cols=["b"],
+                         store=LocalStore(str(tmp_path)))
+    assert est.setEpochs(7) is est
+    assert est.getEpochs() == 7
+    assert est.setBatchSize(16).getBatchSize() == 16
+    assert est.getFeatureCols() == ["a"]
+
+
+@needs_core
+def test_torch_estimator_fit_transform(tmp_path):
+    torch = pytest.importorskip("torch")
+    df = _regression_df()
+    model = torch.nn.Linear(4, 1)
+    est = TorchEstimator(
+        model=model, optimizer="SGD", loss="MSELoss",
+        feature_cols=[f"x{i}" for i in range(4)], label_cols=["y"],
+        store=LocalStore(str(tmp_path)), num_proc=2, epochs=8,
+        batch_size=16, learning_rate=0.05, validation=0.2, verbose=0)
+    trained = est.fit(df)
+    assert trained.history["loss"][-1] < trained.history["loss"][0] * 0.2
+    out = trained.transform(df.head(10))
+    assert "y__output" in out.columns
+    err = np.mean((out["y__output"].to_numpy()
+                   - out["y"].to_numpy()) ** 2)
+    assert err < 0.5
+
+
+@needs_core
+def test_keras_estimator_fit_transform(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    df = _regression_df(n=60)
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Input((4,)), tf.keras.layers.Dense(1)])
+    est = KerasEstimator(
+        model=model, optimizer="SGD", loss="mse",
+        feature_cols=[f"x{i}" for i in range(4)], label_cols=["y"],
+        store=LocalStore(str(tmp_path)), num_proc=2, epochs=6,
+        batch_size=16, learning_rate=0.05, verbose=0)
+    trained = est.fit(df)
+    assert trained.history["loss"][-1] < trained.history["loss"][0]
+    out = trained.transform(df.head(8))
+    assert "y__output" in out.columns
+    assert np.isfinite(out["y__output"].to_numpy()).all()
+
+
+def test_estimator_single_proc_no_core(tmp_path):
+    """num_proc=1 works without the native core (LocalBackend)."""
+    torch = pytest.importorskip("torch")
+    df = _regression_df(n=40)
+    est = TorchEstimator(
+        model=torch.nn.Linear(4, 1), optimizer="SGD", loss="MSELoss",
+        feature_cols=[f"x{i}" for i in range(4)], label_cols=["y"],
+        store=LocalStore(str(tmp_path)), num_proc=1, epochs=5,
+        batch_size=8, learning_rate=0.05, verbose=0)
+    trained = est.fit(df)
+    assert trained.history["loss"][-1] < trained.history["loss"][0]

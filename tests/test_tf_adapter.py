@@ -65,6 +65,31 @@ def test_tf_backward_passes_per_step(tfhvd):
     np.testing.assert_allclose(w.numpy(), [-2.0])  # mean(1,3) applied
 
 
+def test_tf_backward_passes_graph_mode(tfhvd):
+    """backward_passes_per_step under tf.function (keras-compiled train
+    steps): accumulation variables + tf.cond, not numpy on symbolic
+    tensors (r2 review)."""
+    w = tf.Variable([0.0])
+    opt = tfhvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=1.0),
+        backward_passes_per_step=2)
+
+    @tf.function
+    def step(g):
+        return opt.apply_gradients([(g, w)])
+
+    applied1 = step(tf.constant([1.0]))
+    np.testing.assert_allclose(w.numpy(), [0.0])  # accumulating
+    applied2 = step(tf.constant([3.0]))
+    np.testing.assert_allclose(w.numpy(), [-2.0])  # mean(1,3) applied
+    assert not bool(applied1) and bool(applied2)
+    # next cycle accumulates again from zero
+    step(tf.constant([5.0]))
+    np.testing.assert_allclose(w.numpy(), [-2.0])
+    step(tf.constant([7.0]))
+    np.testing.assert_allclose(w.numpy(), [-8.0])  # -2 - mean(5,7)
+
+
 def test_tf_sync_batch_norm(tfhvd):
     """TF-side SyncBatchNormalization (reference:
     tensorflow/sync_batch_norm.py): normalizes with batch moments in
