@@ -71,17 +71,114 @@ def slot_command(slot: SlotInfo, command: List[str], coord_addr: str,
     return SSH_COMMAND_PREFIX + [slot.hostname, remote], dict(os.environ)
 
 
+def probe_coordinator_address(hostnames: List[str],
+                              restrict: Optional[List[str]] = None,
+                              verbose: bool = False) -> Optional[str]:
+    """Multi-NIC bootstrap (reference: ``get_common_interfaces``,
+    ``driver/driver_service.py:49-235``): start a task service on every
+    distinct host, ring-probe candidate interfaces, return a rendezvous
+    address every worker can reach. None = all-local, no probing needed."""
+    import secrets as _secrets
+    import subprocess
+
+    distinct: List[str] = []
+    for h in hostnames:
+        if h not in distinct:
+            distinct.append(h)
+    if all(_is_local(h) for h in distinct):
+        return None
+
+    from horovod_tpu.runner.service import (TaskClient, TaskService,
+                                            find_routable_interfaces,
+                                            pick_rendezvous_address)
+    secret = _secrets.token_bytes(16)
+    services: List[TaskService] = []
+    procs: List[subprocess.Popen] = []
+    clients_by_idx: Dict[int, TaskClient] = {}
+    try:
+        # spawn everything first (concurrent ssh session setup), collect
+        # ports in parallel with a read deadline — a wedged remote must
+        # not hang the launch (probing is best-effort bootstrap)
+        pending: List[Tuple[int, str, subprocess.Popen]] = []
+        for i, host in enumerate(distinct):
+            if _is_local(host):
+                svc = TaskService(i, secret).start()
+                services.append(svc)
+                clients_by_idx[i] = TaskClient("127.0.0.1", svc.port,
+                                               secret)
+                continue
+            remote = (f"{shlex.quote(sys.executable)} -m "
+                      f"horovod_tpu.runner.task_server --index {i}")
+            proc = subprocess.Popen(
+                SSH_COMMAND_PREFIX + ["-o", "ConnectTimeout=15", host,
+                                      remote],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+            # the secret travels over the ssh channel (stdin), never on a
+            # command line where the remote process table would expose it
+            proc.stdin.write(secret.hex() + "\n")
+            proc.stdin.flush()
+            procs.append(proc)
+            pending.append((i, host, proc))
+
+        def read_port(i: int, host: str, proc: subprocess.Popen) -> None:
+            line = proc.stdout.readline()
+            if line.startswith("HVD_TASK_PORT="):
+                clients_by_idx[i] = TaskClient(
+                    host, int(line.strip().split("=", 1)[1]), secret)
+
+        readers = [threading.Thread(target=read_port, args=p, daemon=True)
+                   for p in pending]
+        for t in readers:
+            t.start()
+        for t in readers:
+            t.join(timeout=30)
+        missing = [h for i, h, _ in pending if i not in clients_by_idx]
+        if missing:
+            raise RuntimeError(
+                f"task services failed to start on: {missing}")
+        clients = [clients_by_idx[i] for i in range(len(distinct))]
+        routable = find_routable_interfaces(clients, restrict=restrict)
+        addr = pick_rendezvous_address(routable)
+        if verbose:
+            print(f"[hvdrun] NIC probe: rendezvous via {addr} "
+                  f"(routable: {routable})", flush=True)
+        return addr
+    finally:
+        for c in clients_by_idx.values():
+            c.shutdown()
+        for svc in services:
+            svc.stop()
+        for proc in procs:
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.terminate()
+
+
 def launch_static(hosts: List[HostInfo], np: int, command: List[str],
                   env: Optional[Dict[str, str]] = None,
                   coord_addr: Optional[str] = None,
                   coord_port: Optional[int] = None,
+                  nics: Optional[List[str]] = None,
+                  nic_probe: bool = True,
                   verbose: bool = False) -> int:
     """Run ``command`` on every slot; return first nonzero exit code (or 0).
 
     Reference: ``launch_gloo`` (``gloo_run.py:226``): assignment → env →
-    per-slot exec threads; any failure terminates the rest.
+    per-slot exec threads; any failure terminates the rest. Multi-host
+    launches first resolve a mutually-routable rendezvous address through
+    the task-service NIC probe (``probe_coordinator_address``).
     """
     slots = get_host_assignments(hosts, np)
+    if coord_addr is None and nic_probe and \
+            not all(_is_local(s.hostname) for s in slots):
+        try:
+            coord_addr = probe_coordinator_address(
+                [s.hostname for s in slots], restrict=nics,
+                verbose=verbose)
+        except Exception as e:  # probing is best-effort bootstrap
+            print(f"[hvdrun] NIC probe failed ({e}); falling back to "
+                  f"hostname resolution", file=sys.stderr, flush=True)
     coord_addr = coord_addr or (
         "127.0.0.1" if _is_local(slots[0].hostname) else slots[0].hostname)
     coord_port = coord_port or free_port()

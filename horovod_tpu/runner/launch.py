@@ -67,6 +67,13 @@ def parse_args(argv: List[str]) -> argparse.Namespace:
                    help=argparse.SUPPRESS)
     p.add_argument("--gloo-timeout-seconds", type=float, default=None,
                    help="rendezvous/mesh connect deadline")
+    p.add_argument("--network-interfaces", "--nics", dest="nics",
+                   default=None,
+                   help="comma-separated NIC allowlist for the multi-host "
+                        "routability probe (reference: --network-interfaces)")
+    p.add_argument("--no-nic-probe", action="store_true",
+                   help="skip the task-service NIC probe on multi-host "
+                        "launches")
     p.add_argument("--thread-affinity", type=int, default=None,
                    help="pin the core background thread to this CPU")
     p.add_argument("--log-level", default=None,
@@ -158,7 +165,10 @@ def run_commandline(argv: List[str] = None) -> int:
 
     hosts = resolve_hosts(args)
     np = args.num_proc or sum(h.slots for h in hosts)
+    nics = [n.strip() for n in args.nics.split(",") if n.strip()] \
+        if args.nics else None
     return launch_static(hosts, np, args.command, env=env,
+                         nics=nics, nic_probe=not args.no_nic_probe,
                          verbose=args.verbose)
 
 
