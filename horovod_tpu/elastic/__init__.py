@@ -47,7 +47,87 @@ class HorovodInternalError(RuntimeError):
 
 class HostsUpdatedInterrupt(RuntimeError):
     """Membership changed; re-sync required (reference:
-    ``HostsUpdatedInterrupt``)."""
+    ``HostsUpdatedInterrupt``). Carries the driver's new world document
+    when growth-resync is active."""
+
+    def __init__(self, update: Optional[dict] = None) -> None:
+        super().__init__("hosts updated")
+        self.update = update
+
+
+_current_generation: Optional[int] = None
+
+
+def world_doc_signature(secret: bytes, doc: dict) -> str:
+    """HMAC over the canonical world doc — workers apply env/coordinator
+    changes from this document, so it must not be forgeable by anyone who
+    can reach the driver's KV port."""
+    import hashlib
+    import hmac
+    import json
+    body = json.dumps({k: v for k, v in doc.items() if k != "sig"},
+                      sort_keys=True).encode()
+    return hmac.new(secret, body, hashlib.sha256).hexdigest()
+
+
+def _world_update() -> Optional[dict]:
+    """Poll the driver's KV for a newer world document (reference: the
+    driver→worker host-update push, ``runner/elastic/worker.py:46`` —
+    pull-at-commit here, which needs no per-worker listener port)."""
+    global _current_generation
+    kv = os.environ.get("HVD_ELASTIC_KV", "")
+    if not kv:
+        return None
+    if _current_generation is None:
+        _current_generation = int(
+            os.environ.get("HVD_ELASTIC_GENERATION", "0"))
+    addr, _, port = kv.rpartition(":")
+    try:
+        from horovod_tpu.runner.http_kv import kv_get
+        # short timeout: commit() must stay cheap even if the driver's
+        # port silently drops packets
+        raw = kv_get(addr, int(port), "world", "current", timeout=3.0)
+    except OSError:
+        return None  # driver KV transiently unreachable: not our problem
+    if raw is None:
+        return None
+    import hmac as _hmac
+    import json
+    doc = json.loads(raw)
+    secret_hex = os.environ.get("HVD_ELASTIC_SECRET", "")
+    if secret_hex:
+        expect = world_doc_signature(bytes.fromhex(secret_hex), doc)
+        if not _hmac.compare_digest(doc.get("sig", ""), expect):
+            return None  # forged/corrupt doc: ignore
+    if int(doc.get("generation", 0)) > _current_generation:
+        return doc
+    return None
+
+
+def _apply_world_update(update: dict) -> None:
+    """Re-initialize into the new world IN PLACE (no process restart):
+    survivors keep their stable rank (growth never reshuffles), adopt the
+    new size/topology env, tear the old core down (the shutdown consensus
+    drains as every survivor reaches its next commit) and rendezvous into
+    the new world. Reference analog: ``reset()`` after
+    HostsUpdatedInterrupt, ``common/elastic.py:151-175``."""
+    global _current_generation
+    import horovod_tpu as hvd
+    my_rank = str(rank())
+    slot_env = update["slots"].get(my_rank)
+    if slot_env is None:  # we are not part of the new world
+        raise RuntimeError(
+            f"rank {my_rank} is not in the new world (generation "
+            f"{update['generation']}); exiting for relaunch")
+    hvd.shutdown()
+    os.environ.update({k: str(v) for k, v in slot_env.items()})
+    os.environ["HVD_TPU_COORD_ADDR"] = update["coord_addr"]
+    os.environ["HVD_TPU_COORD_PORT"] = str(update["coord_port"])
+    os.environ["HVD_ELASTIC_GENERATION"] = str(update["generation"])
+    _current_generation = int(update["generation"])
+    from horovod_tpu.common.config import reset_config
+    reset_config()
+    hvd.init()
 
 
 class State:
@@ -68,10 +148,12 @@ class State:
         self.check_host_updates()
 
     def check_host_updates(self) -> None:
-        # Process-restart elasticity: membership changes arrive as process
-        # restarts, not in-band notifications, so this is a no-op hook kept
-        # for reference API parity.
-        pass
+        """Raise :class:`HostsUpdatedInterrupt` when the driver published
+        a newer world (elastic growth without restarting survivors);
+        failures/shrink still arrive as process restarts."""
+        update = _world_update()
+        if update is not None:
+            raise HostsUpdatedInterrupt(update)
 
     def save(self) -> None:
         raise NotImplementedError
@@ -189,7 +271,10 @@ def run(func: Callable) -> Callable:
             except HorovodInternalError:
                 state.restore()
                 state.sync()
-            except HostsUpdatedInterrupt:
+            except HostsUpdatedInterrupt as e:
+                if e.update is not None:
+                    _apply_world_update(e.update)  # in-place re-mesh
+                state.on_reset()
                 state.sync()
 
     return wrapper

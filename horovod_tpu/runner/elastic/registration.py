@@ -47,6 +47,10 @@ class WorkerStateRegistry:
         with self._lock:
             return sum(1 for s in self._states.values() if s == state)
 
+    def state_of(self, rank: int) -> Optional[str]:
+        with self._lock:
+            return self._states.get(rank)
+
     def failed_hosts(self) -> Dict[str, int]:
         with self._lock:
             out: Dict[str, int] = {}

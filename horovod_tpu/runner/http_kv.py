@@ -99,16 +99,18 @@ class KVStoreServer:
             return dict(self._httpd.kv.get(scope, {}))
 
 
-def kv_put(addr: str, port: int, scope: str, key: str, value: bytes) -> None:
+def kv_put(addr: str, port: int, scope: str, key: str, value: bytes,
+           timeout: float = 30.0) -> None:
     req = Request(f"http://{addr}:{port}/{scope}/{key}", data=value,
                   method="PUT")
-    urlopen(req, timeout=30).read()
+    urlopen(req, timeout=timeout).read()
 
 
-def kv_get(addr: str, port: int, scope: str, key: str) -> Optional[bytes]:
+def kv_get(addr: str, port: int, scope: str, key: str,
+           timeout: float = 30.0) -> Optional[bytes]:
     try:
         return urlopen(f"http://{addr}:{port}/{scope}/{key}",
-                       timeout=30).read()
+                       timeout=timeout).read()
     except HTTPError as e:
         if e.code == 404:
             return None

@@ -186,13 +186,38 @@ def find_routable_interfaces(
     if restrict:
         tables = [{k: v for k, v in tab.items() if k in restrict}
                   for tab in tables]
+    # All (prober, candidate) pairs are independent — fan out in threads so
+    # dead candidates cost one connect timeout total, not one per pair
+    # (the reference driver probes concurrently too).
+    jobs: List[Tuple[int, str, TaskClient, str, int]] = []
+    for i, tab in enumerate(tables):
+        for j, prober in enumerate(tasks):
+            if j == i:
+                continue
+            for iface, ip in tab.items():
+                jobs.append((i, iface, prober, ip, tasks[i].port))
+    results: Dict[Tuple[int, str], bool] = {
+        (i, iface): True for i, tab in enumerate(tables) for iface in tab}
+    lock = threading.Lock()
+
+    def run_job(job):
+        i, iface, prober, ip, port = job
+        ok = prober.probe(ip, port)
+        if not ok:
+            with lock:
+                results[(i, iface)] = False
+
+    threads = [threading.Thread(target=run_job, args=(j,), daemon=True)
+               for j in jobs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+
     out: List[Tuple[int, Dict[str, str]]] = []
     for i, tab in enumerate(tables):
-        probers = [t for j, t in enumerate(tasks) if j != i]
-        alive: Dict[str, str] = {}
-        for iface, ip in tab.items():
-            if all(p.probe(ip, tasks[i].port) for p in probers):
-                alive[iface] = ip
+        alive = {iface: ip for iface, ip in tab.items()
+                 if results[(i, iface)]}
         if not alive:
             raise RuntimeError(
                 f"no mutually-routable interface found for task {i} "

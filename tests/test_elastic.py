@@ -155,3 +155,67 @@ def test_elastic_integration_fake_cluster(tmp_path):
         min_np=2, max_np=2, reset_limit=3, ckpt_dir=str(tmp_path))
     rc = driver.run()
     assert rc == 0
+
+
+@pytest.mark.skipif(not core_available(),
+                    reason="libhvdcore.so not built")
+def test_elastic_growth_does_not_restart_survivors(tmp_path):
+    """Scale-up extends the running generation (VERDICT r1 #6): the
+    discovery output grows 2 -> 3 slots mid-run; survivors pick the new
+    world up at commit() via HostsUpdatedInterrupt and re-init IN PLACE
+    (each rank boots exactly once), the new worker joins, and a
+    3-rank collective completes."""
+    boot_log = tmp_path / "boots.log"
+    disco = tmp_path / "discover.sh"
+    # discovery reports 2 slots until the grow-marker appears
+    disco.write_text(
+        "#!/bin/bash\n"
+        f"if [ -f {tmp_path}/grow ]; then echo localhost:3; "
+        "else echo localhost:2; fi\n")
+    disco.chmod(disco.stat().st_mode | stat.S_IEXEC)
+
+    prog = tmp_path / "train.py"
+    prog.write_text(textwrap.dedent(f"""
+        import os, sys, time
+        sys.path.insert(0, {REPO!r})
+        import numpy as np
+        import horovod_tpu as hvd
+        from horovod_tpu import elastic
+
+        hvd.init()
+        with open({str(boot_log)!r}, "a") as f:
+            f.write(f"BOOT rank={{hvd.rank()}} pid={{os.getpid()}}\\n")
+        if hvd.rank() == 0 and hvd.size() == 2:
+            open(os.path.join({str(tmp_path)!r}, "grow"), "w").close()
+
+        state = elastic.ObjectState(name="grow", step=0)
+
+        @elastic.run
+        def train(state):
+            while True:
+                out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                                    name=f"g{{hvd.size()}}.{{state.step}}")
+                state.step += 1
+                time.sleep(0.3)
+                state.commit()   # raises HostsUpdatedInterrupt on growth
+                if hvd.size() >= 3 and float(np.asarray(out)[0]) == 3.0:
+                    return hvd.rank()
+
+        r = train(state)
+        print(f"rank {{r}} done in world of {{hvd.size()}}", flush=True)
+        hvd.shutdown()
+    """))
+
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.elastic.discovery import HostDiscoveryScript
+    driver = ElasticDriver(
+        HostDiscoveryScript(str(disco)), [sys.executable, str(prog)],
+        min_np=2, max_np=3, reset_limit=3, ckpt_dir=str(tmp_path))
+    rc = driver.run()
+    assert rc == 0
+    boots = boot_log.read_text().strip().splitlines()
+    # exactly three process boots: ranks 0,1 once each (NOT restarted on
+    # growth) + the new rank 2
+    assert len(boots) == 3, boots
+    booted_ranks = sorted(line.split()[1] for line in boots)
+    assert booted_ranks == ["rank=0", "rank=1", "rank=2"]
