@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""Bench artifact contract check: bench.py must print exactly one line of
+parseable JSON with the headline metric keys, succeeding (value numeric)
+on TPU and degrading to a diagnostic (value null, error set) elsewhere."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, cwd=REPO, timeout=5400)
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln.strip()]
+    if len(lines) != 1:
+        print(f"expected 1 stdout line, got {len(lines)}:\n{out.stdout}")
+        return 1
+    doc = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "mfu"):
+        if key not in doc:
+            print(f"missing key {key!r} in {doc}")
+            return 1
+    if doc["value"] is None and "error" not in doc:
+        print(f"null value without diagnostic error: {doc}")
+        return 1
+    print(f"bench contract OK: {doc}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
