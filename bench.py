@@ -73,7 +73,11 @@ def _child() -> None:
 
     batch_per_chip = 256
     B = batch_per_chip * n_chips
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    # MLPerf-style space-to-depth stem by default: the 7x7/s2 conv over
+    # C=3 wastes 4x of the MXU's input-channel tiling (docs/PERF.md);
+    # HVD_BENCH_STEM=conv selects the textbook stem for comparison.
+    stem = os.environ.get("HVD_BENCH_STEM", "s2d")
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem=stem)
     params, batch_stats = create_resnet_state(
         model, jax.random.PRNGKey(0), image_size=224, mesh=mesh)
     tx = optax.sgd(0.1, momentum=0.9)
@@ -143,6 +147,7 @@ def _child() -> None:
         "n_chips": n_chips,
         "device_kind": jax.devices()[0].device_kind,
         "batch_per_chip": batch_per_chip,
+        "stem": stem,
     }), flush=True)
 
 

@@ -84,6 +84,11 @@ CoreConfig ParseEnvConfig() {
   cfg.autotune_gp_noise =
       atof(EnvOr("HVD_TPU_AUTOTUNE_GAUSSIAN_PROCESS_NOISE",
                  "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", "1e-6"));
+  cfg.autotune_log =
+      EnvOr("HVD_TPU_AUTOTUNE_LOG", "HOROVOD_AUTOTUNE_LOG", "");
+  cfg.autotune_window_secs =
+      atof(EnvOr("HVD_TPU_AUTOTUNE_WINDOW_SECONDS",
+                 "HOROVOD_AUTOTUNE_WINDOW_SECONDS", "2.0"));
   cfg.rendezvous_timeout_secs =
       atof(EnvOr("HVD_TPU_GLOO_TIMEOUT_SECONDS",
                  "HOROVOD_GLOO_TIMEOUT_SECONDS", "30"));

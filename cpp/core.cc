@@ -182,11 +182,22 @@ double NormCycle(double c) {
 
 void ParameterManager::Enable(int64_t init_fusion, double init_cycle,
                               int warmup_samples, int max_samples,
-                              double gp_noise) {
+                              double gp_noise,
+                              const std::string& log_path,
+                              double window_secs) {
   enabled_ = true;
   warmup_samples_ = warmup_samples;
   max_samples_ = max_samples;
   gp_noise_ = gp_noise;
+  window_secs_ = window_secs;
+  // sample trace (reference: HOROVOD_AUTOTUNE_LOG, parameter_manager.cc
+  // writes a CSV of tried parameters and scores)
+  if (log_) {
+    fclose(log_);  // elastic re-init: close the previous generation's file
+    log_ = nullptr;
+  }
+  if (!log_path.empty()) log_ = fopen(log_path.c_str(), "w");
+  if (log_) fprintf(log_, "sample,fusion_bytes,cycle_ms,bytes_per_sec\n");
   bo_ = std::make_shared<BayesianOptimizer>(2, 17, gp_noise_);
   window_start_ = std::chrono::steady_clock::now();
 }
@@ -197,11 +208,16 @@ bool ParameterManager::Tune(int64_t* fusion_bytes, double* cycle_ms) {
   if (!enabled_) return false;
   auto now = std::chrono::steady_clock::now();
   double secs = std::chrono::duration<double>(now - window_start_).count();
-  if (secs < 2.0) return false;  // scoring window (seconds)
+  if (secs < window_secs_) return false;  // scoring window (seconds)
   double score = bytes_acc_ / secs;
   bytes_acc_ = 0;
   window_start_ = now;
   samples_++;
+  if (log_) {
+    fprintf(log_, "%d,%lld,%g,%g\n", samples_,
+            (long long)*fusion_bytes, *cycle_ms, score);
+    fflush(log_);
+  }
   // discard warmup samples (reference: AUTOTUNE_WARMUP_SAMPLES) so
   // startup transients don't poison the GP
   if (samples_ <= warmup_samples_) return false;
@@ -284,7 +300,13 @@ Status Core::Init(const CoreConfig& cfg) {
   if (cfg.autotune)
     param_mgr_.Enable(cfg.fusion_threshold, cfg.cycle_time_ms,
                       cfg.autotune_warmup_samples,
-                      cfg.autotune_max_samples, cfg.autotune_gp_noise);
+                      cfg.autotune_max_samples, cfg.autotune_gp_noise,
+                      // only the coordinator tunes (Tune() is rank-0-
+                      // gated); a worker opening the same path would
+                      // truncate the coordinator's trace on shared
+                      // filesystems
+                      cfg.rank == 0 ? cfg.autotune_log : std::string(),
+                      cfg.autotune_window_secs);
 
   auto global = std::unique_ptr<CoordDomain>(new CoordDomain());
   global->id = 0;

@@ -94,9 +94,13 @@ class BayesianOptimizer;
 
 class ParameterManager {
  public:
+  ~ParameterManager() {
+    if (log_) fclose(log_);
+  }
   void Enable(int64_t init_fusion, double init_cycle,
               int warmup_samples = 3, int max_samples = 24,
-              double gp_noise = 1e-6);
+              double gp_noise = 1e-6, const std::string& log_path = "",
+              double window_secs = 2.0);
   bool enabled() const { return enabled_; }
   void Record(int64_t bytes);
   // maybe update params; returns true if changed
@@ -110,6 +114,8 @@ class ParameterManager {
   int warmup_samples_ = 3;
   int max_samples_ = 24;
   double gp_noise_ = 1e-6;
+  double window_secs_ = 2.0;
+  FILE* log_ = nullptr;
   std::shared_ptr<BayesianOptimizer> bo_;
 };
 
@@ -139,6 +145,8 @@ struct CoreConfig {
   int autotune_warmup_samples = 3;
   int autotune_max_samples = 24;       // BAYES_OPT_MAX_SAMPLES analog
   double autotune_gp_noise = 1e-6;     // GAUSSIAN_PROCESS_NOISE analog
+  double autotune_window_secs = 2.0;   // scoring window per sample
+  std::string autotune_log;            // AUTOTUNE_LOG sample trace file
   double rendezvous_timeout_secs = 30.0;  // GLOO_TIMEOUT_SECONDS analog
   int thread_affinity = -1;            // pin background loop to this CPU
   bool timeline_mark_cycles = false;

@@ -49,15 +49,40 @@ class BottleneckBlock(nn.Module):
         return nn.relu(y + residual)
 
 
+def space_to_depth(x: jax.Array, block: int = 2) -> jax.Array:
+    """[B, H, W, C] -> [B, H/b, W/b, C*b*b] (pixel-shuffle inverse)."""
+    B, H, W, C = x.shape
+    x = x.reshape(B, H // block, block, W // block, block, C)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        B, H // block, W // block, C * block * block)
+
+
 class ResNet(nn.Module):
+    """``stem="conv"`` is the textbook 7x7/s2 stem. ``stem="s2d"`` is the
+    MLPerf-TPU space-to-depth stem: the 7x7/s2 conv over C=3 tiles the MXU
+    terribly (3 input channels against a 128-wide systolic array);
+    space-to-depth(2) turns it into a 4x4/s1 conv over 12 channels with
+    the same receptive field and output shape, cutting the stem's padding
+    waste 4x.
+    """
+
     stage_sizes: Sequence[int]
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
+    stem: str = "conv"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
-                    use_bias=False, dtype=self.dtype)(x)
+        if self.stem == "s2d":
+            x = space_to_depth(x, 2)  # [B, 112, 112, 12]
+            x = nn.Conv(64, (4, 4), (1, 1), padding="SAME",
+                        use_bias=False, dtype=self.dtype)(x)
+        elif self.stem == "conv":
+            x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                        use_bias=False, dtype=self.dtype)(x)
+        else:
+            raise ValueError(
+                f"unknown stem {self.stem!r}; expected 'conv' or 's2d'")
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-5, dtype=self.dtype)(x)
         x = nn.relu(x)
@@ -72,12 +97,14 @@ class ResNet(nn.Module):
         return x
 
 
-def ResNet50(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
-    return ResNet([3, 4, 6, 3], num_classes, dtype)
+def ResNet50(num_classes: int = 1000, dtype=jnp.bfloat16,
+             stem: str = "conv") -> ResNet:
+    return ResNet([3, 4, 6, 3], num_classes, dtype, stem)
 
 
-def ResNet101(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
-    return ResNet([3, 4, 23, 3], num_classes, dtype)
+def ResNet101(num_classes: int = 1000, dtype=jnp.bfloat16,
+              stem: str = "conv") -> ResNet:
+    return ResNet([3, 4, 23, 3], num_classes, dtype, stem)
 
 
 def create_resnet_state(model: ResNet, rng_key, image_size: int = 224,

@@ -288,3 +288,4 @@ def DistributedOptimizer(optimizer, op: ReduceOp = Average,
 
 from horovod_tpu.tensorflow.sync_batch_norm import (  # noqa: E402,F401
     SyncBatchNormalization)
+from horovod_tpu.tensorflow import elastic  # noqa: E402,F401

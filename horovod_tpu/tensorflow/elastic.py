@@ -1,0 +1,69 @@
+"""Elastic state for TF/Keras training.
+
+Reference: ``horovod/tensorflow/elastic.py`` (``TensorFlowKerasState`` /
+``TensorFlowState``: snapshot + broadcast-based sync of variables). Same
+pattern as the torch adapter's ``TorchState``: model weights (and keras
+optimizer variables) are snapshotted WITH the scalar attributes as one
+commit/restore/sync unit, persisted across generation restarts when the
+elastic driver manages the job.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from horovod_tpu.elastic import ObjectState, run  # noqa: F401 (re-export)
+
+
+def _opt_vars(optimizer):
+    if optimizer is None:
+        return None
+    v = getattr(optimizer, "variables", None)
+    if v is None:
+        return None
+    return v() if callable(v) else v
+
+
+class TensorFlowKerasState(ObjectState):
+    """Reference: ``TensorFlowKerasState`` (``tensorflow/elastic.py``)."""
+
+    def __init__(self, model, optimizer=None,
+                 name: str = "tf_keras_state", **kwargs) -> None:
+        self._model = model
+        self._optimizer = optimizer
+        super().__init__(name=name, keras_snaps=self._capture(), **kwargs)
+        self._apply(self.keras_snaps)
+
+    def _capture(self) -> dict:
+        opt = _opt_vars(self._optimizer)
+        return dict(
+            weights=[np.asarray(w) for w in self._model.get_weights()],
+            opt_weights=[np.asarray(v) for v in opt] if opt else None)
+
+    def _apply(self, snaps: dict) -> None:
+        if snaps.get("weights"):
+            self._model.set_weights(snaps["weights"])
+        opt = _opt_vars(self._optimizer)
+        if snaps.get("opt_weights") and opt:
+            for var, val in zip(opt, snaps["opt_weights"]):
+                if tuple(var.shape) == np.asarray(val).shape:
+                    var.assign(val)
+
+    def save(self) -> None:
+        self.keras_snaps = self._capture()
+        super().save()
+
+    def restore(self) -> None:
+        super().restore()
+        self._apply(self.keras_snaps)
+
+    def sync(self) -> None:
+        # rank 0's live weights are the source of truth; ObjectState.sync
+        # broadcasts the snapshot dict together with the scalars
+        self.keras_snaps = self._capture()
+        super().sync()
+        self._apply(self.keras_snaps)
+
+
+# compat alias matching the reference's non-keras name
+TensorFlowState = TensorFlowKerasState

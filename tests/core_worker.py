@@ -117,6 +117,19 @@ def main():
             last = be.join()
         assert isinstance(last, int)
 
+    # sustained traffic window (autotune tests need enough seconds of
+    # scored collectives for samples to land)
+    extra = float(os.environ.get("HVD_TEST_TRAFFIC_SECONDS", "0"))
+    if extra > 0:
+        import time
+        deadline = time.monotonic() + extra
+        i = 0
+        while time.monotonic() < deadline:
+            be.allreduce_async(f"traffic.{i}",
+                               np.ones(4096, np.float32),
+                               ReduceOp.SUM).wait()
+            i += 1
+
     be.shutdown()
     print(f"worker {rank}: OK")
 

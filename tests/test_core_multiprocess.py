@@ -144,10 +144,19 @@ def test_adasum_vhdd(size):
 
 
 @needs_core
-def test_core_with_autotune():
+def test_core_with_autotune(tmp_path):
     """Autotune enabled: collectives stay correct while the coordinator's
-    GP tuner runs (coordinator-only; threshold broadcast with responses)."""
-    _launch(2, {"HVD_TPU_AUTOTUNE": "1", "HVD_TPU_CYCLE_TIME": "0.5"})
+    GP tuner runs (coordinator-only; threshold broadcast with responses);
+    HOROVOD_AUTOTUNE_LOG records the sample trace."""
+    log = str(tmp_path / "autotune.csv")
+    _launch(2, {"HVD_TPU_AUTOTUNE": "1", "HVD_TPU_CYCLE_TIME": "0.5",
+                "HOROVOD_AUTOTUNE_WINDOW_SECONDS": "0.3",
+                "HVD_TEST_TRAFFIC_SECONDS": "1.5",
+                "HOROVOD_AUTOTUNE_LOG": log})
+    with open(log) as f:
+        lines = f.read().strip().splitlines()
+    assert lines[0].startswith("sample,fusion_bytes,cycle_ms")
+    assert len(lines) >= 2, lines  # at least one recorded sample
 
 
 @needs_core

@@ -1,0 +1,62 @@
+"""ResNet model family tests (reference analog: the synthetic benchmark
+models in examples/; here unit-level so the bench harness model is
+covered off-TPU), including the MLPerf-style space-to-depth stem."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import optax
+
+from horovod_tpu.models.resnet import (ResNet, ResNet50, batch_sharding,
+                                       create_resnet_state,
+                                       make_resnet_train_step,
+                                       space_to_depth)
+
+
+def test_space_to_depth_layout():
+    x = jnp.arange(2 * 4 * 4 * 3, dtype=jnp.float32).reshape(2, 4, 4, 3)
+    y = space_to_depth(x, 2)
+    assert y.shape == (2, 2, 2, 12)
+    # block (0,0) of image 0: pixels (0,0),(0,1),(1,0),(1,1) channel-major
+    np.testing.assert_array_equal(
+        np.asarray(y)[0, 0, 0],
+        np.concatenate([np.asarray(x)[0, 0, 0], np.asarray(x)[0, 0, 1],
+                        np.asarray(x)[0, 1, 0], np.asarray(x)[0, 1, 1]]))
+
+
+@pytest.mark.parametrize("stem", ["conv", "s2d"])
+def test_resnet_stems_same_geometry(stem):
+    """Both stems produce the identical downstream geometry (112x112x64
+    after the stem at 224 input; logits shape equal)."""
+    model = ResNet([1, 1, 1, 1], num_classes=10, dtype=jnp.float32,
+                   stem=stem)
+    x = jnp.zeros((2, 64, 64, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    logits, _ = model.apply(variables, x, train=True,
+                            mutable=["batch_stats"])
+    assert logits.shape == (2, 10)
+
+
+def test_resnet_s2d_trains(hvd):
+    mesh = hvd.build_mesh(dp=-1)
+    model = ResNet([1, 1, 1, 1], num_classes=8, dtype=jnp.float32,
+                   stem="s2d")
+    params, batch_stats = create_resnet_state(
+        model, jax.random.PRNGKey(0), image_size=64, mesh=mesh)
+    tx = optax.sgd(0.05, momentum=0.9)
+    opt_state = jax.jit(tx.init)(params)
+    step = make_resnet_train_step(model, tx, mesh)
+    rng = np.random.RandomState(0)
+    images = jax.device_put(
+        jnp.asarray(rng.rand(16, 64, 64, 3), jnp.float32),
+        batch_sharding(mesh))
+    labels = jax.device_put(jnp.asarray(rng.randint(0, 8, (16,)), jnp.int32),
+                            batch_sharding(mesh))
+    losses = []
+    for _ in range(5):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels)
+        loss.block_until_ready()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
