@@ -59,15 +59,11 @@ def pipeline_spmd(stage_fn: Callable, stage_params, x_microbatches: jax.Array,
     return lax.psum(ys, axis_name)
 
 
-def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array, mesh: Mesh,
-                   n_microbatches: int, axis_name: str = "pp",
-                   batch_axis: Optional[str] = "dp") -> jax.Array:
-    """Array-level GPipe.
-
-    stage_fn(params_for_one_stage, microbatch) -> microbatch (same shape).
-    stage_params: pytree with leading dim = pp size, sharded over ``pp``.
-    x: ``[T, ...]`` global batch; split into ``n_microbatches``.
-    """
+def _pipeline_prep(stage_params, x: jax.Array, mesh: Mesh,
+                   n_microbatches: int, axis_name: str,
+                   batch_axis: Optional[str]):
+    """Shared validation + microbatching for the array-level schedules:
+    returns (S, xm, b_ax)."""
     from horovod_tpu.parallel.mesh import mesh_axis_size
     S = mesh_axis_size(mesh, axis_name)
     leading = {leaf.shape[0] for leaf in
@@ -77,9 +73,6 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array, mesh: Mesh,
             f"stage_params leading dims {sorted(leading)} must all equal the "
             f"'{axis_name}' mesh axis size ({S}); restack the stages for "
             f"this mesh (stage_stacked) instead of silently dropping some.")
-    if S == 1:
-        one = jax.tree_util.tree_map(lambda p: p[0], stage_params)
-        return stage_fn(one, x)
     T = x.shape[0]
     if T % n_microbatches != 0:
         raise ValueError(f"batch {T} not divisible by microbatches "
@@ -87,6 +80,24 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array, mesh: Mesh,
     xm = x.reshape((n_microbatches, T // n_microbatches) + x.shape[1:])
     b_ax = batch_axis if (batch_axis and mesh_axis_size(mesh, batch_axis) > 1) \
         else None
+    return S, xm, b_ax
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array, mesh: Mesh,
+                   n_microbatches: int, axis_name: str = "pp",
+                   batch_axis: Optional[str] = "dp") -> jax.Array:
+    """Array-level GPipe.
+
+    stage_fn(params_for_one_stage, microbatch) -> microbatch (same shape).
+    stage_params: pytree with leading dim = pp size, sharded over ``pp``.
+    x: ``[T, ...]`` global batch; split into ``n_microbatches``.
+    """
+    S, xm, b_ax = _pipeline_prep(stage_params, x, mesh, n_microbatches,
+                                 axis_name, batch_axis)
+    if S == 1:
+        one = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+        return stage_fn(one, x)
+    T = x.shape[0]
     x_spec = P(None, b_ax)
     out_spec = P(None, b_ax)
 
@@ -105,3 +116,127 @@ def stage_stacked(params_per_stage: list):
     layout ``pipeline_apply`` expects (shard the result over ``pp``)."""
     return jax.tree_util.tree_map(
         lambda *leaves: jnp.stack(leaves), *params_per_stage)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B-family schedule: eager backward with bounded activation memory
+# ---------------------------------------------------------------------------
+
+def pipeline_1f1b_spmd(stage_fn: Callable, loss_fn: Callable, stage_params,
+                       x_microbatches: jax.Array, targets: jax.Array,
+                       axis_name: str = "pp"):
+    """Forward AND backward in one compiled schedule with backward starting
+    as soon as each microbatch clears the last stage (1F1B family; GPipe
+    runs all M forwards first, so its live-activation set grows with M).
+
+    Memory: each stage stores only the INPUTS of its in-flight
+    microbatches — a ring of ``min(2S-1, M)`` entries — and rematerializes
+    the stage forward inside the backward tick (``jax.vjp``), the standard
+    TPU recompute trade. GPipe-by-autodiff (differentiating
+    :func:`pipeline_spmd`) keeps all ``M`` per-tick residuals live.
+
+    Schedule (full tick t = one forward phase + one backward phase):
+    stage s runs forward of microbatch ``t - s`` and backward of
+    microbatch ``t - (2S - 2 - s)``; the last stage seeds the loss
+    gradient in the same tick its forward completes. Total ticks:
+    ``M + 2S - 2``.
+
+    Returns ``(mean_loss, grads)`` where grads has this stage's parameter
+    gradients (summed over microbatches, caller scales).
+    """
+    S = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    M = x_microbatches.shape[0]
+    my_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    D = min(2 * S - 1, M)
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+    mb_shape = x_microbatches.shape[1:]
+
+    def tick(carry, t):
+        fwd_act, bwd_grad, in_buf, grad_acc, loss_acc = carry
+        # ---- forward phase -------------------------------------------------
+        prev = lax.ppermute(fwd_act, axis_name, fwd_perm)
+        m_f = t - stage
+        f_valid = (m_f >= 0) & (m_f < M)
+        mf_c = jnp.clip(m_f, 0, M - 1)
+        x_in = jnp.where(stage == 0, x_microbatches[mf_c], prev)
+        out = stage_fn(my_params, x_in)
+        slot_f = mf_c % D
+        in_buf = in_buf.at[slot_f].set(
+            jnp.where(f_valid, x_in, in_buf[slot_f]))
+        # last stage: loss value + gradient seed for the SAME-tick backward
+        tgt = targets[mf_c]
+        loss_m, g_seed = jax.value_and_grad(
+            lambda y: loss_fn(y, tgt))(out)
+        loss_acc = loss_acc + jnp.where(
+            (stage == S - 1) & f_valid, loss_m, 0.0)
+
+        # ---- backward phase ------------------------------------------------
+        g_in = lax.ppermute(bwd_grad, axis_name, bwd_perm)  # from s+1
+        m_b = t - (2 * S - 2 - stage)
+        b_valid = (m_b >= 0) & (m_b < M)
+        mb_c = jnp.clip(m_b, 0, M - 1)
+        x_b = in_buf[mb_c % D]
+        g_out = jnp.where(stage == S - 1, g_seed, g_in)
+        _, pullback = jax.vjp(stage_fn, my_params, x_b)  # remat forward
+        g_params, g_x = pullback(g_out)
+        grad_acc = jax.tree_util.tree_map(
+            lambda a, g: a + jnp.where(b_valid, g, jnp.zeros_like(g)),
+            grad_acc, g_params)
+        bwd_next = jnp.where(b_valid, g_x, jnp.zeros_like(g_x))
+        return (out, bwd_next, in_buf, grad_acc, loss_acc), None
+
+    carry0 = (jnp.zeros(mb_shape, x_microbatches.dtype),
+              jnp.zeros(mb_shape, x_microbatches.dtype),
+              jnp.zeros((D,) + mb_shape, x_microbatches.dtype),
+              jax.tree_util.tree_map(jnp.zeros_like, my_params),
+              jnp.asarray(0.0, jnp.float32))
+    (_, _, _, grads, loss_sum), _ = lax.scan(
+        tick, carry0, jnp.arange(M + 2 * S - 2))
+    # every shard returns the mean loss (only the last stage accumulated)
+    mean_loss = lax.psum(loss_sum, axis_name) / M
+    return mean_loss, grads
+
+
+def pipeline_1f1b_apply(stage_fn: Callable, loss_fn: Callable, stage_params,
+                        x: jax.Array, targets: jax.Array, mesh: Mesh,
+                        n_microbatches: int, axis_name: str = "pp",
+                        batch_axis: Optional[str] = "dp"):
+    """Array-level 1F1B: returns ``(mean_loss, grads)`` with grads in the
+    same stage-stacked layout as ``stage_params`` (per-microbatch-mean
+    scale, matching ``jax.grad`` of the mean loss)."""
+    S, xm, b_ax = _pipeline_prep(stage_params, x, mesh, n_microbatches,
+                                 axis_name, batch_axis)
+    T = x.shape[0]
+    tm = targets.reshape((n_microbatches, T // n_microbatches)
+                         + targets.shape[1:])
+    if S == 1:
+        one = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+
+        def total(p):
+            losses = jax.vmap(lambda xb, tb: loss_fn(stage_fn(p, xb), tb))(
+                xm, tm)
+            return losses.mean()
+        loss, g = jax.value_and_grad(total)(one)
+        return loss, jax.tree_util.tree_map(lambda v: v[None], g)
+    data_spec = P(None, b_ax)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(axis_name), data_spec, data_spec),
+                       out_specs=(P(), P(axis_name)), check_vma=False)
+    def run(params_l, xm_l, tm_l):
+        loss, grads = pipeline_1f1b_spmd(stage_fn, loss_fn, params_l,
+                                         xm_l, tm_l, axis_name)
+        # per-microbatch mean -> same scale as jax.grad of the mean loss;
+        # with a sharded batch axis the per-shard loss_fn already averaged
+        # over local rows, so also average gradients across it
+        grads = jax.tree_util.tree_map(lambda g: g[None] / n_microbatches,
+                                       grads)
+        if b_ax is not None:
+            loss = lax.pmean(loss, b_ax)
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, b_ax), grads)
+        return loss, grads
+
+    return run(stage_params, xm, tm)

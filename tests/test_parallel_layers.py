@@ -141,3 +141,61 @@ def test_pipeline_bad_microbatch_count():
     with pytest.raises(ValueError):
         pipeline_apply(_stage_fn, p, jnp.ones((10, 4)), mesh,
                        n_microbatches=3)
+
+
+def _mse_loss(y, t):
+    return jnp.mean((y - t) ** 2)
+
+
+@pytest.mark.parametrize("pp,dp,n_mb", [(4, 2, 8), (2, 4, 3), (8, 1, 8)])
+def test_pipeline_1f1b_matches_jax_grad(pp, dp, n_mb):
+    """The 1F1B schedule's loss AND gradients must equal jax.grad of the
+    sequentially applied stages (incl. M not a multiple of S, and a
+    sharded batch axis)."""
+    from horovod_tpu.parallel.pipeline import pipeline_1f1b_apply
+    H = 8
+    T = n_mb * 4
+    rng = np.random.RandomState(7)
+    stages = [{"w": jnp.asarray(rng.randn(H, H), jnp.float32) * 0.4,
+               "b": jnp.asarray(rng.randn(H), jnp.float32) * 0.1}
+              for _ in range(pp)]
+    x = jnp.asarray(rng.randn(T, H), jnp.float32)
+    tgt = jnp.asarray(rng.randn(T, H), jnp.float32)
+
+    def oracle(stacked):
+        xm = x.reshape(n_mb, T // n_mb, H)
+        tm = tgt.reshape(n_mb, T // n_mb, H)
+
+        def one_mb(xb, tb):
+            h = xb
+            for s in range(pp):
+                h = _stage_fn(jax.tree_util.tree_map(
+                    lambda p: p[s], stacked), h)
+            return _mse_loss(h, tb)
+        return jax.vmap(one_mb)(xm, tm).mean()
+
+    stacked = stage_stacked(stages)
+    ref_loss, ref_grads = jax.value_and_grad(oracle)(stacked)
+
+    mesh = build_mesh(dp=dp, pp=pp)
+    loss, grads = pipeline_1f1b_apply(
+        _stage_fn, _mse_loss, stacked, x, tgt, mesh, n_microbatches=n_mb)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for g, rg in zip(jax.tree_util.tree_leaves(grads),
+                     jax.tree_util.tree_leaves(ref_grads)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(rg),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_1f1b_pp1_fast_path():
+    from horovod_tpu.parallel.pipeline import pipeline_1f1b_apply
+    rng = np.random.RandomState(9)
+    p = stage_stacked([{"w": jnp.asarray(rng.randn(6, 6), jnp.float32),
+                        "b": jnp.zeros(6, jnp.float32)}])
+    x = jnp.asarray(rng.randn(8, 6), jnp.float32)
+    tgt = jnp.asarray(rng.randn(8, 6), jnp.float32)
+    mesh = build_mesh(dp=8)
+    loss, grads = pipeline_1f1b_apply(_stage_fn, _mse_loss, p, x, tgt,
+                                      mesh, n_microbatches=2)
+    assert np.isfinite(float(loss))
+    assert jax.tree_util.tree_leaves(grads)[0].shape[0] == 1
