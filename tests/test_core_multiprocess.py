@@ -22,6 +22,7 @@ JIT_SYNC_WORKER = os.path.join(os.path.dirname(__file__),
                                "jit_sync_worker.py")
 MATRIX_WORKER = os.path.join(os.path.dirname(__file__), "matrix_worker.py")
 STALL_WORKER = os.path.join(os.path.dirname(__file__), "stall_worker.py")
+TORCH_WORKER = os.path.join(os.path.dirname(__file__), "torch_worker.py")
 
 
 def _free_port():
@@ -118,6 +119,14 @@ def test_stall_shutdown_errors_waiters():
     _launch(2, {"HOROVOD_STALL_CHECK_TIME_SECONDS": "1",
                 "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "4"},
             timeout=180, worker=STALL_WORKER)
+
+
+@needs_core
+def test_torch_adapter_multiprocess():
+    """Torch drop-in at size 2: dense + sparse allreduce and
+    DistributedOptimizer equivalence to full-batch single-process SGD
+    (reference analog: test/parallel/test_torch.py)."""
+    _launch(2, timeout=480, worker=TORCH_WORKER)
 
 
 @needs_core

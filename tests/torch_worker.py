@@ -1,0 +1,77 @@
+"""Multi-process torch drop-in worker (reference analog: the torch cases
+of test/parallel/test_torch.py under horovodrun): eager collectives,
+sparse allreduce, and DistributedOptimizer equivalence to single-process
+full-batch training."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+
+import horovod_tpu.torch as hvd  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    hvd.init()
+    assert hvd.rank() == rank and hvd.size() == size
+
+    # dense allreduce
+    out = hvd.allreduce(torch.arange(6, dtype=torch.float32) + rank,
+                        op=hvd.Sum, name="d")
+    expect = sum(torch.arange(6, dtype=torch.float32) + r
+                 for r in range(size))
+    assert torch.allclose(out, expect), (out, expect)
+
+    # sparse allreduce: overlapping + disjoint coordinates across ranks
+    i = torch.tensor([[0, rank + 1], [0, 0]])
+    v = torch.tensor([1.0, 2.0])
+    sp = torch.sparse_coo_tensor(i, v, (size + 2, 2))
+    handle = hvd.sparse_allreduce_async(sp, name="sp", op=hvd.Sum)
+    dense = hvd.synchronize(handle).to_dense()
+    expect = torch.zeros(size + 2, 2)
+    expect[0, 0] = float(size)          # every rank contributed 1.0 there
+    for r in range(size):
+        expect[r + 1, 0] += 2.0         # each rank's private coordinate
+    assert torch.allclose(dense, expect), (dense, expect)
+
+    # DistributedOptimizer: equal shards => identical to full-batch SGD
+    torch.manual_seed(0)
+    model = hvd.broadcast_object(torch.nn.Linear(4, 1), 0, name="m")
+    ref = torch.nn.Linear(4, 1)
+    ref.load_state_dict(model.state_dict())
+    rng = np.random.RandomState(0)
+    X = torch.from_numpy(rng.randn(8 * size, 4).astype(np.float32))
+    Y = torch.from_numpy(rng.randn(8 * size, 1).astype(np.float32))
+    mine = slice(rank * 8, (rank + 1) * 8)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    ref_opt = torch.optim.SGD(ref.parameters(), lr=0.1)
+    for step in range(5):
+        opt.zero_grad()
+        torch.nn.functional.mse_loss(model(X[mine]), Y[mine]).backward()
+        opt.step()
+        ref_opt.zero_grad()
+        torch.nn.functional.mse_loss(ref(X), Y).backward()
+        ref_opt.step()
+    for a, b in zip(model.parameters(), ref.parameters()):
+        assert torch.allclose(a, b, atol=1e-5), (a, b)
+
+    hvd.barrier()
+    hvd.shutdown()
+    print(f"torch worker {rank}: OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
