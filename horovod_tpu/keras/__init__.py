@@ -37,14 +37,30 @@ def DistributedOptimizer(optimizer, op=None, compression=None,
         _hvd_sync = None
 
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
-            gv = list(grads_and_vars)
-            grads = self._hvd_sync._sync([g for g, _ in gv])
-            return super().apply_gradients(
-                list(zip(grads, [v for _, v in gv])), *args, **kwargs)
+            # sync (+ accumulation when backward_passes_per_step > 1, incl.
+            # the tf.function/graph path) lives in the TF helper; its _opt
+            # shim applies via THIS instance's base class so keras variable
+            # state stays consistent
+            return self._hvd_sync.apply_gradients(
+                list(grads_and_vars), *args, **kwargs)
 
     _KerasDistributed.__name__ = "Distributed" + cls.__name__
     dist = _KerasDistributed.from_config(optimizer.get_config())
     dist._hvd_sync = sync
+
+    class _SuperApply:
+        """Routes the helper's final apply to the base-class method of the
+        keras-registered instance (not the detached original optimizer);
+        other attribute access falls through to that instance so the
+        helper's __getattr__ proxy contract keeps working."""
+
+        def apply_gradients(self, gv, *args, **kwargs):
+            return cls.apply_gradients(dist, list(gv), *args, **kwargs)
+
+        def __getattr__(self, item):
+            return getattr(dist, item)
+
+    sync._opt = _SuperApply()
     return dist
 
 

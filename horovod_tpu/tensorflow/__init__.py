@@ -222,7 +222,7 @@ class _DistributedOptimizer:
             o.set_shape(g.shape)
         return list(flat)
 
-    def apply_gradients(self, grads_and_vars, **kwargs):
+    def apply_gradients(self, grads_and_vars, *args, **kwargs):
         gv = list(grads_and_vars)
         grads = [g for g, _ in gv]
         tvars = [v for _, v in gv]
@@ -232,7 +232,7 @@ class _DistributedOptimizer:
             tf = _tf()
             if not tf.executing_eagerly():
                 return self._graph_accumulate_apply(tf, grads, tvars,
-                                                    kwargs)
+                                                    args, kwargs)
             gn = [_to_np(g) for g in grads]
             self._acc = gn if self._acc is None else \
                 [a + b for a, b in zip(self._acc, gn)]
@@ -243,9 +243,9 @@ class _DistributedOptimizer:
                      for a, g in zip(self._acc, grads)]
             self._acc, self._pass = None, 0
         grads = self._sync(grads)
-        return self._opt.apply_gradients(zip(grads, tvars), **kwargs)
+        return self._opt.apply_gradients(zip(grads, tvars), *args, **kwargs)
 
-    def _graph_accumulate_apply(self, tf, grads, tvars, kwargs):
+    def _graph_accumulate_apply(self, tf, grads, tvars, args, kwargs):
         """tf.function-compatible accumulation: aggregation variables +
         tf.cond applying every k-th call (reference:
         ``gradient_aggregation.py`` graph-mode helper)."""
@@ -266,7 +266,7 @@ class _DistributedOptimizer:
             avg = [tf.cast(v.read_value(), g.dtype) / float(k)
                    for v, g in zip(self._agg_vars, grads)]
             synced = self._sync(avg)
-            self._opt.apply_gradients(zip(synced, tvars), **kwargs)
+            self._opt.apply_gradients(zip(synced, tvars), *args, **kwargs)
             resets = [v.assign(tf.zeros_like(v)) for v in self._agg_vars]
             with tf.control_dependencies(resets):
                 return tf.constant(True)

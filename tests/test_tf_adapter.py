@@ -109,6 +109,25 @@ def test_tf_sync_batch_norm(tfhvd):
     assert np.all(np.isfinite(np.asarray(y2)))
 
 
+def test_keras_optimizer_backward_passes(tfhvd):
+    """hvd.keras.DistributedOptimizer must honor backward_passes_per_step
+    (it used to silently ignore it), including under tf.function."""
+    import horovod_tpu.keras as khvd
+    w = tf.Variable([0.0])
+    opt = khvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=1.0),
+        backward_passes_per_step=2)
+
+    @tf.function
+    def step(g):
+        opt.apply_gradients([(g, w)])
+
+    step(tf.constant([1.0]))
+    np.testing.assert_allclose(w.numpy(), [0.0])  # accumulating
+    step(tf.constant([3.0]))
+    np.testing.assert_allclose(w.numpy(), [-2.0])  # mean(1,3) applied
+
+
 def test_tf_keras_elastic_state(tfhvd, tmp_path, monkeypatch):
     """TensorFlowKerasState snapshots/restores model+optimizer weights as
     one unit (reference: tensorflow/elastic.py)."""
