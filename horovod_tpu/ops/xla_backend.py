@@ -490,6 +490,14 @@ class XlaBackend(Backend):
             self._group, np.zeros(1, np.float32), ReduceOp.SUM,
             1.0, 1.0)).wait()
 
+    def join(self, device: int = -1) -> int:
+        raise NotImplementedError(
+            "hvd.join() requires dynamic negotiation (ranks submit different "
+            "collective sequences by definition), which the same-order XLA "
+            "eager data plane cannot provide; use the TCP core backend "
+            "(unset HOROVOD_TPU_OPERATIONS) for join-style uneven data, or "
+            "pad batches so every rank runs the same steps")
+
     def make_subset(self, ranks: Sequence[int]):
         """Per-set sub-mesh + program cache (reference: per-set NCCL comms,
         ``nccl_operations.cc:65-107``). Shares this backend's dispatch

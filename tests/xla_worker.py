@@ -126,6 +126,14 @@ def main():
     np.testing.assert_allclose(np.asarray(rs),
                                np.ones((2, 3)) * sum(r + 1 for r in range(size)))
 
+    # join needs negotiation: must raise with a pointer to the core, not
+    # silently pretend to work
+    try:
+        hvd.join()
+        raise AssertionError("join must raise on the XLA eager backend")
+    except NotImplementedError:
+        pass
+
     hvd.barrier()
     hvd.shutdown()
     print(f"xla worker {rank}: OK", flush=True)
