@@ -1,0 +1,61 @@
+"""Distributed training on a Ray cluster via RayExecutor.
+
+Run (requires ray):  python examples/ray/ray_mnist.py
+
+Reference analog: ``examples/ray/tensorflow2_mnist_ray.py`` /
+``basic_ray_elastic.py`` — the executor places one worker per slot on the
+Ray cluster, wires the coordinator address through Ray actors, and runs
+the training function on every rank. The training function itself is the
+same JAX data-parallel loop as ``examples/jax/mnist_dp.py``.
+"""
+
+
+def train_fn():
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(32, 10)
+    x = rng.randn(2048, 32).astype(np.float32)
+    y = (x @ w_true).argmax(-1).astype(np.int32)
+    shard = slice(max(hvd.rank(), 0), None, hvd.size())
+    x, y = x[shard], y[shard]
+
+    params = {"w": jnp.zeros((32, 10))}
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    tx = hvd.DistributedOptimizer(optax.adam(1e-2))
+    opt_state = tx.init(params)
+
+    import jax
+    loss_fn = jax.jit(lambda p, xb, yb: optax.softmax_cross_entropy(
+        xb @ p["w"], jax.nn.one_hot(yb, 10)).mean())
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    loss = None
+    for step in range(100):
+        loss, grads = grad_fn(params, jnp.asarray(x), jnp.asarray(y))
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+    out = float(hvd.allreduce(loss, name="loss"))
+    if hvd.rank() == 0:
+        print(f"final loss {out:.4f}")
+    hvd.shutdown()
+    return out
+
+
+def main():
+    from horovod_tpu.ray import RayExecutor
+
+    executor = RayExecutor(num_workers=2, cpus_per_worker=1)
+    executor.start()
+    results = executor.run(train_fn)
+    print(f"per-rank results: {results}")
+    executor.shutdown()
+
+
+if __name__ == "__main__":
+    main()

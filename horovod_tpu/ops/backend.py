@@ -120,8 +120,17 @@ class Backend(abc.ABC):
 
     def join(self, device: int = -1) -> int:
         """Reference Join op (``EnqueueJoin``, ``operations.cc:1714-1742``):
-        declare this rank out of data; returns rank of the last joiner."""
-        return self.size - 1
+        declare this rank out of data; returns rank of the last joiner.
+
+        Raises by default: join needs dynamic negotiation, and a backend
+        that silently pretends to support it deadlocks the OTHER ranks
+        (they keep waiting for collectives the joined rank never submits).
+        Backends that can negotiate (CoreBackend) or where join is trivial
+        (LocalBackend) override this."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support hvd.join(); use the "
+            "TCP core backend (unset HOROVOD_TPU_OPERATIONS) for "
+            "join-style uneven data")
 
     # -- lifecycle ----------------------------------------------------------
     @abc.abstractmethod
@@ -194,6 +203,9 @@ class LocalBackend(Backend):
 
     def barrier(self) -> None:
         return
+
+    def join(self, device: int = -1) -> int:
+        return 0  # sole contributor: this rank is the last joiner
 
     def make_subset(self, ranks):
         return LocalBackend(0, 1)

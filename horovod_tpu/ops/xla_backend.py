@@ -537,6 +537,10 @@ class _XlaSubsetBackend(Backend):
                 f"{self._ranks} and cannot submit collectives to it")
         return self._group
 
+    def join(self, device: int = -1) -> int:
+        # same-order data plane: join is as impossible per-set as globally
+        return self._parent.join(device)
+
     def allreduce_async(self, name, value, op, prescale=1.0, postscale=1.0):
         g = self._require_member()
         return self._parent._submit(lambda: self._parent._allreduce(

@@ -73,6 +73,16 @@ def main():
                       root_rank=root, name="ps.bc", process_set=mine)
     np.testing.assert_allclose(np.asarray(b), float(root))
 
+    # per-set join must reject on the same-order XLA data plane (the
+    # subset backend shares the global backend's no-negotiation limit)
+    if os.environ.get("HOROVOD_TPU_OPERATIONS", "").upper() == "XLA_EAGER":
+        from horovod_tpu.ops.collectives import _backend_for
+        try:
+            _backend_for(mine).join()
+            raise AssertionError("subset join must raise on XLA eager")
+        except NotImplementedError:
+            pass
+
     hvd.barrier()
     hvd.shutdown()
     print(f"psets worker {rank}: OK", flush=True)
