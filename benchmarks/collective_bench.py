@@ -1,0 +1,124 @@
+"""Host-plane collective micro-benchmark (nccl-tests / osu-benchmarks
+analog for the TCP core).
+
+Measures allreduce algorithm bandwidth across message sizes and world
+sizes on localhost workers, the way the reference community benchmarks
+its Gloo/MPI CPU path. Algorithm ("bus") bandwidth for ring allreduce is
+``2(n-1)/n * bytes / time`` — the wire traffic each rank actually moves.
+
+Run:    python benchmarks/collective_bench.py [--sizes 2,4,8]
+                                              [--bytes 4096,...,67108864]
+Output: one table row per (world, bytes): latency and busbw, plus a JSON
+summary line at the end for scripting.
+
+This measures the HOST data plane (``cpp/collectives.cc`` over the TCP
+mesh). On TPU the per-step gradient path rides XLA collectives over ICI
+(see ``ops/mesh_collectives.py``); the host plane carries control traffic,
+CPU-resident tensors, and the tests, so its bandwidth still matters.
+"""
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER_BODY = r"""
+import os, sys, time
+sys.path.insert(0, %(repo)r)
+import numpy as np
+from horovod_tpu.core.core_backend import CoreBackend
+from horovod_tpu.ops.reduce_op import ReduceOp
+
+sizes_bytes = [int(s) for s in os.environ["BENCH_BYTES"].split(",")]
+be = CoreBackend()
+out = []
+for nbytes in sizes_bytes:
+    n = max(nbytes // 4, 1)
+    x = np.ones(n, np.float32)
+    # warmup
+    for i in range(3):
+        be.allreduce_async(f"w.{nbytes}.{i}", x, ReduceOp.SUM).wait(120)
+    iters = 10 if nbytes >= 1 << 22 else 30
+    t0 = time.perf_counter()
+    for i in range(iters):
+        be.allreduce_async(f"b.{nbytes}.{i}", x, ReduceOp.SUM).wait(300)
+    dt = (time.perf_counter() - t0) / iters
+    out.append((nbytes, dt))
+if be.rank == 0:
+    for nbytes, dt in out:
+        print(f"RESULT {nbytes} {dt:.6e}", flush=True)
+be.shutdown()
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_world(world: int, sizes_bytes: list) -> dict:
+    port = _free_port()
+    procs = []
+    for rank in range(world):
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": str(world),
+            "HOROVOD_LOCAL_RANK": str(rank),
+            "HOROVOD_LOCAL_SIZE": str(world),
+            "HOROVOD_CROSS_RANK": "0", "HOROVOD_CROSS_SIZE": "1",
+            "HVD_TPU_COORD_ADDR": "127.0.0.1",
+            "HVD_TPU_COORD_PORT": str(port),
+            "BENCH_BYTES": ",".join(str(b) for b in sizes_bytes),
+            "JAX_PLATFORMS": "cpu",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER_BODY % {"repo": REPO}],
+            stdout=subprocess.PIPE if rank == 0 else subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL, text=True, env=env))
+    out, _ = procs[0].communicate(timeout=1200)
+    for p in procs[1:]:
+        p.wait(timeout=60)
+    results = {}
+    for line in out.splitlines():
+        if line.startswith("RESULT "):
+            _, nbytes, dt = line.split()
+            results[int(nbytes)] = float(dt)
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="2,4",
+                    help="comma-separated world sizes")
+    ap.add_argument("--bytes", default=",".join(
+        str(1 << p) for p in range(12, 27, 2)),
+        help="comma-separated message sizes in bytes")
+    args = ap.parse_args()
+    worlds = [int(s) for s in args.sizes.split(",")]
+    sizes_bytes = [int(b) for b in args.bytes.split(",")]
+
+    print(f"{'world':>5} {'bytes':>10} {'latency_us':>11} {'busbw_GB/s':>11}")
+    summary = []
+    for world in worlds:
+        res = run_world(world, sizes_bytes)
+        for nbytes in sizes_bytes:
+            dt = res.get(nbytes)
+            if dt is None:
+                continue
+            busbw = 2 * (world - 1) / world * nbytes / dt / 1e9
+            print(f"{world:>5} {nbytes:>10} {dt * 1e6:>11.1f} "
+                  f"{busbw:>11.3f}")
+            summary.append({"world": world, "bytes": nbytes,
+                            "latency_s": dt, "busbw_gbps": busbw})
+    print(json.dumps({"allreduce_busbw": summary}))
+
+
+if __name__ == "__main__":
+    main()

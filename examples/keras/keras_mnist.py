@@ -45,8 +45,10 @@ def main():
     callbacks = [
         hvd.callbacks.BroadcastGlobalVariablesCallback(0),
         hvd.callbacks.MetricAverageCallback(),
+        # initial_lr is the UNSCALED base rate: the callback itself ramps
+        # base_lr -> base_lr * size over the warmup epochs
         hvd.callbacks.LearningRateWarmupCallback(
-            base_lr * hvd.size(), warmup_epochs=2,
+            base_lr, warmup_epochs=2,
             steps_per_epoch=len(x) // 128, verbose=hvd.rank() == 0),
     ]
     model.fit(x, y, batch_size=128, epochs=4,

@@ -58,6 +58,7 @@ def main():
     batch = 128
     for epoch in range(3):
         perm = torch.randperm(len(x))
+        loss = torch.zeros(())  # shard smaller than one batch: no steps
         for i in range(0, len(x) - batch + 1, batch):
             idx = perm[i:i + batch]
             opt.zero_grad()

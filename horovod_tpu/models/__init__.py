@@ -10,3 +10,29 @@ from horovod_tpu.models.transformer import (  # noqa: F401
     shard_batch,
     data_sharding_spec,
 )
+
+# CNN zoo (the reference's published benchmark models) + BERT are imported
+# lazily by path — `horovod_tpu.models.{resnet,vgg,inception,bert}` — to
+# keep `import horovod_tpu` light. Every entry is a "module:constructor"
+# returning a model/config object when called with no arguments; resolve
+# with `get_model(name)`. (The flagship dp/pp/tp/sp/ep transformer is
+# config-driven — see `TransformerConfig` above — and not in this index.)
+MODEL_ZOO = {
+    "resnet50": "horovod_tpu.models.resnet:ResNet50",
+    "resnet101": "horovod_tpu.models.resnet:ResNet101",
+    "vgg16": "horovod_tpu.models.vgg:VGG16",
+    "inception3": "horovod_tpu.models.inception:InceptionV3",
+    "bert_large": "horovod_tpu.models.bert:bert_large",
+    "bert_base": "horovod_tpu.models.bert:bert_base",
+}
+
+
+def get_model(name: str, **kwargs):
+    """Resolve a MODEL_ZOO entry to its constructed model/config."""
+    import importlib
+    try:
+        module, attr = MODEL_ZOO[name].split(":")
+    except KeyError:
+        raise KeyError(f"unknown model {name!r}; known: "
+                       f"{sorted(MODEL_ZOO)}") from None
+    return getattr(importlib.import_module(module), attr)(**kwargs)

@@ -1,0 +1,86 @@
+"""VGG-16 — the third model in the reference's published benchmark table
+(reference: ``docs/benchmarks.rst:13-14`` — 68% scaling efficiency at 512
+GPUs; its lower efficiency comes from the huge FC layers' gradient volume,
+which makes it the stress case for gradient-allreduce bandwidth).
+
+TPU-native: flax in bf16, NHWC, data-parallel GSPMD-auto like the ResNet
+family. The 4096-wide FC matmuls land squarely on the MXU, so on TPU this
+model is compute-friendly; it remains the gradient-bandwidth stress test
+(~138M params → ~276 MB of bf16 gradients per step vs ResNet-50's ~51 MB).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+# (convs per stage, channels) — the classic "D" configuration
+VGG16_STAGES: Sequence = ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512))
+
+
+class VGG(nn.Module):
+    stages: Sequence = VGG16_STAGES
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, kernel_size=(3, 3),
+                                 padding="SAME", dtype=self.dtype)
+        for n_convs, ch in self.stages:
+            for _ in range(n_convs):
+                x = nn.relu(conv(ch)(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        for _ in range(2):
+            x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def VGG16(num_classes: int = 1000, dtype=jnp.bfloat16) -> VGG:
+    return VGG(VGG16_STAGES, num_classes, dtype)
+
+
+def create_vgg_state(model: VGG, rng_key, image_size: int = 224,
+                     mesh=None):
+    """Init params, replicated over the mesh (no batch stats: VGG has no
+    BN in the classic configuration)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    variables = model.init({"params": rng_key},
+                           jnp.zeros((1, image_size, image_size, 3),
+                                     model.dtype), train=False)
+    params = variables["params"]
+    if mesh is not None:
+        rep = NamedSharding(mesh, P())
+        params = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, rep), params)
+    return params
+
+
+def make_vgg_train_step(model: VGG, optimizer, mesh, dropout_seed: int = 0):
+    """Data-parallel train step; same GSPMD-auto contract as the ResNet
+    step (``make_resnet_train_step``). ``step_idx`` is folded into the
+    dropout key so every step draws a fresh mask."""
+    import optax
+
+    @jax.jit
+    def step(params, opt_state, images, labels, step_idx=0):
+        def loss_fn(p):
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(dropout_seed), step_idx)
+            logits = model.apply({"params": p}, images, train=True,
+                                 rngs={"dropout": key})
+            one_hot = jax.nn.one_hot(labels, logits.shape[-1])
+            return optax.softmax_cross_entropy(logits, one_hot).mean()
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

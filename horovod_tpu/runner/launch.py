@@ -25,6 +25,9 @@ def parse_args(argv: List[str]) -> argparse.Namespace:
         description="Launch a horovod_tpu distributed job "
                     "(Horovod-class launcher for TPU hosts)")
     p.add_argument("--version", action="version", version=__version__)
+    p.add_argument("-cb", "--check-build", action="store_true",
+                   help="print available frameworks/controllers/ops and "
+                        "exit (reference: horovodrun --check-build)")
     p.add_argument("-np", "--num-proc", type=int, default=None,
                    help="number of worker processes (TPU hosts)")
     p.add_argument("-H", "--hosts", default=None,
@@ -83,7 +86,7 @@ def parse_args(argv: List[str]) -> argparse.Namespace:
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="program and args to run on every worker")
     args = p.parse_args(argv)
-    if not args.command:
+    if not args.command and not args.check_build:
         p.error("no command given")
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
@@ -141,9 +144,47 @@ def resolve_hosts(args: argparse.Namespace) -> List[HostInfo]:
     return [HostInfo("localhost", np)]
 
 
+def check_build() -> str:
+    """Capability report (reference: ``check_build``, ``launch.py:110-145``):
+    which frameworks this install can drive and which data/control planes
+    are built, in the reference's checkbox format."""
+    import importlib.util
+
+    from horovod_tpu.common import basics
+
+    def mark(v):
+        return "X" if v else " "
+
+    def has(mod):
+        try:
+            return importlib.util.find_spec(mod) is not None
+        except (ImportError, ValueError):
+            return False
+
+    return f"""\
+horovod_tpu v{__version__}:
+
+Available Frameworks:
+    [{mark(has('jax'))}] JAX (native surface)
+    [{mark(has('tensorflow'))}] TensorFlow
+    [{mark(has('torch'))}] PyTorch
+    [{mark(has('keras') or has('tensorflow'))}] Keras
+
+Available Controllers:
+    [{mark(basics.tcp_core_built())}] TCP core (libhvdcore)
+
+Available Tensor Operations:
+    [{mark(basics.xla_built())}] XLA (in-graph + eager data plane)
+    [{mark(basics.tcp_core_built())}] TCP core (host collectives)
+    [X] Local (single process)"""
+
+
 def run_commandline(argv: List[str] = None) -> int:
     """Reference: ``run_commandline`` (``launch.py:763``)."""
     args = parse_args(argv if argv is not None else sys.argv[1:])
+    if args.check_build:
+        print(check_build())
+        return 0
     env = dict(os.environ)
     env.update(knobs_to_env(args))
 

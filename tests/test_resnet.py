@@ -38,6 +38,42 @@ def test_resnet_stems_same_geometry(stem):
     assert logits.shape == (2, 10)
 
 
+def test_resnet101_deeper_than_50():
+    """ResNet-101 shares the implementation; only stage depths differ
+    (reference benchmark trio: docs/benchmarks.rst:13-14)."""
+    from horovod_tpu.models.resnet import ResNet101
+    assert ResNet101().stage_sizes == [3, 4, 23, 3]
+    assert ResNet50().stage_sizes == [3, 4, 6, 3]
+
+
+def test_vgg16_trains(hvd):
+    """VGG-16 (the reference's gradient-bandwidth stress model) trains
+    under the same GSPMD-auto contract as the ResNet family."""
+    from horovod_tpu.models.vgg import VGG, create_vgg_state, \
+        make_vgg_train_step
+    mesh = hvd.build_mesh(dp=-1)
+    # thin VGG (same topology, fewer channels) keeps the CPU test fast
+    model = VGG(stages=((1, 8), (1, 16), (1, 16), (1, 32), (1, 32)),
+                num_classes=8, dtype=jnp.float32, dropout=0.0)
+    params = create_vgg_state(model, jax.random.PRNGKey(0), image_size=64,
+                              mesh=mesh)
+    tx = optax.sgd(0.05, momentum=0.9)
+    opt_state = jax.jit(tx.init)(params)
+    step = make_vgg_train_step(model, tx, mesh)
+    rng = np.random.RandomState(0)
+    images = jax.device_put(
+        jnp.asarray(rng.rand(16, 64, 64, 3), jnp.float32),
+        batch_sharding(mesh))
+    labels = jax.device_put(jnp.asarray(rng.randint(0, 8, (16,)), jnp.int32),
+                            batch_sharding(mesh))
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, images, labels)
+        loss.block_until_ready()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
 def test_resnet_s2d_trains(hvd):
     mesh = hvd.build_mesh(dp=-1)
     model = ResNet([1, 1, 1, 1], num_classes=8, dtype=jnp.float32,

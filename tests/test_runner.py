@@ -144,6 +144,17 @@ def test_parse_args_requires_command(capsys):
         parse_args(["-np", "2"])
 
 
+def test_check_build_report():
+    """--check-build prints the capability table without needing a command
+    (reference: horovodrun --check-build, launch.py:110-155)."""
+    from horovod_tpu.runner.launch import check_build, run_commandline
+    report = check_build()
+    assert "[X] JAX" in report
+    assert "TCP core" in report
+    # no command required with -cb, and it exits cleanly
+    assert run_commandline(["--check-build"]) == 0
+
+
 # -- integration: real hvdrun on localhost ----------------------------------
 
 needs_core = pytest.mark.skipif(not core_available(),
