@@ -307,7 +307,7 @@ def _child_cnn(which: str) -> None:
 def _child() -> None:
     """Run the actual measurement; print the result JSON line to stdout."""
     which = os.environ.get("HVD_BENCH_MODEL", "resnet50").lower()
-    if which == "bert":
+    if which in ("bert", "bert_large"):  # zoo key and short form
         _child_bert()
     elif which in ("resnet50", "resnet101", "vgg16", "inception3"):
         _child_cnn(which)
@@ -351,11 +351,15 @@ def _run_attempt():
 
 
 def _failure_identity():
-    """Metric name/unit for the failure JSON, matching the selected model."""
+    """Metric name/unit for the failure JSON, matching the selected model.
+    Unknown model names keep their own (unmintable) metric so a typo is
+    never recorded as a real benchmark's failure."""
     which = os.environ.get("HVD_BENCH_MODEL", "resnet50").lower()
-    if which == "bert":
+    if which in ("bert", "bert_large"):
         return "bert_large_seqs_per_sec_per_chip", "seq/s/chip"
-    return f"{which}_images_per_sec_per_chip", "img/s/chip"
+    if which in FWD_MACS_PER_IMG:
+        return f"{which}_images_per_sec_per_chip", "img/s/chip"
+    return f"unknown_model_{which}", "n/a"
 
 
 def main() -> None:

@@ -20,11 +20,13 @@ CPU-resident tensors, and the tests, so its bandwidth still matters.
 import argparse
 import json
 import os
-import socket
 import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from horovod_tpu.runner.exec_run import free_port  # noqa: E402
 
 WORKER_BODY = r"""
 import os, sys, time
@@ -55,41 +57,47 @@ be.shutdown()
 """
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 def run_world(world: int, sizes_bytes: list) -> dict:
-    port = _free_port()
+    port = free_port()
     procs = []
-    for rank in range(world):
-        env = dict(os.environ)
-        env.update({
-            "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": str(world),
-            "HOROVOD_LOCAL_RANK": str(rank),
-            "HOROVOD_LOCAL_SIZE": str(world),
-            "HOROVOD_CROSS_RANK": "0", "HOROVOD_CROSS_SIZE": "1",
-            "HVD_TPU_COORD_ADDR": "127.0.0.1",
-            "HVD_TPU_COORD_PORT": str(port),
-            "BENCH_BYTES": ",".join(str(b) for b in sizes_bytes),
-            "JAX_PLATFORMS": "cpu",
-        })
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", WORKER_BODY % {"repo": REPO}],
-            stdout=subprocess.PIPE if rank == 0 else subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL, text=True, env=env))
-    out, _ = procs[0].communicate(timeout=1200)
-    for p in procs[1:]:
-        p.wait(timeout=60)
+    try:
+        for rank in range(world):
+            env = dict(os.environ)
+            env.update({
+                "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": str(world),
+                "HOROVOD_LOCAL_RANK": str(rank),
+                "HOROVOD_LOCAL_SIZE": str(world),
+                "HOROVOD_CROSS_RANK": "0", "HOROVOD_CROSS_SIZE": "1",
+                "HVD_TPU_COORD_ADDR": "127.0.0.1",
+                "HVD_TPU_COORD_PORT": str(port),
+                "BENCH_BYTES": ",".join(str(b) for b in sizes_bytes),
+                "JAX_PLATFORMS": "cpu",
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", WORKER_BODY % {"repo": REPO}],
+                stdout=subprocess.PIPE if rank == 0 else subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL, text=True, env=env))
+        out, _ = procs[0].communicate(timeout=1200)
+        for p in procs[1:]:
+            p.wait(timeout=120)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    bad = [(i, p.returncode) for i, p in enumerate(procs)
+           if p.returncode != 0]
+    if bad:
+        raise RuntimeError(
+            f"world={world}: workers exited nonzero: {bad}")
     results = {}
     for line in out.splitlines():
         if line.startswith("RESULT "):
             _, nbytes, dt = line.split()
             results[int(nbytes)] = float(dt)
+    if len(results) != len(sizes_bytes):
+        raise RuntimeError(
+            f"world={world}: expected {len(sizes_bytes)} results, got "
+            f"{sorted(results)}")
     return results
 
 

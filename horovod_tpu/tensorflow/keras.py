@@ -1,0 +1,8 @@
+"""``horovod_tpu.tensorflow.keras`` — alias namespace for reference users
+who import ``horovod.tensorflow.keras as hvd`` (reference:
+``horovod/tensorflow/keras/__init__.py`` re-exports the same surface as
+``horovod.keras`` built on the TF backend; here both namespaces are the
+one Keras adapter)."""
+
+from horovod_tpu.keras import *  # noqa: F401,F403
+from horovod_tpu.keras import DistributedOptimizer, callbacks  # noqa: F401

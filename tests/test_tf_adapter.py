@@ -159,6 +159,17 @@ def test_tf_keras_elastic_state(tfhvd, tmp_path, monkeypatch):
         np.testing.assert_allclose(a, b)
 
 
+def test_tensorflow_keras_alias_namespace(tfhvd):
+    """Reference exposes both horovod.keras and horovod.tensorflow.keras;
+    the alias must carry the full Keras adapter surface."""
+    import horovod_tpu.tensorflow.keras as tk
+    import horovod_tpu.keras as k
+    assert tk.DistributedOptimizer is k.DistributedOptimizer
+    assert tk.callbacks.BroadcastGlobalVariablesCallback is \
+        k.callbacks.BroadcastGlobalVariablesCallback
+    assert tk.rank() == tfhvd.rank() and tk.size() == tfhvd.size()
+
+
 def test_tf_broadcast_variables(tfhvd):
     v = tf.Variable([7.0, 8.0])
     tfhvd.broadcast_variables([v], root_rank=0)
