@@ -328,13 +328,23 @@ def _run_attempt():
     try:
         out, _ = proc.communicate(timeout=ATTEMPT_DEADLINE_S)
     except subprocess.TimeoutExpired:
-        # SIGTERM lets the PJRT client tear down its chip claim; never
-        # SIGKILL a process mid-claim (it wedges the relay lease).
+        # SIGTERM first so the PJRT client can tear down its chip claim;
+        # if the child is wedged in native init (SIGTERM deferred), we
+        # MUST escalate to SIGKILL: an abandoned live child keeps
+        # contending for the chip and starves every later attempt — a
+        # worse outcome than a relay lease that has to expire.
         proc.terminate()
         try:
             proc.wait(timeout=60)
         except subprocess.TimeoutExpired:
-            pass  # abandon rather than SIGKILL
+            proc.kill()
+            try:
+                # a child wedged in uninterruptible native I/O may defer
+                # even SIGKILL until the syscall returns — reap with a
+                # bound so the retry loop keeps its own schedule
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
         return None, f"attempt exceeded {ATTEMPT_DEADLINE_S}s deadline"
     for line in reversed((out or "").strip().splitlines()):
         try:

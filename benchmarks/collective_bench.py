@@ -78,17 +78,25 @@ def run_world(world: int, sizes_bytes: list) -> dict:
                 stdout=subprocess.PIPE if rank == 0 else subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL, text=True, env=env))
         out, _ = procs[0].communicate(timeout=1200)
-        for p in procs[1:]:
-            p.wait(timeout=120)
+        hung = []
+        for i, p in enumerate(procs[1:], start=1):
+            try:
+                p.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                hung.append(i)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pass
     bad = [(i, p.returncode) for i, p in enumerate(procs)
            if p.returncode != 0]
-    if bad:
+    if hung or bad:
         raise RuntimeError(
-            f"world={world}: workers exited nonzero: {bad}")
+            f"world={world}: hung ranks {hung}, nonzero exits {bad}")
     results = {}
     for line in out.splitlines():
         if line.startswith("RESULT "):
@@ -110,7 +118,8 @@ def main():
         help="comma-separated message sizes in bytes")
     args = ap.parse_args()
     worlds = [int(s) for s in args.sizes.split(",")]
-    sizes_bytes = [int(b) for b in args.bytes.split(",")]
+    # dedupe, preserving order: results are keyed by size
+    sizes_bytes = list(dict.fromkeys(int(b) for b in args.bytes.split(",")))
 
     print(f"{'world':>5} {'bytes':>10} {'latency_us':>11} {'busbw_GB/s':>11}")
     summary = []

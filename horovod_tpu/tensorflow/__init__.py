@@ -117,6 +117,64 @@ def broadcast_global_variables(root_rank: int = 0) -> None:
         broadcast_variables(tf.compat.v1.global_variables(), root_rank)
 
 
+# -- graph-mode identity ops (reference: tensorflow/mpi_ops.py:361-440) -----
+# Each resolves its value at graph EXECUTION time (py_function), so a
+# tf.function traced in one environment reports the world it executes in —
+# the reference's contract for size_op/rank_op under elastic resizes.
+
+PROCESS_SET_ERROR_INIT = -1
+PROCESS_SET_ERROR_UNKNOWN_SET = -2
+
+
+def _exec_time_int(fn, name):
+    tf = _tf()
+    return tf.py_function(lambda: fn(), [], tf.int32, name=name)
+
+
+def size_op(process_set_id: int = 0, name: Optional[str] = None):
+    """Execution-time world (or process-set) size."""
+    from horovod_tpu.common import basics, process_sets
+
+    def val():
+        if process_set_id:
+            return process_sets.get_process_set_by_id(
+                process_set_id).size()
+        return basics.size()
+    return _exec_time_int(val, name or "HorovodSize")
+
+
+def local_size_op(name: Optional[str] = None):
+    from horovod_tpu.common import basics
+    return _exec_time_int(basics.local_size, name or "HorovodLocalSize")
+
+
+def rank_op(name: Optional[str] = None):
+    from horovod_tpu.common import basics
+    return _exec_time_int(basics.rank, name or "HorovodRank")
+
+
+def local_rank_op(name: Optional[str] = None):
+    from horovod_tpu.common import basics
+    return _exec_time_int(basics.local_rank, name or "HorovodLocalRank")
+
+
+def process_set_included_op(process_set_id: int = 0,
+                            name: Optional[str] = None):
+    """1/0 whether this process is in the set; negative error codes match
+    the reference (init / unknown-set)."""
+    from horovod_tpu.common import basics, process_sets
+
+    def val():
+        if not basics.is_initialized():
+            return PROCESS_SET_ERROR_INIT
+        try:
+            ps = process_sets.get_process_set_by_id(process_set_id)
+        except (KeyError, ValueError):
+            return PROCESS_SET_ERROR_UNKNOWN_SET
+        return 1 if ps.included() else 0
+    return _exec_time_int(val, name or "HorovodProcessSetIncluded")
+
+
 # -- DistributedGradientTape (reference: tensorflow/__init__.py:777-851) ----
 
 class _DistributedGradientTape:

@@ -65,5 +65,36 @@ class TensorFlowKerasState(ObjectState):
         self._apply(self.keras_snaps)
 
 
-# compat alias matching the reference's non-keras name
-TensorFlowState = TensorFlowKerasState
+class TensorFlowState(ObjectState):
+    """Raw-variable elastic state (reference: ``TensorFlowState`` — the
+    non-Keras variant syncing an explicit variable list rather than a
+    model object)."""
+
+    def __init__(self, variables, name: str = "tf_state",
+                 **kwargs) -> None:
+        self._vars = list(variables)
+        super().__init__(name=name, var_snaps=self._capture(), **kwargs)
+        self._apply(self.var_snaps)
+
+    def _capture(self) -> list:
+        return [np.asarray(v) for v in self._vars]
+
+    def _apply(self, snaps: list) -> None:
+        if not snaps:
+            return
+        for var, val in zip(self._vars, snaps):
+            if tuple(var.shape) == np.asarray(val).shape:
+                var.assign(val)
+
+    def save(self) -> None:
+        self.var_snaps = self._capture()
+        super().save()
+
+    def restore(self) -> None:
+        super().restore()
+        self._apply(self.var_snaps)
+
+    def sync(self) -> None:
+        self.var_snaps = self._capture()
+        super().sync()
+        self._apply(self.var_snaps)

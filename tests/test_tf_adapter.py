@@ -159,6 +159,36 @@ def test_tf_keras_elastic_state(tfhvd, tmp_path, monkeypatch):
         np.testing.assert_allclose(a, b)
 
 
+def test_tf_raw_variable_elastic_state(tfhvd, tmp_path, monkeypatch):
+    """TensorFlowState syncs an explicit variable list (reference's
+    non-Keras variant, tensorflow/elastic.py TensorFlowState)."""
+    monkeypatch.setenv("HVD_ELASTIC_CKPT", str(tmp_path))
+    v1 = tf.Variable([1.0, 2.0])
+    v2 = tf.Variable([[3.0]])
+    state = tfhvd.elastic.TensorFlowState([v1, v2], step=7, name="tfraw")
+    state.save()
+    v1.assign([9.0, 9.0])
+    state.step = 0
+    state.restore()
+    np.testing.assert_allclose(v1.numpy(), [1.0, 2.0])
+    assert state.step == 7
+    state.sync()  # size 1 no-op
+
+
+def test_tf_graph_mode_identity_ops(tfhvd):
+    """size_op/rank_op/... resolve at EXECUTION time inside tf.function
+    (reference: tensorflow/mpi_ops.py:361-440)."""
+    @tf.function
+    def g():
+        return (tfhvd.size_op(), tfhvd.rank_op(), tfhvd.local_size_op(),
+                tfhvd.local_rank_op(), tfhvd.process_set_included_op(0),
+                tfhvd.process_set_included_op(99))
+
+    assert [int(x) for x in g()] == [
+        tfhvd.size(), tfhvd.rank(), tfhvd.local_size(),
+        tfhvd.local_rank(), 1, tfhvd.PROCESS_SET_ERROR_UNKNOWN_SET]
+
+
 def test_tensorflow_keras_alias_namespace(tfhvd):
     """Reference exposes both horovod.keras and horovod.tensorflow.keras;
     the alias must carry the full Keras adapter surface."""
