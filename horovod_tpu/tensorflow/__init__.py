@@ -131,6 +131,18 @@ def _exec_time_int(fn, name):
     return tf.py_function(lambda: fn(), [], tf.int32, name=name)
 
 
+def _identity_or_sentinel(fn):
+    """-1 before hvd.init(), matching the reference C-API contract for
+    horovod_size()/horovod_rank() (so probing graphs don't error)."""
+    from horovod_tpu.common import basics
+
+    def val():
+        if not basics.is_initialized():
+            return -1
+        return fn()
+    return val
+
+
 def size_op(process_set_id: int = 0, name: Optional[str] = None):
     """Execution-time world (or process-set) size."""
     from horovod_tpu.common import basics, process_sets
@@ -140,22 +152,26 @@ def size_op(process_set_id: int = 0, name: Optional[str] = None):
             return process_sets.get_process_set_by_id(
                 process_set_id).size()
         return basics.size()
-    return _exec_time_int(val, name or "HorovodSize")
+    return _exec_time_int(_identity_or_sentinel(val),
+                          name or "HorovodSize")
 
 
 def local_size_op(name: Optional[str] = None):
     from horovod_tpu.common import basics
-    return _exec_time_int(basics.local_size, name or "HorovodLocalSize")
+    return _exec_time_int(_identity_or_sentinel(basics.local_size),
+                          name or "HorovodLocalSize")
 
 
 def rank_op(name: Optional[str] = None):
     from horovod_tpu.common import basics
-    return _exec_time_int(basics.rank, name or "HorovodRank")
+    return _exec_time_int(_identity_or_sentinel(basics.rank),
+                          name or "HorovodRank")
 
 
 def local_rank_op(name: Optional[str] = None):
     from horovod_tpu.common import basics
-    return _exec_time_int(basics.local_rank, name or "HorovodLocalRank")
+    return _exec_time_int(_identity_or_sentinel(basics.local_rank),
+                          name or "HorovodLocalRank")
 
 
 def process_set_included_op(process_set_id: int = 0,

@@ -29,6 +29,14 @@ from horovod_tpu.ops.backend import HvdHandle
 from horovod_tpu.train.compression import Compression  # noqa: F401
 
 
+def __getattr__(name):
+    # lazy: SyncBatchNorm pulls in torch.nn at import time
+    if name == "SyncBatchNorm":
+        from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm
+        return SyncBatchNorm
+    raise AttributeError(name)
+
+
 def _torch():
     import torch
     return torch

@@ -1,0 +1,140 @@
+"""Synchronous BatchNorm for the torch adapter.
+
+Reference: ``horovod/torch/sync_batch_norm.py:40-218`` — normalize over the
+GLOBAL batch by exchanging per-channel statistics in the forward pass, and
+reduce ``sum_dy`` / ``sum_dy_xmu`` in the backward pass so input gradients
+match single-process BN on the concatenated batch. The reference drives
+CUDA-only kernels (``torch.batch_norm_stats`` etc.); here the math is plain
+torch ops on host tensors (the adapter's domain), with the statistics
+moved as ONE grouped allreduce instead of three allgathers.
+
+Gradient contract (same as reference): ``grad_weight``/``grad_bias`` are
+the LOCAL sums — the DistributedOptimizer's hook averaging handles their
+reduction; only the statistics feeding ``grad_input`` are reduced here.
+"""
+
+from __future__ import annotations
+
+import torch
+from torch.autograd import Function
+from torch.nn.modules.batchnorm import _BatchNorm
+
+from horovod_tpu.common.basics import size
+from horovod_tpu.ops.reduce_op import Sum
+
+
+def _reduce_dims(x: torch.Tensor):
+    return [0] + list(range(2, x.dim()))
+
+
+class _SyncBatchNormFn(Function):
+    @staticmethod
+    def forward(ctx, x, weight, bias, running_mean, running_var, eps,
+                momentum, track_running_stats):
+        from horovod_tpu.torch import grouped_allreduce
+
+        x = x.contiguous()
+        dims = _reduce_dims(x)
+        n_local = float(x.numel() // x.size(1))
+        xd = x.double()
+        local = [torch.tensor([n_local], dtype=torch.float64),
+                 xd.sum(dims),
+                 (xd * xd).sum(dims)]
+        count_t, sum_x, sqsum_x = grouped_allreduce(
+            local, op=Sum, name="sync_bn.stats")
+        count = float(count_t.item())
+        mean = (sum_x / count).to(x.dtype)
+        var = (sqsum_x / count).to(x.dtype) - mean * mean
+        invstd = torch.rsqrt(var.clamp_min(0) + eps)
+
+        if track_running_stats and running_mean is not None:
+            # unbiased var for the running estimate (reference applies the
+            # count/(count-1) correction over the GLOBAL batch); momentum
+            # arrives pre-resolved (CMA factor already substituted for
+            # None by the module)
+            unbiased = var * (count / max(count - 1.0, 1.0))
+            m = momentum
+            with torch.no_grad():
+                running_mean.mul_(1 - m).add_(mean * m)
+                running_var.mul_(1 - m).add_(unbiased * m)
+
+        shape = [1, -1] + [1] * (x.dim() - 2)
+        xhat = (x - mean.view(shape)) * invstd.view(shape)
+        out = xhat
+        if weight is not None:
+            out = out * weight.view(shape)
+        if bias is not None:
+            out = out + bias.view(shape)
+        ctx.save_for_backward(x, weight, mean, invstd)
+        ctx.count = count
+        return out
+
+    @staticmethod
+    def backward(ctx, dy):
+        from horovod_tpu.torch import grouped_allreduce
+
+        x, weight, mean, invstd = ctx.saved_tensors
+        dy = dy.contiguous()
+        dims = _reduce_dims(x)
+        shape = [1, -1] + [1] * (x.dim() - 2)
+        xmu = x - mean.view(shape)
+
+        sum_dy_local = dy.sum(dims)
+        sum_dy_xmu_local = (dy * xmu).sum(dims)
+
+        # local grads for affine params (the optimizer reduces them)
+        grad_weight = (sum_dy_xmu_local * invstd) \
+            if (weight is not None and ctx.needs_input_grad[1]) else None
+        grad_bias = sum_dy_local if ctx.needs_input_grad[2] else None
+
+        grad_input = None
+        if ctx.needs_input_grad[0]:
+            sum_dy, sum_dy_xmu = grouped_allreduce(
+                [sum_dy_local, sum_dy_xmu_local], op=Sum,
+                name="sync_bn.grads")
+            n = ctx.count
+            w = weight.view(shape) if weight is not None else 1.0
+            grad_input = (w * invstd.view(shape)) * (
+                dy - (sum_dy / n).view(shape)
+                - xmu * (invstd * invstd * sum_dy_xmu / n).view(shape))
+
+        return (grad_input, grad_weight, grad_bias,
+                None, None, None, None, None)
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Drop-in for the reference's ``hvd.SyncBatchNorm`` on host tensors:
+    training-mode statistics span the global batch across the process
+    set's workers; eval mode uses the running estimates like plain BN."""
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {input.dim()}D input)")
+
+    def forward(self, input):
+        self._check_input_dim(input)
+        if self.training and self.track_running_stats \
+                and self.num_batches_tracked is not None:
+            self.num_batches_tracked = self.num_batches_tracked + 1
+
+        # momentum=None means cumulative moving average (the _BatchNorm
+        # contract): factor 1/num_batches_tracked
+        if self.momentum is None:
+            factor = 1.0 / float(max(int(self.num_batches_tracked or 1), 1))
+        else:
+            factor = self.momentum
+
+        use_sync = self.training or not self.track_running_stats
+        if not use_sync:
+            return torch.nn.functional.batch_norm(
+                input, self.running_mean, self.running_var, self.weight,
+                self.bias, False, 0.0, self.eps)
+        if size() == 1:
+            return torch.nn.functional.batch_norm(
+                input, self.running_mean, self.running_var, self.weight,
+                self.bias, True, factor, self.eps)
+        return _SyncBatchNormFn.apply(
+            input, self.weight, self.bias, self.running_mean,
+            self.running_var, self.eps, factor,
+            self.track_running_stats)

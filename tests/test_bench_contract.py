@@ -1,0 +1,79 @@
+"""Bench harness contract tests (no TPU): the single-JSON-line artifact
+contract under failure, model selection, and failure-identity naming.
+The success path is covered on hardware by ci/check_bench.py."""
+
+import io
+import contextlib
+import json
+import os
+import subprocess
+import sys
+
+import bench
+
+
+def test_failure_json_parses_and_carries_last_measured(monkeypatch):
+    """Persistent failure still yields ONE parseable JSON line with the
+    right metric name and the latest committed real-hardware result as
+    provenance (value stays null, error stays set)."""
+    monkeypatch.setattr(bench, "_run_attempt",
+                        lambda: (None, "child rc=1: backend 'axon' down"))
+    monkeypatch.setattr(bench, "BACKOFFS_S", (0, 0))
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.main()
+    lines = [l for l in buf.getvalue().strip().splitlines() if l.strip()]
+    assert len(lines) == 1
+    doc = json.loads(lines[0])
+    assert doc["metric"] == "resnet50_images_per_sec_per_chip"
+    assert doc["value"] is None and doc["error"]
+    lm = doc["last_measured"]
+    assert lm and lm["result"]["metric"] == doc["metric"]
+    assert lm["result"]["value"] and lm["result"]["mfu"]
+
+
+def test_config_error_fails_fast(monkeypatch):
+    """A deterministic config error (unknown model) must not retry and
+    must not mint a real benchmark's metric name."""
+    monkeypatch.setenv("HVD_BENCH_MODEL", "resent50")  # typo
+    calls = []
+
+    def counting():
+        calls.append(1)
+        return (None, "config error (no retry): child rc=2: unknown")
+    monkeypatch.setattr(bench, "_run_attempt", counting)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.main()
+    assert len(calls) == 1  # no retries
+    doc = json.loads(buf.getvalue().strip())
+    assert doc["metric"] == "unknown_model_resent50"
+    assert doc["unit"] == "n/a" and doc["last_measured"] is None
+
+
+def test_unknown_model_child_exits_rc2():
+    env = dict(os.environ)
+    env.update({"HVD_BENCH_MODEL": "nope", "JAX_PLATFORMS": "cpu"})
+    r = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(bench.__file__),
+                                      "bench.py"), "--child"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2
+    assert "unknown HVD_BENCH_MODEL" in r.stderr
+
+
+def test_failure_identity_names():
+    for model, metric, unit in [
+            ("resnet50", "resnet50_images_per_sec_per_chip", "img/s/chip"),
+            ("resnet101", "resnet101_images_per_sec_per_chip", "img/s/chip"),
+            ("vgg16", "vgg16_images_per_sec_per_chip", "img/s/chip"),
+            ("inception3", "inception3_images_per_sec_per_chip",
+             "img/s/chip"),
+            ("bert", "bert_large_seqs_per_sec_per_chip", "seq/s/chip"),
+            ("bert_large", "bert_large_seqs_per_sec_per_chip",
+             "seq/s/chip")]:
+        os.environ["HVD_BENCH_MODEL"] = model
+        try:
+            assert bench._failure_identity() == (metric, unit)
+        finally:
+            del os.environ["HVD_BENCH_MODEL"]

@@ -240,3 +240,33 @@ def test_object_state_no_persistence_without_driver(thvd, monkeypatch):
     assert leaked == []
     st2 = ObjectState(name="no_persist_check", epoch=0)
     assert st2.epoch == 0  # nothing adopted
+
+
+def test_torch_sync_batch_norm_single_process(thvd):
+    """Size-1 SyncBatchNorm == plain BatchNorm (training + eval), and the
+    module round-trips through train->eval with running stats
+    (reference: torch/sync_batch_norm.py SyncBatchNorm._run_bn path)."""
+    import torch
+    torch.manual_seed(0)
+    sbn = thvd.SyncBatchNorm(3, momentum=0.1)
+    bn = torch.nn.BatchNorm2d(3, momentum=0.1)
+    bn.load_state_dict({k: v.clone() for k, v in sbn.state_dict().items()})
+    x = torch.randn(4, 3, 5, 5)
+    out_s = sbn(x)
+    out_b = bn(x)
+    assert torch.allclose(out_s, out_b, atol=1e-6)
+    assert torch.allclose(sbn.running_mean, bn.running_mean, atol=1e-6)
+    assert torch.allclose(sbn.running_var, bn.running_var, atol=1e-6)
+    sbn.eval(), bn.eval()
+    y = torch.randn(2, 3, 5, 5)
+    assert torch.allclose(sbn(y), bn(y), atol=1e-6)
+
+    # momentum=None = cumulative moving average, same as _BatchNorm
+    sbn2 = thvd.SyncBatchNorm(3, momentum=None)
+    bn2 = torch.nn.BatchNorm2d(3, momentum=None)
+    bn2.load_state_dict({k: v.clone() for k, v in sbn2.state_dict().items()})
+    for _ in range(3):
+        z = torch.randn(4, 3, 5, 5)
+        sbn2(z), bn2(z)
+    assert torch.allclose(sbn2.running_mean, bn2.running_mean, atol=1e-6)
+    assert torch.allclose(sbn2.running_var, bn2.running_var, atol=1e-6)

@@ -68,6 +68,32 @@ def main():
     for a, b in zip(model.parameters(), ref.parameters()):
         assert torch.allclose(a, b, atol=1e-5), (a, b)
 
+    # SyncBatchNorm: sharded batch must match plain BN on the full batch
+    # for output, input grad, affine grads (after averaging), and running
+    # stats (reference: torch/sync_batch_norm.py numerics)
+    torch.manual_seed(1)
+    X = torch.from_numpy(rng.randn(4 * size, 3, 5, 5).astype(np.float32))
+    mine = slice(rank * 4, (rank + 1) * 4)
+    sbn = hvd.SyncBatchNorm(3, momentum=0.1)
+    bn = torch.nn.BatchNorm2d(3, momentum=0.1)
+    bn.load_state_dict({k: v.clone() for k, v in sbn.state_dict().items()})
+    xs = X[mine].clone().requires_grad_(True)
+    xf = X.clone().requires_grad_(True)
+    out_s = sbn(xs)
+    out_f = bn(xf)
+    assert torch.allclose(out_s, out_f[mine], atol=1e-5)
+    out_s.sum().backward()
+    out_f.sum().backward()
+    assert torch.allclose(xs.grad, xf.grad[mine], atol=1e-5)
+    # affine grads are LOCAL sums; averaging across ranks then scaling by
+    # size reproduces the full-batch sums (sum-over-shards contract)
+    gw = hvd.allreduce(sbn.weight.grad, op=hvd.Sum, name="sbn.gw")
+    gb = hvd.allreduce(sbn.bias.grad, op=hvd.Sum, name="sbn.gb")
+    assert torch.allclose(gw, bn.weight.grad, atol=1e-4), (gw, bn.weight.grad)
+    assert torch.allclose(gb, bn.bias.grad, atol=1e-4)
+    assert torch.allclose(sbn.running_mean, bn.running_mean, atol=1e-5)
+    assert torch.allclose(sbn.running_var, bn.running_var, atol=1e-5)
+
     hvd.barrier()
     hvd.shutdown()
     print(f"torch worker {rank}: OK", flush=True)
