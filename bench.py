@@ -274,18 +274,30 @@ def _child_cnn(which: str) -> None:
         jnp.asarray(rng.randint(0, 1000, (B,)), jnp.int32),
         batch_sharding(mesh))
 
+    # vgg16/inception3 take a step_idx that folds into the dropout key;
+    # thread a real counter so the measurement draws a fresh mask per step
+    # (a traced scalar: varying it does not recompile)
+    step_counter = iter(range(10 ** 9))
     if not has_batch_stats:
         run = _Run(step, params, opt_state, images, labels)
 
         def step_fn(run):
-            p, o, loss = run.jitted(*run.args)
+            if which == "vgg16":
+                p, o, loss = run.jitted(*run.args,
+                                        step_idx=next(step_counter))
+            else:
+                p, o, loss = run.jitted(*run.args)
             run.args[0], run.args[1] = p, o
             return run, loss
     else:
         run = _Run(step, params, batch_stats, opt_state, images, labels)
 
         def step_fn(run):
-            p, bs, o, loss = run.jitted(*run.args)
+            if which == "inception3":
+                p, bs, o, loss = run.jitted(*run.args,
+                                            step_idx=next(step_counter))
+            else:
+                p, bs, o, loss = run.jitted(*run.args)
             run.args[0], run.args[1], run.args[2] = p, bs, o
             return run, loss
 

@@ -12,6 +12,7 @@ mlp shard over ``tp`` when the mesh has one.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Optional
 
 import jax
@@ -130,9 +131,13 @@ def pretrain_loss(mlm_logits, nsp_logits, mlm_labels, mlm_mask, nsp_labels):
 
 def make_bert_train_step(model: Bert, optimizer, mesh: Mesh):
     """GSPMD-auto pretraining step; flax partitioning metadata shards the
-    big matrices over ``tp`` while XLA handles dp gradient reduction."""
+    big matrices over ``tp`` while XLA handles dp gradient reduction.
 
-    @jax.jit
+    ``params``/``opt_state`` buffers are DONATED (in-place update on
+    device): keep only the returned state — the inputs are invalidated
+    after the call on TPU."""
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch):
         def loss_fn(p):
             mlm_logits, nsp_logits = model.apply(

@@ -170,10 +170,15 @@ def create_inception_state(model: InceptionV3, rng_key,
 def make_inception_train_step(model: InceptionV3, optimizer, mesh,
                               dropout_seed: int = 0):
     """``step_idx`` is folded into the dropout key so every step draws a
-    fresh mask."""
+    fresh mask (callers must pass an incrementing value; it is a traced
+    scalar, so varying it does not recompile).
+
+    ``params``/``batch_stats``/``opt_state`` buffers are DONATED
+    (in-place update on device): keep only the returned state — the
+    inputs are invalidated after the call on TPU."""
     import optax
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def step(params, batch_stats, opt_state, images, labels, step_idx=0):
         def loss_fn(p):
             key = jax.random.fold_in(

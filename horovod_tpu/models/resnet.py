@@ -124,9 +124,13 @@ def make_resnet_train_step(model: ResNet, optimizer, mesh: Mesh):
     data-like axis; gradient reduction inserted by XLA from shardings —
     functionally identical to the reference's DistributedOptimizer loop
     (``torch/optimizer.py:314-325``) with fusion/overlap done by the
-    compiler instead of the background thread."""
+    compiler instead of the background thread.
 
-    @jax.jit
+    ``params``/``batch_stats``/``opt_state`` buffers are DONATED: the
+    update happens in place on device, so keep only the returned state
+    (the inputs are invalidated after the call on TPU)."""
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def step(params, batch_stats, opt_state, images, labels):
         def loss_fn(p):
             logits, mut = model.apply(

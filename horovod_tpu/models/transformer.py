@@ -397,11 +397,15 @@ def make_grad_fn(cfg: TransformerConfig, mesh: Mesh):
 def make_train_step(cfg: TransformerConfig, mesh: Mesh, optimizer):
     """Jitted full train step: manual-SPMD fwd/bwd (shard_map) + optimizer
     update in GSPMD-auto mode (XLA keeps the elementwise update sharded as
-    the params are)."""
+    the params are).
+
+    ``params``/``opt_state`` buffers are DONATED (in-place update on
+    device): keep only the returned state — the inputs are invalidated
+    after the call on TPU."""
     import optax
     grad_fn = make_grad_fn(cfg, mesh)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, tokens, targets):
         loss, aux, grads = grad_fn(params, tokens, targets)
         updates, opt_state = optimizer.update(grads, opt_state, params)

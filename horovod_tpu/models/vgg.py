@@ -66,10 +66,16 @@ def create_vgg_state(model: VGG, rng_key, image_size: int = 224,
 def make_vgg_train_step(model: VGG, optimizer, mesh, dropout_seed: int = 0):
     """Data-parallel train step; same GSPMD-auto contract as the ResNet
     step (``make_resnet_train_step``). ``step_idx`` is folded into the
-    dropout key so every step draws a fresh mask."""
+    dropout key so every step draws a fresh mask (callers must pass an
+    incrementing value; it is a traced scalar, so varying it does not
+    recompile).
+
+    ``params``/``opt_state`` buffers are DONATED (in-place update on
+    device): keep only the returned state — the inputs are invalidated
+    after the call on TPU."""
     import optax
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, images, labels, step_idx=0):
         def loss_fn(p):
             key = jax.random.fold_in(

@@ -1,0 +1,18 @@
+"""SparkSession stand-in (see package docstring)."""
+
+from __future__ import annotations
+
+from pyspark import _FakeSparkContext
+
+
+class _Session:
+    sparkContext = _FakeSparkContext()
+
+
+class _Builder:
+    def getOrCreate(self):
+        return _Session()
+
+
+class SparkSession:
+    builder = _Builder()
