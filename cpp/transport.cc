@@ -2,6 +2,7 @@
 #include "wire.h"
 
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -89,32 +90,48 @@ Transport::Transport(int rank, int size, const std::string& coord_addr,
 Transport::~Transport() { Shutdown(); }
 
 Status Transport::ConnectTo(const std::string& host, int port, int* fd_out) {
-  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) return Status::Error("socket() failed");
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(port);
-  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
-    close(fd);
-    return Status::Error("bad address: " + host);
-  }
-  // retry loop: peers may not be listening yet. Deadline = the
-  // HOROVOD_GLOO_TIMEOUT_SECONDS-equivalent knob.
+  bool is_literal = inet_pton(AF_INET, host.c_str(), &addr.sin_addr) == 1;
+  // retry loop: peers may not be listening yet — and at fleet startup a
+  // hostname may not RESOLVE yet either (records published as VMs come
+  // up), so name resolution retries under the same deadline. Deadline =
+  // the HOROVOD_GLOO_TIMEOUT_SECONDS-equivalent knob.
+  std::string last_err = "unresolved";
   int attempts = std::max(1, (int)(connect_timeout_secs_ * 10));
   for (int attempt = 0; attempt < attempts; ++attempt) {
+    if (attempt > 0) usleep(100 * 1000);
+    if (!is_literal) {
+      // TPU-VM fleets (and the Ray/Spark integrations) hand out
+      // hostnames; the reference resolves through Gloo's rendezvous
+      addrinfo hints{};
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      addrinfo* res = nullptr;
+      int rc = getaddrinfo(host.c_str(), nullptr, &hints, &res);
+      if (rc != 0 || res == nullptr) {
+        last_err = std::string("bad address: ") + gai_strerror(rc);
+        if (res) freeaddrinfo(res);
+        continue;
+      }
+      addr.sin_addr = ((sockaddr_in*)res->ai_addr)->sin_addr;
+      freeaddrinfo(res);
+    }
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return Status::Error("socket() failed");
     if (connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0) {
       SetNoDelay(fd);
       *fd_out = fd;
       return Status::OK();
     }
+    last_err = strerror(errno);
     close(fd);
-    usleep(100 * 1000);
-    fd = ::socket(AF_INET, SOCK_STREAM, 0);
   }
-  close(fd);
   return Status::Error("could not connect to " + host + ":" +
                        std::to_string(port) + " within " +
-                       std::to_string((int)connect_timeout_secs_) + "s");
+                       std::to_string((int)connect_timeout_secs_) +
+                       "s (" + last_err + ")");
 }
 
 Status Transport::Init() {

@@ -94,6 +94,19 @@ def test_core_with_small_fusion_threshold():
 
 
 @needs_core
+def test_core_hostname_coordinator():
+    """The coordinator address may be a hostname, not an IP literal —
+    TPU-VM fleets (and the Ray/Spark integrations) hand out hostnames;
+    the transport resolves them via getaddrinfo (``cpp/transport.cc``
+    ``ConnectTo``)."""
+    try:
+        socket.getaddrinfo(socket.gethostname(), None, socket.AF_INET)
+    except socket.gaierror:
+        pytest.skip("hostname has no IPv4 mapping in this environment")
+    _launch(2, {"HVD_TPU_COORD_ADDR": socket.gethostname()})
+
+
+@needs_core
 def test_core_with_timeline(tmp_path):
     tl = str(tmp_path / "timeline.json")
     _launch(2, {"HVD_TPU_TIMELINE": tl})
