@@ -7,10 +7,12 @@ published 16-GPU ResNet-101 number — 1656.82 img/s total = 103.55
 img/s/GPU (``docs/benchmarks.rst:32-43``, 4×4 Pascal P100, batch 64) — the
 only absolute throughput the reference publishes.
 
-``HVD_BENCH_MODEL=bert`` selects a BERT-Large pretraining measurement
-instead (the BASELINE north-star secondary model); ``HVD_BENCH_BATCH`` /
-``HVD_BENCH_SEQ`` / ``HVD_BENCH_STEM`` tune shapes. See docs/PERF.md for
-recorded numbers.
+``HVD_BENCH_MODEL`` selects the model: ``resnet50`` (default) /
+``resnet101`` / ``vgg16`` / ``inception3`` / ``bert`` (BERT-Large
+pretraining, the BASELINE north-star secondary model) / ``gpt`` (decoder
+LM on the flagship transformer; shape via ``HVD_BENCH_GPT_{LAYERS,DMODEL,
+HEADS,DFF}``). ``HVD_BENCH_BATCH`` / ``HVD_BENCH_SEQ`` / ``HVD_BENCH_STEM``
+tune shapes. See docs/PERF.md for recorded numbers.
 
 Hardened for the driver contract:
 - the measurement runs in a CHILD process, so every retry gets a fresh JAX
@@ -201,6 +203,67 @@ def _child_bert() -> None:
                "tokens_per_sec_per_chip": lambda v: round(v * S, 1)})
 
 
+def _child_gpt() -> None:
+    """Decoder-only LM pretraining throughput on the flagship transformer
+    (HVD_BENCH_MODEL=gpt): the model family behind the 5-axis parallel
+    path (``horovod_tpu/models/transformer.py``). Defaults to a ~350M
+    GPT-medium shape; HVD_BENCH_GPT_{LAYERS,DMODEL,HEADS,DFF}, HVD_BENCH_BATCH
+    and HVD_BENCH_SEQ tune it."""
+    import numpy as np
+    import jax
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.transformer import (
+        TransformerConfig, init_params, shard_params, make_train_step,
+        init_opt_state, shard_batch)
+
+    _log(f"devices: {jax.devices()}")
+    hvd.init()
+    mesh = hvd.build_mesh(dp=-1)
+    n_chips = int(np.prod(list(mesh.shape.values())))
+
+    cfg = TransformerConfig(
+        vocab_size=32000,
+        d_model=int(os.environ.get("HVD_BENCH_GPT_DMODEL", "1024")),
+        n_heads=int(os.environ.get("HVD_BENCH_GPT_HEADS", "16")),
+        n_layers=int(os.environ.get("HVD_BENCH_GPT_LAYERS", "24")),
+        d_ff=int(os.environ.get("HVD_BENCH_GPT_DFF", "4096")),
+        max_seq=int(os.environ.get("HVD_BENCH_SEQ", "2048")))
+    B = int(os.environ.get("HVD_BENCH_BATCH", "8")) * n_chips
+    S = cfg.max_seq
+
+    params = shard_params(init_params(np.random.RandomState(0), cfg),
+                          cfg, mesh)
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    _log(f"gpt params: {n_params/1e6:.1f}M, batch {B} x seq {S}")
+    tx = optax.adamw(1e-4)
+    opt_state = init_opt_state(tx, params, mesh, cfg)
+    step = make_train_step(cfg, mesh, tx)
+
+    rng = np.random.RandomState(0)
+    tokens, targets = shard_batch(
+        rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32),
+        rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32), mesh)
+
+    run = _Run(step, params, opt_state, tokens, targets)
+
+    def step_fn(run):
+        p, o, loss, aux = run.jitted(*run.args)
+        run.args[0], run.args[1] = p, o
+        return run, loss
+
+    _measure_and_report(
+        step_fn, run, readback=float,
+        analytic_flops_per_device=lambda:
+            6.0 * n_params * (B / n_chips) * S,
+        iters=10, per_step_units=B * S, n_chips=n_chips,
+        metric="gpt_tokens_per_sec_per_chip", unit="tokens/s/chip",
+        vs_baseline_per_unit=None,  # reference publishes no LM absolute
+        extra={"batch_per_chip": B // n_chips, "seq_len": S,
+               "n_params_m": round(n_params / 1e6, 1)})
+
+
 def _child_cnn(which: str) -> None:
     """Synthetic CNN throughput: resnet50 (the headline), resnet101,
     vgg16, or inception3 — the reference's full published benchmark
@@ -321,13 +384,15 @@ def _child() -> None:
     which = os.environ.get("HVD_BENCH_MODEL", "resnet50").lower()
     if which in ("bert", "bert_large"):  # zoo key and short form
         _child_bert()
+    elif which in ("gpt", "transformer"):
+        _child_gpt()
     elif which in ("resnet50", "resnet101", "vgg16", "inception3"):
         _child_cnn(which)
     else:
         # rc 2 = deterministic config error; the parent fails fast
         # instead of retrying
         _log(f"unknown HVD_BENCH_MODEL={which!r}; expected "
-             "resnet50|resnet101|vgg16|inception3|bert")
+             "resnet50|resnet101|vgg16|inception3|bert|gpt")
         sys.exit(2)
 
 
@@ -379,6 +444,8 @@ def _failure_identity():
     which = os.environ.get("HVD_BENCH_MODEL", "resnet50").lower()
     if which in ("bert", "bert_large"):
         return "bert_large_seqs_per_sec_per_chip", "seq/s/chip"
+    if which in ("gpt", "transformer"):
+        return "gpt_tokens_per_sec_per_chip", "tokens/s/chip"
     if which in FWD_MACS_PER_IMG:
         return f"{which}_images_per_sec_per_chip", "img/s/chip"
     return f"unknown_model_{which}", "n/a"

@@ -62,6 +62,33 @@ def test_unknown_model_child_exits_rc2():
     assert "unknown HVD_BENCH_MODEL" in r.stderr
 
 
+def test_gpt_child_runs_on_cpu_mesh():
+    """The gpt bench child is wired end-to-end: tiny shapes on the
+    8-device CPU mesh must produce the one-JSON-line contract."""
+    env = dict(os.environ)
+    env.update({
+        "HVD_BENCH_MODEL": "gpt", "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "HVD_BENCH_GPT_DMODEL": "64", "HVD_BENCH_GPT_HEADS": "4",
+        "HVD_BENCH_GPT_LAYERS": "2", "HVD_BENCH_GPT_DFF": "128",
+        "HVD_BENCH_BATCH": "2", "HVD_BENCH_SEQ": "64",
+    })
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import os\n"
+         "import jax\n"
+         "jax.config.update('jax_platforms', 'cpu')\n"
+         "import bench\n"
+         "bench._child()\n"],
+        env=env, capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(os.path.abspath(bench.__file__)))
+    assert r.returncode == 0, r.stderr[-1500:]
+    doc = json.loads(r.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "gpt_tokens_per_sec_per_chip"
+    assert doc["value"] > 0
+    assert doc["n_chips"] == 8
+
+
 def test_failure_identity_names():
     for model, metric, unit in [
             ("resnet50", "resnet50_images_per_sec_per_chip", "img/s/chip"),
@@ -71,7 +98,10 @@ def test_failure_identity_names():
              "img/s/chip"),
             ("bert", "bert_large_seqs_per_sec_per_chip", "seq/s/chip"),
             ("bert_large", "bert_large_seqs_per_sec_per_chip",
-             "seq/s/chip")]:
+             "seq/s/chip"),
+            ("gpt", "gpt_tokens_per_sec_per_chip", "tokens/s/chip"),
+            ("transformer", "gpt_tokens_per_sec_per_chip",
+             "tokens/s/chip")]:
         os.environ["HVD_BENCH_MODEL"] = model
         try:
             assert bench._failure_identity() == (metric, unit)
