@@ -49,7 +49,12 @@ FWD_MACS_PER_IMG = {"resnet50": 4.09e9, "resnet101": 7.6e9,
 
 ATTEMPTS = 3
 BACKOFFS_S = (10, 30)
-ATTEMPT_DEADLINE_S = 1500  # generous: a good run is ~2-3 min incl. compile
+# Escalating per-attempt deadlines. A good run is ~2-3 min incl. compile;
+# the escalation exists because killing a child that is wedged in chip
+# claim RESTARTS the relay's lease-expiry clock (observed: a killed
+# claimant wedges the next one for 10-25 min) — so each later attempt
+# must be prepared to out-wait the wedge the previous kill created.
+ATTEMPT_DEADLINES_S = (1500, 2400, 3600)
 
 
 def _log(msg: str) -> None:
@@ -396,14 +401,14 @@ def _child() -> None:
         sys.exit(2)
 
 
-def _run_attempt():
+def _run_attempt(deadline_s=ATTEMPT_DEADLINES_S[0]):
     """Run one child attempt; return (result_line | None, error_tail)."""
     proc = subprocess.Popen(
         [sys.executable, "-u", os.path.abspath(__file__), "--child"],
         stdout=subprocess.PIPE, stderr=sys.stderr, text=True,
         cwd=os.path.dirname(os.path.abspath(__file__)))
     try:
-        out, _ = proc.communicate(timeout=ATTEMPT_DEADLINE_S)
+        out, _ = proc.communicate(timeout=deadline_s)
     except subprocess.TimeoutExpired:
         # SIGTERM first so the PJRT client can tear down its chip claim;
         # if the child is wedged in native init (SIGTERM deferred), we
@@ -422,7 +427,7 @@ def _run_attempt():
                 proc.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 pass
-        return None, f"attempt exceeded {ATTEMPT_DEADLINE_S}s deadline"
+        return None, f"attempt exceeded {deadline_s}s deadline"
     for line in reversed((out or "").strip().splitlines()):
         try:
             parsed = json.loads(line)
@@ -454,7 +459,8 @@ def _failure_identity():
 def main() -> None:
     errors = []
     for i in range(ATTEMPTS):
-        line, err = _run_attempt()
+        line, err = _run_attempt(
+            ATTEMPT_DEADLINES_S[min(i, len(ATTEMPT_DEADLINES_S) - 1)])
         if line is not None:
             print(line, flush=True)
             return

@@ -12,9 +12,18 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def main() -> int:
-    out = subprocess.run(
-        [sys.executable, os.path.join(REPO, "bench.py")],
-        capture_output=True, text=True, cwd=REPO, timeout=5400)
+    # budget = bench.py's own worst case (sum of its escalating attempt
+    # deadlines + backoffs + kill/reap overhead) plus slack: bench must
+    # always get to print its failure JSON rather than be killed mid-loop
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, cwd=REPO, timeout=8400)
+    except subprocess.TimeoutExpired as e:
+        print("bench.py exceeded even the worst-case budget — the "
+              "attempt loop itself is wedged (contract violation):\n"
+              f"stderr tail: {(e.stderr or '')[-500:]}")
+        return 1
     lines = [ln for ln in out.stdout.strip().splitlines() if ln.strip()]
     if len(lines) != 1:
         print(f"expected 1 stdout line, got {len(lines)}:\n{out.stdout}")

@@ -16,8 +16,9 @@ def test_failure_json_parses_and_carries_last_measured(monkeypatch):
     """Persistent failure still yields ONE parseable JSON line with the
     right metric name and the latest committed real-hardware result as
     provenance (value stays null, error stays set)."""
-    monkeypatch.setattr(bench, "_run_attempt",
-                        lambda: (None, "child rc=1: backend 'axon' down"))
+    monkeypatch.setattr(
+        bench, "_run_attempt",
+        lambda deadline_s=None: (None, "child rc=1: backend 'axon' down"))
     monkeypatch.setattr(bench, "BACKOFFS_S", (0, 0))
     buf = io.StringIO()
     with contextlib.redirect_stdout(buf):
@@ -38,7 +39,7 @@ def test_config_error_fails_fast(monkeypatch):
     monkeypatch.setenv("HVD_BENCH_MODEL", "resent50")  # typo
     calls = []
 
-    def counting():
+    def counting(deadline_s=None):
         calls.append(1)
         return (None, "config error (no retry): child rc=2: unknown")
     monkeypatch.setattr(bench, "_run_attempt", counting)
