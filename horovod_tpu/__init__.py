@@ -108,6 +108,7 @@ from horovod_tpu.train.optimizer import (  # noqa: F401
     broadcast_parameters,
     broadcast_optimizer_state,
     broadcast_object,
+    allgather_object,
 )
 from horovod_tpu.train.compression import Compression  # noqa: F401
 from horovod_tpu.train.sync_batch_norm import SyncBatchNorm  # noqa: F401

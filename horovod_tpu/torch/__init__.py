@@ -226,6 +226,12 @@ def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
     return _bo(obj, root_rank, name=name)
 
 
+def allgather_object(obj, name: Optional[str] = None):
+    """Reference: ``allgather_object`` (``torch/functions.py:233-266``)."""
+    from horovod_tpu.train.optimizer import allgather_object as _ag
+    return _ag(obj, name=name)
+
+
 # -- DistributedOptimizer (reference: torch/optimizer.py) -------------------
 
 class _DistributedOptimizer:

@@ -342,3 +342,29 @@ def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None,
     payload = np.asarray(C.broadcast(payload, root_rank, name=f"{name}.data",
                                      process_set=process_set))
     return pickle.loads(payload.tobytes())
+
+
+def allgather_object(obj, name: Optional[str] = None,
+                     process_set: ProcessSet = global_process_set):
+    """Pickle-based arbitrary-object allgather: returns the list of every
+    rank's object, ordered by rank (reference: ``allgather_object``,
+    ``torch/functions.py:233-266``: serialize, allgather sizes, allgather
+    ragged bytes, split)."""
+    if size() == 1:
+        return [obj]
+    name = name or "allgather_object"
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+    # enqueue both collectives before waiting (independent: the backend
+    # handles ragged dim 0 itself) so the core can fuse them in one
+    # negotiation cycle, as broadcast_parameters does
+    sizes_h = C.allgather_async(np.array([payload.size], dtype=np.int64),
+                                name=f"{name}.len", process_set=process_set)
+    data_h = C.allgather_async(payload, name=f"{name}.data",
+                               process_set=process_set)
+    sizes = np.asarray(sizes_h.wait())
+    gathered = np.asarray(data_h.wait())
+    out, offset = [], 0
+    for n in sizes.tolist():
+        out.append(pickle.loads(gathered[offset:offset + n].tobytes()))
+        offset += n
+    return out

@@ -44,6 +44,12 @@ def main():
     obj = hvd.broadcast_object({"val": rank * 7}, root_rank=1)
     assert obj == {"val": 7}
 
+    # allgather_object: arbitrary (differently-sized) python objects,
+    # rank-ordered (reference: torch/functions.py:233-266)
+    gathered = hvd.allgather_object({"rank": rank, "pad": "x" * (rank * 13)})
+    assert [g["rank"] for g in gathered] == list(range(size))
+    assert all(len(g["pad"]) == 13 * g["rank"] for g in gathered)
+
     # DistributedOptimizer: eager grads differ per rank, must sync to mean
     tx = hvd.DistributedOptimizer(optax.sgd(1.0))
     p = {"w": jnp.zeros(4)}

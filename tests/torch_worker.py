@@ -45,6 +45,10 @@ def main():
         expect[r + 1, 0] += 2.0         # each rank's private coordinate
     assert torch.allclose(dense, expect), (dense, expect)
 
+    # allgather_object (reference: torch/functions.py:233-266)
+    metas = hvd.allgather_object({"rank": rank, "loss": 0.5 * rank})
+    assert [m["rank"] for m in metas] == list(range(size))
+
     # DistributedOptimizer: equal shards => identical to full-batch SGD
     torch.manual_seed(0)
     model = hvd.broadcast_object(torch.nn.Linear(4, 1), 0, name="m")
