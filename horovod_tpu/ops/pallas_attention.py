@@ -10,6 +10,13 @@ through VMEM while Q stays resident, maintaining the flash running-softmax
   grid = (batch·heads, Sq/BLOCK_Q, Sk/BLOCK_K)   — K-block innermost
   per (q-block): for each k-block: s = q @ kᵀ; online-softmax update
 
+The kernel is DIFFERENTIABLE: a ``jax.custom_vjp`` pairs the forward
+kernel (which also emits the per-row log-sum-exp residual) with a
+blockwise backward pass that recomputes attention probabilities one
+K-block at a time from (q, k, v, o, lse) — the standard flash-attention
+backward (Dao et al.), memory-bounded at O(S·block_k) instead of O(S²),
+so training through the kernel never materializes the score matrix.
+
 Falls back to the pure-XLA implementation on CPU or when shapes don't meet
 TPU tiling constraints (last dim 128-multiple, block-divisible sequence).
 """
@@ -21,6 +28,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -30,8 +38,9 @@ BLOCK_Q = 128
 BLOCK_K = 128
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  scale: float, causal: bool, block_q: int, block_k: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                  l_ref, *, scale: float, causal: bool, block_q: int,
+                  block_k: int):
     """One (q-block, k-block) step; grid (BH, nq, nk) with k innermost."""
     kv_idx = pl.program_id(2)
     q_idx = pl.program_id(1)
@@ -77,20 +86,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(kv_idx == pl.num_programs(2) - 1)
     def _finalize():
-        o_ref[0] = (acc_ref[:] /
-                    jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        # log-sum-exp residual for the backward pass: lse = m + log(l)
+        lse_ref[0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
 
 
-def flash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array,
-                        causal: bool = True,
-                        scale: Optional[float] = None,
-                        block_q: int = BLOCK_Q, block_k: int = BLOCK_K,
-                        interpret: bool = False) -> jax.Array:
-    """q/k/v: [B, S, H, D] → [B, S, H, D]. Requires S % block == 0 and
-    D % 128 == 0 (use :func:`attend` for the auto-fallback wrapper)."""
+def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Run the kernel; q/k/v [B, S, H, D] → (o [B, S, H, D], lse [BH, Sq])."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
-    scale = scale if scale is not None else (1.0 / (D ** 0.5))
     # layout: fold batch & heads; blocks over sequence
     qf = q.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
     kf = k.transpose(0, 2, 1, 3).reshape(B * H, Sk, D)
@@ -100,7 +105,7 @@ def flash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array,
     nk = Sk // block_k
     grid = (B * H, nq, nk)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k),
         grid=grid,
@@ -109,8 +114,14 @@ def flash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array,
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Sq), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),   # acc
             pltpu.VMEM((block_q, 1), jnp.float32),   # m (running max)
@@ -118,14 +129,118 @@ def flash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array,
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+    return out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, _ = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
+                           interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
+                             interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    """Blockwise flash backward (Dao et al.): recompute p = exp(s - lse)
+    one K-block at a time; dv = pᵀdo, ds = p⊙(do·vᵀ − Δ), dq += ds·k,
+    dk = dsᵀq. Peak extra memory O(Sq·block_k) per (batch·head)."""
+    q, k, v, o, lse = res
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    bk = block_k
+    nk = Sk // bk
+
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Sq, D).astype(jnp.float32)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Sk, D).astype(jnp.float32)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Sk, D).astype(jnp.float32)
+    of = o.transpose(0, 2, 1, 3).reshape(B * H, Sq, D).astype(jnp.float32)
+    dof = do.transpose(0, 2, 1, 3).reshape(B * H, Sq, D).astype(jnp.float32)
+
+    delta = jnp.sum(dof * of, axis=-1)             # [BH, Sq]
+
+    dq = jnp.zeros_like(qf)
+    dk = jnp.zeros_like(kf)
+    dv = jnp.zeros_like(vf)
+
+    if causal and nk <= 64:
+        # Statically-unrolled loop with per-block row restriction: K-block
+        # j only reaches q rows >= j*bk (the rest are masked in the
+        # forward), so slicing the q side halves the backward FLOPs —
+        # mirroring the forward kernel's diagonal block-skip. Unrolling is
+        # bounded (<= 64 blocks) to keep compile time sane; longer
+        # sequences take the dynamic full-row loop below.
+        for j in range(nk):
+            r0 = j * bk                                     # first live row
+            qs, dos = qf[:, r0:], dof[:, r0:]
+            kb, vb = kf[:, r0:r0 + bk], vf[:, r0:r0 + bk]
+            s = jnp.einsum("bqd,bkd->bqk", qs, kb) * scale  # [BH,Sq-r0,bk]
+            qpos = r0 + jnp.arange(Sq - r0)
+            kpos = r0 + jnp.arange(bk)
+            s = jnp.where(qpos[:, None] >= kpos[None, :], s, NEG_INF)
+            p = jnp.exp(s - lse[:, r0:, None])
+            dvb = jnp.einsum("bqk,bqd->bkd", p, dos)
+            dp = jnp.einsum("bqd,bkd->bqk", dos, vb)
+            ds = p * (dp - delta[:, r0:, None]) * scale
+            dq = dq.at[:, r0:].add(jnp.einsum("bqk,bkd->bqd", ds, kb))
+            dk = dk.at[:, r0:r0 + bk].set(
+                jnp.einsum("bqk,bqd->bkd", ds, qs))
+            dv = dv.at[:, r0:r0 + bk].set(dvb)
+    else:
+        qpos = jnp.arange(Sq)
+
+        def block(j, carry):
+            dq, dk, dv = carry
+            kb = lax.dynamic_slice_in_dim(kf, j * bk, bk, axis=1)
+            vb = lax.dynamic_slice_in_dim(vf, j * bk, bk, axis=1)
+            s = jnp.einsum("bqd,bkd->bqk", qf, kb) * scale  # [BH,Sq,bk]
+            if causal:
+                kpos = j * bk + jnp.arange(bk)
+                s = jnp.where(qpos[:, None] >= kpos[None, :], s, NEG_INF)
+            p = jnp.exp(s - lse[..., None])                 # [BH,Sq,bk]
+            dvb = jnp.einsum("bqk,bqd->bkd", p, dof)
+            dp = jnp.einsum("bqd,bkd->bqk", dof, vb)
+            ds = p * (dp - delta[..., None]) * scale
+            dq = dq + jnp.einsum("bqk,bkd->bqd", ds, kb)
+            dkb = jnp.einsum("bqk,bqd->bkd", ds, qf)
+            dk = lax.dynamic_update_slice_in_dim(dk, dkb, j * bk, axis=1)
+            dv = lax.dynamic_update_slice_in_dim(dv, dvb, j * bk, axis=1)
+            return dq, dk, dv
+
+        dq, dk, dv = lax.fori_loop(0, nk, block, (dq, dk, dv))
+
+    def unfold(x, S):
+        return x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+    return (unfold(dq, Sq).astype(q.dtype), unfold(dk, Sk).astype(k.dtype),
+            unfold(dv, Sk).astype(v.dtype))
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = True,
+                        scale: Optional[float] = None,
+                        block_q: int = BLOCK_Q, block_k: int = BLOCK_K,
+                        interpret: bool = False) -> jax.Array:
+    """q/k/v: [B, S, H, D] → [B, S, H, D]. Requires S % block == 0 and
+    D % 128 == 0 (use :func:`attend` for the auto-fallback wrapper).
+    Differentiable (custom VJP with blockwise recompute backward)."""
+    D = q.shape[-1]
+    scale = float(scale) if scale is not None else float(1.0 / (D ** 0.5))
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
 def attend(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
            scale: Optional[float] = None) -> jax.Array:
     """Attention with automatic kernel selection: the Pallas flash kernel on
     TPU when shapes satisfy its tiling constraints, else the fused-XLA
-    fallback."""
+    fallback. Differentiable on both paths."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     on_tpu = jax.default_backend() == "tpu"
