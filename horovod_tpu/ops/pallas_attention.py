@@ -133,22 +133,25 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    o, _ = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
                            interpret)
-    return o
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     o, lse = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
                              interpret)
-    return o, (q, k, v, o, lse)
+    return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, cts):
     """Blockwise flash backward (Dao et al.): recompute p = exp(s - lse)
-    one K-block at a time; dv = pᵀdo, ds = p⊙(do·vᵀ − Δ), dq += ds·k,
-    dk = dsᵀq. Peak extra memory O(Sq·block_k) per (batch·head)."""
+    one K-block at a time; dv = pᵀdo, ds = p⊙(do·vᵀ − Δ + dlse), dq +=
+    ds·k, dk = dsᵀq. Peak extra memory O(Sq·block_k) per (batch·head).
+    The lse cotangent enters through ∂lse/∂s_j = p_j (lse is the row
+    log-partition), which is what makes the (o, lse) pair usable as a
+    mergeable partial result (ring attention)."""
+    do, dlse = cts
     q, k, v, o, lse = res
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
@@ -161,7 +164,10 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
     of = o.transpose(0, 2, 1, 3).reshape(B * H, Sq, D).astype(jnp.float32)
     dof = do.transpose(0, 2, 1, 3).reshape(B * H, Sq, D).astype(jnp.float32)
 
-    delta = jnp.sum(dof * of, axis=-1)             # [BH, Sq]
+    if dlse is None:
+        dlse = jnp.zeros_like(lse)
+    # ds = p ⊙ (dp − Δ + dlse): fold the lse cotangent into the row term
+    adj = jnp.sum(dof * of, axis=-1) - dlse.astype(jnp.float32)  # [BH, Sq]
 
     dq = jnp.zeros_like(qf)
     dk = jnp.zeros_like(kf)
@@ -185,7 +191,7 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
             p = jnp.exp(s - lse[:, r0:, None])
             dvb = jnp.einsum("bqk,bqd->bkd", p, dos)
             dp = jnp.einsum("bqd,bkd->bqk", dos, vb)
-            ds = p * (dp - delta[:, r0:, None]) * scale
+            ds = p * (dp - adj[:, r0:, None]) * scale
             dq = dq.at[:, r0:].add(jnp.einsum("bqk,bkd->bqd", ds, kb))
             dk = dk.at[:, r0:r0 + bk].set(
                 jnp.einsum("bqk,bqd->bkd", ds, qs))
@@ -204,7 +210,7 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
             p = jnp.exp(s - lse[..., None])                 # [BH,Sq,bk]
             dvb = jnp.einsum("bqk,bqd->bkd", p, dof)
             dp = jnp.einsum("bqd,bkd->bqk", dof, vb)
-            ds = p * (dp - delta[..., None]) * scale
+            ds = p * (dp - adj[..., None]) * scale
             dq = dq + jnp.einsum("bqk,bkd->bqd", ds, kb)
             dkb = jnp.einsum("bqk,bqd->bkd", ds, qf)
             dk = lax.dynamic_update_slice_in_dim(dk, dkb, j * bk, axis=1)
@@ -220,7 +226,22 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
             unfold(dv, Sk).astype(v.dtype))
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_lse.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
+                             causal: bool = True,
+                             scale: Optional[float] = None,
+                             block_q: int = BLOCK_Q, block_k: int = BLOCK_K,
+                             interpret: bool = False):
+    """Flash attention returning ``(o, lse)``: the normalized output plus
+    the per-row log-partition (``lse`` shaped ``[B*H, Sq]``). The pair is
+    a mergeable partial softmax — two results over disjoint key sets
+    combine exactly via logaddexp (ring attention's per-step merge).
+    Differentiable in both outputs."""
+    D = q.shape[-1]
+    scale = float(scale) if scale is not None else float(1.0 / (D ** 0.5))
+    return _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
 def flash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -233,7 +254,7 @@ def flash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array,
     Differentiable (custom VJP with blockwise recompute backward)."""
     D = q.shape[-1]
     scale = float(scale) if scale is not None else float(1.0 / (D ** 0.5))
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    return _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret)[0]
 
 
 def attend(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,

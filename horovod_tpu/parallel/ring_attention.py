@@ -61,17 +61,35 @@ def _online_update(acc, m_run, l_run, m_new, l_new, pv_new):
 
 def ring_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array,
                         axis_name: str = "sp", causal: bool = True,
-                        scale: Optional[float] = None) -> jax.Array:
+                        scale: Optional[float] = None,
+                        use_flash: Optional[bool] = None,
+                        interpret: bool = False) -> jax.Array:
     """SPMD body: call inside ``shard_map`` with sequence sharded on
     ``axis_name``. Shapes (local): q/k/v ``[B, S_local, H, D]``.
 
     The K/V pair travels the ring; accumulation order is fixed by absolute
     block index so causal masking stays exact.
+
+    ``use_flash`` selects the Pallas flash kernel for each ring step's
+    local block attention (auto: on TPU when tiling permits): every step
+    returns a normalized ``(o, lse)`` partial which merges exactly via
+    logaddexp, so the O(Sq·Sk_local) score matrix is never materialized.
+    Ring causal masking needs no in-kernel offsets — a step's K/V block
+    is fully visible (earlier block), diagonal (own block: standard
+    causal), or fully masked (later block: skipped).
     """
     B, Sq, H, D = q.shape
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     scale = scale if scale is not None else (1.0 / (D ** 0.5))
+
+    if use_flash is None:
+        from horovod_tpu.ops.pallas_attention import BLOCK_K, BLOCK_Q
+        use_flash = (jax.default_backend() == "tpu" and D % 128 == 0
+                     and Sq % BLOCK_Q == 0 and k.shape[1] % BLOCK_K == 0)
+    if use_flash:
+        return _ring_flash(q, k, v, axis_name, causal, scale, n, my,
+                           interpret)
 
     acc = jnp.zeros((B, Sq, H, D), jnp.float32)
     m_run = jnp.full((B, H, Sq), NEG_INF, jnp.float32)
@@ -114,10 +132,65 @@ def ring_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.astype(q.dtype)
 
 
+def _ring_flash(q, k, v, axis_name, causal, scale, n, my, interpret):
+    """Flash-kernel ring body: per step, the local block attention runs in
+    the Pallas kernel and the normalized ``(o, lse)`` partials merge via
+    logaddexp (``o_tot = Σ o_i · exp(lse_i − lse_tot)``)."""
+    from horovod_tpu.ops.pallas_attention import flash_attention_with_lse
+
+    B, Sq, H, D = q.shape
+
+    def attend_step(t, acc, lse_run, k_t, v_t):
+        def full(kv):
+            return flash_attention_with_lse(q, kv[0], kv[1], causal=False,
+                                            scale=scale, interpret=interpret)
+
+        def diag(kv):
+            return flash_attention_with_lse(q, kv[0], kv[1], causal=True,
+                                            scale=scale, interpret=interpret)
+
+        def skip(kv):
+            return (jnp.zeros((B, Sq, H, D), q.dtype),
+                    jnp.full((B * H, Sq), NEG_INF, jnp.float32))
+
+        if causal:
+            src = (my - t) % n                    # whose K/V we hold now
+            idx = jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
+            o_t, lse_t = lax.switch(idx, [full, diag, skip], (k_t, v_t))
+        else:
+            o_t, lse_t = full((k_t, v_t))
+
+        lse_new = jnp.logaddexp(lse_run, lse_t)   # [BH, Sq]
+        # weights: [BH,Sq] → [B,Sq,H,1] (finite NEG_INF keeps this NaN-free)
+        def w(x):
+            return jnp.exp(x - lse_new).reshape(B, H, Sq).transpose(
+                0, 2, 1)[..., None]
+        acc = acc * w(lse_run) + o_t.astype(jnp.float32) * w(lse_t)
+        return acc, lse_new
+
+    acc = jnp.zeros((B, Sq, H, D), jnp.float32)
+    lse_run = jnp.full((B * H, Sq), NEG_INF, jnp.float32)
+
+    def body(t, carry):
+        acc, lse_run, k_t, v_t = carry
+        acc, lse_run = attend_step(t, acc, lse_run, k_t, v_t)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_t = lax.ppermute(k_t, axis_name, perm)
+        v_t = lax.ppermute(v_t, axis_name, perm)
+        return acc, lse_run, k_t, v_t
+
+    acc, lse_run, k_t, v_t = lax.fori_loop(
+        0, n - 1, body, (acc, lse_run, k, v))
+    acc, _ = attend_step(n - 1, acc, lse_run, k_t, v_t)
+    return acc.astype(q.dtype)
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    axis_name: str = "sp", causal: bool = True,
                    scale: Optional[float] = None,
-                   batch_axis: Optional[str] = "dp") -> jax.Array:
+                   batch_axis: Optional[str] = "dp",
+                   use_flash: Optional[bool] = None,
+                   interpret: bool = False) -> jax.Array:
     """Array-level ring attention: global ``[B, S, H, D]`` inputs with S
     sharded over ``axis_name`` (and optionally B over ``batch_axis``)."""
     from horovod_tpu.parallel.mesh import mesh_axis_size
@@ -131,7 +204,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec,) * 3,
                        out_specs=spec, check_vma=False)
     def run(ql, kl, vl):
-        return ring_attention_spmd(ql, kl, vl, axis_name, causal, scale)
+        return ring_attention_spmd(ql, kl, vl, axis_name, causal, scale,
+                                   use_flash=use_flash, interpret=interpret)
 
     return run(q, k, v)
 
