@@ -17,7 +17,9 @@ tune shapes. See docs/PERF.md for recorded numbers.
 Hardened for the driver contract:
 - the measurement runs in a CHILD process, so every retry gets a fresh JAX
   (a failed backend init is cached for the life of a process);
-- bounded retry with backoff on TPU-backend init failure;
+- hard TOTAL wall-clock budget (``HVD_BENCH_TOTAL_BUDGET_S``, default
+  1200 s): one patient attempt sized to the remaining budget, fast
+  retries only if budget remains, fallback JSON emitted BEFORE the cap;
 - on persistent failure the parent prints ONE diagnostic JSON line (rc 0)
   instead of a traceback, so the artifact always parses;
 - reports ``mfu`` computed from compiled-HLO FLOPs (fallback: analytic
@@ -47,14 +49,22 @@ PEAK_BF16_FLOPS = (
 FWD_MACS_PER_IMG = {"resnet50": 4.09e9, "resnet101": 7.6e9,
                     "vgg16": 15.47e9, "inception3": 5.7e9}
 
-ATTEMPTS = 3
-BACKOFFS_S = (10, 30)
-# Escalating per-attempt deadlines. A good run is ~2-3 min incl. compile;
-# the escalation exists because killing a child that is wedged in chip
-# claim RESTARTS the relay's lease-expiry clock (observed: a killed
-# claimant wedges the next one for 10-25 min) — so each later attempt
-# must be prepared to out-wait the wedge the previous kill created.
-ATTEMPT_DEADLINES_S = (1500, 2400, 3600)
+# Total wall-clock budget for the WHOLE bench run (all attempts + the
+# fallback emission). A good run is ~2-3 min incl. compile; the budget
+# exists so the driver's own deadline never kills us mid-attempt with
+# nothing on stdout (round-2 failure mode: escalating per-attempt
+# deadlines of 1500/2400/3600s out-waited the driver → rc=124,
+# parsed=null). One patient attempt inside a hard cap, fallback JSON
+# emitted BEFORE the cap, is strictly better than three attempts that
+# can never all finish.
+TOTAL_BUDGET_S = float(os.environ.get("HVD_BENCH_TOTAL_BUDGET_S", "1200"))
+# Reserved at the end of the budget for writing the fallback JSON and
+# reaping a wedged child.
+FALLBACK_RESERVE_S = 100.0
+BACKOFF_S = 10
+# Secondary bound: a fast-failing attempt (backend down) must not spin
+# through dozens of retries even though budget remains.
+MAX_ATTEMPTS = 5
 
 
 def _log(msg: str) -> None:
@@ -401,7 +411,7 @@ def _child() -> None:
         sys.exit(2)
 
 
-def _run_attempt(deadline_s=ATTEMPT_DEADLINES_S[0]):
+def _run_attempt(deadline_s):
     """Run one child attempt; return (result_line | None, error_tail)."""
     proc = subprocess.Popen(
         [sys.executable, "-u", os.path.abspath(__file__), "--child"],
@@ -427,7 +437,7 @@ def _run_attempt(deadline_s=ATTEMPT_DEADLINES_S[0]):
                 proc.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 pass
-        return None, f"attempt exceeded {deadline_s}s deadline"
+        return None, f"attempt exceeded {deadline_s:.0f}s deadline"
     for line in reversed((out or "").strip().splitlines()):
         try:
             parsed = json.loads(line)
@@ -457,19 +467,36 @@ def _failure_identity():
 
 
 def main() -> None:
+    # One patient attempt sized to the whole remaining budget; further
+    # attempts happen only if the first one failed FAST (backend init
+    # error etc.) and real budget remains. Total wall-clock is hard-capped
+    # at TOTAL_BUDGET_S — the fallback JSON always lands before the cap.
+    t_start = time.monotonic()
     errors = []
-    for i in range(ATTEMPTS):
-        line, err = _run_attempt(
-            ATTEMPT_DEADLINES_S[min(i, len(ATTEMPT_DEADLINES_S) - 1)])
+    attempts_run = 0
+    while attempts_run < MAX_ATTEMPTS:
+        # reserve covers: fallback emission + the kill/reap path inside
+        # _run_attempt (terminate wait 60s + SIGKILL reap 30s = 90s),
+        # which runs AFTER the attempt deadline expires
+        remaining = TOTAL_BUDGET_S - FALLBACK_RESERVE_S - 90 - \
+            (time.monotonic() - t_start)
+        if remaining < 120:
+            if not errors:
+                errors.append(
+                    "insufficient budget for an attempt "
+                    f"(HVD_BENCH_TOTAL_BUDGET_S={TOTAL_BUDGET_S:.0f})")
+            break  # not enough budget for a meaningful attempt
+        attempts_run += 1
+        line, err = _run_attempt(deadline_s=remaining)
         if line is not None:
             print(line, flush=True)
             return
-        errors.append(f"attempt {i + 1}: {err}")
+        errors.append(f"attempt {attempts_run}: {err}")
         print(f"[bench] {errors[-1]}", file=sys.stderr, flush=True)
         if err.startswith("config error"):
             break
-        if i < ATTEMPTS - 1:
-            time.sleep(BACKOFFS_S[min(i, len(BACKOFFS_S) - 1)])
+        if attempts_run < MAX_ATTEMPTS:
+            time.sleep(BACKOFF_S)
     # Persistent failure: still emit one parseable JSON line, rc 0.
     # last_measured carries the most recent REAL-hardware result for this
     # metric (from the committed measurement log) so a relay outage at
@@ -494,7 +521,7 @@ def main() -> None:
         "vs_baseline": None,
         "mfu": None,
         "error": "; ".join(errors)[-800:],
-        "attempts": len(errors),
+        "attempts": attempts_run,
         "last_measured": last,
     }), flush=True)
 

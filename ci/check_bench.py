@@ -12,13 +12,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def main() -> int:
-    # budget = bench.py's own worst case (sum of its escalating attempt
-    # deadlines + backoffs + kill/reap overhead) plus slack: bench must
+    # budget = bench.py's own hard total wall-clock cap
+    # (HVD_BENCH_TOTAL_BUDGET_S, default 1200 s) plus slack: bench must
     # always get to print its failure JSON rather than be killed mid-loop
+    budget = float(os.environ.get("HVD_BENCH_TOTAL_BUDGET_S", "1200"))
     try:
         out = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
-            capture_output=True, text=True, cwd=REPO, timeout=8400)
+            capture_output=True, text=True, cwd=REPO, timeout=budget + 120)
     except subprocess.TimeoutExpired as e:
         print("bench.py exceeded even the worst-case budget — the "
               "attempt loop itself is wedged (contract violation):\n"

@@ -19,7 +19,7 @@ def test_failure_json_parses_and_carries_last_measured(monkeypatch):
     monkeypatch.setattr(
         bench, "_run_attempt",
         lambda deadline_s=None: (None, "child rc=1: backend 'axon' down"))
-    monkeypatch.setattr(bench, "BACKOFFS_S", (0, 0))
+    monkeypatch.setattr(bench, "BACKOFF_S", 0)
     buf = io.StringIO()
     with contextlib.redirect_stdout(buf):
         bench.main()

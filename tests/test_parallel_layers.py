@@ -35,8 +35,10 @@ def test_ring_attention_matches_full(causal):
 def test_ring_attention_flash_path_matches_full(causal):
     """The Pallas-kernel ring path (per-step flash + logaddexp merge of
     normalized (o, lse) partials) must agree with the full oracle —
-    interpret mode stands in for the TPU kernel on the CPU mesh."""
-    mesh = build_mesh(dp=1, sp=2)
+    interpret mode stands in for the TPU kernel on the CPU mesh (2-device
+    sub-mesh: flash blocks need S/sp >= 256, too big for an 8-way ring on
+    the tiny test shapes)."""
+    mesh = build_mesh(dp=1, sp=2, devices=jax.devices()[:2])
     rng = np.random.RandomState(3)
     mk = lambda: jnp.asarray(rng.randn(1, 512, 2, 128), jnp.float32) * 0.3
     q, k, v = mk(), mk(), mk()
@@ -51,7 +53,7 @@ def test_ring_attention_flash_path_grads():
     """Training goes through the ring: the flash ring path's gradients
     (custom-VJP kernel + lse merge + ppermute loop) must match autodiff
     through the oracle."""
-    mesh = build_mesh(dp=1, sp=2)
+    mesh = build_mesh(dp=1, sp=2, devices=jax.devices()[:2])
     rng = np.random.RandomState(4)
     mk = lambda: jnp.asarray(rng.randn(1, 256, 2, 128), jnp.float32) * 0.3
     q, k, v = mk(), mk(), mk()
