@@ -244,4 +244,38 @@ int hvd_last_join_rank(int domain) {
   return Core::Get().last_join_rank(domain);
 }
 
+// Dynamic timeline control (reference: horovod_start_timeline /
+// horovod_stop_timeline, operations.cc:1011-1041). Coordinator-only file;
+// non-zero ranks no-op and return 0.
+int hvd_start_timeline(const char* path, int mark_cycles) {
+  auto st = Core::Get().StartTimeline(path ? path : "", mark_cycles != 0);
+  if (!st.ok()) return SetError(st);
+  return 0;
+}
+
+int hvd_stop_timeline() {
+  auto st = Core::Get().StopTimeline();
+  if (!st.ok()) return SetError(st);
+  return 0;
+}
+
+// Control-plane counters as one JSON object (steady-state observability:
+// cache-hit rate, fusion effectiveness, negotiation volume).
+static std::string g_counters_json;
+const char* hvd_counters_json() {
+  const auto& c = Core::Get().counters();
+  std::ostringstream os;
+  os << "{\"cycles\":" << c.cycles.load()
+     << ",\"cache_hits\":" << c.cache_hits.load()
+     << ",\"cache_misses\":" << c.cache_misses.load()
+     << ",\"cache_evictions\":" << c.cache_evictions.load()
+     << ",\"responses_executed\":" << c.responses_executed.load()
+     << ",\"tensors_fused\":" << c.tensors_fused.load()
+     << ",\"fused_units\":" << c.fused_units.load()
+     << ",\"bytes_allreduced\":" << c.bytes_allreduced.load()
+     << ",\"bytes_allgathered\":" << c.bytes_allgathered.load() << "}";
+  g_counters_json = os.str();
+  return g_counters_json.c_str();
+}
+
 }  // extern "C"

@@ -86,13 +86,42 @@ int ResponseCache::Lookup(const std::string& key) const {
   return it == index_.end() ? -1 : it->second;
 }
 
-int ResponseCache::Insert(const std::string& key, const Response& resp) {
+void ResponseCache::Touch(int bit) {
+  auto it = lru_pos_.find(bit);
+  if (it == lru_pos_.end()) return;
+  lru_.erase(it->second);
+  lru_.push_front(bit);
+  it->second = lru_.begin();
+}
+
+int ResponseCache::Insert(const std::string& key, const Response& resp,
+                          Response* evicted, bool* did_evict) {
   auto it = index_.find(key);
-  if (it != index_.end()) return it->second;  // already cached
-  if (entries_.size() >= capacity_) return -1;  // full: stop caching
-  int bit = (int)entries_.size();
-  entries_.emplace_back(key, resp);
+  if (it != index_.end()) {
+    Touch(it->second);  // coordinated point: refresh recency
+    return it->second;
+  }
+  int bit;
+  if (entries_.size() < capacity_) {
+    bit = (int)entries_.size();
+    entries_.emplace_back(key, resp);
+  } else {
+    // evict the least-recently-used entry and reuse its bit (reference:
+    // response_cache.cc eviction; recency only changes at coordinated
+    // points, so every rank evicts the same entry on the same cycle)
+    if (capacity_ == 0) return -1;
+    bit = lru_.back();
+    if (evicted) *evicted = entries_[bit].second;
+    if (did_evict) *did_evict = true;
+    evictions_++;
+    index_.erase(entries_[bit].first);
+    lru_.pop_back();
+    lru_pos_.erase(bit);
+    entries_[bit] = {key, resp};
+  }
   index_[key] = bit;
+  lru_.push_front(bit);
+  lru_pos_[bit] = lru_.begin();
   return bit;
 }
 
@@ -296,7 +325,8 @@ Status Core::Init(const CoreConfig& cfg) {
                     cfg.rendezvous_timeout_secs));
   auto st = transport_->Init();
   if (!st.ok()) return st;
-  timeline_.reset(new Timeline(cfg.rank, cfg.timeline_path));
+  timeline_.reset(new Timeline(cfg.rank, cfg.timeline_path,
+                               cfg.timeline_mark_cycles));
   if (cfg.autotune)
     param_mgr_.Enable(cfg.fusion_threshold, cfg.cycle_time_ms,
                       cfg.autotune_warmup_samples,
@@ -639,6 +669,23 @@ int Core::last_join_rank(int domain) {
   return it == domains_.end() ? -1 : it->second->join_count;
 }
 
+// -- dynamic timeline (reference: operations.cc:1011-1041) ------------------
+
+Status Core::StartTimeline(const std::string& path, bool mark_cycles) {
+  if (!initialized_ || !timeline_)
+    return Status::Error("hvdcore not initialized");
+  if (!timeline_->Start(path, mark_cycles))
+    return Status::Error("could not open timeline file: " + path);
+  return Status::OK();
+}
+
+Status Core::StopTimeline() {
+  if (!initialized_ || !timeline_)
+    return Status::Error("hvdcore not initialized");
+  timeline_->Stop();
+  return Status::OK();
+}
+
 // -- background loop (reference: BackgroundThreadLoop / RunLoopOnce) --------
 
 void Core::Loop() {
@@ -665,6 +712,21 @@ void Core::Loop() {
                         "(peer failure or shutdown)"));
 }
 
+namespace {
+// negotiation-phase names (reference: timeline.h NEGOTIATING state +
+// activity taxonomy common.h:73-105)
+const char* NegotiatePhase(Request::Type t) {
+  switch (t) {
+    case Request::kAllreduce: return "NEGOTIATE_ALLREDUCE";
+    case Request::kAllgather: return "NEGOTIATE_ALLGATHER";
+    case Request::kBroadcast: return "NEGOTIATE_BROADCAST";
+    case Request::kAlltoall: return "NEGOTIATE_ALLTOALL";
+    case Request::kBarrier: return "NEGOTIATE_BARRIER";
+    default: return "NEGOTIATE";
+  }
+}
+}  // namespace
+
 void Core::HandleRequests(CoordDomain& d, int from_rank,
                           std::vector<Request>& reqs) {
   int gsize = d.group.size();
@@ -684,6 +746,11 @@ void Core::HandleRequests(CoordDomain& d, int from_rank,
     auto& slot = d.ready_table_[r.name];
     if (slot.second.empty()) {
       slot.first = r;
+      // per-tensor negotiation phase opens at the FIRST announcement and
+      // closes when all ranks are in (CollectReady) — the coordinator's
+      // view of who is holding whom up (reference: timeline.h:48-183)
+      if (timeline_ && timeline_->enabled())
+        timeline_->Begin(r.name, NegotiatePhase(r.type));
     } else {
       // duplicate announcement from the same rank must not count twice
       if (std::find(slot.second.begin(), slot.second.end(), from_rank) !=
@@ -717,7 +784,18 @@ void Core::HandleRequests(CoordDomain& d, int from_rank,
 
 void Core::HandleCacheBits(CoordDomain& d, int from_rank,
                            const std::vector<int32_t>& bits) {
-  for (auto b : bits) d.bit_ready_[b].push_back(from_rank);
+  for (auto b : bits) {
+    auto& ranks = d.bit_ready_[b];
+    if (ranks.empty() && timeline_ && timeline_->enabled()) {
+      // cached tensors skip negotiation; the wait for the remaining
+      // ranks' bits is still visible (reference activity name:
+      // WAIT_FOR_OTHER_TENSOR_DATA, common.h:76)
+      const Response& cr = d.cache->Get(b);
+      if (!cr.names.empty())
+        timeline_->Begin(cr.names[0], "WAIT_FOR_OTHER_TENSOR_DATA");
+    }
+    ranks.push_back(from_rank);
+  }
 }
 
 std::vector<Response> Core::CollectReady(CoordDomain& d) {
@@ -743,7 +821,11 @@ std::vector<Response> Core::CollectReady(CoordDomain& d) {
   for (int b : ready_bits) {
     Response resp = d.cache->Get(b);
     resp.from_cache = true;
-    d.stall.RemoveReady(resp.names.empty() ? "" : resp.names[0]);
+    if (!resp.names.empty()) {
+      d.stall.RemoveReady(resp.names[0]);
+      if (timeline_ && timeline_->enabled())
+        timeline_->End(resp.names[0]);  // closes WAIT_FOR_OTHER_TENSOR_DATA
+    }
     out.push_back(std::move(resp));
   }
   // partial cache bits are stalls too: without this, a cached tensor one
@@ -771,6 +853,8 @@ std::vector<Response> Core::CollectReady(CoordDomain& d) {
             [](auto& a, auto& b) { return a.first < b.first; });
   for (auto& kv : ready) {
     auto& r = kv.second;
+    if (timeline_ && timeline_->enabled())
+      timeline_->End(r.name);  // closes the NEGOTIATE_* phase
     auto err = d.error_table_.find(r.name);
     bool poisoned = r.group_id >= 0 &&
                     d.poisoned_groups_.count(r.group_id) > 0;
@@ -855,16 +939,26 @@ std::vector<Response> Core::FuseResponses(
   std::map<std::string, Response> open;  // fuse-group key -> accumulating
   std::map<std::string, int64_t> open_bytes;
   for (auto& s : singles) {
-    if (s.type != Response::kAllreduce) {
+    std::string key;
+    if (s.type == Response::kAllreduce) {
+      std::ostringstream gk;
+      gk << "ar|" << (int)s.dtypes[0] << '|' << (int)s.op << '|'
+         << s.prescale << '|' << s.postscale;
+      if (cfg_.disable_group_fusion)
+        gk << "|g" << s.group_id;  // keep groups (and loose tensors) apart
+      key = gk.str();
+    } else if (s.type == Response::kAllgather) {
+      // fused allgathers share one size-exchange + one data round with
+      // per-tensor displacement math (reference: controller.cc:793 fuses
+      // allgathers; ops/collective_operations.h:209-273); embedding-heavy
+      // steps gather many small tensors per cycle
+      std::ostringstream gk;
+      gk << "ag|" << (int)s.dtypes[0];
+      key = gk.str();
+    } else {
       out.push_back(s);
       continue;
     }
-    std::ostringstream gk;
-    gk << (int)s.dtypes[0] << '|' << (int)s.op << '|' << s.prescale << '|'
-       << s.postscale;
-    if (cfg_.disable_group_fusion)
-      gk << "|g" << s.group_id;  // keep groups (and loose tensors) apart
-    std::string key = gk.str();
     int64_t sz = DataTypeSize(s.dtypes[0]);
     for (auto dim : s.shapes[0]) sz *= dim;
     auto it = open.find(key);
@@ -890,8 +984,8 @@ std::vector<Response> Core::FuseResponses(
 }
 
 namespace {
-std::string KeyFromSingleResponse(const hvd::Response& r) {
-  // must match ResponseCache::Key(Request) for an allreduce request
+hvd::Request RequestFromSingleResponse(const hvd::Response& r) {
+  // must mirror the Request an announcing rank would send for this op
   hvd::Request q;
   q.type = hvd::Request::kAllreduce;
   q.name = r.names[0];
@@ -903,7 +997,12 @@ std::string KeyFromSingleResponse(const hvd::Response& r) {
   q.root_rank = 0;
   q.group_id = r.group_id;
   q.group_size = r.group_size;
-  return hvd::ResponseCache::Key(q);
+  return q;
+}
+
+std::string KeyFromSingleResponse(const hvd::Response& r) {
+  // must match ResponseCache::Key(Request) for an allreduce request
+  return hvd::ResponseCache::Key(RequestFromSingleResponse(r));
 }
 }  // namespace
 
@@ -937,7 +1036,8 @@ void Core::ApplyDomainLifecycle(const std::vector<int32_t>& activate,
 
 bool Core::RunOnce() {
   bool want_shutdown = shutdown_requested_.load();
-  if (timeline_ && timeline_->enabled() && cfg_.timeline_mark_cycles)
+  counters_.cycles++;
+  if (timeline_ && timeline_->enabled() && timeline_->mark_cycles())
     timeline_->Instant("CYCLE_START");  // HOROVOD_TIMELINE_MARK_CYCLES
 
   std::vector<int> domain_ids;
@@ -995,8 +1095,10 @@ bool Core::RunOnce() {
         int bit = d->cache->Lookup(ResponseCache::Key(r));
         if (bit >= 0) {
           my_bits.push_back(bit);
+          counters_.cache_hits++;
           continue;
         }
+        counters_.cache_misses++;
       }
       misses.push_back(r);
     }
@@ -1104,6 +1206,8 @@ bool Core::RunOnce() {
           }
         }
         d->stall.RemoveReady(name);
+        if (timeline_ && timeline_->enabled())
+          timeline_->End(name);  // close the open NEGOTIATE_*/WAIT_* span
         Response e;
         e.type = Response::kError;
         e.names = {name};
@@ -1171,15 +1275,51 @@ bool Core::RunOnce() {
     }
 
     // every rank inserts newly negotiated allreduce responses in identical
-    // (broadcast) order, keeping cache bit spaces aligned across ranks
+    // (broadcast) order — and Touches cached ones in the same order — so
+    // cache bit spaces AND LRU recency stay aligned across ranks
     if (cfg_.cache_enabled) {
       for (auto& s : singles) {
-        if (s.type == Response::kAllreduce && !s.from_cache)
-          d->cache->Insert(KeyFromSingleResponse(s), s);
+        if (s.type != Response::kAllreduce) continue;
+        if (s.from_cache) {
+          int bit = d->cache->Lookup(KeyFromSingleResponse(s));
+          if (bit >= 0) d->cache->Touch(bit);
+          continue;
+        }
+        Response evicted;
+        bool did_evict = false;
+        int bit = d->cache->Insert(KeyFromSingleResponse(s), s, &evicted,
+                                   &did_evict);
+        if (!did_evict) continue;
+        counters_.cache_evictions++;
+        // Coordinator: a pending (partial) bit announcement for the
+        // evicted entry can no longer complete as a bit — the bit now
+        // names the new entry, and ranks that miss post-eviction will
+        // announce full requests. Migrate the announced ranks into
+        // full-request negotiation so the tensor still completes.
+        // (Reference coordinates this with explicit invalid-bit sync,
+        // response_cache.h:135-139; deterministic eviction lets us
+        // migrate locally instead.) Workers have no bit_ready_ state.
+        auto bit_it = d->bit_ready_.find(bit);
+        if (bit_it == d->bit_ready_.end() || evicted.names.empty())
+          continue;
+        Request q = RequestFromSingleResponse(evicted);
+        auto& slot = d->ready_table_[q.name];
+        if (slot.second.empty()) slot.first = q;
+        for (int rk : bit_it->second)
+          if (std::find(slot.second.begin(), slot.second.end(), rk) ==
+              slot.second.end())
+            slot.second.push_back(rk);
+        d->bit_ready_.erase(bit_it);
       }
     }
 
     auto units = FuseResponses(singles);
+    for (auto& resp : units) {
+      if (resp.names.size() > 1) {
+        counters_.fused_units++;
+        counters_.tensors_fused += resp.names.size();
+      }
+    }
     for (auto& resp : units) {
       if (resp.type == Response::kShutdown) {
         got_shutdown_response = true;
@@ -1210,8 +1350,18 @@ bool Core::RunOnce() {
 void Core::Execute(CoordDomain& d, const Response& r) {
   int id = d.id;
   int32_t dtag = DomTag(id, kTagData);
-  if (timeline_ && timeline_->enabled() && !r.names.empty())
-    timeline_->Begin(r.names[0], "EXECUTE");
+  counters_.responses_executed++;
+  // sub-activity markers nested under EXECUTE on the unit's tid
+  // (reference activity taxonomy: MEMCPY_IN_FUSION_BUFFER /
+  // MEMCPY_OUT_FUSION_BUFFER / <op> — common.h:73-105)
+  bool tl = timeline_ && timeline_->enabled() && !r.names.empty();
+  auto act_begin = [&](const char* a) {
+    if (tl) timeline_->Begin(r.names[0], a);
+  };
+  auto act_end = [&] {
+    if (tl) timeline_->End(r.names[0]);
+  };
+  if (tl) timeline_->Begin(r.names[0], "EXECUTE");
 
   switch (r.type) {
     case Response::kAllreduce: {
@@ -1232,10 +1382,12 @@ void Core::Execute(CoordDomain& d, const Response& r) {
         slots[i].off = total;
         total += AlignUp(n);
       }
+      act_begin("MEMCPY_IN_FUSION_BUFFER");
       std::vector<uint8_t> fusion(total, 0);
       for (auto& s : slots)
         if (s.have)
           memcpy(fusion.data() + s.off, s.e.input, s.bytes);
+      act_end();
       int64_t nelem = 0;
       // element count: all same dtype; compute from bytes
       size_t esz = DataTypeSize(r.dtypes[0]);
@@ -1245,52 +1397,159 @@ void Core::Execute(CoordDomain& d, const Response& r) {
           r.op != ReduceOp::kAdasum) {
         // two-level path: intra-host reduce -> cross-host ring among
         // leaders -> intra-host broadcast
+        act_begin("HIERARCHICAL_ALLREDUCE");
         st = HierarchicalAllreduce(*transport_, local_group_, cross_group_,
                                    cfg_.local_rank == 0, dtag,
                                    fusion.data(), nelem, r.dtypes[0], r.op,
                                    r.prescale, r.postscale);
+        act_end();
       } else if (r.op == ReduceOp::kAdasum && d.group.size() > 1) {
+        act_begin("ADASUM_ALLREDUCE");
         ScaleBufferOp(fusion.data(), nelem, r.dtypes[0], r.prescale);
         st = AdasumAllreduce(*transport_, d.group, DomTag(d.id, kTagAdasum),
                              fusion.data(), nelem, r.dtypes[0]);
         ScaleBufferOp(fusion.data(), nelem, r.dtypes[0], r.postscale);
+        act_end();
       } else {
+        act_begin("RING_ALLREDUCE");
         st = RingAllreduce(*transport_, d.group, dtag, fusion.data(),
                            nelem, r.dtypes[0], r.op, r.prescale,
                            r.postscale);
+        act_end();
       }
       param_mgr_.Record(total);
+      counters_.bytes_allreduced += (uint64_t)total;
+      act_begin("MEMCPY_OUT_FUSION_BUFFER");
       for (auto& s : slots) {
         if (!s.have) continue;
         if (st.ok() && s.e.output)
           memcpy(s.e.output, fusion.data() + s.off, s.bytes);
         if (s.e.callback) s.e.callback(st);
       }
+      act_end();
       break;
     }
     case Response::kAllgather: {
-      TensorTableEntry e;
-      bool have = d.queue.Take(r.names[0], &e);
-      int64_t row_bytes = DataTypeSize(r.dtypes[0]);
-      auto shape = r.shapes[0];
-      for (size_t i = 1; i < shape.size(); ++i) row_bytes *= shape[i];
-      int64_t send_bytes = have ? (int64_t)e.ByteSize() : 0;
-      std::vector<int64_t> sizes;
-      std::vector<uint8_t> out;
-      static const uint8_t kEmpty = 0;
-      auto st = AllgatherV(*transport_, d.group, dtag,
-                           have && e.input ? e.input : &kEmpty, send_bytes,
-                           &sizes, &out);
-      if (have) {
-        if (st.ok()) {
-          *e.result = std::move(out);
-          int64_t rows = (int64_t)e.result->size() /
-                         std::max<int64_t>(row_bytes, 1);
-          *e.result_shape = shape;
-          if (!e.result_shape->empty()) (*e.result_shape)[0] = rows;
-        }
-        if (e.callback) e.callback(st);
+      size_t k = r.names.size();
+      struct AgSlot {
+        TensorTableEntry e;
+        bool have;
+        int64_t row_bytes;
+        int64_t my_bytes;
+      };
+      std::vector<AgSlot> slots(k);
+      for (size_t i = 0; i < k; ++i) {
+        slots[i].have = d.queue.Take(r.names[i], &slots[i].e);
+        int64_t rb = DataTypeSize(r.dtypes[i]);
+        for (size_t j = 1; j < r.shapes[i].size(); ++j)
+          rb *= r.shapes[i][j];
+        slots[i].row_bytes = std::max<int64_t>(rb, 1);
+        slots[i].my_bytes =
+            slots[i].have ? (int64_t)slots[i].e.ByteSize() : 0;
       }
+      if (k == 1) {
+        // single-tensor fast path: one round; per-rank sizes come back
+        // from AllgatherV itself
+        auto& s0 = slots[0];
+        std::vector<int64_t> sizes;
+        std::vector<uint8_t> out;
+        static const uint8_t kEmpty = 0;
+        act_begin("ALLGATHERV");
+        auto st = AllgatherV(*transport_, d.group, dtag,
+                             s0.have && s0.e.input ? s0.e.input : &kEmpty,
+                             s0.my_bytes, &sizes, &out);
+        act_end();
+        counters_.bytes_allgathered += (uint64_t)out.size();
+        if (s0.have) {
+          if (st.ok()) {
+            *s0.e.result = std::move(out);
+            int64_t rows = (int64_t)s0.e.result->size() / s0.row_bytes;
+            *s0.e.result_shape = r.shapes[0];
+            if (!s0.e.result_shape->empty())
+              (*s0.e.result_shape)[0] = rows;
+          }
+          if (s0.e.callback) s0.e.callback(st);
+        }
+        break;
+      }
+      // Fused path (reference: fused allgather displacement math,
+      // ops/collective_operations.h:209-273): (1) one fixed-size round
+      // exchanging the k per-tensor byte counts of every rank, (2) one
+      // data round gathering each rank's concatenated tensors, (3)
+      // scatter rank-major slices into per-tensor results. 2 rounds
+      // total instead of k.
+      int n = d.group.size();
+      std::vector<int64_t> my_sizes(k);
+      int64_t send_total = 0;
+      for (size_t i = 0; i < k; ++i) {
+        my_sizes[i] = slots[i].my_bytes;
+        send_total += my_sizes[i];
+      }
+      std::vector<int64_t> size_per_rank;
+      std::vector<uint8_t> size_out;
+      act_begin("ALLGATHER_SIZES");
+      auto st = AllgatherV(*transport_, d.group, dtag, my_sizes.data(),
+                           (int64_t)(k * sizeof(int64_t)), &size_per_rank,
+                           &size_out);
+      act_end();
+      if (st.ok() && size_out.size() != k * sizeof(int64_t) * (size_t)n)
+        st = Status::Error("fused allgather size exchange mismatch");
+      std::vector<uint8_t> data;
+      std::vector<int64_t> rank_off;
+      const int64_t* all_sizes = nullptr;  // [n][k] row-major
+      if (st.ok()) {
+        all_sizes = (const int64_t*)size_out.data();
+        act_begin("MEMCPY_IN_FUSION_BUFFER");
+        std::vector<uint8_t> send((size_t)send_total);
+        int64_t off = 0;
+        for (size_t i = 0; i < k; ++i) {
+          if (slots[i].have && slots[i].e.input && my_sizes[i] > 0)
+            memcpy(send.data() + off, slots[i].e.input, my_sizes[i]);
+          off += my_sizes[i];
+        }
+        act_end();
+        std::vector<int64_t> per_rank;
+        static const uint8_t kEmptyF = 0;
+        act_begin("ALLGATHERV");
+        st = AllgatherV(*transport_, d.group, dtag,
+                        send_total ? send.data() : &kEmptyF, send_total,
+                        &per_rank, &data);
+        act_end();
+        if (st.ok()) {
+          rank_off.assign(n + 1, 0);
+          for (int rr = 0; rr < n; ++rr)
+            rank_off[rr + 1] = rank_off[rr] + per_rank[rr];
+          counters_.bytes_allgathered += (uint64_t)data.size();
+        }
+      }
+      act_begin("MEMCPY_OUT_FUSION_BUFFER");
+      for (size_t i = 0; i < k; ++i) {
+        auto& s = slots[i];
+        if (!s.have) continue;
+        if (st.ok()) {
+          int64_t total_i = 0;
+          for (int rr = 0; rr < n; ++rr)
+            total_i += all_sizes[(size_t)rr * k + i];
+          s.e.result->resize((size_t)total_i);
+          int64_t dst = 0;
+          for (int rr = 0; rr < n; ++rr) {
+            // rank rr's block holds its tensors in announce order;
+            // tensor i sits after rr's tensors 0..i-1
+            int64_t src = rank_off[rr];
+            for (size_t j = 0; j < i; ++j)
+              src += all_sizes[(size_t)rr * k + j];
+            int64_t len = all_sizes[(size_t)rr * k + i];
+            if (len > 0)
+              memcpy(s.e.result->data() + dst, data.data() + src, len);
+            dst += len;
+          }
+          *s.e.result_shape = r.shapes[i];
+          if (!s.e.result_shape->empty())
+            (*s.e.result_shape)[0] = total_i / s.row_bytes;
+        }
+        if (s.e.callback) s.e.callback(st);
+      }
+      act_end();
       break;
     }
     case Response::kBroadcast: {
@@ -1368,8 +1627,7 @@ void Core::Execute(CoordDomain& d, const Response& r) {
     default:
       break;
   }
-  if (timeline_ && timeline_->enabled() && !r.names.empty())
-    timeline_->End(r.names[0]);
+  if (tl) timeline_->End(r.names[0]);  // closes EXECUTE
 }
 
 }  // namespace hvd

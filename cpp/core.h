@@ -48,20 +48,39 @@ class TensorQueue {
 // horovod/common/response_cache.h:45-102). A hit means every rank already
 // agreed on this exact op before — skip negotiation, just bitvector-AND
 // the hit sets each cycle.
+//
+// LRU discipline: recency is updated ONLY at coordinated points (Insert
+// and Touch while processing the broadcast response list), never from the
+// rank-local Lookup — so the eviction sequence is identical on every rank
+// and bit spaces stay aligned without explicit invalidation messages. When
+// a full cache evicts, the freed bit is reused for the new entry; the
+// coordinator migrates any pending bit announcements for the evicted
+// entry back into full-request negotiation (see Core::RunOnce).
 class ResponseCache {
  public:
   explicit ResponseCache(size_t capacity) : capacity_(capacity) {}
   static std::string Key(const Request& r);
   // returns bit position, or -1 if not cached
   int Lookup(const std::string& key) const;
-  int Insert(const std::string& key, const Response& resp);
+  // Insert, evicting the least-recently-used entry when full (reference:
+  // response_cache.cc put() eviction). Returns the bit used; if an
+  // eviction happened, *evicted holds the displaced Response and
+  // *did_evict is set so the coordinator can migrate pending bits.
+  int Insert(const std::string& key, const Response& resp,
+             Response* evicted = nullptr, bool* did_evict = nullptr);
+  // move a bit to most-recently-used; call only at coordinated points
+  void Touch(int bit);
   const Response& Get(int bit) const;
   size_t size() const { return entries_.size(); }
+  uint64_t evictions() const { return evictions_; }
 
  private:
   size_t capacity_;
   std::vector<std::pair<std::string, Response>> entries_;  // bit -> entry
   std::unordered_map<std::string, int> index_;
+  std::list<int> lru_;  // front = most recent
+  std::unordered_map<int, std::list<int>::iterator> lru_pos_;
+  uint64_t evictions_ = 0;
 };
 
 // Stall detection (reference: horovod/common/stall_inspector.h:30-99).
@@ -231,6 +250,29 @@ class Core {
   void RemoveProcessSet(int id);
   int last_join_rank(int domain);
 
+  // Dynamic timeline control (reference: horovod_start_timeline /
+  // horovod_stop_timeline, operations.cc:1011-1041). Coordinator-only
+  // file; non-zero ranks no-op.
+  Status StartTimeline(const std::string& path, bool mark_cycles);
+  Status StopTimeline();
+
+  // Control-plane observability counters (steady-state health: cache-hit
+  // rate, negotiation volume, fusion effectiveness). The reference exposes
+  // this only through the timeline; first-class counters make the
+  // fast-path measurable without tracing overhead.
+  struct Counters {
+    std::atomic<uint64_t> cycles{0};
+    std::atomic<uint64_t> cache_hits{0};        // requests sent as bits
+    std::atomic<uint64_t> cache_misses{0};      // requests fully negotiated
+    std::atomic<uint64_t> cache_evictions{0};
+    std::atomic<uint64_t> responses_executed{0};
+    std::atomic<uint64_t> tensors_fused{0};     // tensors sharing a unit
+    std::atomic<uint64_t> fused_units{0};       // multi-tensor units
+    std::atomic<uint64_t> bytes_allreduced{0};
+    std::atomic<uint64_t> bytes_allgathered{0};
+  };
+  const Counters& counters() const { return counters_; }
+
   Transport* transport() { return transport_.get(); }
 
  private:
@@ -257,6 +299,7 @@ class Core {
                             const std::vector<int32_t>& retired);
 
   CoreConfig cfg_;
+  Counters counters_;
   std::atomic<bool> initialized_{false};
   std::atomic<bool> shutdown_requested_{false};
   std::atomic<bool> loop_done_{false};

@@ -2,16 +2,50 @@
 
 namespace hvd {
 
-Timeline::Timeline(int rank, const std::string& path) : rank_(rank) {
+Timeline::Timeline(int rank, const std::string& path, bool mark_cycles)
+    : rank_(rank) {
   t0_ = std::chrono::steady_clock::now();
-  if (path.empty() || rank != 0) return;  // coordinator-only file
-  file_ = fopen(path.c_str(), "w");
-  if (!file_) return;
-  fputs("[\n", file_);
-  writer_ = std::thread([this] { WriterLoop(); });
+  if (!path.empty()) Start(path, mark_cycles);
 }
 
-Timeline::~Timeline() { Close(); }
+Timeline::~Timeline() { Stop(); }
+
+bool Timeline::Start(const std::string& path, bool mark_cycles) {
+  if (rank_ != 0 || path.empty()) return true;  // coordinator-only file
+  std::unique_lock<std::mutex> lk(mu_);
+  StopLocked(lk);
+  file_ = fopen(path.c_str(), "w");
+  if (!file_) return false;
+  fputs("[\n", file_);
+  closing_ = false;
+  writer_ = std::thread([this] { WriterLoop(); });
+  mark_cycles_.store(mark_cycles, std::memory_order_relaxed);
+  enabled_.store(true, std::memory_order_relaxed);
+  return true;
+}
+
+void Timeline::Stop() {
+  std::unique_lock<std::mutex> lk(mu_);
+  StopLocked(lk);
+}
+
+// caller holds lk on mu_; returns with it re-held
+void Timeline::StopLocked(std::unique_lock<std::mutex>& lk) {
+  if (!file_) return;
+  enabled_.store(false, std::memory_order_relaxed);
+  closing_ = true;
+  cv_.notify_all();
+  if (writer_.joinable()) {
+    // let the writer drain the queue; it exits once empty + closing
+    lk.unlock();
+    writer_.join();
+    lk.lock();
+  }
+  std::queue<Event>().swap(q_);  // drop events raced in after drain
+  fputs("{}]\n", file_);
+  fclose(file_);
+  file_ = nullptr;
+}
 
 double Timeline::Now() {
   return std::chrono::duration<double, std::micro>(
@@ -20,22 +54,25 @@ double Timeline::Now() {
 }
 
 void Timeline::Begin(const std::string& tid, const std::string& name) {
-  if (!file_) return;
+  if (!enabled()) return;
   std::lock_guard<std::mutex> lk(mu_);
+  if (!file_) return;
   q_.push({'B', tid, name, Now()});
   cv_.notify_one();
 }
 
 void Timeline::End(const std::string& tid) {
-  if (!file_) return;
+  if (!enabled()) return;
   std::lock_guard<std::mutex> lk(mu_);
+  if (!file_) return;
   q_.push({'E', tid, "", Now()});
   cv_.notify_one();
 }
 
 void Timeline::Instant(const std::string& name) {
-  if (!file_) return;
+  if (!enabled()) return;
   std::lock_guard<std::mutex> lk(mu_);
+  if (!file_) return;
   q_.push({'i', "marker", name, Now()});
   cv_.notify_one();
 }
@@ -46,7 +83,7 @@ void Timeline::WriterLoop() {
     {
       std::unique_lock<std::mutex> lk(mu_);
       cv_.wait(lk, [&] { return !q_.empty() || closing_; });
-      if (q_.empty()) return;
+      if (q_.empty()) return;  // closing and drained
       ev = q_.front();
       q_.pop();
     }
@@ -55,19 +92,6 @@ void Timeline::WriterLoop() {
             "\"ts\":%.3f},\n",
             ev.ph, ev.name.c_str(), rank_, ev.tid.c_str(), ev.ts_us);
   }
-}
-
-void Timeline::Close() {
-  if (!file_) return;
-  {
-    std::lock_guard<std::mutex> lk(mu_);
-    closing_ = true;
-    cv_.notify_all();
-  }
-  if (writer_.joinable()) writer_.join();
-  fputs("{}]\n", file_);
-  fclose(file_);
-  file_ = nullptr;
 }
 
 }  // namespace hvd

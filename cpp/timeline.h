@@ -1,8 +1,10 @@
 // Chrome-tracing timeline for the native core (reference:
 // horovod/common/timeline.{h,cc} — writer thread + activity events;
-// coordinator-only file, operations.cc:459-475).
+// coordinator-only file, operations.cc:459-475; dynamic start/stop via the
+// C API, operations.cc:1011-1041; activity taxonomy common.h:73-105).
 #pragma once
 
+#include <atomic>
 #include <chrono>
 #include <condition_variable>
 #include <cstdio>
@@ -15,13 +17,22 @@ namespace hvd {
 
 class Timeline {
  public:
-  Timeline(int rank, const std::string& path);
+  // path empty or rank != 0 -> disabled until Start() is called
+  Timeline(int rank, const std::string& path, bool mark_cycles = false);
   ~Timeline();
-  bool enabled() const { return file_ != nullptr; }
+  bool enabled() const { return enabled_.load(std::memory_order_relaxed); }
+  bool mark_cycles() const {
+    return mark_cycles_.load(std::memory_order_relaxed);
+  }
+  // Dynamic control (reference: horovod_start_timeline/_stop_timeline).
+  // Coordinator-only: non-zero ranks no-op and return OK. Start on an
+  // already-running timeline reopens at the new path.
+  bool Start(const std::string& path, bool mark_cycles);
+  void Stop();
   void Begin(const std::string& tid, const std::string& name);
   void End(const std::string& tid);
   void Instant(const std::string& name);
-  void Close();
+  void Close() { Stop(); }
 
  private:
   struct Event {
@@ -30,11 +41,14 @@ class Timeline {
     double ts_us;
   };
   void WriterLoop();
+  void StopLocked(std::unique_lock<std::mutex>& lk);
   double Now();
   int rank_;
   FILE* file_ = nullptr;
+  std::atomic<bool> enabled_{false};
+  std::atomic<bool> mark_cycles_{false};
   std::chrono::steady_clock::time_point t0_;
-  std::mutex mu_;
+  std::mutex mu_;          // queue + lifecycle
   std::condition_variable cv_;
   std::queue<Event> q_;
   bool closing_ = false;

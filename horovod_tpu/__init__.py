@@ -36,6 +36,7 @@ from horovod_tpu.common.basics import (  # noqa: F401
     global_device_count,
     start_timeline,
     stop_timeline,
+    counters,
     xla_built,
     tcp_core_built,
     gloo_built,

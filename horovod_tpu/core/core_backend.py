@@ -82,6 +82,10 @@ def _load_lib():
                                         ctypes.c_int64]
         lib.hvd_add_process_set.restype = ctypes.c_int
         lib.hvd_last_join_rank.restype = ctypes.c_int
+        lib.hvd_counters_json.restype = ctypes.c_char_p
+        lib.hvd_start_timeline.restype = ctypes.c_int
+        lib.hvd_start_timeline.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.hvd_stop_timeline.restype = ctypes.c_int
         _LIB = lib
         return lib
 
@@ -358,6 +362,32 @@ class CoreBackend(Backend):
         ch = self._lib.hvd_enqueue_join(self._domain)
         CoreHandle(self._lib, ch, lambda: None).wait()
         return self._lib.hvd_last_join_rank(self._domain)
+
+    # -- observability -------------------------------------------------------
+    def counters(self) -> dict:
+        """Engine control-plane counters (cpp hvd_counters_json): cycles,
+        cache hits/misses/evictions, responses executed, fusion stats,
+        bytes moved."""
+        import json
+        return json.loads(self._lib.hvd_counters_json().decode())
+
+    def start_core_timeline(self, file_path: str,
+                            mark_cycles: bool = False) -> bool:
+        """Dynamic start of the engine's chrome-tracing timeline
+        (coordinator-only file; reference operations.cc:1011-1041)."""
+        rc = self._lib.hvd_start_timeline(file_path.encode(),
+                                          1 if mark_cycles else 0)
+        if rc != 0:
+            raise RuntimeError("start_timeline failed: " +
+                               self._lib.hvd_last_error().decode())
+        return True
+
+    def stop_core_timeline(self) -> bool:
+        rc = self._lib.hvd_stop_timeline()
+        if rc != 0:
+            raise RuntimeError("stop_timeline failed: " +
+                               self._lib.hvd_last_error().decode())
+        return True
 
     # -- lifecycle -----------------------------------------------------------
     def make_subset(self, ranks: Sequence[int]):

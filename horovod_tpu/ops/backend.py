@@ -132,6 +132,24 @@ class Backend(abc.ABC):
             "TCP core backend (unset HOROVOD_TPU_OPERATIONS) for "
             "join-style uneven data")
 
+    # -- observability ------------------------------------------------------
+    def counters(self) -> dict:
+        """Control-plane counters (cache-hit rate, negotiation volume,
+        fusion effectiveness). Backends without a negotiating control
+        plane have nothing to report."""
+        return {}
+
+    def start_core_timeline(self, file_path: str,
+                            mark_cycles: bool = False) -> bool:
+        """Dynamically start the backend's native timeline (reference:
+        ``horovod_start_timeline``, ``operations.cc:1011-1041``). Returns
+        True if the backend owns the timeline file (Python layer must then
+        NOT open it too — one writer per path)."""
+        return False
+
+    def stop_core_timeline(self) -> bool:
+        return False
+
     # -- lifecycle ----------------------------------------------------------
     @abc.abstractmethod
     def make_subset(self, ranks: Sequence[int]) -> "Backend": ...
