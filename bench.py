@@ -8,6 +8,8 @@ img/s/GPU (``docs/benchmarks.rst:32-43``, 4×4 Pascal P100, batch 64) — the
 only absolute throughput the reference publishes.
 
 ``HVD_BENCH_MODEL`` selects the model: ``resnet50`` (default) /
+``resnet50_bare`` (the SAME model in plain flax+optax with no
+horovod_tpu anywhere — the framework-overhead control) /
 ``resnet101`` / ``vgg16`` / ``inception3`` / ``bert`` (BERT-Large
 pretraining, the BASELINE north-star secondary model) / ``gpt`` (decoder
 LM on the flagship transformer; shape via ``HVD_BENCH_GPT_{LAYERS,DMODEL,
@@ -17,6 +19,13 @@ tune shapes. See docs/PERF.md for recorded numbers.
 Hardened for the driver contract:
 - the measurement runs in a CHILD process, so every retry gets a fresh JAX
   (a failed backend init is cached for the life of a process);
+- a PERSISTENT compilation cache (repo-local ``.jax_cache``) so retries
+  and successive driver rounds compile warm instead of paying the
+  multi-minute cold compile that blew round 3's deadline;
+- a PROVISIONAL result (measured warmup-window throughput,
+  ``"provisional": true``) is emitted before the patient timing window
+  and salvaged by the streaming parent, so even a deadline-killed run
+  carries a real measured number;
 - hard TOTAL wall-clock budget (``HVD_BENCH_TOTAL_BUDGET_S``, default
   1200 s): one patient attempt sized to the remaining budget, fast
   retries only if budget remains, fallback JSON emitted BEFORE the cap;
@@ -33,6 +42,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 REFERENCE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # docs/benchmarks.rst:32-43
@@ -92,20 +102,66 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
     ``step_fn(state) -> (state, loss)`` runs one training step;
     ``readback(state)`` forces completion of the queued chain;
     ``state.lowerable()`` returns ``(jitted, args)`` for cost analysis.
+
+    A PROVISIONAL result line (same schema + ``"provisional": true``) is
+    emitted from a short measured warmup window BEFORE the patient timing
+    window, so a run killed by an external deadline still carries a real
+    measured number (round-3 failure mode: cold compile through the relay
+    out-waited the driver and the round shipped value=null).
     """
     import jax
 
-    _log("compiling + warmup...")
-    for _ in range(3):
+    def emit(value, dt_window, n_iters, provisional, flops_per_device,
+             flops_src, compile_s):
+        peak = _peak_flops(jax.devices()[0].device_kind)
+        mfu = (round(flops_per_device * n_iters / dt_window / peak, 4)
+               if peak and flops_per_device else None)
+        # extra values may be callables of the per-chip rate
+        ex = {k: (v(value) if callable(v) else v) for k, v in extra.items()}
+        doc = {
+            "metric": metric,
+            "value": round(value, 2),
+            "unit": unit,
+            "vs_baseline": round(value / vs_baseline_per_unit, 3)
+            if vs_baseline_per_unit else None,
+            "mfu": mfu,
+            "flops_per_device_per_step": flops_per_device,
+            "flops_source": flops_src,
+            "n_chips": n_chips,
+            "device_kind": jax.devices()[0].device_kind,
+            "compile_s": round(compile_s, 1),
+            "timing_iters": n_iters,
+            **ex,
+        }
+        if provisional:
+            doc["provisional"] = True
+        print(json.dumps(doc), flush=True)
+
+    _log("compiling (first step)...")
+    t_c0 = time.perf_counter()
+    state, loss = step_fn(state)
+    readback(loss)
+    compile_s = time.perf_counter() - t_c0
+    _log(f"first step (compile+run) took {compile_s:.1f}s; warmup window...")
+
+    # measured warmup window -> provisional result (analytic FLOPs: cheap)
+    warmup_iters = 2
+    t_w0 = time.perf_counter()
+    for _ in range(warmup_iters):
         state, loss = step_fn(state)
     readback(loss)
-    _log("warmup done; timing...")
+    dt_w = time.perf_counter() - t_w0
+    emit(per_step_units * warmup_iters / dt_w / n_chips, dt_w, warmup_iters,
+         provisional=True, flops_per_device=analytic_flops_per_device(),
+         flops_src="analytic", compile_s=compile_s)
+    _log(f"provisional emitted (warmup {dt_w:.2f}s); timing...")
 
     t0 = time.perf_counter()
     for _ in range(iters):
         state, loss = step_fn(state)
     readback(loss)  # forces completion of the whole chain
     dt = time.perf_counter() - t0
+    _log(f"timing window {dt:.2f}s for {iters} iters")
 
     per_chip = per_step_units * iters / dt / n_chips
 
@@ -123,25 +179,9 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
         flops_per_device = analytic_flops_per_device()
         flops_src = "analytic"
 
-    peak = _peak_flops(jax.devices()[0].device_kind)
-    mfu = round(flops_per_device * iters / dt / peak, 4) if peak else None
-
-    # extra values may be callables of the per-chip rate (derived fields)
-    extra = {k: (v(per_chip) if callable(v) else v)
-             for k, v in extra.items()}
-    print(json.dumps({
-        "metric": metric,
-        "value": round(per_chip, 2),
-        "unit": unit,
-        "vs_baseline": round(per_chip / vs_baseline_per_unit, 3)
-        if vs_baseline_per_unit else None,
-        "mfu": mfu,
-        "flops_per_device_per_step": flops_per_device,
-        "flops_source": flops_src,
-        "n_chips": n_chips,
-        "device_kind": jax.devices()[0].device_kind,
-        **extra,
-    }), flush=True)
+    emit(per_chip, dt, iters, provisional=False,
+         flops_per_device=flops_per_device, flops_src=flops_src,
+         compile_s=compile_s)
 
 
 class _Run:
@@ -394,32 +434,153 @@ def _child_cnn(which: str) -> None:
         extra=extra)
 
 
+def _child_resnet50_bare() -> None:
+    """CONTROL RUN (HVD_BENCH_MODEL=resnet50_bare): the identical
+    ResNet-50 in plain flax + optax + ``jax.jit`` — no ``hvd.init``, no
+    mesh, no shardings, no framework train-step wrapper, no horovod_tpu
+    collectives. Quantifies the framework's single-chip overhead: if this
+    control lands within ~3% of the framework number, the measured MFU is
+    the model/XLA ceiling, not framework tax (VERDICT r3, weak #2).
+
+    The flax module class is imported for architecture identity — it is
+    pure flax with zero framework coupling (``models/resnet.py``); the
+    training step below is written from scratch here."""
+    import functools
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models.resnet import ResNet50
+
+    _log(f"devices: {jax.devices()}")
+    dev = jax.devices()[0]
+
+    batch = int(os.environ.get("HVD_BENCH_BATCH", "256"))
+    stem = os.environ.get("HVD_BENCH_STEM", "s2d")
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem=stem)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3), jnp.bfloat16),
+        train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = jax.jit(tx.init)(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step(params, batch_stats, opt_state, images, labels):
+        def loss_fn(p):
+            logits, mut = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images,
+                train=True, mutable=["batch_stats"])
+            one_hot = jax.nn.one_hot(labels, logits.shape[-1])
+            loss = optax.softmax_cross_entropy(logits, one_hot).mean()
+            return loss, mut["batch_stats"]
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, opt_state, loss
+
+    rng = np.random.RandomState(0)
+    images = jax.device_put(jnp.asarray(
+        rng.rand(batch, 224, 224, 3), jnp.bfloat16), dev)
+    labels = jax.device_put(jnp.asarray(
+        rng.randint(0, 1000, (batch,)), jnp.int32), dev)
+
+    run = _Run(step, params, batch_stats, opt_state, images, labels)
+
+    def step_fn(run):
+        p, bs, o, loss = run.jitted(*run.args)
+        run.args[0], run.args[1], run.args[2] = p, bs, o
+        return run, loss
+
+    _measure_and_report(
+        step_fn, run, readback=float,
+        analytic_flops_per_device=lambda:
+            3 * 2 * FWD_MACS_PER_IMG["resnet50"] * batch,
+        iters=20, per_step_units=batch, n_chips=1,
+        metric="resnet50_bare_images_per_sec_per_chip", unit="img/s/chip",
+        vs_baseline_per_unit=REFERENCE_IMG_PER_SEC_PER_DEVICE,
+        extra={"batch_per_chip": batch, "stem": stem, "control": True})
+
+
+def _enable_compile_cache() -> None:
+    """Point JAX's persistent compilation cache at a repo-local dir so
+    retries and successive driver rounds compile warm. A cold ResNet-50
+    compile through the relay can exceed the driver's deadline; with the
+    cache populated it is seconds. Harmless no-op if the backend doesn't
+    support the cache."""
+    import jax
+    cache_dir = os.environ.get(
+        "HVD_BENCH_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache EVERY entry: the driver's cold run must find the step
+        # function warm no matter how fast it compiled for the builder
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        _log(f"persistent compile cache at {cache_dir}")
+    except Exception as e:  # cache is an optimization, never a failure
+        _log(f"compile cache unavailable: {e!r}")
+
+
 def _child() -> None:
     """Run the actual measurement; print the result JSON line to stdout."""
+    # honor an explicit JAX_PLATFORMS over any sitecustomize that force-
+    # selects the TPU plugin: a CPU-targeted child must never hang waiting
+    # on the TPU relay (env var alone loses to a config.update made at
+    # interpreter startup)
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    _enable_compile_cache()
     which = os.environ.get("HVD_BENCH_MODEL", "resnet50").lower()
     if which in ("bert", "bert_large"):  # zoo key and short form
         _child_bert()
     elif which in ("gpt", "transformer"):
         _child_gpt()
+    elif which == "resnet50_bare":
+        _child_resnet50_bare()
     elif which in ("resnet50", "resnet101", "vgg16", "inception3"):
         _child_cnn(which)
     else:
         # rc 2 = deterministic config error; the parent fails fast
         # instead of retrying
         _log(f"unknown HVD_BENCH_MODEL={which!r}; expected "
-             "resnet50|resnet101|vgg16|inception3|bert|gpt")
+             "resnet50|resnet50_bare|resnet101|vgg16|inception3|bert|gpt")
         sys.exit(2)
 
 
 def _run_attempt(deadline_s):
-    """Run one child attempt; return (result_line | None, error_tail)."""
+    """Run one child attempt, STREAMING its stdout so lines emitted before
+    a deadline kill survive. Returns ``(final_line | None,
+    provisional_line | None, error | None)`` — ``final_line`` is the
+    non-provisional result; ``provisional_line`` the warmup-window one."""
+    lines = []
     proc = subprocess.Popen(
         [sys.executable, "-u", os.path.abspath(__file__), "--child"],
-        stdout=subprocess.PIPE, stderr=sys.stderr, text=True,
+        stdout=subprocess.PIPE, stderr=sys.stderr, text=True, bufsize=1,
         cwd=os.path.dirname(os.path.abspath(__file__)))
+
+    def _drain(pipe):
+        try:
+            for line in pipe:
+                lines.append(line)
+        except (ValueError, OSError):
+            pass  # parent closed the pipe out from under us: done
+
+    reader = threading.Thread(target=_drain, args=(proc.stdout,),
+                              daemon=True)
+    reader.start()
+    timed_out = False
     try:
-        out, _ = proc.communicate(timeout=deadline_s)
+        proc.wait(timeout=deadline_s)
     except subprocess.TimeoutExpired:
+        timed_out = True
         # SIGTERM first so the PJRT client can tear down its chip claim;
         # if the child is wedged in native init (SIGTERM deferred), we
         # MUST escalate to SIGKILL: an abandoned live child keeps
@@ -437,19 +598,37 @@ def _run_attempt(deadline_s):
                 proc.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 pass
-        return None, f"attempt exceeded {deadline_s:.0f}s deadline"
-    for line in reversed((out or "").strip().splitlines()):
+    # closing our end of the pipe unblocks the drain thread even if a
+    # grandchild inherited the write end and never exits (the reader gets
+    # EBADF/EOF instead of blocking forever, and we stop leaking an fd +
+    # thread per attempt)
+    try:
+        proc.stdout.close()
+    except OSError:
+        pass
+    reader.join(timeout=10)
+
+    final = provisional = None
+    for line in list(lines):  # snapshot: drain thread may yet be alive
         try:
             parsed = json.loads(line)
-            if isinstance(parsed, dict) and "metric" in parsed:
-                return line, None
         except ValueError:
             continue
-    tail = (out or "").strip().splitlines()[-5:]
+        if isinstance(parsed, dict) and "metric" in parsed:
+            if parsed.get("provisional"):
+                provisional = line.strip()
+            else:
+                final = line.strip()
+    if final is not None:
+        return final, provisional, None
+    if timed_out:
+        return None, provisional, \
+            f"attempt exceeded {deadline_s:.0f}s deadline"
+    tail = "".join(lines).strip().splitlines()[-5:]
     err = f"child rc={proc.returncode}: " + " | ".join(tail)[-600:]
     if proc.returncode == 2:  # deterministic config error: do not retry
         err = "config error (no retry): " + err
-    return None, err
+    return None, provisional, err
 
 
 def _failure_identity():
@@ -461,6 +640,8 @@ def _failure_identity():
         return "bert_large_seqs_per_sec_per_chip", "seq/s/chip"
     if which in ("gpt", "transformer"):
         return "gpt_tokens_per_sec_per_chip", "tokens/s/chip"
+    if which == "resnet50_bare":
+        return "resnet50_bare_images_per_sec_per_chip", "img/s/chip"
     if which in FWD_MACS_PER_IMG:
         return f"{which}_images_per_sec_per_chip", "img/s/chip"
     return f"unknown_model_{which}", "n/a"
@@ -474,6 +655,7 @@ def main() -> None:
     t_start = time.monotonic()
     errors = []
     attempts_run = 0
+    best_provisional = None
     while attempts_run < MAX_ATTEMPTS:
         # reserve covers: fallback emission + the kill/reap path inside
         # _run_attempt (terminate wait 60s + SIGKILL reap 30s = 90s),
@@ -487,16 +669,27 @@ def main() -> None:
                     f"(HVD_BENCH_TOTAL_BUDGET_S={TOTAL_BUDGET_S:.0f})")
             break  # not enough budget for a meaningful attempt
         attempts_run += 1
-        line, err = _run_attempt(deadline_s=remaining)
+        line, provisional, err = _run_attempt(deadline_s=remaining)
         if line is not None:
             print(line, flush=True)
             return
+        if provisional is not None:
+            best_provisional = provisional
         errors.append(f"attempt {attempts_run}: {err}")
         print(f"[bench] {errors[-1]}", file=sys.stderr, flush=True)
         if err.startswith("config error"):
             break
         if attempts_run < MAX_ATTEMPTS:
             time.sleep(BACKOFF_S)
+    if best_provisional is not None:
+        # The warmup window produced a REAL measured throughput before the
+        # attempt was cut short — that beats a value:null artifact. The
+        # line keeps "provisional": true and gains the failure context.
+        doc = json.loads(best_provisional)
+        doc["note"] = ("final timing window did not complete: "
+                       + "; ".join(errors)[-400:])
+        print(json.dumps(doc), flush=True)
+        return
     # Persistent failure: still emit one parseable JSON line, rc 0.
     # last_measured carries the most recent REAL-hardware result for this
     # metric (from the committed measurement log) so a relay outage at

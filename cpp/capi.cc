@@ -261,7 +261,9 @@ int hvd_stop_timeline() {
 
 // Control-plane counters as one JSON object (steady-state observability:
 // cache-hit rate, fusion effectiveness, negotiation volume).
-static std::string g_counters_json;
+// thread_local: concurrent callers each keep their own buffer, and the
+// returned pointer stays valid until the SAME thread calls again
+static thread_local std::string g_counters_json;
 const char* hvd_counters_json() {
   const auto& c = Core::Get().counters();
   std::ostringstream os;

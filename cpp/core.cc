@@ -954,6 +954,8 @@ std::vector<Response> Core::FuseResponses(
       // steps gather many small tensors per cycle
       std::ostringstream gk;
       gk << "ag|" << (int)s.dtypes[0];
+      if (cfg_.disable_group_fusion)
+        gk << "|g" << s.group_id;  // keep groups (and loose tensors) apart
       key = gk.str();
     } else {
       out.push_back(s);

@@ -12,39 +12,47 @@ Timeline::~Timeline() { Stop(); }
 
 bool Timeline::Start(const std::string& path, bool mark_cycles) {
   if (rank_ != 0 || path.empty()) return true;  // coordinator-only file
-  std::unique_lock<std::mutex> lk(mu_);
-  StopLocked(lk);
-  file_ = fopen(path.c_str(), "w");
-  if (!file_) return false;
-  fputs("[\n", file_);
-  closing_ = false;
-  writer_ = std::thread([this] { WriterLoop(); });
+  std::lock_guard<std::mutex> lg(lifecycle_mu_);
+  StopUnlocked();  // fully retires any previous writer + file first
+  FILE* f = fopen(path.c_str(), "w");
+  if (!f) return false;
+  fputs("[\n", f);
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    file_ = f;
+    closing_ = false;
+    std::queue<Event>().swap(q_);  // drop events raced in while stopped
+  }
+  // the writer owns its FILE* by value: a later Stop() can null file_
+  // without pulling the file out from under an in-flight fprintf
+  writer_ = std::thread([this, f] { WriterLoop(f); });
   mark_cycles_.store(mark_cycles, std::memory_order_relaxed);
   enabled_.store(true, std::memory_order_relaxed);
   return true;
 }
 
 void Timeline::Stop() {
-  std::unique_lock<std::mutex> lk(mu_);
-  StopLocked(lk);
+  std::lock_guard<std::mutex> lg(lifecycle_mu_);
+  StopUnlocked();
 }
 
-// caller holds lk on mu_; returns with it re-held
-void Timeline::StopLocked(std::unique_lock<std::mutex>& lk) {
-  if (!file_) return;
-  enabled_.store(false, std::memory_order_relaxed);
-  closing_ = true;
-  cv_.notify_all();
-  if (writer_.joinable()) {
-    // let the writer drain the queue; it exits once empty + closing
-    lk.unlock();
-    writer_.join();
-    lk.lock();
+// caller holds lifecycle_mu_; idempotent — a second concurrent Stop (or
+// the destructor racing a Python stop_timeline) sees file_ == nullptr
+// under mu_ and returns without touching the writer or the FILE*.
+void Timeline::StopUnlocked() {
+  FILE* f;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!file_) return;
+    enabled_.store(false, std::memory_order_relaxed);
+    closing_ = true;
+    f = file_;
+    file_ = nullptr;  // Begin/End stop enqueueing from here on
+    cv_.notify_all();
   }
-  std::queue<Event>().swap(q_);  // drop events raced in after drain
-  fputs("{}]\n", file_);
-  fclose(file_);
-  file_ = nullptr;
+  if (writer_.joinable()) writer_.join();  // drains the queue, then exits
+  fputs("{}]\n", f);
+  fclose(f);
 }
 
 double Timeline::Now() {
@@ -77,7 +85,7 @@ void Timeline::Instant(const std::string& name) {
   cv_.notify_one();
 }
 
-void Timeline::WriterLoop() {
+void Timeline::WriterLoop(FILE* file) {
   for (;;) {
     Event ev;
     {
@@ -87,7 +95,7 @@ void Timeline::WriterLoop() {
       ev = q_.front();
       q_.pop();
     }
-    fprintf(file_,
+    fprintf(file,
             "{\"ph\":\"%c\",\"name\":\"%s\",\"pid\":%d,\"tid\":\"%s\","
             "\"ts\":%.3f},\n",
             ev.ph, ev.name.c_str(), rank_, ev.tid.c_str(), ev.ts_us);

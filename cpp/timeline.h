@@ -40,15 +40,20 @@ class Timeline {
     std::string tid, name;
     double ts_us;
   };
-  void WriterLoop();
-  void StopLocked(std::unique_lock<std::mutex>& lk);
+  void WriterLoop(FILE* file);
+  void StopUnlocked();  // caller holds lifecycle_mu_
   double Now();
   int rank_;
   FILE* file_ = nullptr;
   std::atomic<bool> enabled_{false};
   std::atomic<bool> mark_cycles_{false};
   std::chrono::steady_clock::time_point t0_;
-  std::mutex mu_;          // queue + lifecycle
+  // lifecycle_mu_ serializes whole Start()/Stop() operations (a concurrent
+  // Stop/Start/destructor pair must never join the same writer thread
+  // twice or double-close the FILE*); mu_ protects the event queue and is
+  // the only lock the hot Begin/End path or the writer ever takes.
+  std::mutex lifecycle_mu_;
+  std::mutex mu_;  // queue (+ file_ presence check on the event path)
   std::condition_variable cv_;
   std::queue<Event> q_;
   bool closing_ = false;
