@@ -1,7 +1,10 @@
-"""ctypes bindings to the native core (placeholder until libhvdcore lands).
+"""Gate between the Python surface and the native core: raises a
+build-instruction error for multi-process runs without ``libhvdcore.so``,
+hands back a :class:`CoreBackend` otherwise.
 
 Reference analog: ``horovod/common/basics.py:29-149`` loading the C library
-and exposing ``horovod_init``/enqueue functions.
+and exposing ``horovod_init``/enqueue functions (the full ctypes surface
+lives in ``core_backend.py``).
 """
 
 from __future__ import annotations

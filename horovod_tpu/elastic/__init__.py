@@ -70,10 +70,43 @@ def world_doc_signature(secret: bytes, doc: dict) -> str:
     return hmac.new(secret, body, hashlib.sha256).hexdigest()
 
 
-def _world_update() -> Optional[dict]:
-    """Poll the driver's KV for a newer world document (reference: the
-    driver→worker host-update push, ``runner/elastic/worker.py:46`` —
-    pull-at-commit here, which needs no per-worker listener port)."""
+def _validate_doc(raw: Optional[bytes]) -> Optional[dict]:
+    """Parse + HMAC-verify a world doc and keep it only when its
+    generation is newer than ours — shared by both delivery channels
+    (a pushed doc is no more trusted than a polled one: the listener
+    port is open to the network)."""
+    if raw is None:
+        return None
+    import hmac as _hmac
+    import json
+    try:
+        doc = json.loads(raw)
+        if not isinstance(doc, dict):
+            return None
+        secret_hex = os.environ.get("HVD_ELASTIC_SECRET", "")
+        if secret_hex:
+            expect = world_doc_signature(bytes.fromhex(secret_hex), doc)
+            sig = doc.get("sig", "")
+            if not isinstance(sig, str) or \
+                    not _hmac.compare_digest(sig, expect):
+                return None  # forged/corrupt doc: ignore
+        if int(doc.get("generation", 0)) > _current_generation:
+            return doc
+    except (ValueError, TypeError, AttributeError):
+        # anyone can PUT bytes at the listener port: malformed docs must
+        # never escalate past "ignored" (a crash here kills training)
+        return None
+    return None
+
+
+def _world_update(poll: bool = True) -> Optional[dict]:
+    """A newer world document, if the driver published one. Checked in
+    channel order: (1) the push channel — the driver POSTs the doc to a
+    per-worker listener the moment it publishes (reference:
+    ``runner/elastic/worker.py:46+`` WorkerNotificationService), so this
+    is one in-process read; (2) with ``poll=True``, a poll of the driver
+    KV as fallback for lost pushes (the original pull-at-commit design).
+    """
     global _current_generation
     kv = os.environ.get("HVD_ELASTIC_KV", "")
     if not kv:
@@ -82,6 +115,20 @@ def _world_update() -> Optional[dict]:
         _current_generation = int(
             os.environ.get("HVD_ELASTIC_GENERATION", "0"))
     addr, _, port = kv.rpartition(":")
+
+    # listener setup (bind + driver registration, up to one 5s kv_put) only
+    # happens on the COMMIT path; the mid-step probe (poll=False) must stay
+    # an in-process read and just sees "nothing yet" before first commit
+    from horovod_tpu.elastic.notification import (current_listener,
+                                                  ensure_listener)
+    listener = current_listener() if not poll else \
+        ensure_listener(addr, int(port))
+    if listener is not None:
+        doc = _validate_doc(listener.pending_raw())
+        if doc is not None:
+            return doc
+    if not poll:
+        return None
     try:
         from horovod_tpu.runner.http_kv import kv_get
         # short timeout: commit() must stay cheap even if the driver's
@@ -89,19 +136,14 @@ def _world_update() -> Optional[dict]:
         raw = kv_get(addr, int(port), "world", "current", timeout=3.0)
     except OSError:
         return None  # driver KV transiently unreachable: not our problem
-    if raw is None:
-        return None
-    import hmac as _hmac
-    import json
-    doc = json.loads(raw)
-    secret_hex = os.environ.get("HVD_ELASTIC_SECRET", "")
-    if secret_hex:
-        expect = world_doc_signature(bytes.fromhex(secret_hex), doc)
-        if not _hmac.compare_digest(doc.get("sig", ""), expect):
-            return None  # forged/corrupt doc: ignore
-    if int(doc.get("generation", 0)) > _current_generation:
-        return doc
-    return None
+    return _validate_doc(raw)
+
+
+def has_pending_update() -> bool:
+    """True when a newer world document has already ARRIVED at this worker
+    (pushed by the driver) — without any driver round-trip. A long-running
+    step can check this cheaply mid-step to decide to commit early."""
+    return _world_update(poll=False) is not None
 
 
 def _apply_world_update(update: dict) -> None:

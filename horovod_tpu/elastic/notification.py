@@ -1,0 +1,112 @@
+"""Push-based worker notification channel.
+
+Reference: ``horovod/runner/elastic/worker.py:46+``
+(``WorkerNotificationService``/``WorkerNotificationManager``: every worker
+runs a tiny HTTP listener and the driver pushes host-update requests to
+it). With minutes-long TPU steps, the poll-at-commit design alone makes
+growth-response latency equal to the commit interval; the push channel
+delivers the driver's new world document the moment it is published, so
+``state.commit()`` finds it locally (one in-process read, no driver
+round-trip) and ``HostsUpdatedInterrupt`` fires at the very next commit.
+
+Design: the worker listener IS a :class:`KVStoreServer` (the same HMAC'd
+world-document bytes the driver publishes to its own KV are pushed into
+the worker's local KV under ``world/current``), and workers register
+their listener address in the driver KV under ``notify/<rank>``. The
+driver pushes best-effort with short timeouts — the commit-time poll of
+the driver KV remains as the fallback, so a lost push costs latency, not
+correctness. Docs are HMAC-verified on the worker regardless of which
+channel delivered them (the listener port is open to the network).
+
+``HVD_ELASTIC_PUSH=0`` disables the listener (poll-only mode).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Optional
+
+from horovod_tpu.common.logging import get_logger
+
+_lock = threading.Lock()
+_listener: Optional["WorkerNotificationListener"] = None
+_disabled = False
+
+
+class WorkerNotificationListener:
+    """Per-worker push endpoint + registration with the driver KV."""
+
+    def __init__(self) -> None:
+        from horovod_tpu.runner.http_kv import KVStoreServer
+        self._kv = KVStoreServer()
+        self._kv.start()
+
+    @property
+    def port(self) -> int:
+        return self._kv.port
+
+    def pending_raw(self) -> Optional[bytes]:
+        """The most recently pushed world document (unvalidated bytes)."""
+        return self._kv.get("world", "current")
+
+    def register(self, driver_addr: str, driver_port: int) -> None:
+        """Record ``notify/<rank> -> host:port`` in the driver KV so the
+        driver knows where to push (host = this worker's slot hostname,
+        which the driver can route to by construction)."""
+        from horovod_tpu.runner.http_kv import kv_put
+        my_host = os.environ.get("HOROVOD_HOSTNAME") or socket.getfqdn()
+        rank = os.environ.get("HOROVOD_RANK",
+                              os.environ.get("HVD_TPU_RANK", "0"))
+        kv_put(driver_addr, driver_port, "notify", rank,
+               f"{my_host}:{self.port}".encode(), timeout=5.0)
+
+    def stop(self) -> None:
+        self._kv.stop()
+
+
+def ensure_listener(driver_addr: str, driver_port: int) -> \
+        Optional[WorkerNotificationListener]:
+    """Start + register the singleton listener on first use; returns None
+    when push is disabled or registration failed (poll-only fallback)."""
+    global _listener, _disabled
+    with _lock:
+        if _disabled or os.environ.get("HVD_ELASTIC_PUSH", "1") == "0":
+            return None
+        if _listener is not None:
+            return _listener
+        try:
+            listener = WorkerNotificationListener()
+            listener.register(driver_addr, driver_port)
+        except OSError as e:
+            # an unreachable driver KV or unbindable port must never break
+            # training: fall back to poll-at-commit for the process's life
+            get_logger().warning(
+                "worker notification listener disabled (%s); falling back "
+                "to poll-at-commit", e)
+            _disabled = True
+            try:
+                listener.stop()
+            except Exception:
+                pass
+            return None
+        _listener = listener
+        return _listener
+
+
+def current_listener() -> Optional[WorkerNotificationListener]:
+    """The already-started listener, or None — never creates one (the
+    cheap mid-step probe must not pay bind/registration latency)."""
+    with _lock:
+        return _listener
+
+
+def reset_listener() -> None:
+    """Tear down the singleton (tests / full shutdown)."""
+    global _listener, _disabled
+    with _lock:
+        if _listener is not None:
+            _listener.stop()
+        _listener = None
+        _disabled = False

@@ -121,7 +121,29 @@ class ElasticDriver:
                "coord_addr": coord_addr, "coord_port": coord_port,
                "slots": {str(s.rank): s.to_env() for s in slots}}
         doc["sig"] = world_doc_signature(self._world_secret, doc)
-        self._kv.put("world", "current", json.dumps(doc).encode())
+        body = json.dumps(doc).encode()
+        self._kv.put("world", "current", body)
+        self._push_world(body)
+
+    def _push_world(self, body: bytes) -> None:
+        """Push the published doc to every registered worker listener
+        (reference: WorkerNotificationService push,
+        ``runner/elastic/worker.py:46+``). Best-effort with short
+        timeouts: a worker that missed the push still finds the doc by
+        polling the KV at its next commit."""
+        from horovod_tpu.runner.http_kv import kv_put
+
+        def push(host: str, port: int) -> None:
+            try:
+                kv_put(host, port, "world", "current", body, timeout=5.0)
+            except OSError as e:
+                get_logger().debug("world push to %s:%d failed: %s",
+                                   host, port, e)
+
+        for _rank, addr in self._kv.scope("notify").items():
+            host, _, port = addr.decode().rpartition(":")
+            threading.Thread(target=push, args=(host, int(port)),
+                             daemon=True).start()
 
     # -- one generation ------------------------------------------------------
     def _run_generation(self) -> str:
@@ -136,6 +158,12 @@ class ElasticDriver:
         coord_addr = "127.0.0.1" if slots[0].hostname in (
             "localhost", "127.0.0.1") else slots[0].hostname
         self._registry.reset(np)
+        # drop listener registrations from the previous generation: its
+        # processes are gone, and pushing signed world docs at dead (or
+        # recycled) host:port addresses wastes a thread per publish and
+        # could hand the doc to an unrelated process. This generation's
+        # workers re-register at their first commit.
+        self._kv.clear("notify")
         self._hosts_changed.clear()
         gen = self._generation
         self._generation += 1

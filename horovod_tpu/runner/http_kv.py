@@ -98,6 +98,10 @@ class KVStoreServer:
         with self._httpd.kv_lock:
             return dict(self._httpd.kv.get(scope, {}))
 
+    def clear(self, scope: str) -> None:
+        with self._httpd.kv_lock:
+            self._httpd.kv.pop(scope, None)
+
 
 def kv_put(addr: str, port: int, scope: str, key: str, value: bytes,
            timeout: float = 30.0) -> None:

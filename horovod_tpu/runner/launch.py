@@ -34,6 +34,12 @@ def parse_args(argv: List[str]) -> argparse.Namespace:
                    help='host list "h1:slots,h2:slots"')
     p.add_argument("--hostfile", default=None,
                    help="hostfile with lines 'host slots=N'")
+    p.add_argument("--tpu", action="store_true",
+                   help="enumerate the TPU pod slice's worker VMs from "
+                        "the GCE metadata service instead of -H/--hostfile "
+                        "(the TPU analog of the reference's MPI/LSF "
+                        "environment detection); with --min-np, elastic "
+                        "discovery re-reads the slice each refresh")
     p.add_argument("--verbose", action="store_true")
     # elastic (reference: --min-np/--max-np/--host-discovery-script)
     p.add_argument("--min-np", type=int, default=None)
@@ -134,8 +140,13 @@ def knobs_to_env(args: argparse.Namespace) -> Dict[str, str]:
 
 
 def resolve_hosts(args: argparse.Namespace) -> List[HostInfo]:
-    if args.hosts and args.hostfile:
-        raise ValueError("Specify either --hosts or --hostfile, not both")
+    if sum(bool(x) for x in
+           (args.hosts, args.hostfile, getattr(args, "tpu", False))) > 1:
+        raise ValueError(
+            "Specify only one of --hosts, --hostfile, --tpu")
+    if getattr(args, "tpu", False):
+        from horovod_tpu.runner.tpu_discovery import tpu_pod_hosts
+        return tpu_pod_hosts()
     if args.hostfile:
         return parse_hostfile(args.hostfile)
     if args.hosts:
@@ -196,6 +207,9 @@ def run_commandline(argv: List[str] = None) -> int:
             FixedHosts, HostDiscoveryScript)
         if args.host_discovery_script:
             discovery = HostDiscoveryScript(args.host_discovery_script)
+        elif args.tpu:
+            from horovod_tpu.runner.tpu_discovery import TpuPodDiscovery
+            discovery = TpuPodDiscovery()
         else:
             discovery = FixedHosts(resolve_hosts(args))
         return run_elastic(discovery, args.num_proc, args.command,

@@ -1,0 +1,107 @@
+"""TPU pod-slice host discovery from the GCE metadata service.
+
+The TPU-native replacement for the reference's scheduler-environment
+detection (``horovod/runner/launch.py:677-709`` MPI/LSF probing,
+``horovod/runner/util/lsf.py`` jsrun cluster enumeration): on a Cloud TPU
+VM every worker can enumerate the whole pod slice from the instance
+metadata server, so ``hvdrun --tpu`` and elastic ``TpuPodDiscovery`` need
+no hand-written ``-H`` host list.
+
+Metadata facts (public GCP/Cloud-TPU surface, the same one jax's
+``cloud_tpu_cluster`` bootstraps from):
+- server: ``http://metadata.google.internal/computeMetadata/v1/``,
+  requests must carry ``Metadata-Flavor: Google``;
+- ``instance/attributes/worker-network-endpoints``: comma-separated
+  entries, one per pod-slice worker, with the worker's internal IP as the
+  last ``:``-field;
+- ``instance/attributes/agent-worker-number``: this VM's worker index;
+- ``instance/attributes/accelerator-type``: e.g. ``v5litepod-16``.
+
+``HVD_TPU_METADATA_ENDPOINT`` overrides the server base URL (unit tests
+point it at a local fake; nothing else should).
+"""
+
+from __future__ import annotations
+
+import os
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from horovod_tpu.runner.hosts import HostInfo
+
+DEFAULT_ENDPOINT = "http://metadata.google.internal"
+_ATTR_BASE = "/computeMetadata/v1/instance/attributes/"
+
+
+def _endpoint(endpoint: Optional[str]) -> str:
+    return (endpoint or os.environ.get("HVD_TPU_METADATA_ENDPOINT")
+            or DEFAULT_ENDPOINT).rstrip("/")
+
+
+def metadata_get(attribute: str, endpoint: Optional[str] = None,
+                 timeout: float = 5.0) -> str:
+    """Fetch one instance attribute; raises ``OSError`` when not on a TPU
+    VM (no metadata server) or the attribute is absent."""
+    req = urllib.request.Request(
+        _endpoint(endpoint) + _ATTR_BASE + attribute,
+        headers={"Metadata-Flavor": "Google"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read().decode().strip()
+    except (urllib.error.URLError, urllib.error.HTTPError) as e:
+        raise OSError(f"metadata attribute {attribute!r} unavailable: {e}") \
+            from e
+
+
+def tpu_pod_hosts(slots: int = 1, endpoint: Optional[str] = None) -> \
+        List[HostInfo]:
+    """All pod-slice worker VMs, in worker order. ``slots`` is processes
+    per host — 1 by design (one worker process drives all local chips)."""
+    raw = metadata_get("worker-network-endpoints", endpoint)
+    hosts = []
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        # entry fields: <uuid>:<worker-name>:<ip>; be liberal and take the
+        # last field so single-field test/bare-IP entries also work
+        hosts.append(HostInfo(entry.rsplit(":", 1)[-1], slots))
+    if not hosts:
+        raise OSError("worker-network-endpoints was empty")
+    return hosts
+
+
+def tpu_worker_index(endpoint: Optional[str] = None) -> int:
+    """This VM's worker number within the slice."""
+    return int(metadata_get("agent-worker-number", endpoint))
+
+
+def tpu_accelerator_type(endpoint: Optional[str] = None) -> str:
+    return metadata_get("accelerator-type", endpoint)
+
+
+def running_on_tpu_vm(endpoint: Optional[str] = None,
+                      timeout: float = 1.0) -> bool:
+    """Cheap probe: is the TPU metadata surface reachable from here?"""
+    try:
+        metadata_get("worker-network-endpoints", endpoint, timeout=timeout)
+        return True
+    except OSError:
+        return False
+
+
+class TpuPodDiscovery:
+    """Elastic host discovery backed by the metadata server (drop-in for
+    ``HostDiscoveryScript`` in ``runner/elastic/discovery.py``). Each
+    refresh re-reads the slice membership, so repaired/replaced worker VMs
+    show up without a user discovery script; dead-but-listed workers are
+    handled by the driver's blacklist like any other failed host."""
+
+    def __init__(self, slots: int = 1, endpoint: Optional[str] = None):
+        self._slots = slots
+        self._endpoint = endpoint
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return {h.hostname: h.slots
+                for h in tpu_pod_hosts(self._slots, self._endpoint)}

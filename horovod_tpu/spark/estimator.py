@@ -139,20 +139,24 @@ class HorovodEstimator(Params):
             mask = pdf[self._validation].astype(bool)
             val_pdf, pdf = pdf[mask], pdf[~mask]
 
+        # ALL artifact IO goes through the Store's path algebra + byte API
+        # so gs://-class object stores work identically to local paths
+        # (reference: store.py:36-530 — estimators read/write exclusively
+        # through the Store)
         train_path = store.get_train_data_path(run_id)
         val_path = store.get_val_data_path(run_id)
-        os.makedirs(train_path, exist_ok=True)
-        pdf.reset_index(drop=True).to_parquet(
-            os.path.join(train_path, "data.parquet"))
+        store.makedirs(train_path)
+        store.write(store.join(train_path, "data.parquet"),
+                    _parquet_bytes(pdf.reset_index(drop=True)))
         if val_pdf is not None and len(val_pdf):
-            os.makedirs(val_path, exist_ok=True)
-            val_pdf.reset_index(drop=True).to_parquet(
-                os.path.join(val_path, "data.parquet"))
+            store.makedirs(val_path)
+            store.write(store.join(val_path, "data.parquet"),
+                        _parquet_bytes(val_pdf.reset_index(drop=True)))
         else:
             val_path = ""
 
         ckpt_dir = store.get_checkpoint_path(run_id)
-        os.makedirs(ckpt_dir, exist_ok=True)
+        store.makedirs(ckpt_dir)
         self._save_model_spec(ckpt_dir)
 
         remote = self._make_remote_fn(ckpt_dir, train_path, val_path)
@@ -174,11 +178,23 @@ class HorovodEstimator(Params):
         return model
 
 
-def read_shard(data_path: str, rank: int, size: int):
-    """Worker-side shard read: rows [rank::size] of the materialized
-    parquet (the reference partitions Petastorm row groups per rank)."""
+def _parquet_bytes(pdf) -> bytes:
+    import io
+    buf = io.BytesIO()
+    pdf.to_parquet(buf)
+    return buf.getvalue()
+
+
+def read_shard(store: Store, data_path: str, rank: int, size: int):
+    """Worker-side shard read through the Store: rows [rank::size] of the
+    materialized parquet (the reference partitions Petastorm row groups
+    per rank). The store travels to the worker by pickle, so remote
+    backends reconnect there."""
+    import io
+
     import pandas as pd
-    pdf = pd.read_parquet(os.path.join(data_path, "data.parquet"))
+    pdf = pd.read_parquet(
+        io.BytesIO(store.read(store.join(data_path, "data.parquet"))))
     return pdf.iloc[rank::size].reset_index(drop=True)
 
 

@@ -11,7 +11,6 @@ rank 0 checkpoints the final weights back to the Store.
 from __future__ import annotations
 
 import json
-import os
 import pickle
 from typing import Callable
 
@@ -21,7 +20,7 @@ from horovod_tpu.spark.estimator import (HorovodEstimator, HorovodModel,
                                          read_shard, xy_arrays)
 
 
-def _save_keras(ckpt_dir: str, model, tag: str,
+def _save_keras(store, ckpt_dir: str, model, tag: str,
                 arch: str = None) -> None:
     # arch override: the trained model is compiled with the dynamic
     # DistributedOptimizer subclass, whose compile config would not
@@ -29,15 +28,13 @@ def _save_keras(ckpt_dir: str, model, tag: str,
     # architecture instead
     spec = dict(arch=arch if arch is not None else model.to_json(),
                 weights=[np.asarray(w) for w in model.get_weights()])
-    with open(os.path.join(ckpt_dir, f"{tag}.pkl"), "wb") as f:
-        pickle.dump(spec, f)
+    store.write(store.join(ckpt_dir, f"{tag}.pkl"), pickle.dumps(spec))
 
 
-def _load_keras(ckpt_dir: str, tag: str, custom_objects):
+def _load_keras(store, ckpt_dir: str, tag: str, custom_objects):
     """Returns (model, arch_json) from one deserialization."""
     import tensorflow as tf
-    with open(os.path.join(ckpt_dir, f"{tag}.pkl"), "rb") as f:
-        spec = pickle.load(f)
+    spec = pickle.loads(store.read(store.join(ckpt_dir, f"{tag}.pkl")))
     model = tf.keras.models.model_from_json(
         spec["arch"], custom_objects=custom_objects or {})
     model.set_weights(spec["weights"])
@@ -63,30 +60,32 @@ class KerasEstimator(HorovodEstimator):
     """
 
     def _save_model_spec(self, ckpt_dir: str) -> None:
-        _save_keras(ckpt_dir, self._model, "initial")
-        with open(os.path.join(ckpt_dir, "train_spec.json"), "w") as f:
-            json.dump(dict(optimizer=self._optimizer or "sgd",
-                           learning_rate=self._learning_rate,
-                           loss=self._loss or "mse",
-                           metrics=list(self._metrics or []),
-                           feature_cols=list(self._feature_cols),
-                           label_cols=list(self._label_cols),
-                           batch_size=self._batch_size,
-                           epochs=self._epochs,
-                           verbose=self._verbose), f)
+        store = self._store
+        _save_keras(store, ckpt_dir, self._model, "initial")
+        store.write(store.join(ckpt_dir, "train_spec.json"), json.dumps(
+            dict(optimizer=self._optimizer or "sgd",
+                 learning_rate=self._learning_rate,
+                 loss=self._loss or "mse",
+                 metrics=list(self._metrics or []),
+                 feature_cols=list(self._feature_cols),
+                 label_cols=list(self._label_cols),
+                 batch_size=self._batch_size,
+                 epochs=self._epochs,
+                 verbose=self._verbose)).encode())
 
     def _make_remote_fn(self, ckpt_dir: str, train_path: str,
                         val_path: str) -> Callable:
         custom_objects = self._custom_objects
+        store = self._store  # pickled into the worker closure
 
         def remote_train():
             import tensorflow as tf
             import horovod_tpu.keras as hvd_keras
             import horovod_tpu as hvd
 
-            with open(os.path.join(ckpt_dir, "train_spec.json")) as f:
-                spec = json.load(f)
-            model, initial_arch = _load_keras(ckpt_dir, "initial",
+            spec = json.loads(store.read_text(
+                store.join(ckpt_dir, "train_spec.json")))
+            model, initial_arch = _load_keras(store, ckpt_dir, "initial",
                                               custom_objects)
             opt = tf.keras.optimizers.get(
                 {"class_name": spec["optimizer"],
@@ -96,11 +95,11 @@ class KerasEstimator(HorovodEstimator):
                 optimizer=hvd_keras.DistributedOptimizer(opt),
                 loss=spec["loss"], metrics=spec["metrics"])
 
-            pdf = read_shard(train_path, hvd.rank(), hvd.size())
+            pdf = read_shard(store, train_path, hvd.rank(), hvd.size())
             X, Y = xy_arrays(pdf, spec["feature_cols"], spec["label_cols"])
             val = None
             if val_path:
-                vX, vY = xy_arrays(read_shard(val_path, 0, 1),
+                vX, vY = xy_arrays(read_shard(store, val_path, 0, 1),
                                    spec["feature_cols"],
                                    spec["label_cols"])
                 val = (vX, vY)
@@ -111,14 +110,16 @@ class KerasEstimator(HorovodEstimator):
                              verbose=spec["verbose"] if hvd.rank() == 0
                              else 0, callbacks=cb)
             if hvd.rank() == 0:
-                _save_keras(ckpt_dir, model, "final", arch=initial_arch)
+                _save_keras(store, ckpt_dir, model, "final",
+                            arch=initial_arch)
             return {k: [float(x) for x in v]
                     for k, v in hist.history.items()}
 
         return remote_train
 
     def _load_trained_model(self, ckpt_dir: str) -> KerasModel:
-        model, _ = _load_keras(ckpt_dir, "final", self._custom_objects)
+        model, _ = _load_keras(self._store, ckpt_dir, "final",
+                               self._custom_objects)
         return KerasModel(model=model, feature_cols=self._feature_cols,
                           label_cols=self._label_cols,
                           custom_objects=self._custom_objects,

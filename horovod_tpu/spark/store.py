@@ -38,6 +38,19 @@ class Store:
     def write(self, path: str, data: bytes) -> None:
         raise NotImplementedError
 
+    def join(self, path: str, *parts: str) -> str:
+        """Path join in the store's own path algebra — estimator code must
+        never use ``os.path`` on store paths (they may be object-store
+        URLs)."""
+        raise NotImplementedError
+
+    def makedirs(self, path: str) -> None:
+        """Ensure a directory exists (no-op on keyspace-only backends)."""
+        raise NotImplementedError
+
+    def read_text(self, path: str) -> str:
+        return self.read(path).decode()
+
     @staticmethod
     def create(prefix_path: str, *args, **kwargs) -> "Store":
         """Reference: ``Store.create`` dispatch by URL scheme."""
@@ -55,7 +68,7 @@ class LocalStore(Store):
         os.makedirs(prefix_path, exist_ok=True)
 
     def _join(self, *parts: str) -> str:
-        p = os.path.join(self._prefix, *parts)
+        p = self.join(self._prefix, *parts)
         os.makedirs(os.path.dirname(p), exist_ok=True)
         return p
 
@@ -87,22 +100,36 @@ class LocalStore(Store):
             f.write(data)
         os.replace(tmp, path)
 
+    def join(self, path: str, *parts: str) -> str:
+        return os.path.join(path, *parts)
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
 
 class FilesystemStore(Store):
     """fsspec-backed store for s3://, gs://, hdfs:// URLs (reference:
-    ``FilesystemStore``/``HDFSStore``; fsspec is the modern superset)."""
+    ``FilesystemStore``/``HDFSStore``; fsspec is the modern superset).
 
-    def __init__(self, prefix_path: str) -> None:
+    ``fs`` injects a ready filesystem instance (tests use a
+    ``DirFileSystem`` faking a remote scheme; estimator workers receive
+    the store by pickle, so the fs must be picklable — fsspec filesystems
+    reconstruct from their storage options)."""
+
+    def __init__(self, prefix_path: str, fs=None) -> None:
         try:
             import fsspec
         except ImportError as e:
             raise ImportError(
                 f"FilesystemStore({prefix_path!r}) requires fsspec, which "
                 "is not installed; use LocalStore or install fsspec.") from e
-        self._fs, self._prefix = fsspec.core.url_to_fs(prefix_path)
+        if fs is not None:
+            self._fs, self._prefix = fs, prefix_path
+        else:
+            self._fs, self._prefix = fsspec.core.url_to_fs(prefix_path)
 
     def _join(self, *parts: str) -> str:
-        return "/".join([self._prefix.rstrip("/")] + list(parts))
+        return self.join(self._prefix, *parts)
 
     def get_train_data_path(self, idx: Optional[str] = None) -> str:
         return self._join("intermediate_train_data" + (f".{idx}" if idx
@@ -128,6 +155,15 @@ class FilesystemStore(Store):
     def write(self, path: str, data: bytes) -> None:
         with self._fs.open(path, "wb") as f:
             f.write(data)
+
+    def join(self, path: str, *parts: str) -> str:
+        return "/".join([path.rstrip("/")] + list(parts))
+
+    def makedirs(self, path: str) -> None:
+        try:
+            self._fs.makedirs(path, exist_ok=True)
+        except NotImplementedError:
+            pass  # keyspace-only backend (e.g. s3): directories are implied
 
 
 def checkpoint_handler(store: Store, run_id: str):

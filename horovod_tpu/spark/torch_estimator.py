@@ -11,7 +11,6 @@ and rank 0 checkpoints the final state back to the Store.
 from __future__ import annotations
 
 import json
-import os
 import pickle
 from typing import Callable
 
@@ -41,38 +40,41 @@ class TorchEstimator(HorovodEstimator):
     """
 
     def _save_model_spec(self, ckpt_dir: str) -> None:
-        with open(os.path.join(ckpt_dir, "initial.pkl"), "wb") as f:
-            pickle.dump(self._model, f)
+        store = self._store
+        store.write(store.join(ckpt_dir, "initial.pkl"),
+                    pickle.dumps(self._model))
         loss_value = self._loss if self._loss is not None else "MSELoss"
         loss = loss_value if isinstance(loss_value, str) else None
-        with open(os.path.join(ckpt_dir, "loss.pkl"), "wb") as f:
-            pickle.dump(loss_value if loss is None else None, f)
-        with open(os.path.join(ckpt_dir, "train_spec.json"), "w") as f:
-            json.dump(dict(optimizer=self._optimizer or "SGD",
-                           learning_rate=self._learning_rate,
-                           loss_name=loss,
-                           feature_cols=list(self._feature_cols),
-                           label_cols=list(self._label_cols),
-                           batch_size=self._batch_size,
-                           epochs=self._epochs,
-                           verbose=self._verbose), f)
+        store.write(store.join(ckpt_dir, "loss.pkl"),
+                    pickle.dumps(loss_value if loss is None else None))
+        store.write(store.join(ckpt_dir, "train_spec.json"), json.dumps(
+            dict(optimizer=self._optimizer or "SGD",
+                 learning_rate=self._learning_rate,
+                 loss_name=loss,
+                 feature_cols=list(self._feature_cols),
+                 label_cols=list(self._label_cols),
+                 batch_size=self._batch_size,
+                 epochs=self._epochs,
+                 verbose=self._verbose)).encode())
 
     def _make_remote_fn(self, ckpt_dir: str, train_path: str,
                         val_path: str) -> Callable:
+        store = self._store  # pickled into the worker closure
+
         def remote_train():
             import torch
             import horovod_tpu.torch as thvd
             import horovod_tpu as hvd
 
-            with open(os.path.join(ckpt_dir, "train_spec.json")) as f:
-                spec = json.load(f)
-            with open(os.path.join(ckpt_dir, "initial.pkl"), "rb") as f:
-                model = pickle.load(f)
+            spec = json.loads(store.read_text(
+                store.join(ckpt_dir, "train_spec.json")))
+            model = pickle.loads(store.read(
+                store.join(ckpt_dir, "initial.pkl")))
             if spec["loss_name"]:
                 loss_fn = getattr(torch.nn, spec["loss_name"])()
             else:
-                with open(os.path.join(ckpt_dir, "loss.pkl"), "rb") as f:
-                    loss_fn = pickle.load(f)
+                loss_fn = pickle.loads(store.read(
+                    store.join(ckpt_dir, "loss.pkl")))
             opt_cls = getattr(torch.optim, spec["optimizer"])
             opt = thvd.DistributedOptimizer(
                 opt_cls(model.parameters(),
@@ -81,13 +83,13 @@ class TorchEstimator(HorovodEstimator):
             thvd.broadcast_parameters(model.state_dict(), root_rank=0)
             thvd.broadcast_optimizer_state(opt, root_rank=0)
 
-            pdf = read_shard(train_path, hvd.rank(), hvd.size())
+            pdf = read_shard(store, train_path, hvd.rank(), hvd.size())
             X, Y = xy_arrays(pdf, spec["feature_cols"], spec["label_cols"])
             X_t = torch.from_numpy(X)
             Y_t = torch.from_numpy(Y)
             val = None
             if val_path:
-                vX, vY = xy_arrays(read_shard(val_path, 0, 1),
+                vX, vY = xy_arrays(read_shard(store, val_path, 0, 1),
                                    spec["feature_cols"],
                                    spec["label_cols"])
                 val = (torch.from_numpy(vX), torch.from_numpy(vY))
@@ -120,15 +122,15 @@ class TorchEstimator(HorovodEstimator):
                     print(f"[torch-estimator] epoch {epoch}: loss={mean}",
                           flush=True)
             if hvd.rank() == 0:
-                with open(os.path.join(ckpt_dir, "final.pkl"), "wb") as f:
-                    pickle.dump(model, f)
+                store.write(store.join(ckpt_dir, "final.pkl"),
+                            pickle.dumps(model))
             return history
 
         return remote_train
 
     def _load_trained_model(self, ckpt_dir: str) -> TorchModel:
-        with open(os.path.join(ckpt_dir, "final.pkl"), "rb") as f:
-            model = pickle.load(f)
+        model = pickle.loads(self._store.read(
+            self._store.join(ckpt_dir, "final.pkl")))
         return TorchModel(model=model, feature_cols=self._feature_cols,
                           label_cols=self._label_cols,
                           run_id=self._run_id)

@@ -1,0 +1,146 @@
+"""TPU pod-slice discovery against a faked GCE metadata server — the
+TPU-native analog of the reference's LSF/MPI environment-detection tests
+(reference: ``horovod/runner/launch.py:677-709``, ``runner/util/lsf.py``).
+No -H/--hostfile anywhere: hosts come from the metadata surface."""
+
+import http.server
+import threading
+
+import pytest
+
+from horovod_tpu.runner import launch
+from horovod_tpu.runner.elastic.discovery import HostManager
+from horovod_tpu.runner.tpu_discovery import (
+    TpuPodDiscovery, metadata_get, running_on_tpu_vm, tpu_accelerator_type,
+    tpu_pod_hosts, tpu_worker_index)
+
+WORKERS4 = ("9f3a:w-0:10.164.0.10,9f3a:w-1:10.164.0.11,"
+            "9f3a:w-2:10.164.0.12,9f3a:w-3:10.164.0.13")
+
+
+class _FakeMetadata:
+    """Tiny metadata server: serves instance attributes from a mutable
+    dict, enforcing the Metadata-Flavor header like the real one."""
+
+    def __init__(self):
+        self.attrs = {
+            "worker-network-endpoints": WORKERS4,
+            "agent-worker-number": "2",
+            "accelerator-type": "v5litepod-16",
+        }
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.headers.get("Metadata-Flavor") != "Google":
+                    self.send_error(403, "Missing Metadata-Flavor header")
+                    return
+                prefix = "/computeMetadata/v1/instance/attributes/"
+                if not self.path.startswith(prefix):
+                    self.send_error(404)
+                    return
+                val = outer.attrs.get(self.path[len(prefix):])
+                if val is None:
+                    self.send_error(404)
+                    return
+                body = val.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                      Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+        self.endpoint = f"http://127.0.0.1:{self.server.server_address[1]}"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def metadata(monkeypatch):
+    fake = _FakeMetadata()
+    monkeypatch.setenv("HVD_TPU_METADATA_ENDPOINT", fake.endpoint)
+    yield fake
+    fake.close()
+
+
+def test_pod_hosts_parsed_in_worker_order(metadata):
+    hosts = tpu_pod_hosts()
+    assert [h.hostname for h in hosts] == [
+        "10.164.0.10", "10.164.0.11", "10.164.0.12", "10.164.0.13"]
+    assert all(h.slots == 1 for h in hosts)
+
+
+def test_worker_index_and_accelerator_type(metadata):
+    assert tpu_worker_index() == 2
+    assert tpu_accelerator_type() == "v5litepod-16"
+
+
+def test_missing_attribute_raises_oserror(metadata):
+    with pytest.raises(OSError):
+        metadata_get("no-such-attribute")
+
+
+def test_running_on_tpu_vm_probe(metadata):
+    assert running_on_tpu_vm()
+    assert not running_on_tpu_vm(endpoint="http://127.0.0.1:1",
+                                 timeout=0.5)
+
+
+def test_cli_resolves_pod_hosts_without_dash_h(metadata):
+    args = launch.parse_args(["--tpu", "--", "echo", "hi"])
+    hosts = launch.resolve_hosts(args)
+    assert len(hosts) == 4 and hosts[0].hostname == "10.164.0.10"
+
+
+def test_cli_tpu_excludes_explicit_hosts(metadata):
+    args = launch.parse_args(["--tpu", "-H", "a:1", "--", "echo"])
+    with pytest.raises(ValueError):
+        launch.resolve_hosts(args)
+
+
+def test_launch_static_receives_metadata_hosts(metadata, monkeypatch):
+    """hvdrun --tpu end to end through run_commandline: the static
+    launcher gets the 4 pod workers, np defaults to the slot sum."""
+    captured = {}
+
+    def fake_launch(hosts, np, command, **kw):
+        captured.update(hosts=hosts, np=np, command=command)
+        return 0
+
+    monkeypatch.setattr(launch, "launch_static", fake_launch)
+    rc = launch.run_commandline(["--tpu", "--no-nic-probe", "--",
+                                 "echo", "hi"])
+    assert rc == 0
+    assert [h.hostname for h in captured["hosts"]] == [
+        "10.164.0.10", "10.164.0.11", "10.164.0.12", "10.164.0.13"]
+    assert captured["np"] == 4
+    assert captured["command"] == ["echo", "hi"]
+
+
+def test_elastic_discovery_tracks_slice_changes(metadata):
+    """TpuPodDiscovery re-reads the slice each refresh: a repaired 4th
+    worker VM appears without a user discovery script; blacklisted hosts
+    stay excluded (driver semantics unchanged)."""
+    metadata.attrs["worker-network-endpoints"] = \
+        "9f3a:w-0:10.164.0.10,9f3a:w-1:10.164.0.11,9f3a:w-2:10.164.0.12"
+    mgr = HostManager(TpuPodDiscovery())
+    assert mgr.update_available_hosts() is True
+    assert mgr.slot_count() == 3
+
+    metadata.attrs["worker-network-endpoints"] = WORKERS4
+    assert mgr.update_available_hosts() is True  # growth observed
+    assert mgr.slot_count() == 4
+
+    mgr.blacklist("10.164.0.12")
+    assert mgr.update_available_hosts() is True
+    assert mgr.slot_count() == 3
+    assert "10.164.0.12" not in [h.hostname for h in mgr.current_hosts()]

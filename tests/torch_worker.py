@@ -98,6 +98,36 @@ def main():
     assert torch.allclose(sbn.running_mean, bn.running_mean, atol=1e-5)
     assert torch.allclose(sbn.running_var, bn.running_var, atol=1e-5)
 
+    # join with genuinely uneven batches (reference:
+    # test/parallel/test_torch.py join tests; controller.cc:94-98,262-265):
+    # rank r trains on r+1 batches, calling hvd.join() when it runs out —
+    # later ranks keep allreducing gradients while joined ranks contribute
+    # nothing, then everyone agrees on the last rank to join
+    if size >= 2:
+        jmodel = hvd.broadcast_object(torch.nn.Linear(4, 1), 0, name="jm")
+        jopt = hvd.DistributedOptimizer(
+            torch.optim.SGD(jmodel.parameters(), lr=0.05),
+            named_parameters=jmodel.named_parameters())
+        for b in range(rank + 1):  # uneven: rank r has r+1 batches
+            jopt.zero_grad()
+            xb = torch.from_numpy(
+                rng.randn(4, 4).astype(np.float32))
+            yb = torch.from_numpy(rng.randn(4, 1).astype(np.float32))
+            torch.nn.functional.mse_loss(jmodel(xb), yb).backward()
+            jopt.step()
+        last = hvd.join()
+        # every rank agrees on who joined last (it holds the most-trained
+        # parameters), and the standard post-join broadcast from that rank
+        # leaves the whole world with identical parameters
+        lasts = hvd.allgather_object(last)
+        assert len(set(lasts)) == 1, lasts
+        hvd.broadcast_parameters(jmodel.state_dict(), root_rank=lasts[0])
+        ws = hvd.allgather_object(
+            [p.detach().numpy() for p in jmodel.parameters()])
+        for other in ws[1:]:
+            for a, b in zip(ws[0], other):
+                assert np.allclose(a, b, atol=1e-6)
+
     hvd.barrier()
     hvd.shutdown()
     print(f"torch worker {rank}: OK", flush=True)
