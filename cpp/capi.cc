@@ -54,6 +54,9 @@ CoreConfig ParseEnvConfig() {
   cfg.disable_group_fusion = atoi(EnvOr("HVD_TPU_DISABLE_GROUP_FUSION",
                                         "HOROVOD_DISABLE_GROUP_FUSION",
                                         "0"));
+  cfg.hierarchical_allgather = atoi(EnvOr("HVD_TPU_HIERARCHICAL_ALLGATHER",
+                                          "HOROVOD_HIERARCHICAL_ALLGATHER",
+                                          "0")) != 0;
   cfg.hierarchical_allreduce = atoi(EnvOr("HVD_TPU_HIERARCHICAL_ALLREDUCE",
                                           "HOROVOD_HIERARCHICAL_ALLREDUCE",
                                           "0"));
@@ -275,7 +278,9 @@ const char* hvd_counters_json() {
      << ",\"tensors_fused\":" << c.tensors_fused.load()
      << ",\"fused_units\":" << c.fused_units.load()
      << ",\"bytes_allreduced\":" << c.bytes_allreduced.load()
-     << ",\"bytes_allgathered\":" << c.bytes_allgathered.load() << "}";
+     << ",\"bytes_allgathered\":" << c.bytes_allgathered.load()
+     << ",\"hier_allreduces\":" << c.hier_allreduces.load()
+     << ",\"hier_allgathers\":" << c.hier_allgathers.load() << "}";
   g_counters_json = os.str();
   return g_counters_json.c_str();
 }

@@ -277,6 +277,101 @@ Status HierarchicalAllreduce(Transport& t, const Group& local,
   return Status::OK();
 }
 
+Status HierarchicalAllgatherV(Transport& t, const Group& local,
+                              const Group& cross, bool is_leader,
+                              int32_t tag, const void* send,
+                              int64_t send_bytes,
+                              std::vector<int64_t>* per_rank_bytes,
+                              std::vector<uint8_t>* out) {
+  int lsz = local.size(), csz = cross.size();
+  int n_global = lsz * csz;
+  if (!is_leader) {
+    // 1) hand our block to the node leader (size prefix + data)...
+    std::vector<uint8_t> pkt(sizeof(int64_t) + (size_t)send_bytes);
+    memcpy(pkt.data(), &send_bytes, sizeof(int64_t));
+    if (send_bytes > 0)
+      memcpy(pkt.data() + sizeof(int64_t), send, send_bytes);
+    auto st = t.Send(local.global(0), tag, pkt.data(), pkt.size());
+    if (!st.ok()) return st;
+    // ...then wait for the leader's fan-out: [n_global sizes][all data]
+    std::vector<uint8_t> sizes_buf((size_t)n_global * sizeof(int64_t));
+    st = Broadcast(t, local, tag + 3, sizes_buf.data(),
+                   (int64_t)sizes_buf.size(), 0);
+    if (!st.ok()) return st;
+    per_rank_bytes->assign(n_global, 0);
+    memcpy(per_rank_bytes->data(), sizes_buf.data(), sizes_buf.size());
+    int64_t total = 0;
+    for (auto b : *per_rank_bytes) total += b;
+    out->resize((size_t)total);
+    return Broadcast(t, local, tag + 4, out->data(), total, 0);
+  }
+  // leader: 1) gather local blocks in local-rank order
+  std::vector<int64_t> local_sizes(lsz, 0);
+  std::vector<std::vector<uint8_t>> local_blocks(lsz);
+  local_sizes[0] = send_bytes;
+  int64_t host_total = send_bytes;
+  for (int i = 1; i < lsz; ++i) {
+    std::vector<uint8_t> pkt;
+    auto st = t.Recv(local.global(i), tag, &pkt);
+    if (!st.ok()) return st;
+    memcpy(&local_sizes[i], pkt.data(), sizeof(int64_t));
+    local_blocks[i].assign(pkt.begin() + sizeof(int64_t), pkt.end());
+    host_total += local_sizes[i];
+  }
+  // host concat: [sizes of my lsz ranks][their data in local-rank order]
+  std::vector<uint8_t> host((size_t)lsz * sizeof(int64_t) +
+                            (size_t)host_total);
+  memcpy(host.data(), local_sizes.data(), (size_t)lsz * sizeof(int64_t));
+  int64_t off = (int64_t)lsz * sizeof(int64_t);
+  if (send_bytes > 0) memcpy(host.data() + off, send, send_bytes);
+  off += send_bytes;
+  for (int i = 1; i < lsz; ++i) {
+    memcpy(host.data() + off, local_blocks[i].data(), local_sizes[i]);
+    off += local_sizes[i];
+  }
+  // 2) leaders exchange host blocks; [cross][local] order IS global rank
+  // order under the launcher's homogeneous topology contract
+  std::vector<int64_t> per_host;
+  std::vector<uint8_t> gathered;
+  auto st = AllgatherV(t, cross, tag + 1, host.data(), (int64_t)host.size(),
+                       &per_host, &gathered);
+  if (!st.ok()) return st;
+  per_rank_bytes->assign(n_global, 0);
+  int64_t total = 0;
+  {
+    int64_t goff = 0;
+    for (int c = 0; c < csz; ++c) {
+      memcpy(per_rank_bytes->data() + (size_t)c * lsz,
+             gathered.data() + goff, (size_t)lsz * sizeof(int64_t));
+      goff += per_host[c];
+    }
+    for (auto b : *per_rank_bytes) total += b;
+  }
+  out->clear();
+  out->reserve((size_t)total);
+  {
+    int64_t goff = 0;
+    for (int c = 0; c < csz; ++c) {
+      const uint8_t* data0 = gathered.data() + goff +
+                             (int64_t)lsz * sizeof(int64_t);
+      int64_t data_bytes = per_host[c] - (int64_t)lsz * sizeof(int64_t);
+      out->insert(out->end(), data0, data0 + data_bytes);
+      goff += per_host[c];
+    }
+  }
+  // 3) fan the result out to local members
+  if (lsz > 1) {
+    std::vector<uint8_t> sizes_buf((size_t)n_global * sizeof(int64_t));
+    memcpy(sizes_buf.data(), per_rank_bytes->data(), sizes_buf.size());
+    st = Broadcast(t, local, tag + 3, sizes_buf.data(),
+                   (int64_t)sizes_buf.size(), 0);
+    if (!st.ok()) return st;
+    st = Broadcast(t, local, tag + 4, out->data(), total, 0);
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
 Status AllgatherV(Transport& t, const Group& g, int32_t tag,
                   const void* send, int64_t send_bytes,
                   std::vector<int64_t>* per_rank_bytes,

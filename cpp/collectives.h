@@ -65,6 +65,21 @@ Status HierarchicalAllreduce(Transport& t, const Group& local,
                              void* data, int64_t nelem, DataType dtype,
                              ReduceOp op, double prescale, double postscale);
 
+// Two-level hierarchical allgatherv (reference: MPIHierarchicalAllgather,
+// horovod/common/ops/mpi_operations.cc — node-leader gather + shared
+// buffer fan-out; here the fan-out is a local binomial broadcast):
+// (1) local members send their block to the node leader, (2) leaders
+// allgatherv their hosts' concatenations cross-host (global rank order ==
+// [cross][local] by the launcher's topology contract), (3) leaders
+// broadcast sizes + data locally. Uses tags [tag, tag+4]. Requires the
+// homogeneous topology the launcher injects (size == local*cross).
+Status HierarchicalAllgatherV(Transport& t, const Group& local,
+                              const Group& cross, bool is_leader,
+                              int32_t tag, const void* send,
+                              int64_t send_bytes,
+                              std::vector<int64_t>* per_rank_bytes,
+                              std::vector<uint8_t>* out);
+
 // Adasum VHDD allreduce (cpp/adasum.cc; reference: adasum/adasum.h).
 // Uses tags [tag, tag+4].
 Status AdasumAllreduce(Transport& t, const Group& g, int32_t tag, void* data,

@@ -8,6 +8,7 @@
 #include <sstream>
 
 #include "bayes_opt.h"
+#include "logging.h"
 #include "timeline.h"
 #include "wire.h"
 
@@ -213,8 +214,9 @@ void ParameterManager::Enable(int64_t init_fusion, double init_cycle,
                               int warmup_samples, int max_samples,
                               double gp_noise,
                               const std::string& log_path,
-                              double window_secs) {
+                              double window_secs, bool allow_hier) {
   enabled_ = true;
+  allow_hier_ = allow_hier;
   warmup_samples_ = warmup_samples;
   max_samples_ = max_samples;
   gp_noise_ = gp_noise;
@@ -226,14 +228,21 @@ void ParameterManager::Enable(int64_t init_fusion, double init_cycle,
     log_ = nullptr;
   }
   if (!log_path.empty()) log_ = fopen(log_path.c_str(), "w");
-  if (log_) fprintf(log_, "sample,fusion_bytes,cycle_ms,bytes_per_sec\n");
-  bo_ = std::make_shared<BayesianOptimizer>(2, 17, gp_noise_);
+  if (log_)
+    fprintf(log_,
+            "sample,fusion_bytes,cycle_ms,hierarchical,cache,"
+            "bytes_per_sec\n");
+  // 4-D space: (log fusion, log cycle, hierarchical, cache) — the
+  // categorical dims the reference's ParameterManager also explores
+  // (parameter_manager.h:42-105)
+  bo_ = std::make_shared<BayesianOptimizer>(4, 17, gp_noise_);
   window_start_ = std::chrono::steady_clock::now();
 }
 
 void ParameterManager::Record(int64_t bytes) { bytes_acc_ += bytes; }
 
-bool ParameterManager::Tune(int64_t* fusion_bytes, double* cycle_ms) {
+bool ParameterManager::Tune(int64_t* fusion_bytes, double* cycle_ms,
+                            bool* hierarchical, bool* cache_enabled) {
   if (!enabled_) return false;
   auto now = std::chrono::steady_clock::now();
   double secs = std::chrono::duration<double>(now - window_start_).count();
@@ -243,14 +252,17 @@ bool ParameterManager::Tune(int64_t* fusion_bytes, double* cycle_ms) {
   window_start_ = now;
   samples_++;
   if (log_) {
-    fprintf(log_, "%d,%lld,%g,%g\n", samples_,
-            (long long)*fusion_bytes, *cycle_ms, score);
+    fprintf(log_, "%d,%lld,%g,%d,%d,%g\n", samples_,
+            (long long)*fusion_bytes, *cycle_ms, *hierarchical ? 1 : 0,
+            *cache_enabled ? 1 : 0, score);
     fflush(log_);
   }
   // discard warmup samples (reference: AUTOTUNE_WARMUP_SAMPLES) so
   // startup transients don't poison the GP
   if (samples_ <= warmup_samples_) return false;
-  bo_->AddSample({NormFusion(*fusion_bytes), NormCycle(*cycle_ms)}, score);
+  bo_->AddSample({NormFusion(*fusion_bytes), NormCycle(*cycle_ms),
+                  *hierarchical ? 1.0 : 0.0, *cache_enabled ? 1.0 : 0.0},
+                 score);
   std::vector<double> x;
   if (samples_ > warmup_samples_ + max_samples_) {  // converge to best
     x = bo_->BestSample();
@@ -260,6 +272,8 @@ bool ParameterManager::Tune(int64_t* fusion_bytes, double* cycle_ms) {
   }
   *fusion_bytes = DenormFusion(x[0]);
   *cycle_ms = DenormCycle(x[1]);
+  *hierarchical = allow_hier_ && x[2] >= 0.5;
+  *cache_enabled = x[3] >= 0.5;
   return true;
 }
 
@@ -320,6 +334,11 @@ void Core::PushToDomain(int domain, TensorTableEntry e, Request r) {
 Status Core::Init(const CoreConfig& cfg) {
   if (initialized_) return Status::OK();
   cfg_ = cfg;
+  LogRank() = cfg.rank;  // stamp every later log line with our rank
+  HVD_LOG(Info) << "core init: size=" << cfg.size << " coordinator="
+                << cfg.coord_addr << ":" << cfg.coord_port
+                << " fusion=" << cfg.fusion_threshold
+                << "B cycle=" << cfg.cycle_time_ms << "ms";
   transport_.reset(
       new Transport(cfg.rank, cfg.size, cfg.coord_addr, cfg.coord_port,
                     cfg.rendezvous_timeout_secs));
@@ -336,7 +355,9 @@ Status Core::Init(const CoreConfig& cfg) {
                       // truncate the coordinator's trace on shared
                       // filesystems
                       cfg.rank == 0 ? cfg.autotune_log : std::string(),
-                      cfg.autotune_window_secs);
+                      cfg.autotune_window_secs,
+                      /*allow_hier=*/cfg.local_size > 1 &&
+                          cfg.size == cfg.local_size * cfg.cross_size);
 
   auto global = std::unique_ptr<CoordDomain>(new CoordDomain());
   global->id = 0;
@@ -351,9 +372,11 @@ Status Core::Init(const CoreConfig& cfg) {
   }
   // hierarchical allreduce topology (reference enables it only on
   // homogeneous clusters — operations.cc:514-538)
-  hier_enabled_ = cfg.hierarchical_allreduce && cfg.local_size > 1 &&
-                  cfg.size == cfg.local_size * cfg.cross_size;
-  if (hier_enabled_) {
+  hier_topology_ok_ = cfg.local_size > 1 &&
+                      cfg.size == cfg.local_size * cfg.cross_size;
+  hier_enabled_ = cfg.hierarchical_allreduce && hier_topology_ok_;
+  hier_ag_enabled_ = cfg.hierarchical_allgather && hier_topology_ok_;
+  if (hier_topology_ok_) {
     local_group_.ranks.clear();
     for (int i = 0; i < cfg.local_size; ++i)
       local_group_.ranks.push_back(cfg.cross_rank * cfg.local_size + i);
@@ -367,11 +390,14 @@ Status Core::Init(const CoreConfig& cfg) {
   loop_done_ = false;
   initialized_ = true;
   loop_ = std::thread([this] { Loop(); });
+  HVD_LOG(Debug) << "background loop started"
+                 << (hier_enabled_ ? " (hierarchical allreduce on)" : "");
   return Status::OK();
 }
 
 void Core::Shutdown() {
   if (!initialized_) return;
+  HVD_LOG(Info) << "core shutdown requested";
   shutdown_requested_ = true;
   // Prefer the negotiated shutdown (all ranks vote, coordinator emits a
   // SHUTDOWN response — reference: operations.cc:994-1005); if a peer died
@@ -1061,10 +1087,10 @@ bool Core::RunOnce() {
             std::chrono::duration<double>(std::chrono::steady_clock::now() -
                                           cd->registered_at)
                     .count() > cfg_.stall_warning_secs) {
-          fprintf(stderr,
-                  "[hvdcore] WARNING: collectives pending on process set %d "
-                  "which not all ranks have registered after %.0fs\n",
-                  kv.first, cfg_.stall_warning_secs);
+          HVD_LOG(Warning)
+              << "collectives pending on process set " << kv.first
+              << " which not all ranks have registered after "
+              << cfg_.stall_warning_secs << "s";
           cd->inactive_warned = true;
         }
       }
@@ -1114,6 +1140,10 @@ bool Core::RunOnce() {
       HandleCacheBits(*d, cfg_.rank, my_bits);
       singles = CollectReady(*d);
       if (want_shutdown && id == 0) got_shutdown_response = true;
+      if (id == 0 && has_pending_knobs_) {  // no peers to synchronize with
+        ApplyKnobFlags(pending_knob_flags_);
+        has_pending_knobs_ = false;
+      }
     } else if (is_coord) {
       // gather (lockstep cycle; reference: MPIController::RecvReadyTensors)
       HandleRequests(*d, cfg_.rank, misses);
@@ -1124,10 +1154,9 @@ bool Core::RunOnce() {
           auto& c = announce_table_[a.id];
           if (c.ranks.empty()) c.ranks_hash = a.ranks_hash;
           if (c.ranks_hash != a.ranks_hash && !c.mismatch_warned) {
-            fprintf(stderr,
-                    "[hvdcore] ERROR: ranks disagree on the member list of "
-                    "process set %d; the set will never activate\n",
-                    a.id);
+            HVD_LOG(Error)
+                << "ranks disagree on the member list of process set "
+                << a.id << "; the set will never activate";
             c.mismatch_warned = true;
           }
           c.ranks.insert(from);
@@ -1208,6 +1237,9 @@ bool Core::RunOnce() {
           }
         }
         d->stall.RemoveReady(name);
+        HVD_LOG(Error) << "tensor '" << name << "' fatally stalled ("
+                       << cfg_.stall_shutdown_secs
+                       << "s); erroring its waiters";
         if (timeline_ && timeline_->enabled())
           timeline_->End(name);  // close the open NEGOTIATE_*/WAIT_* span
         Response e;
@@ -1241,8 +1273,10 @@ bool Core::RunOnce() {
         sd.type = Response::kShutdown;
         singles.push_back(sd);
       }
+      uint8_t knobs = (id == 0 && has_pending_knobs_)
+                          ? pending_knob_flags_ : KnobFlags();
       auto payload = wire::EncodeResponseList(singles, cfg_.fusion_threshold,
-                                              activate, retired);
+                                              activate, retired, knobs);
       for (int i = 1; i < d->group.size(); ++i) {
         auto st = transport_->Send(d->group.global(i),
                                    DomTag(id, kTagResponse), payload.data(),
@@ -1250,10 +1284,18 @@ bool Core::RunOnce() {
         if (!st.ok()) return false;
       }
       if (id == 0) ApplyDomainLifecycle(activate, retired);
+      if (id == 0 && has_pending_knobs_) {
+        // apply to ourselves only now that the packet carrying the flags
+        // to every worker is on the wire: the whole world flips at this
+        // cycle boundary (workers apply at the matching receive)
+        ApplyKnobFlags(pending_knob_flags_);
+        has_pending_knobs_ = false;
+      }
       // stall check (reference: controller.cc:132-143)
       auto warn = d->stall.Check(cfg_.stall_warning_secs);
-      if (!warn.empty()) fprintf(stderr, "[hvdcore] STALL WARNING:\n%s",
-                                 warn.c_str());
+      if (!warn.empty()) {
+        HVD_LOG(Warning) << "STALL:\n" << warn;
+      }
     } else {
       auto payload = wire::EncodeRequestList(
           misses, want_shutdown, my_bits,
@@ -1267,13 +1309,17 @@ bool Core::RunOnce() {
       if (!st.ok()) return false;
       int64_t coord_threshold = cfg_.fusion_threshold;
       std::vector<int32_t> activate, retired;
+      uint8_t knobs = KnobFlags();
       singles = wire::DecodeResponseList(buf.data(), buf.size(),
                                          &coord_threshold, &activate,
-                                         &retired);
+                                         &retired, &knobs);
       if (id == 0) ApplyDomainLifecycle(activate, retired);
       // adopt the coordinator's threshold so FuseResponses groups
-      // identically on every rank (autotune is coordinator-only)
+      // identically on every rank (autotune is coordinator-only), and its
+      // categorical knobs at the same cycle boundary the coordinator
+      // applied them (the packet that carries them)
       cfg_.fusion_threshold = coord_threshold;
+      if (id == 0) ApplyKnobFlags(knobs);
     }
 
     // every rank inserts newly negotiated allreduce responses in identical
@@ -1339,12 +1385,38 @@ bool Core::RunOnce() {
   if (cfg_.rank == 0) {
     int64_t fusion = cfg_.fusion_threshold;
     double cycle = cfg_.cycle_time_ms;
-    if (param_mgr_.Tune(&fusion, &cycle)) {
+    bool hier = hier_enabled_;
+    bool cache = cfg_.cache_enabled;
+    if (param_mgr_.Tune(&fusion, &cycle, &hier, &cache)) {
       cfg_.fusion_threshold = fusion;
       cfg_.cycle_time_ms = cycle;
+      // categorical knobs must flip on every rank at the same cycle
+      // boundary: stage them for the next domain-0 response broadcast
+      // instead of applying locally now (see pending_knob_flags_)
+      pending_knob_flags_ = (uint8_t)((hier ? 0x1 : 0) | (cache ? 0x2 : 0));
+      has_pending_knobs_ = true;
     }
   }
   return true;
+}
+
+uint8_t Core::KnobFlags() const {
+  return (uint8_t)((hier_enabled_ ? 0x1 : 0) |
+                   (cfg_.cache_enabled ? 0x2 : 0));
+}
+
+void Core::ApplyKnobFlags(uint8_t flags) {
+  bool hier = (flags & 0x1) != 0;
+  bool cache = (flags & 0x2) != 0;
+  if (hier != hier_enabled_ || cache != cfg_.cache_enabled) {
+    HVD_LOG(Debug) << "autotune knob flip: hierarchical="
+                   << (hier ? 1 : 0) << " cache=" << (cache ? 1 : 0);
+  }
+  // only honor hier when this rank's topology supports the two-level
+  // path (identical on every rank: the coordinator proposes it only when
+  // its own — identical — topology config allows)
+  hier_enabled_ = hier && hier_topology_ok_;
+  cfg_.cache_enabled = cache;
 }
 
 // -- execution (reference: PerformOperation, operations.cc:257-306) ---------
@@ -1404,6 +1476,7 @@ void Core::Execute(CoordDomain& d, const Response& r) {
                                    cfg_.local_rank == 0, dtag,
                                    fusion.data(), nelem, r.dtypes[0], r.op,
                                    r.prescale, r.postscale);
+        counters_.hier_allreduces++;
         act_end();
       } else if (r.op == ReduceOp::kAdasum && d.group.size() > 1) {
         act_begin("ADASUM_ALLREDUCE");
@@ -1449,6 +1522,20 @@ void Core::Execute(CoordDomain& d, const Response& r) {
         slots[i].my_bytes =
             slots[i].have ? (int64_t)slots[i].e.ByteSize() : 0;
       }
+      // two-level node-leader path (reference: MPIHierarchicalAllgather,
+      // mpi_operations.cc) — global domain only: sub-sets have no
+      // topology contract
+      bool hier_ag = hier_ag_enabled_ && d.id == 0 && d.group.size() > 1;
+      auto allgatherv = [&](const void* send, int64_t send_bytes,
+                            std::vector<int64_t>* sizes,
+                            std::vector<uint8_t>* out) {
+        if (hier_ag)
+          return HierarchicalAllgatherV(
+              *transport_, local_group_, cross_group_,
+              cfg_.local_rank == 0, dtag, send, send_bytes, sizes, out);
+        return AllgatherV(*transport_, d.group, dtag, send, send_bytes,
+                          sizes, out);
+      };
       if (k == 1) {
         // single-tensor fast path: one round; per-rank sizes come back
         // from AllgatherV itself
@@ -1456,11 +1543,12 @@ void Core::Execute(CoordDomain& d, const Response& r) {
         std::vector<int64_t> sizes;
         std::vector<uint8_t> out;
         static const uint8_t kEmpty = 0;
-        act_begin("ALLGATHERV");
-        auto st = AllgatherV(*transport_, d.group, dtag,
-                             s0.have && s0.e.input ? s0.e.input : &kEmpty,
-                             s0.my_bytes, &sizes, &out);
+        act_begin(hier_ag ? "HIERARCHICAL_ALLGATHER" : "ALLGATHERV");
+        auto st = allgatherv(
+            s0.have && s0.e.input ? s0.e.input : &kEmpty,
+            s0.my_bytes, &sizes, &out);
         act_end();
+        if (hier_ag && st.ok()) counters_.hier_allgathers++;
         counters_.bytes_allgathered += (uint64_t)out.size();
         if (s0.have) {
           if (st.ok()) {
@@ -1490,7 +1578,7 @@ void Core::Execute(CoordDomain& d, const Response& r) {
       std::vector<int64_t> size_per_rank;
       std::vector<uint8_t> size_out;
       act_begin("ALLGATHER_SIZES");
-      auto st = AllgatherV(*transport_, d.group, dtag, my_sizes.data(),
+      auto st = allgatherv(my_sizes.data(),
                            (int64_t)(k * sizeof(int64_t)), &size_per_rank,
                            &size_out);
       act_end();
@@ -1512,9 +1600,8 @@ void Core::Execute(CoordDomain& d, const Response& r) {
         act_end();
         std::vector<int64_t> per_rank;
         static const uint8_t kEmptyF = 0;
-        act_begin("ALLGATHERV");
-        st = AllgatherV(*transport_, d.group, dtag,
-                        send_total ? send.data() : &kEmptyF, send_total,
+        act_begin(hier_ag ? "HIERARCHICAL_ALLGATHER" : "ALLGATHERV");
+        st = allgatherv(send_total ? send.data() : &kEmptyF, send_total,
                         &per_rank, &data);
         act_end();
         if (st.ok()) {
@@ -1522,6 +1609,7 @@ void Core::Execute(CoordDomain& d, const Response& r) {
           for (int rr = 0; rr < n; ++rr)
             rank_off[rr + 1] = rank_off[rr] + per_rank[rr];
           counters_.bytes_allgathered += (uint64_t)data.size();
+          if (hier_ag) counters_.hier_allgathers++;  // once per collective
         }
       }
       act_begin("MEMCPY_OUT_FUSION_BUFFER");

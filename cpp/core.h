@@ -119,14 +119,20 @@ class ParameterManager {
   void Enable(int64_t init_fusion, double init_cycle,
               int warmup_samples = 3, int max_samples = 24,
               double gp_noise = 1e-6, const std::string& log_path = "",
-              double window_secs = 2.0);
+              double window_secs = 2.0, bool allow_hier = false);
   bool enabled() const { return enabled_; }
   void Record(int64_t bytes);
-  // maybe update params; returns true if changed
-  bool Tune(int64_t* fusion_bytes, double* cycle_ms);
+  // maybe update params; returns true if changed. Categorical dims
+  // (reference tunes these too — parameter_manager.h:42-105): the GP
+  // searches a 4-D space (log fusion, log cycle, hierarchical on/off,
+  // cache on/off); binary dims threshold at 0.5. hier candidates are
+  // clamped off unless the topology supports the two-level path.
+  bool Tune(int64_t* fusion_bytes, double* cycle_ms, bool* hierarchical,
+            bool* cache_enabled);
 
  private:
   bool enabled_ = false;
+  bool allow_hier_ = false;
   int64_t bytes_acc_ = 0;
   std::chrono::steady_clock::time_point window_start_;
   int samples_ = 0;
@@ -142,6 +148,7 @@ struct CoreConfig {
   int rank = 0;
   bool disable_group_fusion = false;
   bool hierarchical_allreduce = false;
+  bool hierarchical_allgather = false;
   int local_rank = 0;
   int local_size = 1;
   int cross_rank = 0;
@@ -270,6 +277,9 @@ class Core {
     std::atomic<uint64_t> fused_units{0};       // multi-tensor units
     std::atomic<uint64_t> bytes_allreduced{0};
     std::atomic<uint64_t> bytes_allgathered{0};
+    // two-level paths actually taken (proof the topology dispatch ran)
+    std::atomic<uint64_t> hier_allreduces{0};
+    std::atomic<uint64_t> hier_allgathers{0};
   };
   const Counters& counters() const { return counters_; }
 
@@ -307,6 +317,17 @@ class Core {
   std::thread loop_;
   std::unique_ptr<Timeline> timeline_;
   ParameterManager param_mgr_;
+  // autotuned categorical knobs awaiting the atomic cross-rank flip: the
+  // coordinator defers applying hier/cache to ITSELF until the domain-0
+  // response send that hands them to the workers, so every rank switches
+  // at the same cycle boundary (a skewed cache flip would split readiness
+  // accounting between bit and name tables and deadlock negotiation)
+  bool has_pending_knobs_ = false;
+  uint8_t pending_knob_flags_ = 0;
+  bool hier_topology_ok_ = false;
+  // current effective knob flags (bit0 hier, bit1 cache) for the wire
+  uint8_t KnobFlags() const;
+  void ApplyKnobFlags(uint8_t flags);
 
   std::mutex domains_mu_;
   std::map<int, std::unique_ptr<CoordDomain>> domains_;
@@ -319,8 +340,9 @@ class Core {
   };
   std::map<int, Consensus> announce_table_;
   std::map<int, std::set<int>> retire_table_;
-  // hierarchical topology groups (valid when hier_enabled_)
+  // hierarchical topology groups (valid when hier_topology_ok_)
   bool hier_enabled_ = false;
+  bool hier_ag_enabled_ = false;
   Group local_group_;
   Group cross_group_;
 

@@ -1,0 +1,110 @@
+// Leveled, rank-tagged logging for the native core.
+//
+// Reference: horovod/common/logging.{h,cc} — LOG(level) stream macros
+// honoring HOROVOD_LOG_LEVEL, with rank + timestamp prefixes. Format
+// matches this package's Python logger ("[time] [tag] [rank N] LEVEL:
+// msg") so interleaved host logs from both planes read uniformly.
+// HOROVOD_LOG_HIDE_TIME drops the timestamp (reference knob).
+#pragma once
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <sstream>
+#include <string>
+
+#include <sys/time.h>
+
+namespace hvd {
+
+enum class LogSeverity : int {
+  kTrace = 0, kDebug = 1, kInfo = 2, kWarning = 3, kError = 4, kFatal = 5
+};
+
+inline const char* LogSeverityName(LogSeverity s) {
+  switch (s) {
+    case LogSeverity::kTrace: return "TRACE";
+    case LogSeverity::kDebug: return "DEBUG";
+    case LogSeverity::kInfo: return "INFO";
+    case LogSeverity::kWarning: return "WARNING";
+    case LogSeverity::kError: return "ERROR";
+    case LogSeverity::kFatal: return "FATAL";
+  }
+  return "?";
+}
+
+inline LogSeverity ParseLogLevel(const char* v) {
+  if (!v || !*v) return LogSeverity::kWarning;  // reference default
+  std::string s(v);
+  for (auto& c : s) c = (char)tolower(c);
+  if (s == "trace") return LogSeverity::kTrace;
+  if (s == "debug") return LogSeverity::kDebug;
+  if (s == "info") return LogSeverity::kInfo;
+  if (s == "warning" || s == "warn") return LogSeverity::kWarning;
+  if (s == "error") return LogSeverity::kError;
+  if (s == "fatal") return LogSeverity::kFatal;
+  return LogSeverity::kWarning;
+}
+
+// threshold / rank / hide-time are process-wide; rank is stamped by the
+// core once its config is parsed (env fallback covers pre-init messages)
+inline LogSeverity& LogThreshold() {
+  static LogSeverity lvl = ParseLogLevel(getenv("HOROVOD_LOG_LEVEL"));
+  return lvl;
+}
+
+inline int& LogRank() {
+  static int rank = [] {
+    const char* e = getenv("HOROVOD_RANK");
+    if (!e) e = getenv("HVD_TPU_RANK");
+    return e ? atoi(e) : -1;
+  }();
+  return rank;
+}
+
+inline bool& LogHideTime() {
+  static bool hide = [] {
+    const char* e = getenv("HOROVOD_LOG_HIDE_TIME");
+    return e && *e && strcmp(e, "0") != 0;
+  }();
+  return hide;
+}
+
+// Stream-style message; the destructor emits ONE fprintf so concurrent
+// threads' lines never interleave mid-line. LOG(FATAL) aborts like the
+// reference's.
+class LogMessage {
+ public:
+  explicit LogMessage(LogSeverity severity) : severity_(severity) {}
+
+  ~LogMessage() {
+    char ts[64] = "";
+    if (!LogHideTime()) {
+      struct timeval tv;
+      gettimeofday(&tv, nullptr);
+      struct tm tm_buf;
+      localtime_r(&tv.tv_sec, &tm_buf);
+      size_t n = strftime(ts, sizeof(ts), "[%F %T", &tm_buf);
+      snprintf(ts + n, sizeof(ts) - n, ".%03d] ", (int)(tv.tv_usec / 1000));
+    }
+    fprintf(stderr, "%s[hvdcore] [rank %d] %s: %s\n", ts, LogRank(),
+            LogSeverityName(severity_), stream_.str().c_str());
+    if (severity_ == LogSeverity::kFatal) abort();
+  }
+
+  std::ostringstream& stream() { return stream_; }
+
+ private:
+  LogSeverity severity_;
+  std::ostringstream stream_;
+};
+
+}  // namespace hvd
+
+// usage: HVD_LOG(Warning) << "stalled for " << secs << "s";
+#define HVD_LOG(severity)                                                  \
+  if (::hvd::LogSeverity::k##severity < ::hvd::LogThreshold())             \
+    ;                                                                      \
+  else                                                                     \
+    ::hvd::LogMessage(::hvd::LogSeverity::k##severity).stream()

@@ -196,9 +196,13 @@ inline std::vector<Request> DecodeRequestList(
 inline std::vector<uint8_t> EncodeResponseList(
     const std::vector<Response>& rs, int64_t fusion_threshold,
     const std::vector<int32_t>& activate = {},
-    const std::vector<int32_t>& retired = {}) {
+    const std::vector<int32_t>& retired = {},
+    uint8_t knob_flags = 0x2) {
   Writer w;
   w.i64(fusion_threshold);  // coordinator's (possibly autotuned) value
+  // autotuned categorical knobs (bit0 hierarchical, bit1 cache): ride the
+  // response list so every rank flips at the same cycle boundary
+  w.i32((int32_t)knob_flags);
   w.i32((int32_t)activate.size());
   for (auto a : activate) w.i32(a);
   w.i32((int32_t)retired.size());
@@ -211,9 +215,12 @@ inline std::vector<uint8_t> EncodeResponseList(
 inline std::vector<Response> DecodeResponseList(
     const uint8_t* p, size_t n, int64_t* fusion_threshold,
     std::vector<int32_t>* activate = nullptr,
-    std::vector<int32_t>* retired = nullptr) {
+    std::vector<int32_t>* retired = nullptr,
+    uint8_t* knob_flags = nullptr) {
   Reader rd(p, n);
   *fusion_threshold = rd.i64();
+  int32_t kf = rd.i32();
+  if (knob_flags) *knob_flags = (uint8_t)kf;
   int32_t na = rd.i32();
   for (int i = 0; i < na; ++i) {
     int32_t v = rd.i32();

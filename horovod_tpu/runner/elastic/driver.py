@@ -141,8 +141,16 @@ class ElasticDriver:
                                    host, port, e)
 
         for _rank, addr in self._kv.scope("notify").items():
-            host, _, port = addr.decode().rpartition(":")
-            threading.Thread(target=push, args=(host, int(port)),
+            try:
+                # the KV PUT surface is open to the network: malformed
+                # registrations must be skipped, never crash the driver
+                host, _, port = addr.decode().rpartition(":")
+                port_num = int(port)
+            except (UnicodeDecodeError, ValueError):
+                get_logger().warning("ignoring malformed notify "
+                                     "registration for rank %s", _rank)
+                continue
+            threading.Thread(target=push, args=(host, port_num),
                              daemon=True).start()
 
     # -- one generation ------------------------------------------------------

@@ -94,6 +94,12 @@ def parse_args(argv: List[str]) -> argparse.Namespace:
     args = p.parse_args(argv)
     if not args.command and not args.check_build:
         p.error("no command given")
+    # one host source only — enforced here so the elastic path can't
+    # silently ignore a conflicting -H/--hostfile/--host-discovery-script
+    if sum(bool(x) for x in (args.hosts, args.hostfile, args.tpu,
+                             args.host_discovery_script)) > 1:
+        p.error("specify only one of -H/--hosts, --hostfile, --tpu, "
+                "--host-discovery-script")
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
     return args

@@ -43,13 +43,18 @@ def metadata_get(attribute: str, endpoint: Optional[str] = None,
                  timeout: float = 5.0) -> str:
     """Fetch one instance attribute; raises ``OSError`` when not on a TPU
     VM (no metadata server) or the attribute is absent."""
+    import http.client
     req = urllib.request.Request(
         _endpoint(endpoint) + _ATTR_BASE + attribute,
         headers={"Metadata-Flavor": "Google"})
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.read().decode().strip()
-    except (urllib.error.URLError, urllib.error.HTTPError) as e:
+    except (urllib.error.URLError, urllib.error.HTTPError,
+            http.client.HTTPException, UnicodeDecodeError, OSError) as e:
+        # non-HTTP services answering the probe (captive portals, proxies)
+        # raise BadStatusLine/UnicodeDecodeError — the contract stays
+        # "OSError when not on a TPU VM"
         raise OSError(f"metadata attribute {attribute!r} unavailable: {e}") \
             from e
 

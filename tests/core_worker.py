@@ -128,14 +128,18 @@ def main():
         log_path = os.environ.get(
             "HVD_TPU_AUTOTUNE_LOG",
             os.environ.get("HOROVOD_AUTOTUNE_LOG", ""))
-        limit = max(extra, 30.0) if log_path else extra
+        # rows to wait for: header + N samples (categorical-dim tests need
+        # several tuned samples so the GP explores the binary knobs)
+        want_rows = 1 + int(os.environ.get("HVD_TEST_AUTOTUNE_MIN_SAMPLES",
+                                           "1"))
+        limit = max(extra, 60.0) if log_path else extra
         deadline = time.monotonic() + limit
         i = 0
         while time.monotonic() < deadline:
             stop = 0.0
             if rank == 0 and log_path and os.path.exists(log_path):
                 with open(log_path) as f:
-                    stop = 1.0 if len(f.readlines()) >= 2 else 0.0
+                    stop = 1.0 if len(f.readlines()) >= want_rows else 0.0
             out = be.allreduce_async(f"traffic.{i}",
                                      np.full(4096, stop, np.float32),
                                      ReduceOp.MAX).wait()
@@ -143,6 +147,9 @@ def main():
             if log_path and float(np.asarray(out)[0]) >= 1.0:
                 break  # a sample is on disk; the assertion is satisfied
 
+    if os.environ.get("HVD_TEST_EXPECT_HIER_AG"):
+        c = be.counters()
+        assert c["hier_allgathers"] > 0, c  # two-level path actually ran
     be.shutdown()
     print(f"worker {rank}: OK")
 

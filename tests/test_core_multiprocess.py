@@ -183,6 +183,31 @@ def test_core_with_autotune(tmp_path):
 
 
 @needs_core
+def test_autotune_explores_categorical_knobs(tmp_path):
+    """On a faked 2-host x 2-local topology the autotuner's 4-D GP space
+    includes the hierarchical and cache binary dims (VERDICT r3 weak #8;
+    reference: parameter_manager.h:42-105): the sample trace must show
+    BOTH hierarchical settings tried — i.e. the knob actually flipped
+    mid-run, atomically across ranks — while collectives stay correct."""
+    log = str(tmp_path / "autotune.csv")
+    _launch(4, {"HVD_TPU_AUTOTUNE": "1", "HVD_TPU_CYCLE_TIME": "0.5",
+                "HOROVOD_AUTOTUNE_WINDOW_SECONDS": "0.15",
+                "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "1",
+                "HVD_TEST_TRAFFIC_SECONDS": "2.0",
+                "HVD_TEST_AUTOTUNE_MIN_SAMPLES": "10",
+                "HOROVOD_AUTOTUNE_LOG": log},
+            topology=(2, 2), timeout=360)
+    with open(log) as f:
+        header, *rows = f.read().strip().splitlines()
+    assert header == ("sample,fusion_bytes,cycle_ms,hierarchical,cache,"
+                      "bytes_per_sec")
+    hier_vals = {r.split(",")[3] for r in rows}
+    cache_vals = {r.split(",")[4] for r in rows}
+    assert hier_vals == {"0", "1"}, rows  # the two-level path was tried
+    assert "1" in cache_vals, rows
+
+
+@needs_core
 def test_core_group_fusion_disabled():
     """HOROVOD_DISABLE_GROUP_FUSION: grouped allreduces stay numerically
     correct when groups are kept out of shared fusion units."""
@@ -226,11 +251,87 @@ def test_cache_eviction_and_fused_allgather(size, tmp_path):
 
 
 @needs_core
+def test_core_leveled_rank_tagged_logging():
+    """HOROVOD_LOG_LEVEL gates the C++ core's logging and every line
+    carries rank + timestamp in the Python logger's format (VERDICT r3
+    weak #5; reference: horovod/common/logging.{h,cc})."""
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.update({
+            "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": "2",
+            "HVD_TPU_COORD_ADDR": "127.0.0.1",
+            "HVD_TPU_COORD_PORT": str(port),
+            "HOROVOD_LOCAL_RANK": str(rank), "HOROVOD_LOCAL_SIZE": "2",
+            "HOROVOD_CROSS_RANK": "0", "HOROVOD_CROSS_SIZE": "1",
+            "HOROVOD_LOG_LEVEL": "INFO",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = [p.communicate(timeout=240)[0].decode() for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    for rank, out in enumerate(outs):
+        line = next(l for l in out.splitlines()
+                    if f"[hvdcore] [rank {rank}] INFO: core init" in l)
+        # timestamp prefix: "[YYYY-MM-DD HH:MM:SS.mmm]"
+        assert line.startswith("[2"), line
+        assert "size=2" in line and "coordinator=" in line, line
+        assert any(f"[rank {rank}] INFO: core shutdown" in l
+                   for l in out.splitlines()), out
+    # default threshold (WARNING) silences INFO lifecycle lines
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("HOROVOD_LOG_LEVEL", None)
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        e = dict(env)
+        e.update({
+            "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": "2",
+            "HVD_TPU_COORD_ADDR": "127.0.0.1",
+            "HVD_TPU_COORD_PORT": str(port),
+            "HOROVOD_LOCAL_RANK": str(rank), "HOROVOD_LOCAL_SIZE": "2",
+            "HOROVOD_CROSS_RANK": "0", "HOROVOD_CROSS_SIZE": "1",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER], env=e,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = [p.communicate(timeout=240)[0].decode() for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    assert not any("INFO: core init" in o for o in outs), outs
+
+
+@needs_core
 def test_core_hierarchical_allreduce():
     """HOROVOD_HIERARCHICAL_ALLREDUCE over a faked 2-host x 2-local
     topology: intra-host reduce -> leader ring -> intra-host broadcast
     (reference: NCCLHierarchicalAllreduce, nccl_operations.cc:233-420)."""
     _launch(4, {"HOROVOD_HIERARCHICAL_ALLREDUCE": "1"}, topology=(2, 2))
+
+
+@needs_core
+def test_core_hierarchical_allgather():
+    """HOROVOD_HIERARCHICAL_ALLGATHER over a faked 2-host x 2-local
+    topology (reference: MPIHierarchicalAllgather, mpi_operations.cc):
+    core_worker's ragged + fused allgather numerics must hold on the
+    node-leader path, and the hier_allgathers counter proves the
+    two-level dispatch actually ran."""
+    _launch(4, {"HOROVOD_HIERARCHICAL_ALLGATHER": "1",
+                "HVD_TEST_EXPECT_HIER_AG": "1"}, topology=(2, 2))
+
+
+@needs_core
+def test_matrix_numerics_hierarchical():
+    """The full dtype x shape x op sweep with BOTH hierarchical paths on,
+    over the faked two-level topology — exact numerics end to end."""
+    _launch(4, timeout=480, worker=MATRIX_WORKER,
+            extra_env={"HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+                       "HOROVOD_HIERARCHICAL_ALLGATHER": "1",
+                       "HVD_TPU_FUSION_THRESHOLD": "512"},
+            topology=(2, 2))
 
 
 @needs_core

@@ -102,9 +102,13 @@ def test_cli_resolves_pod_hosts_without_dash_h(metadata):
 
 
 def test_cli_tpu_excludes_explicit_hosts(metadata):
-    args = launch.parse_args(["--tpu", "-H", "a:1", "--", "echo"])
-    with pytest.raises(ValueError):
-        launch.resolve_hosts(args)
+    # conflicting host sources are rejected at parse time, for the elastic
+    # path too (parse_args errors via SystemExit)
+    for argv in (["--tpu", "-H", "a:1", "--", "echo"],
+                 ["--tpu", "--host-discovery-script", "./d.sh",
+                  "--min-np", "2", "--", "echo"]):
+        with pytest.raises(SystemExit):
+            launch.parse_args(argv)
 
 
 def test_launch_static_receives_metadata_hosts(metadata, monkeypatch):
