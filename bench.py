@@ -156,6 +156,16 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
          flops_src="analytic", compile_s=compile_s)
     _log(f"provisional emitted (warmup {dt_w:.2f}s); timing...")
 
+    # graceful self-deadline: a child the parent has to SIGTERM/SIGKILL
+    # tears the PJRT chip claim down dirty and can wedge the relay lease
+    # for the NEXT run (10-25 min); exiting cleanly with the provisional
+    # already on stdout is strictly better than being killed mid-window
+    deadline_epoch = float(os.environ.get("HVD_BENCH_CHILD_DEADLINE", "0"))
+    if deadline_epoch and time.time() > deadline_epoch - 45:
+        _log("skipping final window: too close to the attempt deadline; "
+             "provisional already emitted, exiting cleanly")
+        sys.exit(0)
+
     t0 = time.perf_counter()
     for _ in range(iters):
         state, loss = step_fn(state)
@@ -561,10 +571,14 @@ def _run_attempt(deadline_s):
     provisional_line | None, error | None)`` — ``final_line`` is the
     non-provisional result; ``provisional_line`` the warmup-window one."""
     lines = []
+    env = dict(os.environ)
+    # child exits cleanly 90s before we would have to kill it (a killed
+    # TPU child can wedge the relay lease for the following run)
+    env["HVD_BENCH_CHILD_DEADLINE"] = str(time.time() + deadline_s - 90)
     proc = subprocess.Popen(
         [sys.executable, "-u", os.path.abspath(__file__), "--child"],
         stdout=subprocess.PIPE, stderr=sys.stderr, text=True, bufsize=1,
-        cwd=os.path.dirname(os.path.abspath(__file__)))
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
 
     def _drain(pipe):
         try:

@@ -36,6 +36,7 @@ from horovod_tpu.core.core_backend import CoreBackend
 from horovod_tpu.ops.reduce_op import ReduceOp
 
 sizes_bytes = [int(s) for s in os.environ["BENCH_BYTES"].split(",")]
+iters_env = int(os.environ.get("BENCH_ITERS", "0"))
 be = CoreBackend()
 out = []
 for nbytes in sizes_bytes:
@@ -44,7 +45,7 @@ for nbytes in sizes_bytes:
     # warmup
     for i in range(3):
         be.allreduce_async(f"w.{nbytes}.{i}", x, ReduceOp.SUM).wait(120)
-    iters = 10 if nbytes >= 1 << 22 else 30
+    iters = iters_env or (10 if nbytes >= 1 << 22 else 30)
     t0 = time.perf_counter()
     for i in range(iters):
         be.allreduce_async(f"b.{nbytes}.{i}", x, ReduceOp.SUM).wait(300)
@@ -57,13 +58,14 @@ be.shutdown()
 """
 
 
-def run_world(world: int, sizes_bytes: list) -> dict:
+def run_world(world: int, sizes_bytes: list, iters: int = 0) -> dict:
     port = free_port()
     procs = []
     try:
         for rank in range(world):
             env = dict(os.environ)
             env.update({
+                "BENCH_ITERS": str(iters),
                 "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": str(world),
                 "HOROVOD_LOCAL_RANK": str(rank),
                 "HOROVOD_LOCAL_SIZE": str(world),

@@ -1,0 +1,142 @@
+"""Eager-adapter overhead micro-benchmark (VERDICT r3 weak #6).
+
+The drop-in Torch/TF adapters issue one EAGER collective per call
+through the process backend — per-call negotiation, host-memory copies,
+TCP wire time (``ops/backend.py`` dispatch over the C++ core; under
+``HOROVOD_TPU_OPERATIONS=XLA_EAGER`` additional device<->host
+``device_put`` round-trips stack on top, so the numbers here are a
+LOWER bound on adapter overhead). The native JAX path compiles the
+collective INTO the step (``ops/mesh_collectives.py`` jit-cached
+shard_map programs). This harness quantifies that gap so "drop-in
+Horovod on TPU" users know what the eager convenience costs and when to
+move the hot loop in-graph (``docs/MIGRATION.md``).
+
+Three timings per tensor size, same math (global SUM):
+- ``ingraph``:   jitted shard_map allreduce replayed from cache
+                 (``device_allreduce``) — the native per-step path;
+- ``eager``:     a REAL 2-process eager allreduce through the TCP core
+                 (via ``collective_bench.run_world``, always host CPU
+                 processes — per-call negotiation + host copies, the
+                 path the Torch/TF adapters ride; the single-process
+                 LOCAL backend short-circuits and would measure
+                 nothing);
+- ``step_fused``: the same reduction fused into a jitted
+                 compute+update step — what a real training step pays
+                 (the collective rides the step's compilation, so the
+                 adapter-vs-native gap is pure launch overhead).
+
+Run:    python benchmarks/eager_overhead_bench.py [--bytes ...]
+Output: a table + one JSON summary line (eager_overhead).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+if not os.environ.get("HVD_BENCH_TPU"):  # default: 8-device CPU mesh
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.core import core_available  # noqa: E402
+from horovod_tpu.ops.mesh_collectives import device_allreduce  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from collective_bench import run_world  # noqa: E402
+
+
+def _time(fn, readback, iters):
+    fn()  # compile / warm path
+    readback()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    readback()
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bytes", default=",".join(
+        str(1 << p) for p in range(12, 25, 4)))
+    ap.add_argument("--iters", type=int, default=30)
+    args = ap.parse_args()
+
+    hvd.init()
+    mesh = hvd.build_mesh(dp=-1)
+
+    sizes_bytes = [int(b) for b in args.bytes.split(",")]
+    # the eager column: real 2-process negotiation + host copies
+    if core_available():
+        eager_lat = run_world(2, sizes_bytes, iters=args.iters)
+    else:
+        eager_lat = {}
+        print("WARNING: libhvdcore.so not built — eager column omitted "
+              "(build with `make -C cpp`)", file=sys.stderr)
+
+    print(f"devices: {jax.device_count()}x {jax.devices()[0].device_kind}")
+    print(f"{'bytes':>10} {'ingraph_us':>11} {'eager_us':>10} "
+          f"{'fused_us':>10} {'eager_x':>8}")
+    results = []
+    last = {}
+    n_dev = jax.device_count()
+    for nbytes in sizes_bytes:
+        rows = max(nbytes // 4 // n_dev, 1)
+        # in-graph contract: leading dim = mesh axis size, one shard/row
+        xs = jax.device_put(jnp.ones((n_dev, rows), jnp.float32),
+                            hvd.batch_sharding(mesh))
+
+        out = {}
+
+        def ingraph():
+            out["v"] = device_allreduce(xs, mesh)
+
+        @jax.jit
+        def fused_step(x):
+            y = x * 2.0 - 1.0  # stand-in compute
+            return device_allreduce(y, mesh) * 0.5
+
+        def fused():
+            out["v"] = fused_step(xs)
+
+        def readback():
+            np.asarray(out["v"])  # host sync: the only reliable fence
+
+        t_in = _time(ingraph, readback, args.iters)
+        t_eager = eager_lat.get(nbytes)  # None when the core isn't built
+        t_fused = _time(fused, readback, args.iters)
+        ratio = (t_eager / t_fused) if (t_eager and t_fused) else None
+        print(f"{nbytes:>10} {t_in * 1e6:>11.1f} "
+              f"{t_eager * 1e6 if t_eager else float('nan'):>10.1f} "
+              f"{t_fused * 1e6:>10.1f} "
+              f"{ratio if ratio else float('nan'):>8.1f}")
+        results.append({"bytes": nbytes, "ingraph_s": t_in,
+                        "eager_s": t_eager, "fused_step_s": t_fused,
+                        "eager_over_fused": ratio})
+        last = results[-1]
+
+    print(json.dumps({
+        "eager_overhead": results,
+        "device_kind": jax.devices()[0].device_kind,
+        "n_devices": jax.device_count(),
+        "headline_eager_over_fused": last.get("eager_over_fused"),
+    }))
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

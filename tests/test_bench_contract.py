@@ -105,6 +105,36 @@ def test_gpt_child_runs_on_cpu_mesh():
     assert doc["compile_s"] > 0
 
 
+def test_child_exits_cleanly_before_deadline():
+    """With the attempt deadline imminent, the child must emit the
+    provisional line and exit 0 WITHOUT running the final window — a
+    child the parent has to kill tears the TPU chip claim down dirty and
+    wedges the relay lease for the next run."""
+    env = dict(os.environ)
+    env.update({
+        "HVD_BENCH_MODEL": "gpt", "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "HVD_BENCH_GPT_DMODEL": "64", "HVD_BENCH_GPT_HEADS": "4",
+        "HVD_BENCH_GPT_LAYERS": "2", "HVD_BENCH_GPT_DFF": "128",
+        "HVD_BENCH_BATCH": "2", "HVD_BENCH_SEQ": "64",
+        "HVD_BENCH_CHILD_DEADLINE": "1",  # long past: skip final window
+    })
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import jax\n"
+         "jax.config.update('jax_platforms', 'cpu')\n"
+         "import bench\n"
+         "bench._child()\n"],
+        env=env, capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(os.path.abspath(bench.__file__)))
+    assert r.returncode == 0, r.stderr[-1500:]
+    lines = [json.loads(l) for l in r.stdout.strip().splitlines()
+             if l.strip().startswith("{")]
+    assert len(lines) == 1  # provisional only, no final window
+    assert lines[0]["provisional"] is True and lines[0]["value"] > 0
+    assert "exiting cleanly" in r.stderr
+
+
 def test_provisional_salvaged_when_final_window_never_lands(monkeypatch):
     """If every attempt times out but a warmup-window provisional line was
     streamed out, main() must print that REAL measured number (with the
