@@ -159,11 +159,17 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
     # graceful self-deadline: a child the parent has to SIGTERM/SIGKILL
     # tears the PJRT chip claim down dirty and can wedge the relay lease
     # for the NEXT run (10-25 min); exiting cleanly with the provisional
-    # already on stdout is strictly better than being killed mid-window
+    # already on stdout is strictly better than being killed mid-window.
+    # The warmup window just measured the per-step cost, so PREDICT the
+    # final window's duration instead of using a fixed margin — on a slow
+    # relay day 10 steps can take minutes.
     deadline_epoch = float(os.environ.get("HVD_BENCH_CHILD_DEADLINE", "0"))
-    if deadline_epoch and time.time() > deadline_epoch - 45:
-        _log("skipping final window: too close to the attempt deadline; "
-             "provisional already emitted, exiting cleanly")
+    est_final_s = dt_w / warmup_iters * iters
+    if deadline_epoch and \
+            time.time() + est_final_s + 45 > deadline_epoch:
+        _log(f"skipping final window (predicted {est_final_s:.0f}s would "
+             "cross the attempt deadline); provisional already emitted, "
+             "exiting cleanly")
         sys.exit(0)
 
     t0 = time.perf_counter()

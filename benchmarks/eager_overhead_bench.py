@@ -81,10 +81,14 @@ def main():
 
     sizes_bytes = [int(b) for b in args.bytes.split(",")]
     # the eager column: real 2-process negotiation + host copies
+    eager_lat = {}
     if core_available():
-        eager_lat = run_world(2, sizes_bytes, iters=args.iters)
+        try:
+            eager_lat = run_world(2, sizes_bytes, iters=args.iters)
+        except (RuntimeError, OSError) as e:  # worker died / port race
+            print(f"WARNING: eager workers failed ({e}); eager column "
+                  "omitted", file=sys.stderr)
     else:
-        eager_lat = {}
         print("WARNING: libhvdcore.so not built — eager column omitted "
               "(build with `make -C cpp`)", file=sys.stderr)
 

@@ -19,6 +19,27 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# OPT-IN persistent compilation cache (HVD_TEST_COMPILE_CACHE=1): cuts
+# the hot suite's XLA:CPU compile time ~35% (InceptionV3 70s -> 46s),
+# but on this 1-core box the faster warm-cache dispatch can pile up
+# multi-device executions and trip the known XLA:CPU co-scheduling
+# SIGABRT (see .claude/skills/verify gotchas) — observed twice at ~90%
+# of the full suite with the cache on, never with it off. Default off:
+# suite determinism outranks wall clock.
+if os.environ.get("HVD_TEST_COMPILE_CACHE") == "1":
+    try:
+        # honor a user-chosen cache dir; otherwise use repo-local (same
+        # value in-process and via env so subprocess workers share it)
+        _cache = os.environ.get("JAX_COMPILATION_CACHE_DIR") or \
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), ".jax_cache")
+        os.makedirs(_cache, exist_ok=True)
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache
+        jax.config.update("jax_compilation_cache_dir", _cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    except Exception:
+        pass  # an optimization, never a failure
+
 import pytest  # noqa: E402
 
 assert jax.device_count() == 8, (
