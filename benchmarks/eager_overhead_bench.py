@@ -83,9 +83,11 @@ def main():
     # the eager column: real 2-process negotiation + host copies
     eager_lat = {}
     if core_available():
+        import subprocess
         try:
             eager_lat = run_world(2, sizes_bytes, iters=args.iters)
-        except (RuntimeError, OSError) as e:  # worker died / port race
+        except (RuntimeError, OSError,
+                subprocess.SubprocessError) as e:  # died / hung / port race
             print(f"WARNING: eager workers failed ({e}); eager column "
                   "omitted", file=sys.stderr)
     else:

@@ -29,7 +29,7 @@ while [ $try -lt 8 ]; do
     echo "[capture] DONE ($missing secondaries missing)" >&2
     exit $missing
   fi
-  sleep 300
+  [ $try -lt 8 ] && sleep 300
 done
 echo "[capture] relay never recovered" >&2
 exit 1

@@ -219,16 +219,47 @@ TSAN_SO = os.path.join(os.path.dirname(os.path.dirname(
     "libhvdcore_tsan.so")
 
 
+TSAN_ENV = {"HVD_TPU_CORE_LIB": TSAN_SO,
+            "LD_PRELOAD": "/lib/x86_64-linux-gnu/libtsan.so.2",
+            "TSAN_OPTIONS": "exitcode=66 halt_on_error=1"}
+
+
 @pytest.mark.skipif(not os.path.exists(TSAN_SO),
                     reason="build with `make -C cpp tsan` to enable")
 def test_core_under_tsan():
     """Race hunting: the full collective battery under ThreadSanitizer
     (the reference ships no TSAN coverage — SURVEY.md §5)."""
     # dlopen of a tsan-instrumented .so requires the runtime preloaded
-    _launch(2, {"HVD_TPU_CORE_LIB": TSAN_SO,
-                "LD_PRELOAD": "/lib/x86_64-linux-gnu/libtsan.so.2",
-                "TSAN_OPTIONS": "exitcode=66 halt_on_error=1"},
-            timeout=480)
+    _launch(2, dict(TSAN_ENV), timeout=480)
+
+
+@pytest.mark.skipif(not os.path.exists(TSAN_SO),
+                    reason="build with `make -C cpp tsan` to enable")
+def test_cache_timeline_restart_under_tsan(tmp_path):
+    """The round-4 concurrency surfaces under TSAN: dynamic timeline
+    stop/start (lifecycle mutex), LRU eviction under pressure, fused
+    allgather."""
+    tl1, tl2 = str(tmp_path / "t1.json"), str(tmp_path / "t2.json")
+    _launch(2, {**TSAN_ENV, "HOROVOD_CACHE_CAPACITY": "4",
+                "HVD_TPU_FUSION_THRESHOLD": "512",
+                "HVD_TEST_TL1": tl1, "HVD_TEST_TL2": tl2},
+            worker=CACHE_WORKER, timeout=480)
+
+
+@pytest.mark.skipif(not os.path.exists(TSAN_SO),
+                    reason="build with `make -C cpp tsan` to enable")
+def test_autotune_hier_under_tsan(tmp_path):
+    """Categorical knob flips + both hierarchical paths under TSAN on the
+    faked two-level topology."""
+    _launch(4, {**TSAN_ENV, "HVD_TPU_AUTOTUNE": "1",
+                "HVD_TPU_CYCLE_TIME": "0.5",
+                "HOROVOD_AUTOTUNE_WINDOW_SECONDS": "0.2",
+                "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "1",
+                "HVD_TEST_TRAFFIC_SECONDS": "1.5",
+                "HVD_TEST_AUTOTUNE_MIN_SAMPLES": "6",
+                "HOROVOD_AUTOTUNE_LOG": str(tmp_path / "at.csv"),
+                "HOROVOD_HIERARCHICAL_ALLGATHER": "1"},
+            topology=(2, 2), timeout=480)
 
 
 @needs_core
