@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Merge capture results into BENCH_MEASURED.json.
+
+``ci/capture_round.sh`` appends verbatim bench.py result lines to a
+jsonl file; this tool folds them into the committed measurement log
+(provenance for rounds where the driver's own capture window hits a
+relay outage — value stays with the measured_at timestamp, never
+replacing the driver artifacts).
+
+Usage: python ci/record_measured.py /tmp/round4_captures.jsonl
+"""
+
+import datetime
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "BENCH_MEASURED.json")
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print(__doc__)
+        return 2
+    with open(LOG) as f:
+        log = json.load(f)
+    known = {json.dumps(r["result"], sort_keys=True)
+             for r in log.get("runs", [])}
+    added = 0
+    with open(sys.argv[1]) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            result = json.loads(line)
+            if not result.get("value"):
+                continue  # diagnostic-only lines are not measurements
+            key = json.dumps(result, sort_keys=True)
+            if key in known:
+                continue
+            log.setdefault("runs", []).append({
+                "measured_at": datetime.datetime.now(
+                    datetime.timezone.utc).strftime("%Y-%m-%dT%H:%MZ"),
+                "result": result,
+            })
+            known.add(key)
+            added += 1
+    with open(LOG, "w") as f:
+        json.dump(log, f, indent=2)
+        f.write("\n")
+    print(f"recorded {added} new measurement(s) into {LOG}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
