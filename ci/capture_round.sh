@@ -11,7 +11,10 @@ while [ $try -lt 8 ]; do
   HVD_BENCH_TOTAL_BUDGET_S=1800 timeout 1900 python bench.py \
       > /tmp/cap_headline.json 2>/tmp/cap_headline.log
   if python -c "import json,sys; d=json.load(open('/tmp/cap_headline.json')); sys.exit(0 if d.get('value') else 1)" 2>/dev/null; then
-    cat /tmp/cap_headline.json >> "$OUT"
+    stamp() {  # wrap with the CAPTURE time so provenance survives late merges
+      python -c "import json,datetime,sys; print(json.dumps({'measured_at': datetime.datetime.now(datetime.timezone.utc).strftime('%Y-%m-%dT%H:%MZ'), 'result': json.load(open(sys.argv[1]))}))" "$1"
+    }
+    stamp /tmp/cap_headline.json >> "$OUT"
     echo "[capture] headline OK; sweeping secondaries" >&2
     missing=0
     for model in resnet50_bare bert gpt; do
@@ -20,7 +23,7 @@ while [ $try -lt 8 ]; do
         python bench.py > /tmp/cap_$model.json 2>/tmp/cap_$model.log
       # append only validated, value-carrying JSON (same bar as headline)
       if python -c "import json,sys; d=json.load(open('/tmp/cap_$model.json')); sys.exit(0 if d.get('value') else 1)" 2>/dev/null; then
-        cat /tmp/cap_$model.json >> "$OUT"
+        stamp /tmp/cap_$model.json >> "$OUT"
       else
         echo "[capture] $model FAILED (no valid value)" >&2
         missing=$((missing+1))

@@ -33,22 +33,34 @@ def main() -> int:
             line = line.strip()
             if not line:
                 continue
-            result = json.loads(line)
+            doc = json.loads(line)
+            if "result" in doc and "measured_at" in doc:
+                # capture_round.sh wraps lines with the CAPTURE time —
+                # provenance must not shift to the (possibly much later)
+                # merge time, or bench.py's last_measured picks stale data
+                measured_at, result = doc["measured_at"], doc["result"]
+            else:  # bare bench.py line: merge time is all we have
+                measured_at = datetime.datetime.now(
+                    datetime.timezone.utc).strftime("%Y-%m-%dT%H:%MZ")
+                result = doc
             if not result.get("value"):
                 continue  # diagnostic-only lines are not measurements
             key = json.dumps(result, sort_keys=True)
             if key in known:
                 continue
-            log.setdefault("runs", []).append({
-                "measured_at": datetime.datetime.now(
-                    datetime.timezone.utc).strftime("%Y-%m-%dT%H:%MZ"),
-                "result": result,
-            })
+            log.setdefault("runs", []).append(
+                {"measured_at": measured_at, "result": result})
             known.add(key)
             added += 1
+    # keep the committed log's one-line-per-measurement format (its
+    # comment documents entries as verbatim bench.py lines)
+    body = ",\n".join(
+        '    {"measured_at": %s,\n     "result": %s}' % (
+            json.dumps(r["measured_at"]), json.dumps(r["result"]))
+        for r in log.get("runs", []))
     with open(LOG, "w") as f:
-        json.dump(log, f, indent=2)
-        f.write("\n")
+        f.write('{\n  "comment": %s,\n  "runs": [\n%s\n  ]\n}\n'
+                % (json.dumps(log.get("comment", "")), body))
     print(f"recorded {added} new measurement(s) into {LOG}")
     return 0
 
