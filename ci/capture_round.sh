@@ -5,7 +5,7 @@
 OUT=${OUT:-/tmp/round4_captures.jsonl}
 cd "$(dirname "$0")/.."
 try=0
-while [ $try -lt 8 ]; do
+while [ $try -lt 24 ]; do
   try=$((try+1))
   echo "[capture] headline try $try $(date -u +%H:%M)" >&2
   HVD_BENCH_TOTAL_BUDGET_S=1800 timeout 1900 python bench.py \
@@ -32,7 +32,7 @@ while [ $try -lt 8 ]; do
     echo "[capture] DONE ($missing secondaries missing)" >&2
     exit $missing
   fi
-  [ $try -lt 8 ] && sleep 300
+  [ $try -lt 24 ] && sleep 300
 done
 echo "[capture] relay never recovered" >&2
 exit 1
