@@ -6,14 +6,28 @@ import os
 import subprocess
 
 from setuptools import setup, find_packages
+from setuptools.command.build_ext import build_ext
 from setuptools.command.build_py import build_py
+
+
+def _make_core() -> None:
+    cpp = os.path.join(os.path.dirname(os.path.abspath(__file__)), "cpp")
+    if os.path.isdir(cpp):
+        subprocess.run(["make", "-j4"], cwd=cpp, check=True)
 
 
 class BuildWithCore(build_py):
     def run(self):
-        cpp = os.path.join(os.path.dirname(os.path.abspath(__file__)), "cpp")
-        if os.path.isdir(cpp):
-            subprocess.run(["make", "-j4"], cwd=cpp, check=True)
+        _make_core()
+        super().run()
+
+
+class BuildCoreExt(build_ext):
+    """`python setup.py build_ext` — the command the runtime's
+    missing-library error advertises — must actually build the core."""
+
+    def run(self):
+        _make_core()
         super().run()
 
 
@@ -24,7 +38,7 @@ setup(
                 "(Horovod-class capabilities on JAX/XLA)",
     packages=find_packages(include=["horovod_tpu*"]),
     package_data={"horovod_tpu.core": ["libhvdcore.so"]},
-    cmdclass={"build_py": BuildWithCore},
+    cmdclass={"build_py": BuildWithCore, "build_ext": BuildCoreExt},
     entry_points={
         "console_scripts": [
             "hvdrun = horovod_tpu.runner.launch:main",
