@@ -17,7 +17,7 @@ while [ $try -lt 24 ]; do
     stamp /tmp/cap_headline.json >> "$OUT"
     echo "[capture] headline OK; sweeping secondaries" >&2
     missing=0
-    for model in resnet50_bare bert gpt; do
+    for model in resnet50_bare bert gpt resnet101 vgg16 inception3; do
       echo "[capture] $model $(date -u +%H:%M)" >&2
       HVD_BENCH_MODEL=$model HVD_BENCH_TOTAL_BUDGET_S=1200 timeout 1300 \
         python bench.py > /tmp/cap_$model.json 2>/tmp/cap_$model.log

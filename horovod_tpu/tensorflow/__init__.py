@@ -10,6 +10,7 @@ distribution layer while models migrate.
 
 from __future__ import annotations
 
+import zlib
 from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -42,6 +43,67 @@ def _to_np(tensor) -> np.ndarray:
 def _from_np(arr, like):
     tf = _tf()
     return tf.constant(np.asarray(arr), dtype=like.dtype)
+
+
+def _host_grouped_allreduce(grads, compression, op, prefix, process_set,
+                            var_names=None):
+    """Shared eager/graph gradient-allreduce body for the tape and the
+    optimizer: compress → TCP-core grouped allreduce → decompress over the
+    non-None entries. Inside a tf.function the work rides a py_function so
+    the world size and the collective itself resolve at graph EXECUTION
+    time (same contract as size_op below — an elastic resize after tracing
+    must take effect without retracing).
+
+    The collective name is derived from the variable names (when the
+    caller knows them — the reference names every allreduce after its
+    variable) plus gradient positions/shapes/dtypes: stable across steps
+    and across re-wrapped tape instances (so the ResponseCache keeps
+    hitting), yet distinct for distinct models — two tapes in one traced
+    step (GAN- or siamese-style) produce unordered py_function ops whose
+    allreduces must not cross-match across ranks."""
+    present = [i for i, g in enumerate(grads) if g is not None]
+    if not present:
+        return grads
+    tf = _tf()
+    if tf.executing_eagerly() and size() <= 1:
+        return grads
+    # sparse embedding updates arrive as IndexedSlices; densify like the
+    # reference's sparse_as_dense path so one fused dense program carries
+    # the group (tensorflow/__init__.py DistributedOptimizer option)
+    grads = [tf.convert_to_tensor(g) if isinstance(g, tf.IndexedSlices)
+             else g for g in grads]
+    struct = ",".join(
+        f"{i}:{var_names[i] if var_names else ''}:"
+        f"{grads[i].shape}:{grads[i].dtype.name}" for i in present)
+    name = f"{prefix}.{zlib.crc32(struct.encode()):08x}"
+
+    def do(*gs):
+        if size() <= 1:
+            return [np.asarray(g) for g in gs]
+        comp, ctxs = [], []
+        for g in gs:
+            c, ctx = compression.compress(np.asarray(g))
+            comp.append(np.asarray(c))
+            ctxs.append(ctx)
+        outs = _C.grouped_allreduce(comp, op=op, name=name,
+                                    process_set=process_set)
+        return [np.asarray(compression.decompress(
+            np.asarray(o), ctx)) for o, ctx in zip(outs, ctxs)]
+
+    result = list(grads)
+    if tf.executing_eagerly():
+        outs = do(*[_to_np(grads[i]) for i in present])
+        for i, o in zip(present, outs):
+            result[i] = _from_np(o, grads[i])
+        return result
+    flat = tf.py_function(do, [grads[i] for i in present],
+                          [grads[i].dtype for i in present])
+    if not isinstance(flat, (list, tuple)):
+        flat = [flat]
+    for i, o in zip(present, flat):
+        o.set_shape(grads[i].shape)
+        result[i] = o
+    return result
 
 
 def allreduce(tensor, average: Optional[bool] = None,
@@ -220,25 +282,21 @@ class _DistributedGradientTape:
 
     def gradient(self, target, sources, output_gradients=None):
         grads = self._tape.gradient(target, sources, output_gradients)
-        return self._allreduce_grads(grads)
+        # the reference tape accepts a lone Variable or any nest as
+        # sources; flatten for the grouped allreduce and restore the
+        # caller's structure afterwards
+        tf = _tf()
+        flat_src = tf.nest.flatten(sources)
+        flat_grads = tf.nest.flatten(grads, expand_composites=False)
+        names = [getattr(v, "name", "") for v in flat_src]
+        out = self._allreduce_grads(flat_grads, names)
+        return tf.nest.pack_sequence_as(grads, out,
+                                        expand_composites=False)
 
-    def _allreduce_grads(self, grads):
-        flat: List[Tuple[int, np.ndarray, Any]] = []
-        for i, g in enumerate(grads):
-            if g is None:
-                continue
-            c, ctx = self._compression.compress(_to_np(g))
-            flat.append((i, np.asarray(c), ctx))
-        if size() <= 1 or not flat:
-            return grads
-        outs = _C.grouped_allreduce([f[1] for f in flat], op=self._op,
-                                    name="tfgrad",
-                                    process_set=self._process_set)
-        result = list(grads)
-        for (i, _, ctx), o in zip(flat, outs):
-            result[i] = _from_np(self._compression.decompress(
-                np.asarray(o), ctx), grads[i])
-        return result
+    def _allreduce_grads(self, grads, var_names=None):
+        return _host_grouped_allreduce(grads, self._compression, self._op,
+                                       "tfgrad", self._process_set,
+                                       var_names)
 
 
 def DistributedGradientTape(gradtape, op: ReduceOp = Average,
@@ -273,34 +331,10 @@ class _DistributedOptimizer:
     def __getattr__(self, item):
         return getattr(self._opt, item)
 
-    def _sync(self, grads):
-        if size() <= 1:
-            return grads
-        tf = _tf()
-
-        def do(*gs):
-            comp, ctxs = [], []
-            for g in gs:
-                c, ctx = self._compression.compress(np.asarray(g))
-                comp.append(np.asarray(c))
-                ctxs.append(ctx)
-            outs = _C.grouped_allreduce(comp, op=self._op, name="tfopt",
-                                        process_set=self._process_set)
-            return [np.asarray(self._compression.decompress(
-                np.asarray(o), ctx)) for o, ctx in zip(outs, ctxs)]
-
-        if tf.executing_eagerly():
-            outs = do(*[_to_np(g) for g in grads])
-            return [_from_np(o, g) for o, g in zip(outs, grads)]
-        # graph mode (keras compiles train_step into a tf.function):
-        # py_function runs the host allreduce eagerly inside the graph
-        flat = tf.py_function(do, list(grads),
-                              [g.dtype for g in grads])
-        if not isinstance(flat, (list, tuple)):
-            flat = [flat]
-        for o, g in zip(flat, grads):
-            o.set_shape(g.shape)
-        return list(flat)
+    def _sync(self, grads, tvars=None):
+        names = [v.name for v in tvars] if tvars else None
+        return _host_grouped_allreduce(grads, self._compression, self._op,
+                                       "tfopt", self._process_set, names)
 
     def apply_gradients(self, grads_and_vars, *args, **kwargs):
         gv = list(grads_and_vars)
@@ -322,7 +356,7 @@ class _DistributedOptimizer:
             grads = [_from_np(a / self.backward_passes_per_step, g)
                      for a, g in zip(self._acc, grads)]
             self._acc, self._pass = None, 0
-        grads = self._sync(grads)
+        grads = self._sync(grads, tvars)
         return self._opt.apply_gradients(zip(grads, tvars), *args, **kwargs)
 
     def _graph_accumulate_apply(self, tf, grads, tvars, args, kwargs):
@@ -345,7 +379,7 @@ class _DistributedOptimizer:
         def apply_now():
             avg = [tf.cast(v.read_value(), g.dtype) / float(k)
                    for v, g in zip(self._agg_vars, grads)]
-            synced = self._sync(avg)
+            synced = self._sync(avg, tvars)
             self._opt.apply_gradients(zip(synced, tvars), *args, **kwargs)
             resets = [v.assign(tf.zeros_like(v)) for v in self._agg_vars]
             with tf.control_dependencies(resets):

@@ -23,6 +23,7 @@ JIT_SYNC_WORKER = os.path.join(os.path.dirname(__file__),
 MATRIX_WORKER = os.path.join(os.path.dirname(__file__), "matrix_worker.py")
 STALL_WORKER = os.path.join(os.path.dirname(__file__), "stall_worker.py")
 TORCH_WORKER = os.path.join(os.path.dirname(__file__), "torch_worker.py")
+TF_WORKER = os.path.join(os.path.dirname(__file__), "tf_worker.py")
 CACHE_WORKER = os.path.join(os.path.dirname(__file__), "cache_worker.py")
 
 
@@ -141,6 +142,16 @@ def test_torch_adapter_multiprocess():
     DistributedOptimizer equivalence to full-batch single-process SGD
     (reference analog: test/parallel/test_torch.py)."""
     _launch(2, timeout=480, worker=TORCH_WORKER)
+
+
+@needs_core
+def test_tf_tape_in_tf_function():
+    """DistributedGradientTape traced by tf.function at size 2: averaged
+    gradients match the locally-computed cross-rank mean, None gradients
+    pass through, eager == traced (reference analog: the tf.function
+    tape cases of test/parallel/test_tensorflow.py)."""
+    pytest.importorskip("tensorflow")
+    _launch(2, timeout=480, worker=TF_WORKER)
 
 
 @needs_core

@@ -224,3 +224,28 @@ def test_keras_callbacks_fit(tfhvd):
                    khvd.callbacks.LearningRateWarmupCallback(
                        0.05, warmup_epochs=1, steps_per_epoch=4)])
     assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+
+def test_indexed_slices_passthrough_size1(tfhvd):
+    """Sparse embedding grads (IndexedSlices) stay sparse through the tape
+    and apply at world size 1 (the eager pass-through must not densify)."""
+    emb = tf.Variable(np.zeros((4, 3), np.float32))
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(tf.nn.embedding_lookup(emb, [1, 1, 2]))
+    g = tfhvd.DistributedGradientTape(tape).gradient(loss, [emb])[0]
+    assert isinstance(g, tf.IndexedSlices)
+    opt = tfhvd.DistributedOptimizer(tf.keras.optimizers.SGD(1.0))
+    opt.apply_gradients([(g, emb)])
+    got = emb.numpy()
+    assert got[1, 0] == -2.0 and got[2, 0] == -1.0 and got[0, 0] == 0.0
+
+
+def test_tape_single_variable_source(tfhvd):
+    """sources may be a lone Variable (reference tape nest semantics):
+    the result keeps the caller's structure — a tensor, not a list."""
+    w = tf.Variable(np.ones((3, 2), np.float32))
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(w * w)
+    g = tfhvd.DistributedGradientTape(tape).gradient(loss, w)
+    assert not isinstance(g, (list, tuple))
+    np.testing.assert_allclose(g.numpy(), 2 * np.ones((3, 2)), rtol=1e-6)
