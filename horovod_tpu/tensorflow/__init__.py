@@ -11,7 +11,7 @@ distribution layer while models migrate.
 from __future__ import annotations
 
 import zlib
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Optional
 
 import numpy as np
 
@@ -45,37 +45,85 @@ def _from_np(arr, like):
     return tf.constant(np.asarray(arr), dtype=like.dtype)
 
 
+def _sparse_reduce(tf, g, op, name, process_set):
+    """Allgather-based reduce of ONE IndexedSlices gradient, keeping it
+    sparse end-to-end (reference: ``sparse_allreduce_async``,
+    ``torch/mpi_ops.py:515-535`` — same contract as the torch adapter's
+    sparse path): every rank's indices and values concatenate; duplicate
+    coordinates sum in the optimizer's sparse apply, so dividing values
+    by the world size yields the elementwise average."""
+
+    def do(values, indices):
+        if size() <= 1:
+            return [np.asarray(values), np.asarray(indices)]
+        vh = _C.allgather_async(np.asarray(values), name=f"{name}.v",
+                                process_set=process_set)
+        ih = _C.allgather_async(np.asarray(indices), name=f"{name}.i",
+                                process_set=process_set)
+        v, i = vh.wait(), ih.wait()
+        if op == Average:
+            v = v / process_set.size()
+        return [np.asarray(v), np.asarray(i)]
+
+    if tf.executing_eagerly():
+        v, i = do(g.values, g.indices)
+        return tf.IndexedSlices(
+            tf.constant(v, dtype=g.values.dtype),
+            tf.constant(i, dtype=g.indices.dtype), g.dense_shape)
+    v, i = tf.py_function(do, [g.values, g.indices],
+                          [g.values.dtype, g.indices.dtype])
+    v.set_shape(tf.TensorShape([None]).concatenate(g.values.shape[1:]))
+    i.set_shape([None])
+    return tf.IndexedSlices(v, i, g.dense_shape)
+
+
 def _host_grouped_allreduce(grads, compression, op, prefix, process_set,
                             var_names=None):
     """Shared eager/graph gradient-allreduce body for the tape and the
     optimizer: compress → TCP-core grouped allreduce → decompress over the
-    non-None entries. Inside a tf.function the work rides a py_function so
-    the world size and the collective itself resolve at graph EXECUTION
-    time (same contract as size_op below — an elastic resize after tracing
-    must take effect without retracing).
+    non-None dense entries; IndexedSlices entries stay sparse via the
+    allgather path (_sparse_reduce). Inside a tf.function the work rides
+    py_functions so the world size and the collectives resolve at graph
+    EXECUTION time (same contract as size_op below — an elastic resize
+    after tracing must take effect without retracing).
 
-    The collective name is derived from the variable names (when the
-    caller knows them — the reference names every allreduce after its
-    variable) plus gradient positions/shapes/dtypes: stable across steps
-    and across re-wrapped tape instances (so the ResponseCache keeps
-    hitting), yet distinct for distinct models — two tapes in one traced
-    step (GAN- or siamese-style) produce unordered py_function ops whose
-    allreduces must not cross-match across ranks."""
+    Collective names derive from the variable names (when the caller
+    knows them — the reference names every allreduce after its variable)
+    plus gradient positions/shapes/dtypes: stable across steps and across
+    re-wrapped tape instances (so the ResponseCache keeps hitting), yet
+    distinct for distinct models. In graph mode a trace-time
+    graph-unique suffix additionally separates two structurally identical
+    calls in ONE traced step (WGAN-GP-style double gradients over the
+    same variables): their py_function ops are unordered, so name reuse
+    could cross-match across ranks; trace order is deterministic under
+    SPMD, so the suffix agrees on every rank. Eager calls run
+    synchronously in program order and need no suffix."""
     present = [i for i, g in enumerate(grads) if g is not None]
     if not present:
         return grads
     tf = _tf()
     if tf.executing_eagerly() and size() <= 1:
         return grads
-    # sparse embedding updates arrive as IndexedSlices; densify like the
-    # reference's sparse_as_dense path so one fused dense program carries
-    # the group (tensorflow/__init__.py DistributedOptimizer option)
-    grads = [tf.convert_to_tensor(g) if isinstance(g, tf.IndexedSlices)
-             else g for g in grads]
     struct = ",".join(
         f"{i}:{var_names[i] if var_names else ''}:"
         f"{grads[i].shape}:{grads[i].dtype.name}" for i in present)
     name = f"{prefix}.{zlib.crc32(struct.encode()):08x}"
+    if not tf.executing_eagerly():
+        # keep the FULL scoped path — the scope is part of what makes
+        # unique_name unique ('gen/tfgrad' vs 'disc/tfgrad')
+        uid = tf.compat.v1.get_default_graph().unique_name(
+            prefix).replace("/", ".")
+        name = f"{name}.{uid}"
+
+    result = list(grads)
+    sparse = [i for i in present
+              if isinstance(grads[i], tf.IndexedSlices)]
+    for i in sparse:
+        result[i] = _sparse_reduce(tf, grads[i], op, f"{name}.s{i}",
+                                   process_set)
+    dense = [i for i in present if i not in sparse]
+    if not dense:
+        return result
 
     def do(*gs):
         if size() <= 1:
@@ -90,17 +138,16 @@ def _host_grouped_allreduce(grads, compression, op, prefix, process_set,
         return [np.asarray(compression.decompress(
             np.asarray(o), ctx)) for o, ctx in zip(outs, ctxs)]
 
-    result = list(grads)
     if tf.executing_eagerly():
-        outs = do(*[_to_np(grads[i]) for i in present])
-        for i, o in zip(present, outs):
+        outs = do(*[_to_np(grads[i]) for i in dense])
+        for i, o in zip(dense, outs):
             result[i] = _from_np(o, grads[i])
         return result
-    flat = tf.py_function(do, [grads[i] for i in present],
-                          [grads[i].dtype for i in present])
+    flat = tf.py_function(do, [grads[i] for i in dense],
+                          [grads[i].dtype for i in dense])
     if not isinstance(flat, (list, tuple)):
         flat = [flat]
-    for i, o in zip(present, flat):
+    for i, o in zip(dense, flat):
         o.set_shape(grads[i].shape)
         result[i] = o
     return result
@@ -344,6 +391,12 @@ class _DistributedOptimizer:
         # LocalGradientAggregationHelper, tensorflow/gradient_aggregation.py)
         if self.backward_passes_per_step > 1:
             tf = _tf()
+            # accumulator variables / numpy sums need dense tensors, so
+            # sparse grads densify here (the no-accumulation path keeps
+            # them sparse via _sparse_reduce)
+            grads = [tf.convert_to_tensor(g)
+                     if isinstance(g, tf.IndexedSlices) else g
+                     for g in grads]
             if not tf.executing_eagerly():
                 return self._graph_accumulate_apply(tf, grads, tvars,
                                                     args, kwargs)

@@ -249,3 +249,19 @@ def test_tape_single_variable_source(tfhvd):
     g = tfhvd.DistributedGradientTape(tape).gradient(loss, w)
     assert not isinstance(g, (list, tuple))
     np.testing.assert_allclose(g.numpy(), 2 * np.ones((3, 2)), rtol=1e-6)
+
+
+def test_accumulation_with_sparse_grads(tfhvd):
+    """backward_passes_per_step with IndexedSlices grads: the accumulator
+    densifies them instead of crashing (sparse stays sparse only on the
+    no-accumulation path)."""
+    emb = tf.Variable(np.zeros((4, 2), np.float32))
+    opt = tfhvd.DistributedOptimizer(tf.keras.optimizers.SGD(1.0),
+                                     backward_passes_per_step=2)
+    for _ in range(2):
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(tf.nn.embedding_lookup(emb, [1, 2]))
+        g = tape.gradient(loss, [emb])[0]
+        opt.apply_gradients([(g, emb)])
+    got = emb.numpy()
+    assert got[1, 0] == -1.0 and got[2, 0] == -1.0 and got[0, 0] == 0.0

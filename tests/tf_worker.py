@@ -62,8 +62,9 @@ def main():
     eg = hvd.DistributedGradientTape(tape).gradient(loss, [w])[0]
     np.testing.assert_allclose(eg.numpy(), expect, rtol=1e-5)
 
-    # sparse embedding grads (IndexedSlices) densify (reference
-    # sparse_as_dense) and average across ranks inside the tf.function
+    # sparse embedding grads (IndexedSlices) stay sparse inside the
+    # tf.function: every rank's (indices, values) allgather and the
+    # values average, so densifying reproduces the cross-rank mean
     emb = tf.Variable(np.zeros((5, 2), np.float32))
 
     @tf.function
@@ -73,11 +74,47 @@ def main():
         return hvd.DistributedGradientTape(tape).gradient(loss, [emb])[0]
 
     g = emb_step(tf.constant([rank, rank]))  # rank r touches row r twice
+    assert isinstance(g, tf.IndexedSlices), type(g)
+    assert int(tf.shape(g.indices)[0]) == 2 * size  # gathered, not densified
     exp = np.zeros((5, 2), np.float32)
     for r in range(size):
         exp[r] += 2.0
     exp /= size
-    np.testing.assert_allclose(np.asarray(g), exp, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(tf.convert_to_tensor(g)), exp, rtol=1e-6)
+
+    # two tapes over the SAME variables in one traced step (WGAN-GP
+    # style): identical gradient structure, so only the trace-time
+    # graph-unique name suffix keeps their allreduces apart
+    def local_pair(data_rank):
+        x_r = tf.constant(np.full((4, 3), float(data_rank + 1), np.float32))
+        with tf.GradientTape() as t1:
+            l1 = tf.reduce_sum(tf.linalg.matmul(x_r, w))
+        with tf.GradientTape() as t2:
+            l2 = tf.reduce_sum(tf.linalg.matmul(x_r, w) ** 2)
+        return (t1.gradient(l1, [w])[0].numpy(),
+                t2.gradient(l2, [w])[0].numpy())
+
+    @tf.function
+    def double_step(xx):
+        with tf.GradientTape() as t1:
+            l1 = tf.reduce_sum(tf.linalg.matmul(xx, w))
+        with tf.GradientTape() as t2:
+            l2 = tf.reduce_sum(tf.linalg.matmul(xx, w) ** 2)
+        # distinct name_scopes: the uniquifier must keep the scope path
+        # ('gen/tfgrad' vs 'disc/tfgrad'), not just the leaf name
+        with tf.name_scope("gen"):
+            g1 = hvd.DistributedGradientTape(t1).gradient(l1, [w])[0]
+        with tf.name_scope("disc"):
+            g2 = hvd.DistributedGradientTape(t2).gradient(l2, [w])[0]
+        return g1, g2
+
+    g1, g2 = double_step(x)
+    pairs = [local_pair(r) for r in range(size)]
+    np.testing.assert_allclose(
+        g1.numpy(), np.mean([p[0] for p in pairs], axis=0), rtol=1e-5)
+    np.testing.assert_allclose(
+        g2.numpy(), np.mean([p[1] for p in pairs], axis=0), rtol=1e-5)
 
     # a lone Variable source keeps its structure at size > 1 too
     with tf.GradientTape() as tape:
