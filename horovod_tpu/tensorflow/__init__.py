@@ -185,11 +185,16 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None,
 
 def alltoall(tensor, splits=None, name: Optional[str] = None,
              process_set: ProcessSet = global_process_set):
+    """Received splits are returned ONLY when ``splits`` was supplied
+    (reference return contract, ``tensorflow/mpi_ops.py`` alltoall)."""
     t, recv_splits = _C.alltoall(
         _to_np(tensor),
         None if splits is None else _to_np(splits), name, process_set)
     tf = _tf()
-    return _from_np(t, tensor), tf.constant(np.asarray(recv_splits))
+    gathered = _from_np(t, tensor)
+    if splits is None:
+        return gathered
+    return gathered, tf.constant(np.asarray(recv_splits))
 
 
 def join(device: int = -1) -> int:

@@ -97,13 +97,81 @@ def allreduce_(tensor, average: Optional[bool] = None,
     return tensor
 
 
+def allreduce_async_(tensor, average: Optional[bool] = None,
+                     name: Optional[str] = None,
+                     op: Optional[ReduceOp] = None,
+                     prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0,
+                     process_set: ProcessSet = global_process_set):
+    """In-place async variant (reference: ``allreduce_async_``,
+    ``torch/mpi_ops.py``): the handle's wait/synchronize copies the
+    reduction back into ``tensor`` and returns it."""
+    h = _C.allreduce_async(_to_np(tensor), average, name, op,
+                           prescale_factor, postscale_factor, process_set)
+
+    def post(out):
+        tensor.copy_(_from_np(np.asarray(out), tensor))
+        return tensor
+    return _TorchHandle(h, tensor, post)
+
+
+def grouped_allreduce_async(tensors, average: Optional[bool] = None,
+                            name: Optional[str] = None,
+                            op: Optional[ReduceOp] = None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0,
+                            process_set: ProcessSet = global_process_set):
+    """One fused negotiation+program for the whole group (reference:
+    ``grouped_allreduce_async``, ``torch/mpi_ops.py``)."""
+    h = _C.grouped_allreduce_async([_to_np(t) for t in tensors], average,
+                                   name, op, prescale_factor,
+                                   postscale_factor, process_set)
+
+    def post(outs):
+        return [_from_np(np.asarray(o), t) for o, t in zip(outs, tensors)]
+    return _TorchHandle(h, tensors, post)
+
+
 def grouped_allreduce(tensors, average: Optional[bool] = None,
                       name: Optional[str] = None,
                       op: Optional[ReduceOp] = None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0,
                       process_set: ProcessSet = global_process_set):
-    outs = _C.grouped_allreduce([_to_np(t) for t in tensors], average, name,
-                                op, process_set=process_set)
-    return [_from_np(np.asarray(o), t) for o, t in zip(outs, tensors)]
+    return grouped_allreduce_async(tensors, average, name, op,
+                                   prescale_factor, postscale_factor,
+                                   process_set).wait()
+
+
+def grouped_allreduce_(tensors, average: Optional[bool] = None,
+                       name: Optional[str] = None,
+                       op: Optional[ReduceOp] = None,
+                       prescale_factor: float = 1.0,
+                       postscale_factor: float = 1.0,
+                       process_set: ProcessSet = global_process_set):
+    """In-place grouped variant (reference: ``grouped_allreduce_``)."""
+    return grouped_allreduce_async_(tensors, average, name, op,
+                                    prescale_factor, postscale_factor,
+                                    process_set).wait()
+
+
+def grouped_allreduce_async_(tensors, average: Optional[bool] = None,
+                             name: Optional[str] = None,
+                             op: Optional[ReduceOp] = None,
+                             prescale_factor: float = 1.0,
+                             postscale_factor: float = 1.0,
+                             process_set: ProcessSet = global_process_set):
+    """In-place async grouped variant (reference:
+    ``grouped_allreduce_async_``)."""
+    h = _C.grouped_allreduce_async([_to_np(t) for t in tensors], average,
+                                   name, op, prescale_factor,
+                                   postscale_factor, process_set)
+
+    def post(outs):
+        for t, o in zip(tensors, outs):
+            t.copy_(_from_np(np.asarray(o), t))
+        return tensors
+    return _TorchHandle(h, tensors, post)
 
 
 def allgather_async(tensor, name: Optional[str] = None,
@@ -128,6 +196,17 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None,
     return broadcast_async(tensor, root_rank, name, process_set).wait()
 
 
+def broadcast_async_(tensor, root_rank: int, name: Optional[str] = None,
+                     process_set: ProcessSet = global_process_set):
+    """In-place async broadcast (reference: ``broadcast_async_``)."""
+    h = _C.broadcast_async(_to_np(tensor), root_rank, name, process_set)
+
+    def post(out):
+        tensor.copy_(_from_np(np.asarray(out), tensor))
+        return tensor
+    return _TorchHandle(h, tensor, post)
+
+
 def broadcast_(tensor, root_rank: int, name: Optional[str] = None,
                process_set: ProcessSet = global_process_set):
     out = broadcast(tensor, root_rank, name, process_set)
@@ -135,14 +214,29 @@ def broadcast_(tensor, root_rank: int, name: Optional[str] = None,
     return tensor
 
 
-def alltoall(tensor, splits=None, name: Optional[str] = None,
-             process_set: ProcessSet = global_process_set):
-    t, recv_splits = _C.alltoall(
+def alltoall_async(tensor, splits=None, name: Optional[str] = None,
+                   process_set: ProcessSet = global_process_set):
+    """Async uneven alltoallv (reference: ``alltoall_async``,
+    ``torch/mpi_ops.py:765``); wait returns the gathered tensor, plus the
+    received splits ONLY when ``splits`` was supplied (the reference's
+    return contract, ``torch/mpi_ops.py:817-846``)."""
+    h = _C.alltoall_async(
         _to_np(tensor), None if splits is None else _to_np(splits)
         if hasattr(splits, "detach") else splits, name, process_set)
-    torch = _torch()
-    return (_from_np(np.asarray(t), tensor),
-            torch.from_numpy(np.asarray(recv_splits)))
+
+    def post(out):
+        t, recv_splits = out
+        gathered = _from_np(np.asarray(t), tensor)
+        if splits is None:
+            return gathered
+        torch = _torch()
+        return gathered, torch.from_numpy(np.asarray(recv_splits))
+    return _TorchHandle(h, tensor, post)
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set: ProcessSet = global_process_set):
+    return alltoall_async(tensor, splits, name, process_set).wait()
 
 
 def sparse_allreduce_async(tensor, name: str, op: ReduceOp = None):

@@ -24,8 +24,10 @@ def test_tf_allgather_broadcast_alltoall(tfhvd):
     assert g.shape == (2, 2)
     b = tfhvd.broadcast(tf.constant([5.0]), root_rank=0)
     np.testing.assert_allclose(b.numpy(), [5.0])
-    t, rs = tfhvd.alltoall(tf.constant([[1.0], [2.0]]))
-    assert t.shape == (2, 1)
+    t = tfhvd.alltoall(tf.constant([[1.0], [2.0]]))
+    assert t.shape == (2, 1)  # no splits arg -> bare tensor (reference)
+    t2, rs = tfhvd.alltoall(tf.constant([[1.0], [2.0]]), splits=[2])
+    assert t2.shape == (2, 1) and list(rs.numpy()) == [2]
 
 
 def test_tf_distributed_gradient_tape(tfhvd):

@@ -49,6 +49,39 @@ def main():
     metas = hvd.allgather_object({"rank": rank, "loss": 0.5 * rank})
     assert [m["rank"] for m in metas] == list(range(size))
 
+    # in-place async variants (reference: torch/mpi_ops.py allreduce_async_
+    # / broadcast_async_ / grouped_allreduce family): the handle's
+    # synchronize writes back into the argument tensors
+    t = torch.full((3,), float(rank + 1))
+    out = hvd.synchronize(hvd.allreduce_async_(t, op=hvd.Sum, name="ip"))
+    assert out is t
+    expect_sum = float(sum(r + 1 for r in range(size)))
+    assert torch.allclose(t, torch.full((3,), expect_sum)), t
+
+    b = torch.full((2,), float(rank))
+    hvd.synchronize(hvd.broadcast_async_(b, root_rank=0, name="ipb"))
+    assert torch.allclose(b, torch.zeros(2)), b
+
+    g1, g2 = torch.full((2,), float(rank)), torch.full((4,), 2.0 * rank)
+    outs = hvd.grouped_allreduce([g1, g2], op=hvd.Average, name="ga")
+    mean_r = float(sum(range(size))) / size
+    assert torch.allclose(outs[0], torch.full((2,), mean_r))
+    assert torch.allclose(outs[1], torch.full((4,), 2 * mean_r))
+    hvd.synchronize(hvd.grouped_allreduce_async_(
+        [g1, g2], op=hvd.Average, name="ga_"))
+    assert torch.allclose(g1, torch.full((2,), mean_r)), g1
+    hvd.grouped_allreduce_([g2], op=hvd.Average, name="ga2_")
+    # g2 was already reduced in place once, so averaging the averages is
+    # idempotent across equal ranks' values
+    assert torch.allclose(g2, torch.full((4,), 2 * mean_r)), g2
+
+    # async alltoall returns (tensor, recv_splits) from wait
+    a2a = torch.arange(size, dtype=torch.float32) + rank * 10
+    at, asplits = hvd.synchronize(
+        hvd.alltoall_async(a2a, splits=[1] * size, name="a2a"))
+    assert at.shape[0] == size and list(asplits) == [1] * size
+    assert float(at[0]) == float(rank)  # rank 0's slot r element
+
     # DistributedOptimizer: equal shards => identical to full-batch SGD
     torch.manual_seed(0)
     model = hvd.broadcast_object(torch.nn.Linear(4, 1), 0, name="m")
