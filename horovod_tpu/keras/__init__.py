@@ -11,9 +11,11 @@ from __future__ import annotations
 
 from typing import Optional
 
-from horovod_tpu.common.basics import rank, size  # noqa: F401
+from horovod_tpu.common.basics import (  # noqa: F401
+    local_rank, local_size, mpi_threads_supported, rank, size)
 from horovod_tpu.tensorflow import (  # noqa: F401
-    allreduce, allgather, broadcast, broadcast_variables, init, shutdown)
+    allreduce, allgather, broadcast, broadcast_global_variables,
+    broadcast_variables, init, shutdown)
 from horovod_tpu.train import callbacks as _cb
 
 
@@ -23,45 +25,11 @@ def DistributedOptimizer(optimizer, op=None, compression=None,
     class whose ``apply_gradients`` syncs gradients first (reference:
     ``horovod/_keras/__init__.py create_distributed_optimizer`` — same
     dynamic-subclass trick, required because ``model.compile`` validates
-    the optimizer's type)."""
-    from horovod_tpu.ops.reduce_op import Average
-    from horovod_tpu.train.compression import Compression
-    from horovod_tpu.tensorflow import _DistributedOptimizer
-
-    sync = _DistributedOptimizer(optimizer, op or Average,
-                                 compression or Compression.none,
-                                 backward_passes_per_step)
-    cls = optimizer.__class__
-
-    class _KerasDistributed(cls):
-        _hvd_sync = None
-
-        def apply_gradients(self, grads_and_vars, *args, **kwargs):
-            # sync (+ accumulation when backward_passes_per_step > 1, incl.
-            # the tf.function/graph path) lives in the TF helper; its _opt
-            # shim applies via THIS instance's base class so keras variable
-            # state stays consistent
-            return self._hvd_sync.apply_gradients(
-                list(grads_and_vars), *args, **kwargs)
-
-    _KerasDistributed.__name__ = "Distributed" + cls.__name__
-    dist = _KerasDistributed.from_config(optimizer.get_config())
-    dist._hvd_sync = sync
-
-    class _SuperApply:
-        """Routes the helper's final apply to the base-class method of the
-        keras-registered instance (not the detached original optimizer);
-        other attribute access falls through to that instance so the
-        helper's __getattr__ proxy contract keeps working."""
-
-        def apply_gradients(self, gv, *args, **kwargs):
-            return cls.apply_gradients(dist, list(gv), *args, **kwargs)
-
-        def __getattr__(self, item):
-            return getattr(dist, item)
-
-    sync._opt = _SuperApply()
-    return dist
+    the optimizer's type). A fresh instance is built from the config; to
+    distribute an already-built optimizer while keeping its slot state
+    (the load_model path), see ``_wrap_in_place``."""
+    dist = optimizer.__class__.from_config(optimizer.get_config())
+    return _wrap_in_place(dist, op, compression, backward_passes_per_step)
 
 
 def _keras():
@@ -145,3 +113,70 @@ callbacks = type("callbacks", (), {
     "MetricAverageCallback": MetricAverageCallback,
     "LearningRateWarmupCallback": LearningRateWarmupCallback,
 })
+
+
+def _wrap_in_place(optimizer, op=None, compression=None,
+                   backward_passes_per_step: int = 1):
+    """Make an optimizer instance distributed by swapping in a dynamic
+    subclass WITHOUT re-instantiating, so built variables and restored
+    slot state (momentum, Adam moments, ...) stay live. Shared engine of
+    DistributedOptimizer (which feeds it a fresh from_config instance)
+    and load_model (which feeds it the checkpoint-loaded one)."""
+    from horovod_tpu.ops.reduce_op import Average
+    from horovod_tpu.train.compression import Compression
+    from horovod_tpu.tensorflow import _DistributedOptimizer
+
+    cls = optimizer.__class__
+
+    class _KerasDistributed(cls):
+        _hvd_sync = None
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            # sync (+ accumulation when backward_passes_per_step > 1, incl.
+            # the tf.function/graph path) lives in the TF helper; its _opt
+            # shim applies via THIS instance's base class so keras variable
+            # state stays consistent
+            return self._hvd_sync.apply_gradients(
+                list(grads_and_vars), *args, **kwargs)
+
+    _KerasDistributed.__name__ = "Distributed" + cls.__name__
+    optimizer.__class__ = _KerasDistributed
+    sync = _DistributedOptimizer(optimizer, op or Average,
+                                 compression or Compression.none,
+                                 backward_passes_per_step)
+
+    class _SuperApply:
+        """Routes the helper's final apply to the base-class method of the
+        keras-registered instance; other attribute access falls through so
+        the helper's __getattr__ proxy contract keeps working."""
+
+        def apply_gradients(self, gv, *args, **kwargs):
+            return cls.apply_gradients(optimizer, list(gv), *args, **kwargs)
+
+        def __getattr__(self, item):
+            return getattr(optimizer, item)
+
+    sync._opt = _SuperApply()
+    optimizer._hvd_sync = sync
+    return optimizer
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=None):
+    """Load a saved keras model and make its optimizer distributed so the
+    restored model keeps training across workers (reference:
+    ``horovod/keras/__init__.py:167`` — there via load-time custom-object
+    substitution of every optimizer class; here the loaded instance's
+    class is swapped for the distributed subclass in place, which keeps
+    its checkpointed slot state). ``custom_optimizers`` are extra
+    optimizer classes needed for deserialization; they merge into
+    ``custom_objects``."""
+    keras = _keras()
+    co = dict(custom_objects or {})
+    for c in (custom_optimizers or []):
+        co.setdefault(c.__name__, c)
+    model = keras.models.load_model(filepath, custom_objects=co)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None:
+        _wrap_in_place(opt, compression=compression)
+    return model

@@ -216,6 +216,16 @@ def allgather_object(obj, name: Optional[str] = None):
     return _ag(obj, name=name)
 
 
+def broadcast_object_fn(root_rank: int = 0, session=None,
+                        name: Optional[str] = None):
+    """Reference: ``broadcast_object_fn`` (``tensorflow/functions.py:103``)
+    — there a TF1 graph of placeholders bound to a session; eager TF2 needs
+    no prebuilt graph, so this returns a closure over broadcast_object
+    with the same call shape (``session`` accepted for signature parity)."""
+    del session
+    return lambda obj: broadcast_object(obj, root_rank, name=name)
+
+
 # -- variable broadcast (reference: broadcast_variables /
 # broadcast_global_variables, tensorflow/__init__.py:270-300) ---------------
 

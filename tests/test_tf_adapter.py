@@ -267,3 +267,27 @@ def test_accumulation_with_sparse_grads(tfhvd):
         opt.apply_gradients([(g, emb)])
     got = emb.numpy()
     assert got[1, 0] == -1.0 and got[2, 0] == -1.0 and got[0, 0] == 0.0
+
+
+def test_keras_load_model_wraps_optimizer(tfhvd, tmp_path):
+    """hvd.keras.load_model restores a saved model with its optimizer made
+    distributed IN PLACE — the checkpointed slot state (Adam moments,
+    iteration count) must survive the wrap (reference keras load_model)."""
+    import horovod_tpu.keras as khvd
+    model = tf.keras.Sequential([tf.keras.layers.Dense(1, input_shape=(4,))])
+    model.compile(optimizer=tf.keras.optimizers.Adam(0.05), loss="mse",
+                  run_eagerly=True)
+    x = np.random.RandomState(0).randn(32, 4).astype(np.float32)
+    y = x @ np.asarray([[1.0], [2.0], [3.0], [4.0]], np.float32)
+    model.fit(x, y, epochs=1, batch_size=16, verbose=0)
+    path = str(tmp_path / "m.keras")
+    model.save(path)
+    saved_slots = [np.asarray(v) for v in model.optimizer.variables]
+    assert any(np.abs(s).sum() > 0 for s in saved_slots)  # moments moved
+
+    loaded = khvd.load_model(path)
+    assert type(loaded.optimizer).__name__ == "DistributedAdam"
+    for got, want in zip(loaded.optimizer.variables, saved_slots):
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+    hist = loaded.fit(x, y, epochs=2, batch_size=16, verbose=0)
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
