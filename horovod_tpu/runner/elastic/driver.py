@@ -49,7 +49,18 @@ class ElasticDriver:
                  reset_limit: Optional[int] = None,
                  verbose: bool = False,
                  ckpt_dir: Optional[str] = None,
-                 target_np: Optional[int] = None) -> None:
+                 target_np: Optional[int] = None,
+                 remote_exec=None,
+                 world_secret: Optional[bytes] = None) -> None:
+        # remote_exec(slot, command, worker_env, events) -> rc replaces the
+        # local/ssh exec when the cluster reaches hosts another way — e.g.
+        # Spark tasks acting as host agents (spark/elastic.py). The
+        # reference's analog is routing exec through its task services
+        # instead of ssh (spark/gloo_run.py). world_secret lets such a
+        # caller pre-share the world-doc HMAC key over its own trusted
+        # channel instead of shipping it in worker envs over the network.
+        self._remote_exec = remote_exec
+        self._preshared_secret = world_secret
         self._hosts = HostManager(discovery)
         self._command = command
         self._min_np = min_np
@@ -70,10 +81,13 @@ class ElasticDriver:
         from horovod_tpu.runner.http_kv import KVStoreServer
         self._kv = KVStoreServer()
         self._kv.start()
-        self._world_secret = _secrets.token_bytes(16)
+        self._world_secret = self._preshared_secret or \
+            _secrets.token_bytes(16)
         # the KV runs on THIS driver machine; remote workers need an
-        # address that routes back here, not rank 0's host
-        self._driver_addr = _socket.getfqdn()
+        # address that routes back here, not rank 0's host. gethostname,
+        # not getfqdn: the latter can resolve to 'localhost' → ::1 while
+        # the KV server is IPv4-only (see spark/elastic.py kv_addr)
+        self._driver_addr = _socket.gethostname()
 
     # -- discovery thread (reference: driver.py:181-201) --------------------
     def _discovery_loop(self) -> None:
@@ -184,21 +198,36 @@ class ElasticDriver:
         fail_lock = threading.Lock()
 
         def run_slot(slot, slot_gen):
-            # local-vs-ssh dispatch shared with the static launcher so
-            # multi-host elastic jobs actually place workers remotely
-            cmd, env = slot_command(
-                slot, self._command, coord_addr, coord_port, self._env,
-                extra_env={
-                    "HVD_TPU_ELASTIC": "1",
-                    "HVD_ELASTIC_GENERATION": str(slot_gen),
-                    "HVD_ELASTIC_CKPT": self._ckpt_dir,
-                    "HVD_ELASTIC_SECRET": self._world_secret.hex(),
-                    "HVD_ELASTIC_KV": f"127.0.0.1:{self._kv.port}"
-                    if slot.hostname in ("localhost", "127.0.0.1")
-                    else f"{self._driver_addr}:{self._kv.port}"})
+            extra_env = {
+                "HVD_TPU_ELASTIC": "1",
+                "HVD_ELASTIC_GENERATION": str(slot_gen),
+                "HVD_ELASTIC_CKPT": self._ckpt_dir,
+                "HVD_ELASTIC_SECRET": self._world_secret.hex(),
+                "HVD_ELASTIC_KV": f"127.0.0.1:{self._kv.port}"
+                if slot.hostname in ("localhost", "127.0.0.1")
+                else f"{self._driver_addr}:{self._kv.port}"}
             prefix = f"[{slot.rank}]" if self._verbose else ""
-            rc = safe_execute(cmd, env=env, prefix=prefix,
-                              events=[failure, teardown])
+            if self._remote_exec is not None:
+                # agent transport: ship the RAW worker command + env; the
+                # agent on slot.hostname execs it locally (no ssh wrap)
+                from horovod_tpu.runner.exec_run import build_worker_env
+                wenv = build_worker_env(slot, coord_addr, coord_port,
+                                        self._env)
+                wenv.update(extra_env)
+                if self._preshared_secret is not None:
+                    # the caller distributed the secret over its own
+                    # trusted channel; keep it off the wire
+                    wenv.pop("HVD_ELASTIC_SECRET", None)
+                rc = self._remote_exec(slot, self._command, wenv,
+                                       [failure, teardown])
+            else:
+                # local-vs-ssh dispatch shared with the static launcher so
+                # multi-host elastic jobs actually place workers remotely
+                cmd, env = slot_command(
+                    slot, self._command, coord_addr, coord_port, self._env,
+                    extra_env=extra_env)
+                rc = safe_execute(cmd, env=env, prefix=prefix,
+                                  events=[failure, teardown])
             if rc == 0:
                 self._registry.record(slot.rank, slot.hostname, SUCCESS)
                 return
@@ -276,6 +305,7 @@ class ElasticDriver:
         ess_ok = all(
             self._registry.state_of(r) == SUCCESS for r in essential_ranks)
         if ess_ok and self._registry.count(FAILURE) == 0:
+            self._final_np = np
             return SUCCESS
         if (teardown.is_set() or self._hosts_changed.is_set()) and \
                 self._registry.count(FAILURE) == 0:
@@ -288,7 +318,15 @@ class ElasticDriver:
                 if n >= host_slots:
                     self._hosts.blacklist(host)
             return FAILURE
+        self._final_np = np
         return SUCCESS
+
+    @property
+    def final_np(self) -> Optional[int]:
+        """World size of the generation that completed successfully (None
+        until then) — callers collecting per-rank artifacts use it to
+        ignore leftovers from aborted generations."""
+        return getattr(self, "_final_np", None)
 
     # -- main loop -----------------------------------------------------------
     def run(self) -> int:

@@ -60,12 +60,18 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
 
+class _KVServer(ThreadingHTTPServer):
+    # many agents poll concurrently; the socketserver default backlog of
+    # 5 resets connections under bursts on slow machines
+    request_queue_size = 128
+
+
 class KVStoreServer:
     """Threaded KV server (reference: ``RendezvousServer.start``,
     ``http_server.py:152``)."""
 
     def __init__(self, port: int = 0) -> None:
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
+        self._httpd = _KVServer(("0.0.0.0", port), _KVHandler)
         self._httpd.kv = {}
         self._httpd.kv_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
@@ -103,18 +109,37 @@ class KVStoreServer:
             self._httpd.kv.pop(scope, None)
 
 
+def _with_retries(do, attempts: int = 4):
+    """Transient-error shield: a busy single-core box can overflow the
+    server's listen backlog under polling bursts, resetting connections
+    mid-handshake; retry with short backoff instead of failing a worker."""
+    import http.client
+    delay = 0.05
+    for i in range(attempts):
+        try:
+            return do()
+        except (ConnectionError, http.client.RemoteDisconnected,
+                TimeoutError, OSError) as e:
+            if isinstance(e, HTTPError) or i == attempts - 1:
+                raise
+            import time
+            time.sleep(delay)
+            delay *= 2
+
+
 def kv_put(addr: str, port: int, scope: str, key: str, value: bytes,
            timeout: float = 30.0) -> None:
     req = Request(f"http://{addr}:{port}/{scope}/{key}", data=value,
                   method="PUT")
-    urlopen(req, timeout=timeout).read()
+    _with_retries(lambda: urlopen(req, timeout=timeout).read())
 
 
 def kv_get(addr: str, port: int, scope: str, key: str,
            timeout: float = 30.0) -> Optional[bytes]:
     try:
-        return urlopen(f"http://{addr}:{port}/{scope}/{key}",
-                       timeout=timeout).read()
+        return _with_retries(
+            lambda: urlopen(f"http://{addr}:{port}/{scope}/{key}",
+                            timeout=timeout).read())
     except HTTPError as e:
         if e.code == 404:
             return None

@@ -25,6 +25,13 @@ from horovod_tpu.spark.torch_estimator import (  # noqa: F401
     TorchEstimator, TorchModel)
 
 
+def run_elastic(*args, **kwargs):
+    """Elastic Spark launch (reference: ``horovod.spark.run_elastic``,
+    ``spark/runner.py:309``); see :mod:`horovod_tpu.spark.elastic`."""
+    from horovod_tpu.spark.elastic import run_elastic as _impl
+    return _impl(*args, **kwargs)
+
+
 def _require_pyspark():
     try:
         import pyspark  # noqa: F401

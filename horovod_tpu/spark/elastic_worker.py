@@ -1,0 +1,32 @@
+"""Worker entry for ``horovod_tpu.spark.run_elastic``: fetch the pickled
+training fn from the driver KV, run it, publish this rank's result
+(reference analog: ``spark/task/__init__.py`` exec of the pickled fn in
+the task process)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    import cloudpickle
+    from horovod_tpu.runner.http_kv import kv_get, kv_put
+
+    import socket
+    addr, port = os.environ["HVD_SPARK_KV"].rsplit(":", 1)
+    if socket.gethostname() == addr.split(".")[0]:
+        addr = "127.0.0.1"  # same-box fast path, mirrors the agent loop
+    payload = kv_get(addr, int(port), "payload", "fn")
+    if payload is None:
+        print("elastic_worker: no payload published", file=sys.stderr)
+        return 1
+    fn, args, kwargs = cloudpickle.loads(payload)
+    result = fn(*args, **kwargs)
+    kv_put(addr, int(port), "result", os.environ["HOROVOD_RANK"],
+           cloudpickle.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

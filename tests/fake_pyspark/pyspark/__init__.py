@@ -175,9 +175,75 @@ class _FakeSparkContext:
         return _FakeParallelized(n)
 
 
+class _FakePlainRDD:
+    """Non-barrier mapPartitions: each partition is a subprocess fed its
+    partition's data, no sync-dir rendezvous (used by run_elastic's agent
+    tasks, which coordinate through the driver KV instead)."""
+
+    def __init__(self, n: int, fn):
+        self._n = n
+        self._fn = fn
+
+    def collect(self):
+        import cloudpickle
+
+        tmp = tempfile.mkdtemp(prefix="fake_spark_plain_")
+        fn_path = os.path.join(tmp, "task_fn.pkl")
+        with open(fn_path, "wb") as f:
+            cloudpickle.dump(self._fn, f)
+        procs = []
+        for rank in range(self._n):
+            env = dict(os.environ)
+            env["PYTHONPATH"] = os.pathsep.join(
+                [os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))] +
+                [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                 if p])
+            out_path = os.path.join(tmp, f"out_{rank}.pkl")
+            procs.append((rank, out_path, subprocess.Popen(
+                [sys.executable, "-c",
+                 "import os, sys\n"
+                 "os.environ.setdefault(\n"
+                 "    'XLA_FLAGS', '--xla_force_host_platform_device_count=1')\n"
+                 "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+                 "import jax\n"
+                 "jax.config.update('jax_platforms', 'cpu')\n"
+                 "import cloudpickle\n"
+                 "fn_path, out_path, rank = sys.argv[1:4]\n"
+                 "with open(fn_path, 'rb') as f:\n"
+                 "    fn = cloudpickle.load(f)\n"
+                 "result = list(fn(iter([int(rank)])))\n"
+                 "with open(out_path, 'wb') as f:\n"
+                 "    cloudpickle.dump(result, f)\n",
+                 fn_path, out_path, str(rank)],
+                env=env)))
+        results = []
+        failed = []
+        try:
+            for rank, out_path, p in procs:
+                rc = p.wait(timeout=300)
+                if rc != 0:
+                    failed.append((rank, rc))
+                    continue
+                with open(out_path, "rb") as f:
+                    results.extend(cloudpickle.load(f))
+        finally:
+            for _, _, p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=30)
+            shutil.rmtree(tmp, ignore_errors=True)
+        if failed:
+            raise RuntimeError(f"fake spark tasks failed: {failed}")
+        return results
+
+
 class _FakeParallelized:
     def __init__(self, n: int):
         self._n = n
 
     def barrier(self):
         return _FakeBarrierRDD(self._n)
+
+    def mapPartitions(self, fn):
+        return _FakePlainRDD(self._n, fn)

@@ -84,3 +84,33 @@ def test_spark_run_requires_pyspark():
         pytest.skip("real or fake pyspark importable in this environment")
     with pytest.raises(ImportError, match="pyspark"):
         spark.run(lambda: None, num_proc=1)
+
+
+@needs_core
+def test_spark_run_elastic_recovers_from_worker_crash(fake_pyspark,
+                                                      tmp_path):
+    """run_elastic over fake Spark tasks acting as host agents: rank 1
+    crashes in generation 0, the ElasticDriver restarts the generation on
+    the same agents, and the retry completes with correct collectives
+    (reference: ``horovod.spark.run_elastic``, ``spark/runner.py:309``)."""
+    import horovod_tpu.spark as spark
+
+    marker = str(tmp_path / "crashed_once")
+
+    def train():
+        import os
+        import numpy as np
+        import horovod_tpu as hvd
+
+        hvd.init()
+        if hvd.rank() == 1 and not os.path.exists(marker):
+            open(marker, "w").close()
+            os._exit(17)  # hard crash mid-job, pre-collective
+        out = hvd.allreduce(np.ones(2) * (hvd.rank() + 1), op=hvd.Sum,
+                            name="el")
+        hvd.shutdown()
+        return float(np.asarray(out)[0])
+
+    results = spark.run_elastic(train, num_proc=2, min_np=2, max_np=2)
+    assert os.path.exists(marker)  # the crash really happened
+    assert results == [3.0, 3.0]
