@@ -134,24 +134,125 @@ class RayHostDiscovery:
 
 class ElasticRayExecutor:
     """Elastic executor over Ray (reference: ``ElasticRayExecutor``,
-    ``ray/elastic.py:90-149``): the generation-based elastic driver with
-    Ray-node discovery; workers run the command via ssh to Ray nodes.
+    ``ray/elastic.py:149+``). Two modes, matching the reference's two
+    deployment styles:
+
+    - ``run()`` with a ``command``: the generation-based elastic driver
+      with Ray-node discovery; workers launch via ssh to Ray nodes.
+    - ``run(fn)``: reference-style in-cluster execution — Ray actors host
+      the shared agent transport (:mod:`horovod_tpu.runner.elastic.agent`)
+      and the driver execs workers through them, no ssh; per-rank results
+      of the completed generation are returned.
+
     Gated on ray availability."""
 
-    def __init__(self, command, min_np: int = 1, max_np: Optional[int] = None,
+    def __init__(self, command=None, min_np: int = 1,
+                 max_np: Optional[int] = None,
                  cpus_per_slot: int = 1, env: Optional[Dict[str, str]] = None,
                  reset_limit: Optional[int] = None) -> None:
         _require_ray()
         self._discovery = RayHostDiscovery(cpus_per_slot)
         self._command = command
+        self._cpus = cpus_per_slot
         self._min_np = min_np
         self._max_np = max_np
         self._env = env
         self._reset_limit = reset_limit
 
-    def run(self) -> int:
-        from horovod_tpu.runner.elastic.driver import ElasticDriver
-        driver = ElasticDriver(self._discovery, self._command,
-                               min_np=self._min_np, max_np=self._max_np,
-                               env=self._env, reset_limit=self._reset_limit)
-        return driver.run()
+    def run(self, fn: Optional[Callable] = None, args: tuple = (),
+            kwargs: Optional[dict] = None):
+        if fn is None:
+            if self._command is None:
+                raise ValueError("ElasticRayExecutor.run() needs either a "
+                                 "constructor command or a fn argument")
+            from horovod_tpu.runner.elastic.driver import ElasticDriver
+            driver = ElasticDriver(self._discovery, self._command,
+                                   min_np=self._min_np,
+                                   max_np=self._max_np,
+                                   env=self._env,
+                                   reset_limit=self._reset_limit)
+            return driver.run()
+        return self._run_fn(fn, args, kwargs)
+
+    def _run_fn(self, fn: Callable, args: tuple, kwargs: Optional[dict]):
+        import time as _time
+        ray = _require_ray()
+        from horovod_tpu.runner.elastic.agent import run_agent_elastic
+
+        @ray.remote(num_cpus=self._cpus)
+        class _AgentActor:
+            def run_agent(self, ordinal, kv_addr, kv_port, secret_hex,
+                          world_secret_hex):
+                from horovod_tpu.runner.elastic.agent import (
+                    agent_loop, resolve_kv_addr)
+                agent_loop(int(ordinal), resolve_kv_addr(kv_addr),
+                           kv_port, secret_hex, world_secret_hex)
+                return True
+
+        def start_agents(ctx):
+            import threading
+            from horovod_tpu.runner.elastic.agent import (
+                STALE_S, resolve_kv_addr)
+            from horovod_tpu.runner.http_kv import kv_get, kv_scope_keys
+
+            addr = resolve_kv_addr(ctx["kv_addr"])
+            port = ctx["kv_port"]
+            actors = []
+            stop = threading.Event()
+            next_ordinal = [0]
+
+            def spawn():
+                a = _AgentActor.remote()
+                a.run_agent.remote(next_ordinal[0], ctx["kv_addr"], port,
+                                   ctx["secret_hex"],
+                                   ctx["world_secret_hex"])
+                next_ordinal[0] += 1
+                actors.append(a)
+
+            for _ in range(ctx["max_np"]):
+                spawn()
+
+            def fresh_agent_count():
+                import json as _json
+                import time as _t
+                n = 0
+                for key in kv_scope_keys(addr, port, "agents"):
+                    blob = kv_get(addr, port, "agents", key)
+                    if blob and _t.time() - _json.loads(blob)["ts"] \
+                            < STALE_S:
+                        n += 1
+                return n
+
+            def respawner():
+                # Ray actors are not auto-restarted (unlike Spark task
+                # retry): top the registry back up to max_np when actor
+                # loss shrinks it, so the driver can grow back
+                misses = 0
+                while not stop.wait(5.0):
+                    try:
+                        misses = misses + 1 \
+                            if fresh_agent_count() < ctx["max_np"] else 0
+                    except OSError:
+                        continue  # KV briefly unreachable; retry
+                    if misses >= 2:
+                        spawn()
+                        misses = 0
+
+            mon = threading.Thread(target=respawner, daemon=True)
+            mon.start()
+
+            def cleanup():
+                stop.set()
+                mon.join(timeout=10)
+                # shutdown is already posted; give loops one poll cycle to
+                # exit cleanly, then reclaim the actors
+                _time.sleep(1.0)
+                for a in actors:
+                    ray.kill(a)
+            return cleanup
+
+        return run_agent_elastic(
+            start_agents, fn, args, kwargs,
+            num_proc=self._max_np or self._min_np, min_np=self._min_np,
+            max_np=self._max_np, env=self._env,
+            reset_limit=self._reset_limit)

@@ -1,0 +1,35 @@
+"""Worker entry for agent-transport elastic jobs (Spark/Ray): fetch the
+pickled training fn from the driver KV, run it, publish this rank's
+result (reference analog: ``spark/task/__init__.py`` exec of the pickled
+fn in the task process)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    import cloudpickle
+    from horovod_tpu.runner.elastic.agent import resolve_kv_addr
+    from horovod_tpu.runner.http_kv import kv_get, kv_put
+
+    addr, port = os.environ["HVD_AGENT_KV"].rsplit(":", 1)
+    addr = resolve_kv_addr(addr)
+    payload = kv_get(addr, int(port), "payload", "fn")
+    if payload is None:
+        print("agent_worker: no payload published", file=sys.stderr)
+        return 1
+    fn, args, kwargs = cloudpickle.loads(payload)
+    result = fn(*args, **kwargs)
+    # generation-scoped key: a late publish from an aborted generation
+    # must never be mistaken for (or overwrite) the completed one's
+    gen = os.environ.get("HVD_ELASTIC_GENERATION", "0")
+    kv_put(addr, int(port), "result",
+           f"{gen}.{os.environ['HOROVOD_RANK']}",
+           cloudpickle.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -250,6 +250,7 @@ class ElasticDriver:
         # survivors never joined (completion raced the scale-up) must not
         # hold the driver hostage
         essential_ranks = [s.rank for s in slots]
+        essential_gen = gen  # growth below reuses the name `gen`
 
         while any(t.is_alive() for t in threads.values()):
             time.sleep(0.25)
@@ -305,7 +306,11 @@ class ElasticDriver:
         ess_ok = all(
             self._registry.state_of(r) == SUCCESS for r in essential_ranks)
         if ess_ok and self._registry.count(FAILURE) == 0:
-            self._final_np = np
+            # only the ESSENTIAL ranks are guaranteed complete — in-place
+            # growth may have raised np while its stragglers were torn
+            # down after the survivors finished in the old world
+            self._final_np = len(essential_ranks)
+            self._final_gen = essential_gen
             return SUCCESS
         if (teardown.is_set() or self._hosts_changed.is_set()) and \
                 self._registry.count(FAILURE) == 0:
@@ -318,7 +323,8 @@ class ElasticDriver:
                 if n >= host_slots:
                     self._hosts.blacklist(host)
             return FAILURE
-        self._final_np = np
+        self._final_np = len(essential_ranks)
+        self._final_gen = essential_gen
         return SUCCESS
 
     @property
@@ -327,6 +333,13 @@ class ElasticDriver:
         until then) — callers collecting per-rank artifacts use it to
         ignore leftovers from aborted generations."""
         return getattr(self, "_final_np", None)
+
+    @property
+    def final_generation(self) -> Optional[int]:
+        """Generation number the completed ranks were launched with
+        (their ``HVD_ELASTIC_GENERATION``) — pairs with final_np for
+        generation-scoped artifact collection."""
+        return getattr(self, "_final_gen", None)
 
     # -- main loop -----------------------------------------------------------
     def run(self) -> int:

@@ -3,188 +3,20 @@
 Reference: ``horovod.spark.run_elastic`` (``spark/runner.py:309-430``) —
 there, Spark tasks host task services the driver execs workers through,
 and the elastic driver treats the set of live task services as its host
-universe. Same architecture here, TPU-launcher-native: every Spark task
-runs a small HOST AGENT loop that registers itself in a driver-side KV
-(heartbeat), executes HMAC-signed worker commands the ElasticDriver
-routes to it, and reports exit codes. Executor loss → heartbeat expiry →
-the driver shrinks; Spark's task retry respawns the agent → the driver
-grows back. The data plane is the ordinary TCP core rendezvous the
-workers set up among themselves.
-
-Trust model: command docs are integrity-protected (HMAC over a secret
-shipped through Spark's own task-serialization channel, never the KV),
-and secrets — including the elastic world-doc key — stay off the wire;
-the KV itself, like the reference's rendezvous server and Spark's own
-block transfer service, assumes the cluster-private network. Do not
-expose the driver KV port outside that network.
+universe. Same architecture here over the shared agent transport
+(:mod:`horovod_tpu.runner.elastic.agent`): every Spark task runs the host
+agent loop; executor loss → heartbeat expiry → the driver shrinks;
+Spark's task retry respawns the agent → the driver grows back. The data
+plane is the ordinary TCP core rendezvous the workers set up among
+themselves.
 """
 
 from __future__ import annotations
 
-import hashlib
-import hmac
-import json
-import os
-import subprocess
-import sys
 import threading
-import time
-import uuid as uuidlib
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, List, Optional
 
-HEARTBEAT_S = 1.0
-STALE_S = 10.0
-
-
-def _sign(secret: bytes, body: bytes) -> str:
-    return hmac.new(secret, body, hashlib.sha256).hexdigest()
-
-
-# -- agent side (runs inside a Spark task) ----------------------------------
-
-def _agent_loop(ordinal: int, kv_addr: str, kv_port: int,
-                secret_hex: str, world_secret_hex: str = "") -> None:
-    """Register as a host agent and execute signed worker commands until
-    the driver posts shutdown (reference analog: the task service loop,
-    ``spark/driver/`` + ``runner/common/service/task_service.py``).
-
-    The world-doc secret arrives through Spark's own task-serialization
-    channel (this function's arguments), NOT over the KV — the agent
-    injects it into each worker's environment locally."""
-    import collections
-    import socket
-    from horovod_tpu.runner.http_kv import kv_get, kv_put
-
-    secret = bytes.fromhex(secret_hex)
-    host = socket.gethostname()
-    agent_id = f"{host}@{ordinal}"  # '@' is URL-path-safe; '#' would be
-    # stripped as a URI fragment by the HTTP KV client
-    seen = collections.OrderedDict()  # bounded processed-uuid memory
-    proc: Optional[subprocess.Popen] = None
-    cur_uuid: Optional[str] = None
-
-    def beat() -> None:
-        kv_put(kv_addr, kv_port, "agents", agent_id, json.dumps(
-            {"host": host, "ts": time.time()}).encode())
-
-    beat()
-    last_beat = time.time()
-    while True:
-        now = time.time()
-        if now - last_beat >= HEARTBEAT_S:
-            beat()
-            last_beat = now
-        if kv_get(kv_addr, kv_port, "ctl", "shutdown") is not None:
-            if proc is not None and proc.poll() is None:
-                proc.terminate()
-            return
-        if proc is not None:
-            if kv_get(kv_addr, kv_port, "kill", cur_uuid) is not None \
-                    and proc.poll() is None:
-                proc.terminate()
-            rc = proc.poll()
-            if rc is not None:
-                kv_put(kv_addr, kv_port, "rc", cur_uuid,
-                       str(rc).encode())
-                proc, cur_uuid = None, None
-        else:
-            doc = kv_get(kv_addr, kv_port, "cmd", agent_id)
-            if doc:
-                body, _, sig = doc.rpartition(b"|")
-                if sig and hmac.compare_digest(sig.decode(),
-                                               _sign(secret, body)):
-                    spec = json.loads(body)
-                    if spec["uuid"] not in seen:
-                        seen[spec["uuid"]] = True
-                        while len(seen) > 64:
-                            seen.popitem(last=False)
-                        cur_uuid = spec["uuid"]
-                        wenv = {**os.environ, **spec["env"]}
-                        if world_secret_hex:
-                            wenv["HVD_ELASTIC_SECRET"] = world_secret_hex
-                        proc = subprocess.Popen(spec["cmd"], env=wenv)
-        time.sleep(0.25)
-
-
-# -- driver side ------------------------------------------------------------
-
-class SparkAgentDiscovery:
-    """Host discovery over the agent registry: one slot per agent whose
-    heartbeat is fresh (reference analog: the driver's view of registered
-    task services)."""
-
-    def __init__(self, kv) -> None:
-        self._kv = kv
-
-    def agents_on(self, host: str) -> List[str]:
-        out = []
-        for agent_id, blob in sorted(self._kv.scope("agents").items()):
-            meta = json.loads(blob)
-            if meta["host"] == host and \
-                    time.time() - meta["ts"] < STALE_S:
-                out.append(agent_id)
-        return out
-
-    def find_available_hosts_and_slots(self) -> Dict[str, int]:
-        slots: Dict[str, int] = {}
-        for agent_id, blob in self._kv.scope("agents").items():
-            meta = json.loads(blob)
-            if time.time() - meta["ts"] < STALE_S:
-                slots[meta["host"]] = slots.get(meta["host"], 0) + 1
-        return slots
-
-
-_ENV_SHIP_PREFIXES = ("HOROVOD_", "HVD_", "PATH", "PYTHONPATH")
-
-
-def _make_agent_exec(kv, discovery: SparkAgentDiscovery, secret: bytes,
-                     user_env_keys=()):
-    """remote_exec for ElasticDriver: route (command, env) to the agent
-    occupying this slot and wait for its exit code.
-
-    Only launcher-owned env keys (and the caller's explicit ``env``
-    overrides) travel in the command doc — the agent merges them over ITS
-    executor environment, so driver-side credentials never cross the
-    network (the ssh launcher filters exports the same way,
-    ``exec_run.py slot_command``)."""
-
-    def _exec(slot, command: List[str], wenv: Dict[str, str],
-              events) -> int:
-        agents = discovery.agents_on(slot.hostname)
-        if len(agents) <= slot.local_rank:
-            # an agent's heartbeat went stale between assignment and
-            # launch; failing the slot restarts the generation cleanly
-            # rather than doubling two slots onto one agent
-            return 1
-        agent_id = agents[slot.local_rank]
-        uid = uuidlib.uuid4().hex
-        ship = {k: v for k, v in wenv.items()
-                if isinstance(v, str) and
-                (k.startswith(_ENV_SHIP_PREFIXES) or k in user_env_keys)}
-        body = json.dumps(
-            {"uuid": uid, "cmd": list(command), "env": ship}).encode()
-        kv.put("cmd", agent_id, body + b"|" + _sign(secret, body).encode())
-        killed = False
-        kill_deadline = None
-        while True:
-            rc = kv.get("rc", uid)
-            if rc is not None:
-                # retire the doc so the KV doesn't accumulate a full env
-                # copy per launch over a long elastic job
-                kv.put("cmd", agent_id, b"")
-                return int(rc)
-            if not killed and any(e.is_set() for e in events):
-                kv.put("kill", uid, b"1")
-                killed = True
-                kill_deadline = time.time() + 3 * STALE_S
-            # a dead agent never posts rc: give up once its heartbeat is
-            # stale (executor loss) or a kill went unacknowledged
-            if agent_id not in discovery.agents_on(slot.hostname) or \
-                    (kill_deadline and time.time() > kill_deadline):
-                return 1
-            time.sleep(0.1)
-
-    return _exec
+from horovod_tpu.runner.elastic.agent import run_agent_elastic
 
 
 def run_elastic(fn: Callable, args: tuple = (),
@@ -200,84 +32,36 @@ def run_elastic(fn: Callable, args: tuple = (),
     ``hvd.elastic`` API internally, reference-style — on Spark tasks that
     may come and go, returning per-rank results of the generation that
     completed."""
-    import cloudpickle
     from horovod_tpu.spark import _require_pyspark
-    from horovod_tpu.runner.http_kv import KVStoreServer
-    from horovod_tpu.runner.elastic.driver import ElasticDriver
 
     _require_pyspark()
     from pyspark.sql import SparkSession
 
-    kwargs = kwargs or {}
     spark = SparkSession.builder.getOrCreate()
     sc = spark.sparkContext
     num_proc = num_proc or int(sc.defaultParallelism)
-    min_np = min_np or num_proc
-    max_np = max_np or num_proc
 
-    kv = KVStoreServer()
-    kv.start()
-    import secrets as _secrets
-    import socket as _socket
-    secret = _secrets.token_bytes(16)
-    world_secret = _secrets.token_bytes(16)
-    kv.put("payload", "fn", cloudpickle.dumps((fn, args, kwargs)))
-    # advertise the hostname, not getfqdn(): agents on other hosts resolve
-    # it via cluster DNS (the reference's task-address model) and same-host
-    # agents shortcut to loopback; getfqdn() can be 'localhost', which
-    # resolves to ::1 while the KV server is IPv4-only
-    kv_addr = _socket.gethostname()
-    kv_port, secret_hex = kv.port, secret.hex()
-    world_secret_hex = world_secret.hex()
+    def start_agents(ctx) -> Callable[[], None]:
+        kv_addr, kv_port = ctx["kv_addr"], ctx["kv_port"]
+        secret_hex = ctx["secret_hex"]
+        world_secret_hex = ctx["world_secret_hex"]
+        n = ctx["max_np"]
 
-    def spark_job():
-        def task(it):
-            import socket
-            from horovod_tpu.spark.elastic import _agent_loop
-            for ordinal in it:
-                addr = kv_addr
-                # same-box fast path (and the fake-cluster tests)
-                if socket.gethostname() == addr.split(".")[0]:
-                    addr = "127.0.0.1"
-                _agent_loop(int(ordinal), addr, kv_port, secret_hex,
-                            world_secret_hex)
-            return iter([(0, b"")])
+        def spark_job():
+            def task(it):
+                from horovod_tpu.runner.elastic.agent import (
+                    agent_loop, resolve_kv_addr)
+                for ordinal in it:
+                    agent_loop(int(ordinal), resolve_kv_addr(kv_addr),
+                               kv_port, secret_hex, world_secret_hex)
+                return iter([(0, b"")])
 
-        sc.parallelize(range(max_np), max_np).mapPartitions(task).collect()
+            sc.parallelize(range(n), n).mapPartitions(task).collect()
 
-    job = threading.Thread(target=spark_job, daemon=True)
-    job.start()
+        job = threading.Thread(target=spark_job, daemon=True)
+        job.start()
+        return lambda: job.join(timeout=30)
 
-    discovery = SparkAgentDiscovery(kv)
-    worker_env = dict(os.environ)
-    worker_env.update(env or {})
-    worker_env["HVD_SPARK_KV"] = f"{kv_addr}:{kv_port}"
-    driver = ElasticDriver(
-        discovery,
-        [sys.executable, "-u", "-m", "horovod_tpu.spark.elastic_worker"],
-        min_np=min_np, max_np=max_np, env=worker_env,
-        reset_limit=reset_limit, verbose=bool(verbose),
-        target_np=num_proc, world_secret=world_secret,
-        remote_exec=_make_agent_exec(kv, discovery, secret,
-                                     user_env_keys=tuple(env or ())))
-    try:
-        rc = driver.run()
-        if rc != 0:
-            raise RuntimeError(
-                f"elastic Spark job failed (driver rc={rc})")
-        # only the generation that completed counts: a rank that finished
-        # inside an ABORTED world may have published a result too
-        final_np = driver.final_np or 0
-        results: Dict[int, Any] = {}
-        for key, blob in kv.scope("result").items():
-            if int(key) < final_np:
-                results[int(key)] = cloudpickle.loads(blob)
-        if sorted(results) != list(range(final_np)):
-            raise RuntimeError(
-                f"elastic Spark job succeeded but results are missing: "
-                f"have ranks {sorted(results)}, expected 0..{final_np - 1}")
-        return [results[r] for r in range(final_np)]
-    finally:
-        kv.put("ctl", "shutdown", b"1")
-        job.join(timeout=30)
-        kv.stop()
+    return run_agent_elastic(
+        start_agents, fn, args, kwargs, num_proc=num_proc, min_np=min_np,
+        max_np=max_np, env=env, reset_limit=reset_limit, verbose=verbose)

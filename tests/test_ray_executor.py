@@ -99,3 +99,33 @@ def test_ray_executor_requires_ray():
     from horovod_tpu.ray import RayExecutor
     with pytest.raises(ImportError, match="ray"):
         RayExecutor(num_workers=1)
+
+
+@needs_core
+def test_elastic_ray_executor_fn_recovers_from_crash(fake_ray, tmp_path):
+    """ElasticRayExecutor.run(fn): Ray actors host the agent transport,
+    a rank-1 crash in generation 0 triggers a generation restart on the
+    same actors, and the retry completes (reference:
+    ``ElasticRayExecutor``, ``ray/elastic.py:149+``)."""
+    from horovod_tpu.ray import ElasticRayExecutor
+
+    marker = str(tmp_path / "crashed_once")
+
+    def train():
+        import os
+        import numpy as np
+        import horovod_tpu as hvd
+
+        hvd.init()
+        if hvd.rank() == 1 and not os.path.exists(marker):
+            open(marker, "w").close()
+            os._exit(17)
+        out = hvd.allreduce(np.ones(2) * (hvd.rank() + 1), op=hvd.Sum,
+                            name="rayel")
+        hvd.shutdown()
+        return float(np.asarray(out)[0])
+
+    ex = ElasticRayExecutor(min_np=2, max_np=2)
+    results = ex.run(train)
+    assert os.path.exists(marker)
+    assert results == [3.0, 3.0]
