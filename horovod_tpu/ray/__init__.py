@@ -190,12 +190,11 @@ class ElasticRayExecutor:
                 return True
 
         def start_agents(ctx):
+            import json as _json
             import threading
-            from horovod_tpu.runner.elastic.agent import (
-                STALE_S, resolve_kv_addr)
-            from horovod_tpu.runner.http_kv import kv_get, kv_scope_keys
+            from horovod_tpu.runner.elastic.agent import STALE_S
 
-            addr = resolve_kv_addr(ctx["kv_addr"])
+            kv = ctx["kv"]  # in-process server handle (driver side)
             port = ctx["kv_port"]
             actors = []
             stop = threading.Event()
@@ -213,30 +212,28 @@ class ElasticRayExecutor:
                 spawn()
 
             def fresh_agent_count():
-                import json as _json
-                import time as _t
                 n = 0
-                for key in kv_scope_keys(addr, port, "agents"):
-                    blob = kv_get(addr, port, "agents", key)
-                    if blob and _t.time() - _json.loads(blob)["ts"] \
-                            < STALE_S:
+                for blob in kv.scope("agents").values():
+                    if _time.time() - _json.loads(blob)["ts"] < STALE_S:
                         n += 1
                 return n
 
             def respawner():
                 # Ray actors are not auto-restarted (unlike Spark task
                 # retry): top the registry back up to max_np when actor
-                # loss shrinks it, so the driver can grow back
+                # loss shrinks it, so the driver can grow back. Bounded:
+                # a replacement that never registers (no capacity, node
+                # permanently gone) must not turn into an unbounded
+                # stream of pending actors
+                budget = 4 * ctx["max_np"]
                 misses = 0
                 while not stop.wait(5.0):
-                    try:
-                        misses = misses + 1 \
-                            if fresh_agent_count() < ctx["max_np"] else 0
-                    except OSError:
-                        continue  # KV briefly unreachable; retry
-                    if misses >= 2:
+                    misses = misses + 1 \
+                        if fresh_agent_count() < ctx["max_np"] else 0
+                    if misses >= 2 and budget > 0:
                         spawn()
-                        misses = 0
+                        budget -= 1
+                        misses = -4  # cooldown: let the replacement land
 
             mon = threading.Thread(target=respawner, daemon=True)
             mon.start()

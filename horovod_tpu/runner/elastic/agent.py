@@ -234,7 +234,10 @@ def run_agent_elastic(start_agents: Callable[[dict], Callable[[], None]],
         # 'localhost', which resolves to ::1 while the KV server is
         # IPv4-only
         kv_addr = _socket.gethostname()
-        ctx = {"kv_addr": kv_addr, "kv_port": kv.port,
+        # ctx["kv"] is the IN-PROCESS server handle for driver-side
+        # helpers (e.g. the Ray respawner); framework closures must
+        # capture the scalar entries, never ctx itself
+        ctx = {"kv_addr": kv_addr, "kv_port": kv.port, "kv": kv,
                "secret_hex": secret.hex(),
                "world_secret_hex": world_secret.hex(), "max_np": max_np}
         cleanup = start_agents(ctx)
@@ -256,16 +259,23 @@ def run_agent_elastic(start_agents: Callable[[dict], Callable[[], None]],
         if rc != 0:
             raise RuntimeError(
                 f"elastic agent job failed (driver rc={rc})")
-        # results are generation-scoped: only the completed generation's
-        # publishes count — a late write from an ABORTED world must not
-        # be mistaken for (or overwrite) them
+        # results are generation-scoped. Aborted generations are strictly
+        # OLDER than the successful launch generation, while in-place
+        # growth resyncs move a surviving worker's generation FORWARD
+        # (elastic/__init__.py _apply_world_update) — so the completed
+        # world's publishes are exactly those at gen >= final_generation;
+        # per rank, the newest wins
         final_np = driver.final_np or 0
-        prefix = f"{driver.final_generation}."
+        final_gen = driver.final_generation or 0
         results: Dict[int, Any] = {}
+        best_gen: Dict[int, int] = {}
         for key, blob in kv.scope("result").items():
-            if key.startswith(prefix) and \
-                    int(key[len(prefix):]) < final_np:
-                results[int(key[len(prefix):])] = cloudpickle.loads(blob)
+            g_str, _, r_str = key.partition(".")
+            g, r = int(g_str), int(r_str)
+            if g >= final_gen and r < final_np and \
+                    g >= best_gen.get(r, final_gen):
+                best_gen[r] = g
+                results[r] = cloudpickle.loads(blob)
         if sorted(results) != list(range(final_np)):
             raise RuntimeError(
                 f"elastic agent job succeeded but results are missing: "

@@ -32,15 +32,8 @@ class _KVHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         scope, key = self._split()
-        if key == "":
-            # scope listing: newline-joined key names (agent respawners
-            # watch registry scopes remotely)
-            with self.server.kv_lock:
-                names = sorted(self.server.kv.get(scope, {}))
-            val = "\n".join(names).encode()
-        else:
-            with self.server.kv_lock:
-                val = self.server.kv.get(scope, {}).get(key)
+        with self.server.kv_lock:
+            val = self.server.kv.get(scope, {}).get(key)
         if val is None:
             self.send_response(404)
             self.end_headers()
@@ -153,10 +146,3 @@ def kv_get(addr: str, port: int, scope: str, key: str,
         raise
 
 
-def kv_scope_keys(addr: str, port: int, scope: str,
-                  timeout: float = 30.0) -> list:
-    """Remote scope listing (the server-side analog is ``scope()``)."""
-    out = kv_get(addr, port, scope, "", timeout=timeout)
-    if not out:
-        return []
-    return out.decode().split("\n")
