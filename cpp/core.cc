@@ -328,7 +328,17 @@ void Core::PushToDomain(int domain, TensorTableEntry e, Request r) {
       cb(Status::Error("duplicate tensor name submitted before previous "
                        "operation on '" + name + "' completed (reference: "
                        "DUPLICATE_NAME error)"));
+    return;
   }
+  KickCycle();
+}
+
+void Core::KickCycle() {
+  {
+    std::lock_guard<std::mutex> lk(cycle_mu_);
+    cycle_kick_ = true;
+  }
+  cycle_cv_.notify_one();
 }
 
 Status Core::Init(const CoreConfig& cfg) {
@@ -399,6 +409,7 @@ void Core::Shutdown() {
   if (!initialized_) return;
   HVD_LOG(Info) << "core shutdown requested";
   shutdown_requested_ = true;
+  KickCycle();  // cast the shutdown vote without waiting out a cycle
   // Prefer the negotiated shutdown (all ranks vote, coordinator emits a
   // SHUTDOWN response — reference: operations.cc:994-1005); if a peer died
   // mid-collective the loop may be blocked in Recv, so force-close the
@@ -724,8 +735,14 @@ void Core::Loop() {
     pthread_setaffinity_np(pthread_self(), sizeof(cpus), &cpus);
   }
   while (RunOnce()) {
-    std::this_thread::sleep_for(
-        std::chrono::duration<double, std::milli>(cfg_.cycle_time_ms));
+    // idle-poll at the (autotunable) cycle time, but wake immediately on
+    // a fresh enqueue — a lone eager op should pay the negotiation RTT,
+    // not the poll latency
+    std::unique_lock<std::mutex> lk(cycle_mu_);
+    cycle_cv_.wait_for(
+        lk, std::chrono::duration<double, std::milli>(cfg_.cycle_time_ms),
+        [this] { return cycle_kick_; });
+    cycle_kick_ = false;
   }
   loop_done_ = true;
   // Abnormal exits (peer death mid-collective) leave waiters pending —

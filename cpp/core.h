@@ -12,7 +12,9 @@
 
 #include <atomic>
 #include <chrono>
+#include <condition_variable>
 #include <deque>
+#include <mutex>
 #include <list>
 #include <map>
 #include <memory>
@@ -313,6 +315,14 @@ class Core {
   std::atomic<bool> initialized_{false};
   std::atomic<bool> shutdown_requested_{false};
   std::atomic<bool> loop_done_{false};
+  // wake-on-enqueue: the loop sleeps cycle_time_ms between lockstep
+  // rounds, but a freshly enqueued collective (or shutdown vote) kicks it
+  // awake so single eager ops don't pay the idle-poll latency. SPMD ranks
+  // enqueue together, so all enter the next round together.
+  std::mutex cycle_mu_;
+  std::condition_variable cycle_cv_;
+  bool cycle_kick_ = false;
+  void KickCycle();
   std::unique_ptr<Transport> transport_;
   std::thread loop_;
   std::unique_ptr<Timeline> timeline_;

@@ -20,16 +20,24 @@ def main():
         raise SystemExit(f"rank {rank}: mismatch did NOT error")
     except RuntimeError as e:
         assert "mismatched" in str(e), e
-    # 2) duplicate name while in flight → immediate DUPLICATE error;
-    #    serialize ranks so the negotiation can't complete the first one
-    h1 = be.allreduce_async("dup", np.ones(4, np.float32), ReduceOp.SUM)
+    # 2) duplicate name while in flight → immediate DUPLICATE error.
+    #    Each rank first submits a name the PEER has not submitted yet, so
+    #    the first op provably cannot complete before the duplicate lands
+    #    (the wake-on-enqueue loop finishes same-name pairs in ~100 µs,
+    #    which made a shared name racy)
+    mine, theirs = f"dup.{rank}", f"dup.{1 - rank}"
+    h1 = be.allreduce_async(mine, np.ones(4, np.float32), ReduceOp.SUM)
     try:
-        be.allreduce_async("dup", np.ones(4, np.float32), ReduceOp.SUM).wait(5)
+        be.allreduce_async(mine, np.ones(4, np.float32), ReduceOp.SUM).wait(5)
         raise SystemExit(f"rank {rank}: duplicate did NOT error")
     except RuntimeError as e:
         assert "duplicate" in str(e).lower(), e
-    out = h1.wait(30)
-    np.testing.assert_allclose(out, 2.0)
+    # barrier BEFORE anyone submits the peer's name: both duplicate checks
+    # have now run while their firsts were provably still in flight
+    be.barrier()
+    h2 = be.allreduce_async(theirs, np.ones(4, np.float32), ReduceOp.SUM)
+    np.testing.assert_allclose(h1.wait(30), 2.0)
+    np.testing.assert_allclose(h2.wait(30), 2.0)
     # 3) grouped allreduce with one mismatched member: the whole group
     #    errors (poisoned-group path), no handle hangs
     bad = np.ones(7 if rank == 0 else 9, np.float32)
