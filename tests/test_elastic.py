@@ -349,3 +349,101 @@ def test_elastic_growth_does_not_restart_survivors(tmp_path):
     assert len(boots) == 3, boots
     booted_ranks = sorted(line.split()[1] for line in boots)
     assert booted_ranks == ["rank=0", "rank=1", "rank=2"]
+
+
+def _growth_agent_main(ordinal, kv_port, secret_hex, world_secret_hex):
+    """multiprocessing target for the growth test: module-level with
+    scalar args so it pickles under any mp start method (agent.py's ctx
+    must never be captured by framework closures)."""
+    from horovod_tpu.runner.elastic.agent import agent_loop
+    agent_loop(ordinal, "127.0.0.1", kv_port, secret_hex,
+               world_secret_hex)
+
+
+@needs_core
+def test_agent_elastic_growth_resync_collects_results(tmp_path):
+    """Agent-transport elastic (the Spark/Ray substrate) with IN-PLACE
+    growth: the second host agent appears only after generation 0 has
+    launched at np=1, the driver grows the running generation, the
+    surviving rank resyncs at commit (its HVD_ELASTIC_GENERATION moves
+    forward), and run_agent_elastic still collects its result — the
+    growth-resync scenario of the r4 review."""
+    import multiprocessing
+    import threading
+
+    from horovod_tpu.runner.elastic.agent import run_agent_elastic
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def train():
+        import time
+
+        import numpy as np
+
+        import horovod_tpu as hvd
+        import horovod_tpu.elastic as elastic
+
+        hvd.init()
+        state = elastic.ObjectState(name="agent_growth", tick=0)
+
+        @elastic.run
+        def wait_for_two(state):
+            # a long "step" that only ends once growth has landed; the
+            # commit both snapshots and polls the world doc
+            deadline = time.time() + 60
+            while hvd.size() < 2:
+                if time.time() > deadline:
+                    raise RuntimeError("growth never arrived")
+                time.sleep(0.3)
+                state.tick += 1
+                state.commit()
+
+        wait_for_two(state)
+        out = hvd.allreduce(np.ones(1, np.float32), op=hvd.Sum, name="gr")
+        val = float(np.asarray(out)[0])
+        hvd.shutdown()
+        return val
+
+    def start_agents(ctx):
+        procs = []
+        args = (ctx["kv_port"], ctx["secret_hex"],
+                ctx["world_secret_hex"])
+        kv = ctx["kv"]
+
+        def launch(ordinal):
+            p = multiprocessing.Process(
+                target=_growth_agent_main, args=(ordinal,) + args,
+                daemon=True)
+            p.start()
+            procs.append(p)
+
+        launch(0)
+
+        def late_joiner():
+            # deterministic growth: the second "host" appears only once
+            # generation 0 has provably launched (its worker command doc
+            # reached agent 0 through the KV)
+            deadline = time.time() + 60
+            while not kv.scope("cmd") and time.time() < deadline:
+                time.sleep(0.1)
+            launch(1)
+
+        joiner = threading.Thread(target=late_joiner, daemon=True)
+        joiner.start()
+
+        def cleanup():
+            joiner.join(timeout=70)
+            for p in procs:
+                p.join(timeout=15)
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+
+        return cleanup
+
+    results = run_agent_elastic(
+        start_agents, train, num_proc=2, min_np=1, max_np=2,
+        env={"PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"})
+    # the essential (launch-generation) world was np=1: one result,
+    # computed AFTER growth at world size 2
+    assert results == [2.0], results
