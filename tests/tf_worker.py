@@ -124,6 +124,26 @@ def main():
     assert not isinstance(sg, (list, tuple))
     np.testing.assert_allclose(sg.numpy(), expect, rtol=1e-5)
 
+    # keras model.fit at size 2: the wrapped optimizer's graph-mode sync
+    # (keras compiles train_step into a tf.function) plus the broadcast
+    # callback must leave every rank with IDENTICAL weights
+    import horovod_tpu.keras as khvd
+    tf.random.set_seed(rank)  # deliberately different init per rank
+    model = tf.keras.Sequential([tf.keras.layers.Dense(1, input_shape=(4,))])
+    model.compile(
+        optimizer=khvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.05)),
+        loss="mse")
+    rng = np.random.RandomState(0)
+    fx = rng.randn(32, 4).astype(np.float32)
+    fy = (fx @ np.asarray([[1.0], [2.0], [3.0], [4.0]], np.float32))
+    mine = slice(rank * 16, (rank + 1) * 16)
+    model.fit(fx[mine], fy[mine], epochs=2, batch_size=8, verbose=0,
+              callbacks=[khvd.callbacks.BroadcastGlobalVariablesCallback(0)])
+    final = np.concatenate([w.reshape(-1) for w in model.get_weights()])
+    gathered = hvd.allgather(tf.constant(final[None, :]))
+    np.testing.assert_allclose(np.asarray(gathered)[0],
+                               np.asarray(gathered)[1], rtol=1e-6)
+
     hvd.shutdown()
     print("tf_worker ok")
 
