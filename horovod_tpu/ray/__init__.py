@@ -37,8 +37,14 @@ class RayExecutor:
         self.cpus_per_worker = cpus_per_worker
         self._env = dict(env or {})
         self._workers: List[Any] = []
+        self._has_executable = False
 
-    def start(self) -> None:
+    def start(self, executable_cls=None, executable_args=None,
+              executable_kwargs=None) -> None:
+        """Spin up the worker actors and wire the coordinator; with
+        ``executable_cls``, also instantiate it on every worker for
+        ``execute``/``execute_single`` (reference: ``RayExecutor.start``,
+        ``ray/runner.py:250-280``)."""
         ray = self._ray
 
         @ray.remote(num_cpus=self.cpus_per_worker)
@@ -74,8 +80,23 @@ class RayExecutor:
                 fn, args, kwargs = cloudpickle.loads(fn_blob)
                 import horovod_tpu as hvd
                 hvd.init()
-                out = fn(*args, **kwargs)
-                return cloudpickle.dumps(out)
+                # return the VALUE (ray serializes it): run_remote futures
+                # must resolve to results, reference-style
+                return fn(*args, **kwargs)
+
+            def make_executable(self, blob: bytes) -> None:
+                # reference: start(executable_cls=...) instantiates the
+                # user's class on every worker (ray/runner.py:250-280)
+                import cloudpickle
+                cls, a, k = cloudpickle.loads(blob)
+                import horovod_tpu as hvd
+                hvd.init()
+                self.executable = cls(*a, **k)
+
+            def execute_obj(self, fn_blob: bytes):
+                import cloudpickle
+                fn = cloudpickle.loads(fn_blob)
+                return fn(self.executable)
 
             def shutdown(self) -> None:
                 import horovod_tpu as hvd
@@ -92,14 +113,53 @@ class RayExecutor:
         port = ray.get(self._workers[0].pick_free_port.remote())
         ray.get([w.set_coordinator.remote(coord_host, port)
                  for w in self._workers])
+        if executable_cls is not None:
+            import cloudpickle
+            blob = cloudpickle.dumps((executable_cls,
+                                      tuple(executable_args or ()),
+                                      dict(executable_kwargs or {})))
+            ray.get([w.make_executable.remote(blob)
+                     for w in self._workers])
+            self._has_executable = True
+
+    def _require_started(self, need_executable: bool = False) -> None:
+        if not self._workers:
+            raise ValueError("RayExecutor: call start() first")
+        if need_executable and not self._has_executable:
+            raise ValueError(
+                "RayExecutor: call start(executable_cls=...) first")
+
+    def run_remote(self, fn: Callable, args: tuple = (),
+                   kwargs: Optional[dict] = None) -> List[Any]:
+        """Async variant (reference: ``run_remote``, ``ray/runner.py:312``):
+        one future per worker; ``ray.get`` resolves them to the fns'
+        return values."""
+        import cloudpickle
+        self._require_started()
+        blob = cloudpickle.dumps((fn, args, kwargs or {}))
+        return [w.execute.remote(blob) for w in self._workers]
 
     def run(self, fn: Callable, args: tuple = (),
             kwargs: Optional[dict] = None) -> List[Any]:
+        return self._ray.get(self.run_remote(fn, args, kwargs))
+
+    def execute(self, fn: Callable) -> List[Any]:
+        """Apply ``fn(executable)`` on every worker (reference:
+        ``RayExecutor.execute``, ``ray/runner.py:281``); requires
+        ``start(executable_cls=...)``."""
         import cloudpickle
-        ray = self._ray
-        blob = cloudpickle.dumps((fn, args, kwargs or {}))
-        outs = ray.get([w.execute.remote(blob) for w in self._workers])
-        return [cloudpickle.loads(o) for o in outs]
+        self._require_started(need_executable=True)
+        blob = cloudpickle.dumps(fn)
+        return self._ray.get([w.execute_obj.remote(blob)
+                              for w in self._workers])
+
+    def execute_single(self, fn: Callable) -> Any:
+        """Apply ``fn(executable)`` on the rank-0 worker only (reference:
+        ``execute_single``, ``ray/runner.py:332``)."""
+        import cloudpickle
+        self._require_started(need_executable=True)
+        blob = cloudpickle.dumps(fn)
+        return self._ray.get(self._workers[0].execute_obj.remote(blob))
 
     def shutdown(self) -> None:
         ray = self._ray
@@ -158,6 +218,11 @@ class ElasticRayExecutor:
         self._max_np = max_np
         self._env = env
         self._reset_limit = reset_limit
+
+    def start(self) -> None:
+        """Reference API shape (``ElasticRayExecutor.start``): agents are
+        created lazily by ``run(fn)``, so this only validates ray."""
+        _require_ray()
 
     def run(self, fn: Optional[Callable] = None, args: tuple = (),
             kwargs: Optional[dict] = None):
