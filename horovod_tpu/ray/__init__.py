@@ -46,6 +46,7 @@ class RayExecutor:
         ``execute``/``execute_single`` (reference: ``RayExecutor.start``,
         ``ray/runner.py:250-280``)."""
         ray = self._ray
+        self._has_executable = False  # a restart may drop the executable
 
         @ray.remote(num_cpus=self.cpus_per_worker)
         class _Worker:

@@ -161,7 +161,8 @@ def launch_static(hosts: List[HostInfo], np: int, command: List[str],
                   coord_port: Optional[int] = None,
                   nics: Optional[List[str]] = None,
                   nic_probe: bool = True,
-                  verbose: bool = False) -> int:
+                  verbose: bool = False,
+                  output_dir: Optional[str] = None) -> int:
     """Run ``command`` on every slot; return first nonzero exit code (or 0).
 
     Reference: ``launch_gloo`` (``gloo_run.py:226``): assignment → env →
@@ -187,14 +188,32 @@ def launch_static(hosts: List[HostInfo], np: int, command: List[str],
     failure = threading.Event()
 
     def run_slot(idx: int, slot: SlotInfo) -> None:
-        cmd, run_env = slot_command(slot, command, coord_addr, coord_port,
-                                    env)
-        prefix = f"[{slot.rank}]<stdout/err> " if verbose else ""
-        rc = safe_execute(cmd, env=run_env, prefix=prefix,
-                          events=[failure])
-        results[idx] = rc
-        if rc != 0:
-            failure.set()
+        rc = 1  # anything that dies before safe_execute is a failure
+        out_f = err_f = None
+        try:
+            cmd, run_env = slot_command(slot, command, coord_addr,
+                                        coord_port, env)
+            prefix = f"[{slot.rank}]<stdout/err> " if verbose else ""
+            if output_dir:
+                # reference --output-filename layout: <dir>/rank.N/
+                # {stdout,stderr} per worker
+                d = os.path.join(output_dir, f"rank.{slot.rank}")
+                os.makedirs(d, exist_ok=True)
+                out_f = open(os.path.join(d, "stdout"), "w", buffering=1)
+                err_f = open(os.path.join(d, "stderr"), "w", buffering=1)
+            rc = safe_execute(cmd, env=run_env, prefix=prefix,
+                              stdout=out_f, stderr=err_f,
+                              events=[failure])
+        except Exception as e:
+            print(f"[hvdrun] rank {slot.rank} failed to launch: {e}",
+                  file=sys.stderr, flush=True)
+        finally:
+            for f in (out_f, err_f):
+                if f:
+                    f.close()
+            results[idx] = rc
+            if rc != 0:
+                failure.set()
 
     threads = [threading.Thread(target=run_slot, args=(i, s), daemon=True)
                for i, s in enumerate(slots)]

@@ -85,6 +85,10 @@ def parse_args(argv: List[str]) -> argparse.Namespace:
                         "launches")
     p.add_argument("--thread-affinity", type=int, default=None,
                    help="pin the core background thread to this CPU")
+    p.add_argument("--output-filename", default=None,
+                   help="directory collecting per-worker output as "
+                        "<dir>/rank.N/{stdout,stderr} (reference: "
+                        "horovodrun --output-filename)")
     p.add_argument("--log-level", default=None,
                    choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
                             "FATAL"])
@@ -230,7 +234,8 @@ def run_commandline(argv: List[str] = None) -> int:
         if args.nics else None
     return launch_static(hosts, np, args.command, env=env,
                          nics=nics, nic_probe=not args.no_nic_probe,
-                         verbose=args.verbose)
+                         verbose=args.verbose,
+                         output_dir=args.output_filename)
 
 
 def main() -> None:

@@ -215,3 +215,27 @@ def test_interactive_run():
                         timeout=120, cwd=REPO)
     assert rc.returncode == 0, rc.stdout.decode() + rc.stderr.decode()
     assert "[7, 17]" in rc.stdout.decode()
+
+
+@needs_core
+def test_hvdrun_output_filename(tmp_path):
+    """--output-filename collects per-worker output under
+    <dir>/rank.N/{stdout,stderr} (reference: horovodrun
+    --output-filename)."""
+    prog = tmp_path / "worker.py"
+    prog.write_text(
+        "import os, sys\n"
+        "print('hello from rank', os.environ['HOROVOD_RANK'])\n"
+        "print('warn', os.environ['HOROVOD_RANK'], file=sys.stderr)\n")
+    out_dir = tmp_path / "logs"
+    rc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         "--output-filename", str(out_dir),
+         sys.executable, str(prog)],
+        cwd=REPO, capture_output=True, timeout=120)
+    assert rc.returncode == 0, rc.stdout.decode() + rc.stderr.decode()
+    for r in (0, 1):
+        out = (out_dir / f"rank.{r}" / "stdout").read_text()
+        assert f"hello from rank {r}" in out, out
+        err = (out_dir / f"rank.{r}" / "stderr").read_text()
+        assert f"warn {r}" in err, err
