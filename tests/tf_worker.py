@@ -124,6 +124,21 @@ def main():
     assert not isinstance(sg, (list, tuple))
     np.testing.assert_allclose(sg.numpy(), expect, rtol=1e-5)
 
+    # fp16 compression through the traced optimizer path: compressed
+    # wire dtype, original dtype after decompress, ranks agree
+    wc = tf.Variable(np.ones((3,), np.float32))
+    copt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(1.0), compression=hvd.Compression.fp16)
+
+    @tf.function
+    def cstep(g):
+        copt.apply_gradients([(g, wc)])
+
+    cstep(tf.constant(np.full(3, float(rank + 1), np.float32)))
+    np.testing.assert_allclose(wc.numpy(),
+                               1.0 - (sum(range(size)) + size) / size,
+                               rtol=1e-3)
+
     # keras model.fit at size 2: the wrapped optimizer's graph-mode sync
     # (keras compiles train_step into a tf.function) plus the broadcast
     # callback must leave every rank with IDENTICAL weights
