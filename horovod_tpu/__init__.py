@@ -45,7 +45,10 @@ from horovod_tpu.common.basics import (  # noqa: F401
     ccl_built,
     cuda_built,
     rocm_built,
+    ddl_built,
+    sycl_built,
     mpi_enabled,
+    gloo_enabled,
     mpi_threads_supported,
 )
 

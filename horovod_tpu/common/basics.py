@@ -311,8 +311,20 @@ def rocm_built() -> bool:
     return False
 
 
+def ddl_built() -> bool:
+    return False
+
+
+def sycl_built() -> bool:
+    return False
+
+
 def mpi_enabled() -> bool:
     return False
+
+
+def gloo_enabled() -> bool:  # the TCP core is the Gloo-role plane
+    return tcp_core_built()
 
 
 def mpi_threads_supported() -> bool:

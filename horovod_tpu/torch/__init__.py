@@ -19,6 +19,7 @@ from horovod_tpu.common.basics import (  # noqa: F401
     init, shutdown, is_initialized, rank, size, local_rank, local_size,
     cross_rank, cross_size, is_homogeneous, mpi_threads_supported,
     mpi_built, gloo_built, nccl_built, ccl_built, cuda_built, rocm_built,
+    ddl_built, sycl_built, mpi_enabled, gloo_enabled,
     start_timeline, stop_timeline)
 from horovod_tpu.common.process_sets import (  # noqa: F401
     ProcessSet, add_process_set, remove_process_set, global_process_set)

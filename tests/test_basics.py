@@ -25,6 +25,11 @@ def test_built_queries(hvd):
     assert not hvd.mpi_built()
     assert not hvd.nccl_built()
     assert not hvd.cuda_built()
+    assert not hvd.ddl_built()
+    assert not hvd.sycl_built()
+    assert not hvd.mpi_enabled()
+    # the TCP core stands in for gloo; enabled tracks the built .so
+    assert hvd.gloo_enabled() == hvd.gloo_built()
 
 
 def test_num_devices(hvd):
