@@ -1,0 +1,106 @@
+"""Unit tests for the agent-transport exec edge cases
+(runner/elastic/agent.py): the paths that prevent a dead executor from
+hanging a generation, which the end-to-end Spark/Ray tests only reach
+when something actually dies."""
+
+import json
+import threading
+import time
+
+from horovod_tpu.runner.elastic.agent import (AgentRegistryDiscovery,
+                                              make_agent_exec,
+                                              resolve_kv_addr)
+
+
+class FakeKV:
+    def __init__(self):
+        self._d = {}
+
+    def put(self, scope, key, value):
+        self._d.setdefault(scope, {})[key] = value
+
+    def get(self, scope, key):
+        return self._d.get(scope, {}).get(key)
+
+    def scope(self, scope):
+        return dict(self._d.get(scope, {}))
+
+
+class Slot:
+    def __init__(self, hostname="h1", local_rank=0, rank=0):
+        self.hostname = hostname
+        self.local_rank = local_rank
+        self.rank = rank
+
+
+def _register(kv, agent_id, host, ts=None):
+    kv.put("agents", agent_id, json.dumps(
+        {"host": host, "ts": ts if ts is not None else time.time()}
+    ).encode())
+
+
+def test_exec_fails_fast_when_no_agent_for_slot():
+    kv = FakeKV()
+    disc = AgentRegistryDiscovery(kv)
+    _exec = make_agent_exec(kv, disc, b"s" * 16)
+    # no agents at all, and fewer agents than the slot's local_rank
+    assert _exec(Slot(), ["cmd"], {}, []) == 1
+    _register(kv, "h1@0", "h1")
+    assert _exec(Slot(local_rank=1), ["cmd"], {}, []) == 1
+
+
+def test_exec_gives_up_and_retires_cmd_when_agent_dies():
+    """A dead agent never posts rc: once its heartbeat goes stale the
+    exec returns failure AND blanks the command doc, so a respawned
+    same-id agent cannot execute the dead generation's command."""
+    kv = FakeKV()
+    disc = AgentRegistryDiscovery(kv)
+    _exec = make_agent_exec(kv, disc, b"s" * 16)
+    _register(kv, "h1@0", "h1")
+    rc = [None]
+
+    def run():
+        rc[0] = _exec(Slot(), ["worker"], {"HOROVOD_RANK": "0"}, [])
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    assert t.is_alive()  # waiting on rc while the agent looks healthy
+    assert kv.get("cmd", "h1@0")  # the signed doc was posted
+    # the "executor dies": heartbeat goes stale
+    _register(kv, "h1@0", "h1", ts=time.time() - 1e6)
+    t.join(timeout=10)
+    assert not t.is_alive() and rc[0] == 1
+    assert kv.get("cmd", "h1@0") == b""  # retired, not replayable
+
+
+def test_exec_kill_deadline_bounds_teardown_wait():
+    """After a teardown kill, an agent that never acks is abandoned at
+    the kill deadline instead of blocking the generation restart."""
+    kv = FakeKV()
+    disc = AgentRegistryDiscovery(kv)
+    _exec = make_agent_exec(kv, disc, b"s" * 16)
+    _register(kv, "h1@0", "h1")
+    stopper = threading.Event()
+    keepalive = threading.Thread(
+        target=lambda: [(_register(kv, "h1@0", "h1"), time.sleep(0.5))
+                        for _ in iter(lambda: not stopper.is_set(), False)],
+        daemon=True)
+    keepalive.start()
+    ev = threading.Event()
+    ev.set()  # failure already signalled -> kill path immediately
+    try:
+        start = time.time()
+        rc = _exec(Slot(), ["worker"], {}, [ev])
+        took = time.time() - start
+    finally:
+        stopper.set()
+    assert rc == 1
+    assert kv.scope("kill")  # the kill was posted (under the op's uuid)
+    assert took < 60  # bounded by 3 * STALE_S, not forever
+
+
+def test_resolve_kv_addr_loopback():
+    import socket
+    assert resolve_kv_addr(socket.gethostname()) == "127.0.0.1"
+    assert resolve_kv_addr("elsewhere.example") == "elsewhere.example"
