@@ -142,3 +142,14 @@ def test_estimator_single_proc_no_core(tmp_path):
         batch_size=8, learning_rate=0.05, verbose=0)
     trained = est.fit(df)
     assert trained.history["loss"][-1] < trained.history["loss"][0]
+
+
+def test_reference_module_path_aliases():
+    """Reference import paths horovod.spark.torch / horovod.spark.keras
+    resolve under horovod_tpu the same way."""
+    from horovod_tpu.spark.keras import KerasEstimator, KerasModel
+    from horovod_tpu.spark.torch import TorchEstimator, TorchModel
+    import horovod_tpu.spark as s
+    assert KerasEstimator is s.KerasEstimator
+    assert TorchEstimator is s.TorchEstimator
+    assert KerasModel is s.KerasModel and TorchModel is s.TorchModel
