@@ -76,6 +76,7 @@ class KerasEstimator(HorovodEstimator):
     def _make_remote_fn(self, ckpt_dir: str, train_path: str,
                         val_path: str) -> Callable:
         custom_objects = self._custom_objects
+        user_callbacks = list(self._callbacks or [])  # cloudpickled along
         store = self._store  # pickled into the worker closure
 
         def remote_train():
@@ -105,6 +106,8 @@ class KerasEstimator(HorovodEstimator):
                 val = (vX, vY)
             cb = [hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
                   hvd_keras.callbacks.MetricAverageCallback()]
+            cb += user_callbacks  # reference: the callbacks param rides
+            # along after the distributed ones (spark/keras/estimator.py)
             hist = model.fit(X, Y, batch_size=spec["batch_size"],
                              epochs=spec["epochs"], validation_data=val,
                              verbose=spec["verbose"] if hvd.rank() == 0

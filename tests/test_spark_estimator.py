@@ -55,22 +55,43 @@ def test_torch_estimator_fit_transform(tmp_path):
     assert err < 0.5
 
 
+class _EpochStamp:
+    """User callback double: proves the estimator's callbacks param rides
+    into model.fit on the workers (cloudpickled, keras-API via __call__
+    construction on the worker to avoid pickling live tf state)."""
+
+    def __new__(cls, path):
+        import tensorflow as tf
+
+        class _Impl(tf.keras.callbacks.Callback):
+            def on_epoch_end(self, epoch, logs=None):
+                with open(path, "a") as f:
+                    f.write(f"{epoch}\n")
+
+        return _Impl()
+
+
 @needs_core
 def test_keras_estimator_fit_transform(tmp_path):
     tf = pytest.importorskip("tensorflow")
     df = _regression_df(n=60)
     model = tf.keras.Sequential(
         [tf.keras.layers.Input((4,)), tf.keras.layers.Dense(1)])
+    stamp = str(tmp_path / "epochs.log")
     est = KerasEstimator(
         model=model, optimizer="SGD", loss="mse",
         feature_cols=[f"x{i}" for i in range(4)], label_cols=["y"],
         store=LocalStore(str(tmp_path)), num_proc=2, epochs=6,
-        batch_size=16, learning_rate=0.05, verbose=0)
+        batch_size=16, learning_rate=0.05, verbose=0,
+        callbacks=[_EpochStamp(stamp)])
     trained = est.fit(df)
     assert trained.history["loss"][-1] < trained.history["loss"][0]
     out = trained.transform(df.head(8))
     assert "y__output" in out.columns
     assert np.isfinite(out["y__output"].to_numpy()).all()
+    # the user callback ran on the workers: 6 epochs x 2 ranks
+    with open(stamp) as f:
+        assert len(f.read().split()) == 12
 
 
 def test_filesystem_store_contract_memory_scheme():
