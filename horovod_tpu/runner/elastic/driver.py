@@ -51,7 +51,8 @@ class ElasticDriver:
                  ckpt_dir: Optional[str] = None,
                  target_np: Optional[int] = None,
                  remote_exec=None,
-                 world_secret: Optional[bytes] = None) -> None:
+                 world_secret: Optional[bytes] = None,
+                 timestamp_output: bool = False) -> None:
         # remote_exec(slot, command, worker_env, events) -> rc replaces the
         # local/ssh exec when the cluster reaches hosts another way — e.g.
         # Spark tasks acting as host agents (spark/elastic.py). The
@@ -61,6 +62,7 @@ class ElasticDriver:
         # channel instead of shipping it in worker envs over the network.
         self._remote_exec = remote_exec
         self._preshared_secret = world_secret
+        self._timestamp_output = timestamp_output
         self._hosts = HostManager(discovery)
         self._command = command
         self._min_np = min_np
@@ -227,7 +229,8 @@ class ElasticDriver:
                     slot, self._command, coord_addr, coord_port, self._env,
                     extra_env=extra_env)
                 rc = safe_execute(cmd, env=env, prefix=prefix,
-                                  events=[failure, teardown])
+                                  events=[failure, teardown],
+                                  timestamp=self._timestamp_output)
             if rc == 0:
                 self._registry.record(slot.rank, slot.hostname, SUCCESS)
                 return
@@ -372,8 +375,9 @@ def run_elastic(discovery: HostDiscovery, np: Optional[int],
                 min_np: int = 1, max_np: Optional[int] = None,
                 env: Optional[Dict[str, str]] = None,
                 verbose: bool = False,
-                reset_limit: Optional[int] = None) -> int:
+                reset_limit: Optional[int] = None,
+                timestamp_output: bool = False) -> int:
     driver = ElasticDriver(discovery, command, min_np=min_np, max_np=max_np,
                            env=env, verbose=verbose, reset_limit=reset_limit,
-                           target_np=np)
+                           target_np=np, timestamp_output=timestamp_output)
     return driver.run()

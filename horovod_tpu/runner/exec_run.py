@@ -162,7 +162,8 @@ def launch_static(hosts: List[HostInfo], np: int, command: List[str],
                   nics: Optional[List[str]] = None,
                   nic_probe: bool = True,
                   verbose: bool = False,
-                  output_dir: Optional[str] = None) -> int:
+                  output_dir: Optional[str] = None,
+                  timestamp_output: bool = False) -> int:
     """Run ``command`` on every slot; return first nonzero exit code (or 0).
 
     Reference: ``launch_gloo`` (``gloo_run.py:226``): assignment → env →
@@ -203,7 +204,8 @@ def launch_static(hosts: List[HostInfo], np: int, command: List[str],
                 err_f = open(os.path.join(d, "stderr"), "w", buffering=1)
             rc = safe_execute(cmd, env=run_env, prefix=prefix,
                               stdout=out_f, stderr=err_f,
-                              events=[failure])
+                              events=[failure],
+                              timestamp=timestamp_output)
         except Exception as e:
             print(f"[hvdrun] rank {slot.rank} failed to launch: {e}",
                   file=sys.stderr, flush=True)

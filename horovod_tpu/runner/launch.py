@@ -89,6 +89,9 @@ def parse_args(argv: List[str]) -> argparse.Namespace:
                    help="directory collecting per-worker output as "
                         "<dir>/rank.N/{stdout,stderr} (reference: "
                         "horovodrun --output-filename)")
+    p.add_argument("--prefix-output-with-timestamp", action="store_true",
+                   help="timestamp every pumped worker output line "
+                        "(reference flag of the same name)")
     p.add_argument("--log-level", default=None,
                    choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
                             "FATAL"])
@@ -222,11 +225,11 @@ def run_commandline(argv: List[str] = None) -> int:
             discovery = TpuPodDiscovery()
         else:
             discovery = FixedHosts(resolve_hosts(args))
-        return run_elastic(discovery, args.num_proc, args.command,
-                           min_np=args.min_np or 1,
-                           max_np=args.max_np,
-                           env=env, verbose=args.verbose,
-                           reset_limit=args.reset_limit)
+        return run_elastic(
+            discovery, args.num_proc, args.command,
+            min_np=args.min_np or 1, max_np=args.max_np,
+            env=env, verbose=args.verbose, reset_limit=args.reset_limit,
+            timestamp_output=args.prefix_output_with_timestamp)
 
     hosts = resolve_hosts(args)
     np = args.num_proc or sum(h.slots for h in hosts)
@@ -235,7 +238,8 @@ def run_commandline(argv: List[str] = None) -> int:
     return launch_static(hosts, np, args.command, env=env,
                          nics=nics, nic_probe=not args.no_nic_probe,
                          verbose=args.verbose,
-                         output_dir=args.output_filename)
+                         output_dir=args.output_filename,
+                         timestamp_output=args.prefix_output_with_timestamp)
 
 
 def main() -> None:

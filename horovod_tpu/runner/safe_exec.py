@@ -18,11 +18,16 @@ from typing import Dict, List, Optional
 GRACEFUL_TERMINATION_TIME_S = 5
 
 
-def _pump(stream, out, prefix: str = "") -> None:
+def _pump(stream, out, prefix: str = "", timestamp: bool = False) -> None:
     for line in iter(stream.readline, b""):
         try:
             text = line.decode(errors="replace")
-            out.write(prefix + text)
+            stamp = ""
+            if timestamp:
+                # reference: --prefix-output-with-timestamp
+                # (safe_shell_exec prepend_context)
+                stamp = time.strftime("%Y-%m-%d %H:%M:%S") + " "
+            out.write(stamp + prefix + text)
             out.flush()
         except ValueError:
             break
@@ -31,7 +36,8 @@ def _pump(stream, out, prefix: str = "") -> None:
 
 def safe_execute(command: List[str], env: Optional[Dict[str, str]] = None,
                  stdout=None, stderr=None, prefix: str = "",
-                 events: Optional[List[threading.Event]] = None) -> int:
+                 events: Optional[List[threading.Event]] = None,
+                 timestamp: bool = False) -> int:
     """Run command; if any event fires, terminate the process group
     (reference: ``safe_shell_exec.execute``)."""
     stdout = stdout or sys.stdout
@@ -40,9 +46,11 @@ def safe_execute(command: List[str], env: Optional[Dict[str, str]] = None,
         command, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         preexec_fn=os.setsid)
     pumps = [
-        threading.Thread(target=_pump, args=(proc.stdout, stdout, prefix),
+        threading.Thread(target=_pump,
+                         args=(proc.stdout, stdout, prefix, timestamp),
                          daemon=True),
-        threading.Thread(target=_pump, args=(proc.stderr, stderr, prefix),
+        threading.Thread(target=_pump,
+                         args=(proc.stderr, stderr, prefix, timestamp),
                          daemon=True),
     ]
     for t in pumps:

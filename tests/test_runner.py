@@ -239,3 +239,22 @@ def test_hvdrun_output_filename(tmp_path):
         assert f"hello from rank {r}" in out, out
         err = (out_dir / f"rank.{r}" / "stderr").read_text()
         assert f"warn {r}" in err, err
+
+
+@needs_core
+def test_hvdrun_timestamped_output(tmp_path):
+    """--prefix-output-with-timestamp stamps every pumped line
+    (reference flag of the same name)."""
+    prog = tmp_path / "worker.py"
+    prog.write_text("print('stamped line')\n")
+    out_dir = tmp_path / "logs"
+    rc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "1",
+         "--output-filename", str(out_dir),
+         "--prefix-output-with-timestamp",
+         sys.executable, str(prog)],
+        cwd=REPO, capture_output=True, timeout=120)
+    assert rc.returncode == 0, rc.stdout.decode() + rc.stderr.decode()
+    line = (out_dir / "rank.0" / "stdout").read_text().strip()
+    # "YYYY-MM-DD HH:MM:SS stamped line"
+    assert line.endswith("stamped line") and line[:4].isdigit(), line
