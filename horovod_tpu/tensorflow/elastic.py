@@ -98,3 +98,84 @@ class TensorFlowState(ObjectState):
         self.var_snaps = self._capture()
         super().sync()
         self._apply(self.var_snaps)
+
+
+def _keras_callbacks_base():
+    import tensorflow as tf
+    return tf.keras.callbacks.Callback
+
+
+def CommitStateCallback(state, batches_per_commit: int = 1):
+    """Commit the elastic state every ``batches_per_commit`` batches and
+    at every epoch end (reference: ``CommitStateCallbackImpl``,
+    ``_keras/elastic.py:17-40``).
+
+    List this LAST in ``callbacks`` (reference usage order) so each
+    commit captures the Update*StateCallback counters for the same
+    batch/epoch — keras runs callbacks in list order. Factory function
+    returning a ``tf.keras.callbacks.Callback`` instance."""
+
+    class _Impl(_keras_callbacks_base()):
+        def __init__(self):
+            super().__init__()
+            self._remaining = batches_per_commit
+
+        def on_train_begin(self, logs=None):
+            # reset on every sync event for cross-rank consistency
+            self._remaining = batches_per_commit
+
+        def on_batch_end(self, batch, logs=None):
+            self._remaining -= 1
+            if self._remaining == 0:
+                state.commit()
+                self._remaining = batches_per_commit
+
+        def on_epoch_end(self, epoch, logs=None):
+            state.commit()
+
+    return _Impl()
+
+
+def UpdateBatchStateCallback(state):
+    """Track the COMPLETED-batch count in ``state.batch`` through fit
+    (reference: ``UpdateBatchStateCallbackImpl``,
+    ``_keras/elastic.py:42-63``).
+
+    Keras 3 caveat: the reference shrank the resumed epoch by mutating
+    ``self.params["steps"]``, which modern keras ignores (the loop takes
+    its step count from the data handler). ``state.batch`` is still
+    maintained so the CALLER can resume mid-epoch — pass
+    ``steps_per_epoch - state.batch`` (or slice the dataset) to the
+    post-restore ``fit``; without that, a restore replays the committed
+    epoch's earlier batches. Factory function returning a callback."""
+
+    class _Impl(_keras_callbacks_base()):
+        def on_batch_end(self, batch, logs=None):
+            state.batch = batch + 1  # completed count, not last index
+
+        def on_epoch_end(self, epoch, logs=None):
+            state.batch = 0
+
+    return _Impl()
+
+
+def UpdateEpochStateCallback(state):
+    """Track the GLOBAL epoch number in ``state.epoch`` across resets —
+    keras restarts epoch numbering at 0 on every retry (reference:
+    ``UpdateEpochStateCallbackImpl``, ``_keras/elastic.py:66-89``).
+    Factory function returning a callback."""
+
+    class _Impl(_keras_callbacks_base()):
+        def __init__(self):
+            super().__init__()
+            self._initial_epoch = state.epoch
+
+        def on_train_begin(self, logs=None):
+            self._initial_epoch = state.epoch
+
+        def on_epoch_end(self, epoch, logs=None):
+            # +1: a reset after state.batch returns to 0 must not repeat
+            # the finished epoch
+            state.epoch = self._initial_epoch + epoch + 1
+
+    return _Impl()

@@ -291,3 +291,38 @@ def test_keras_load_model_wraps_optimizer(tfhvd, tmp_path):
         np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
     hist = loaded.fit(x, y, epochs=2, batch_size=16, verbose=0)
     assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+
+def test_keras_elastic_callbacks(tfhvd, tmp_path, monkeypatch):
+    """CommitStateCallback + Update{Batch,Epoch}StateCallback drive a
+    keras fit with elastic state tracking (reference: _keras/elastic.py):
+    commits happen per batch cadence, state.epoch counts globally."""
+    monkeypatch.setenv("HVD_ELASTIC_CKPT", str(tmp_path))
+    from horovod_tpu.tensorflow.elastic import (CommitStateCallback,
+                                                TensorFlowKerasState,
+                                                UpdateBatchStateCallback,
+                                                UpdateEpochStateCallback)
+
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Input((4,)), tf.keras.layers.Dense(1)])
+    opt = tf.keras.optimizers.SGD(0.05)
+    model.compile(optimizer=opt, loss="mse", run_eagerly=True)
+    state = TensorFlowKerasState(model, opt, epoch=0, batch=0,
+                                 name="kcb")
+    commits = []
+    orig_commit = state.commit
+    state.commit = lambda: (commits.append(1), orig_commit())[1]
+
+    x = np.random.RandomState(0).randn(32, 4).astype(np.float32)
+    y = x @ np.asarray([[1.0], [2.0], [3.0], [4.0]], np.float32)
+    # reference order: Update* first, Commit LAST so every commit
+    # captures counters for the same batch/epoch
+    model.fit(x, y, epochs=3, batch_size=8, verbose=0, callbacks=[
+        UpdateBatchStateCallback(state),
+        UpdateEpochStateCallback(state),
+        CommitStateCallback(state, batches_per_commit=2)])
+
+    assert state.epoch == 3          # global epochs tracked
+    assert state.batch == 0          # reset at epoch end
+    # 4 batches/epoch -> 2 cadence commits + 1 epoch-end commit, x3
+    assert len(commits) == 9, commits
