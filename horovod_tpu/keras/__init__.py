@@ -180,3 +180,15 @@ def load_model(filepath, custom_optimizers=None, custom_objects=None,
     if opt is not None:
         _wrap_in_place(opt, compression=compression)
     return model
+
+
+def __getattr__(name):
+    if name == "elastic":
+        # lazy (the elastic submodule pulls the TF adapter); import_module
+        # directly — a from-import here would recurse through this very
+        # __getattr__ via importlib's fromlist handling
+        import importlib
+        mod = importlib.import_module("horovod_tpu.keras.elastic")
+        globals()["elastic"] = mod
+        return mod
+    raise AttributeError(name)

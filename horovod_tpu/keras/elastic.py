@@ -1,0 +1,11 @@
+"""``horovod_tpu.keras.elastic`` — the reference's
+``horovod.tensorflow.keras.elastic`` / ``horovod.keras.elastic`` surface
+(``horovod/tensorflow/keras/elastic.py``): the run decorator, the Keras
+state, and the fit-loop elastic callbacks."""
+
+from horovod_tpu.elastic import run  # noqa: F401
+from horovod_tpu.tensorflow.elastic import (  # noqa: F401
+    CommitStateCallback, TensorFlowKerasState, UpdateBatchStateCallback,
+    UpdateEpochStateCallback)
+
+KerasState = TensorFlowKerasState  # reference alias

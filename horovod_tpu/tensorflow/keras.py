@@ -6,3 +6,4 @@ one Keras adapter)."""
 
 from horovod_tpu.keras import *  # noqa: F401,F403
 from horovod_tpu.keras import DistributedOptimizer, callbacks  # noqa: F401
+from horovod_tpu.keras import elastic  # noqa: F401

@@ -326,3 +326,16 @@ def test_keras_elastic_callbacks(tfhvd, tmp_path, monkeypatch):
     assert state.batch == 0          # reset at epoch end
     # 4 batches/epoch -> 2 cadence commits + 1 epoch-end commit, x3
     assert len(commits) == 9, commits
+
+
+def test_keras_elastic_namespace(tfhvd):
+    """horovod.keras.elastic / horovod.tensorflow.keras.elastic resolve
+    here with the reference surface (run, KerasState, fit callbacks)."""
+    import horovod_tpu.keras as khvd
+    import horovod_tpu.tensorflow.keras as tkhvd
+    for ns in (khvd.elastic, tkhvd.elastic):
+        assert callable(ns.run)
+        assert ns.KerasState is ns.TensorFlowKerasState
+        assert callable(ns.CommitStateCallback)
+        assert callable(ns.UpdateBatchStateCallback)
+        assert callable(ns.UpdateEpochStateCallback)
