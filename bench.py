@@ -77,6 +77,17 @@ BACKOFF_S = 10
 MAX_ATTEMPTS = 5
 
 
+def _git_commit() -> str:
+    """Short commit hash for result provenance (empty off-git)."""
+    try:
+        return subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5).stdout.strip()
+    except Exception:
+        return ""
+
+
 def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
@@ -131,6 +142,7 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
             "device_kind": jax.devices()[0].device_kind,
             "compile_s": round(compile_s, 1),
             "timing_iters": n_iters,
+            "commit": _git_commit(),
             **ex,
         }
         if provisional:
