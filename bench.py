@@ -630,6 +630,12 @@ def _run_attempt(deadline_s):
                 proc.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 pass
+    if not timed_out:
+        # clean child exit: the writer side is closed, so the drain thread
+        # hits EOF on its own — let it finish before touching the pipe, or
+        # a close here can interrupt it mid-iteration and drop buffered
+        # lines (including the final result JSON)
+        reader.join(timeout=10)
     # closing our end of the pipe unblocks the drain thread even if a
     # grandchild inherited the write end and never exits (the reader gets
     # EBADF/EOF instead of blocking forever, and we stop leaking an fd +

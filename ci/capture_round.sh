@@ -2,7 +2,10 @@
 # Round perf capture orchestrator: wait out relay outages on the headline
 # model, then sweep the control + secondary models in the same healthy
 # window. Appends every verbatim result line to $OUT.
-OUT=${OUT:-/tmp/round4_captures.jsonl}
+OUT=${OUT:-/tmp/round5_captures.jsonl}
+# Gate: value present AND not a provisional warmup-window line — provisional
+# throughput must not be folded into BENCH_MEASURED.json as a real measurement.
+GATE="import json,sys; d=json.load(open(sys.argv[1])); sys.exit(0 if d.get('value') and not d.get('provisional') else 1)"
 cd "$(dirname "$0")/.."
 try=0
 while [ $try -lt 24 ]; do
@@ -10,7 +13,7 @@ while [ $try -lt 24 ]; do
   echo "[capture] headline try $try $(date -u +%H:%M)" >&2
   HVD_BENCH_TOTAL_BUDGET_S=1800 timeout 1900 python bench.py \
       > /tmp/cap_headline.json 2>/tmp/cap_headline.log
-  if python -c "import json,sys; d=json.load(open('/tmp/cap_headline.json')); sys.exit(0 if d.get('value') else 1)" 2>/dev/null; then
+  if python -c "$GATE" /tmp/cap_headline.json 2>/dev/null; then
     stamp() {  # wrap with the CAPTURE time so provenance survives late merges
       python -c "import json,datetime,sys; print(json.dumps({'measured_at': datetime.datetime.now(datetime.timezone.utc).strftime('%Y-%m-%dT%H:%MZ'), 'result': json.load(open(sys.argv[1]))}))" "$1"
     }
@@ -22,7 +25,7 @@ while [ $try -lt 24 ]; do
       HVD_BENCH_MODEL=$model HVD_BENCH_TOTAL_BUDGET_S=1200 timeout 1300 \
         python bench.py > /tmp/cap_$model.json 2>/tmp/cap_$model.log
       # append only validated, value-carrying JSON (same bar as headline)
-      if python -c "import json,sys; d=json.load(open('/tmp/cap_$model.json')); sys.exit(0 if d.get('value') else 1)" 2>/dev/null; then
+      if python -c "$GATE" /tmp/cap_$model.json 2>/dev/null; then
         stamp /tmp/cap_$model.json >> "$OUT"
       else
         echo "[capture] $model FAILED (no valid value)" >&2

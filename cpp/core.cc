@@ -1493,7 +1493,9 @@ void Core::Execute(CoordDomain& d, const Response& r) {
                                    cfg_.local_rank == 0, dtag,
                                    fusion.data(), nelem, r.dtypes[0], r.op,
                                    r.prescale, r.postscale);
-        counters_.hier_allreduces++;
+        // counter documents that the path RAN successfully (matches the
+        // hier_allgathers guard) — do not count failed attempts
+        if (st.ok()) counters_.hier_allreduces++;
         act_end();
       } else if (r.op == ReduceOp::kAdasum && d.group.size() > 1) {
         act_begin("ADASUM_ALLREDUCE");

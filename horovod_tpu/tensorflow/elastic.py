@@ -141,19 +141,48 @@ def UpdateBatchStateCallback(state):
     (reference: ``UpdateBatchStateCallbackImpl``,
     ``_keras/elastic.py:42-63``).
 
-    Keras 3 caveat: the reference shrank the resumed epoch by mutating
-    ``self.params["steps"]``, which modern keras ignores (the loop takes
-    its step count from the data handler). ``state.batch`` is still
-    maintained so the CALLER can resume mid-epoch — pass
+    Like the reference, the first ``on_epoch_begin`` after a restore
+    with ``state.batch > 0`` reduces ``self.params["steps"]`` by the
+    already-committed batch count (restored at epoch end so later
+    epochs run full length). Only LEGACY training loops (tf.keras
+    before the 2.2 DataHandler rewrite) honor that mutation; every
+    modern tf.keras / Keras 3 loop takes its step count from the data
+    handler and merely shows the shrunk count in the progress bar. On
+    modern keras the CALLER must therefore compensate — pass
     ``steps_per_epoch - state.batch`` (or slice the dataset) to the
-    post-restore ``fit``; without that, a restore replays the committed
-    epoch's earlier batches. Factory function returning a callback."""
+    post-restore ``fit`` — else the committed epoch's earlier batches
+    replay.
+
+    ``state.batch`` counts completed batches WITHIN THE CURRENT RUN of
+    the epoch (matching the reference). After a mid-epoch resume the
+    count therefore lags the true position in the original epoch by
+    the resumed offset, so a commit taken inside a resumed epoch can
+    only cause a later restore to RE-train a few batches — never to
+    skip training. Callers wanting exact positions after a resume
+    should commit at epoch boundaries (``batches_per_commit`` large,
+    or rely on the epoch-end commit). Factory function returning a
+    callback."""
 
     class _Impl(_keras_callbacks_base()):
+        def __init__(self):
+            super().__init__()
+            self._saved_steps = None
+
+        def on_epoch_begin(self, epoch, logs=None):
+            if state.batch > 0:
+                steps = (self.params or {}).get("steps")
+                if steps:
+                    self._saved_steps = steps
+                    self.params["steps"] = max(steps - state.batch, 0)
+
         def on_batch_end(self, batch, logs=None):
             state.batch = batch + 1  # completed count, not last index
 
         def on_epoch_end(self, epoch, logs=None):
+            if self._saved_steps is not None:
+                # later epochs start from 0 and must run full length
+                self.params["steps"] = self._saved_steps
+                self._saved_steps = None
             state.batch = 0
 
     return _Impl()

@@ -328,6 +328,40 @@ def test_keras_elastic_callbacks(tfhvd, tmp_path, monkeypatch):
     assert len(commits) == 9, commits
 
 
+def test_batch_state_callback_resumed_epoch_shrink(tfhvd):
+    """After a mid-epoch restore, on_epoch_begin shrinks params['steps']
+    by the committed batch count (reference parity — honored by legacy
+    loops, progbar-only on modern keras) and restores it at epoch end;
+    state.batch counts completed batches within the current run, never
+    overcounting (reference: _keras/elastic.py UpdateBatchStateCallbackImpl)."""
+    import horovod_tpu.tensorflow.elastic as tfe
+
+    class _State:
+        batch = 30
+    state = _State()
+    cb = tfe.UpdateBatchStateCallback(state)
+    cb.params = {"steps": 100}
+    cb.on_epoch_begin(0)
+    assert cb.params["steps"] == 70           # resumed epoch runs remainder
+    cb.on_batch_end(0)
+    assert state.batch == 1                   # within-run count: a commit
+    cb.on_batch_end(1)                        # here may re-train batches on
+    assert state.batch == 2                   # restore, but never skips any
+    cb.on_epoch_end(0)
+    assert cb.params["steps"] == 100          # later epochs run full length
+    assert state.batch == 0
+    cb.on_epoch_begin(1)
+    assert cb.params["steps"] == 100          # no shrink without resume
+    # unknown-cardinality fit: params['steps'] is None -> no shrink, no crash
+    state2 = _State()
+    cb2 = tfe.UpdateBatchStateCallback(state2)
+    cb2.params = {"steps": None}
+    cb2.on_epoch_begin(0)
+    assert cb2.params["steps"] is None
+    cb2.on_batch_end(49)
+    assert state2.batch == 50
+
+
 def test_keras_elastic_namespace(tfhvd):
     """horovod.keras.elastic / horovod.tensorflow.keras.elastic resolve
     here with the reference surface (run, KerasState, fit callbacks)."""
