@@ -102,7 +102,8 @@ def _peak_flops(device_kind: str):
 
 def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
                         iters, per_step_units, n_chips, metric, unit,
-                        vs_baseline_per_unit, extra) -> None:
+                        vs_baseline_per_unit, extra,
+                        hlo_flops_factor: int = 1) -> None:
     """Shared hardened measurement: warmup, a queued timing window bracketed
     by host readbacks (``jax.block_until_ready`` is unreliable on the axon
     relay platform — it can return before execution completes), per-device
@@ -200,7 +201,11 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
         cost = jitted.lower(*args).compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
-        flops_per_device = float(cost.get("flops", 0.0)) or None
+        # XLA's cost analysis counts a while-loop (lax.scan) body ONCE,
+        # not trip-count times (verified empirically) — scale by the
+        # in-graph step count so hlo- and analytic-sourced results agree
+        flops_per_device = (float(cost.get("flops", 0.0))
+                            * hlo_flops_factor) or None
     except Exception as e:
         _log(f"cost_analysis unavailable ({e!r}); using analytic FLOPs")
     if not flops_per_device:
@@ -241,12 +246,13 @@ def _child_bert() -> None:
 
     B = int(os.environ.get("HVD_BENCH_BATCH", "64")) * n_chips
     S = int(os.environ.get("HVD_BENCH_SEQ", "128"))
+    scan = max(1, int(os.environ.get("HVD_BENCH_SCAN", "8")))
     cfg = bert_large()
     model = Bert(cfg)
     params = init_bert(model, jax.random.PRNGKey(0), S, mesh)
     tx = optax.adamw(1e-4)
     opt_state = jax.jit(tx.init)(params)
-    step = make_bert_train_step(model, tx, mesh)
+    step = make_bert_train_step(model, tx, mesh, scan_steps=scan)
 
     rng = np.random.RandomState(0)
     sh = hvd.batch_sharding(mesh)
@@ -274,15 +280,17 @@ def _child_bert() -> None:
         # 6 * params * tokens (dense transformer training rule of thumb)
         n_params = sum(x.size
                        for x in jax.tree_util.tree_leaves(run.args[0]))
-        return 6.0 * n_params * (B / n_chips) * S
+        return 6.0 * n_params * (B / n_chips) * S * scan
 
     _measure_and_report(
         step_fn, run, readback=float,
-        analytic_flops_per_device=analytic, iters=10, per_step_units=B,
+        analytic_flops_per_device=analytic, iters=10,
+        per_step_units=B * scan,
         n_chips=n_chips, metric="bert_large_seqs_per_sec_per_chip",
         unit="seq/s/chip",
         vs_baseline_per_unit=None,  # reference publishes no BERT absolute
         extra={"batch_per_chip": B // n_chips, "seq_len": S,
+               "scan_steps": scan,
                "tokens_per_sec_per_chip": lambda v: round(v * S, 1)})
 
 
@@ -322,7 +330,8 @@ def _child_gpt() -> None:
     _log(f"gpt params: {n_params/1e6:.1f}M, batch {B} x seq {S}")
     tx = optax.adamw(1e-4)
     opt_state = init_opt_state(tx, params, mesh, cfg)
-    step = make_train_step(cfg, mesh, tx)
+    scan = max(1, int(os.environ.get("HVD_BENCH_SCAN", "8")))
+    step = make_train_step(cfg, mesh, tx, scan_steps=scan)
 
     rng = np.random.RandomState(0)
     tokens, targets = shard_batch(
@@ -339,11 +348,12 @@ def _child_gpt() -> None:
     _measure_and_report(
         step_fn, run, readback=float,
         analytic_flops_per_device=lambda:
-            6.0 * n_params * (B / n_chips) * S,
-        iters=10, per_step_units=B * S, n_chips=n_chips,
+            6.0 * n_params * (B / n_chips) * S * scan,
+        iters=10, per_step_units=B * S * scan, n_chips=n_chips,
         metric="gpt_tokens_per_sec_per_chip", unit="tokens/s/chip",
         vs_baseline_per_unit=None,  # reference publishes no LM absolute
         extra={"batch_per_chip": B // n_chips, "seq_len": S,
+               "scan_steps": scan,
                "n_params_m": round(n_params / 1e6, 1)})
 
 
@@ -381,6 +391,10 @@ def _child_cnn(which: str) -> None:
     # C=3 wastes 4x of the MXU's input-channel tiling (docs/PERF.md);
     # HVD_BENCH_STEM=conv selects the textbook stem for comparison.
     stem = os.environ.get("HVD_BENCH_STEM", "s2d")
+    # In-graph multi-step (lax.scan): one dispatch covers the chain, so
+    # host->device launch latency (significant through the relay) is off
+    # the critical path and the number reflects device throughput.
+    scan = max(1, int(os.environ.get("HVD_BENCH_SCAN", "8")))
 
     has_batch_stats = True
     if which == "vgg16":
@@ -391,17 +405,17 @@ def _child_cnn(which: str) -> None:
         has_batch_stats = False
         tx = optax.sgd(0.01, momentum=0.9)
         opt_state = jax.jit(tx.init)(params)
-        step = make_vgg_train_step(model, tx, mesh)
-        extra = {"batch_per_chip": batch_per_chip}
+        step = make_vgg_train_step(model, tx, mesh, scan_steps=scan)
+        extra = {"batch_per_chip": batch_per_chip, "scan_steps": scan}
     elif which == "inception3":
         model = InceptionV3(num_classes=1000, dtype=jnp.bfloat16)
         params, batch_stats = create_inception_state(
             model, jax.random.PRNGKey(0), image_size=image_size, mesh=mesh)
         tx = optax.sgd(0.1, momentum=0.9)
         opt_state = jax.jit(tx.init)(params)
-        step = make_inception_train_step(model, tx, mesh)
+        step = make_inception_train_step(model, tx, mesh, scan_steps=scan)
         extra = {"batch_per_chip": batch_per_chip,
-                 "image_size": image_size}
+                 "image_size": image_size, "scan_steps": scan}
     else:
         mk = ResNet101 if which == "resnet101" else ResNet50
         model = mk(num_classes=1000, dtype=jnp.bfloat16, stem=stem)
@@ -409,8 +423,9 @@ def _child_cnn(which: str) -> None:
             model, jax.random.PRNGKey(0), image_size=image_size, mesh=mesh)
         tx = optax.sgd(0.1, momentum=0.9)
         opt_state = jax.jit(tx.init)(params)
-        step = make_resnet_train_step(model, tx, mesh)
-        extra = {"batch_per_chip": batch_per_chip, "stem": stem}
+        step = make_resnet_train_step(model, tx, mesh, scan_steps=scan)
+        extra = {"batch_per_chip": batch_per_chip, "stem": stem,
+                 "scan_steps": scan}
 
     rng = np.random.RandomState(0)
     images = jax.device_put(
@@ -449,9 +464,10 @@ def _child_cnn(which: str) -> None:
 
     _measure_and_report(
         step_fn, run, readback=float,
+        # per dispatch = scan optimizer steps
         analytic_flops_per_device=lambda:
-            3 * 2 * FWD_MACS_PER_IMG[which] * B / n_chips,
-        iters=20, per_step_units=B, n_chips=n_chips,
+            3 * 2 * FWD_MACS_PER_IMG[which] * B * scan / n_chips,
+        iters=20, per_step_units=B * scan, n_chips=n_chips,
         metric=f"{which}_images_per_sec_per_chip", unit="img/s/chip",
         # the published 1656.82/16 figure is a ResNet-101 measurement
         # (docs/benchmarks.rst:32-43): it is the apples-to-apples baseline
@@ -487,6 +503,7 @@ def _child_resnet50_bare() -> None:
 
     batch = int(os.environ.get("HVD_BENCH_BATCH", "256"))
     stem = os.environ.get("HVD_BENCH_STEM", "s2d")
+    scan = max(1, int(os.environ.get("HVD_BENCH_SCAN", "8")))
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem=stem)
     variables = model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3), jnp.bfloat16),
@@ -495,8 +512,7 @@ def _child_resnet50_bare() -> None:
     tx = optax.sgd(0.1, momentum=0.9)
     opt_state = jax.jit(tx.init)(params)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-    def step(params, batch_stats, opt_state, images, labels):
+    def one_step(params, batch_stats, opt_state, images, labels):
         def loss_fn(p):
             logits, mut = model.apply(
                 {"params": p, "batch_stats": batch_stats}, images,
@@ -509,6 +525,21 @@ def _child_resnet50_bare() -> None:
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, new_stats, opt_state, loss
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step(params, batch_stats, opt_state, images, labels):
+        # same in-graph multi-step as the framework path, so the control
+        # stays apples-to-apples (one dispatch per scan-step chain)
+        if scan == 1:
+            return one_step(params, batch_stats, opt_state, images, labels)
+
+        def body(carry, _):
+            p, bs, o = carry
+            p, bs, o, loss = one_step(p, bs, o, images, labels)
+            return (p, bs, o), loss
+        (params, batch_stats, opt_state), losses = jax.lax.scan(
+            body, (params, batch_stats, opt_state), None, length=scan)
+        return params, batch_stats, opt_state, losses[-1]
 
     rng = np.random.RandomState(0)
     images = jax.device_put(jnp.asarray(
@@ -526,11 +557,12 @@ def _child_resnet50_bare() -> None:
     _measure_and_report(
         step_fn, run, readback=float,
         analytic_flops_per_device=lambda:
-            3 * 2 * FWD_MACS_PER_IMG["resnet50"] * batch,
-        iters=20, per_step_units=batch, n_chips=1,
+            3 * 2 * FWD_MACS_PER_IMG["resnet50"] * batch * scan,
+        iters=20, per_step_units=batch * scan, n_chips=1,
         metric="resnet50_bare_images_per_sec_per_chip", unit="img/s/chip",
         vs_baseline_per_unit=REFERENCE_IMG_PER_SEC_PER_DEVICE,
-        extra={"batch_per_chip": batch, "stem": stem, "control": True})
+        extra={"batch_per_chip": batch, "stem": stem, "scan_steps": scan,
+               "control": True})
 
 
 def _enable_compile_cache() -> None:

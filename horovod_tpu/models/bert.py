@@ -17,6 +17,8 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+
+from horovod_tpu.models.scan_util import multi_step
 import flax.linen as nn
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -129,16 +131,20 @@ def pretrain_loss(mlm_logits, nsp_logits, mlm_labels, mlm_mask, nsp_labels):
     return mlm + nsp
 
 
-def make_bert_train_step(model: Bert, optimizer, mesh: Mesh):
+def make_bert_train_step(model: Bert, optimizer, mesh: Mesh,
+                         scan_steps: int = 1):
     """GSPMD-auto pretraining step; flax partitioning metadata shards the
     big matrices over ``tp`` while XLA handles dp gradient reduction.
+
+    ``scan_steps > 1`` runs that many optimizer steps per call via
+    ``lax.scan`` in ONE compiled program (one dispatch per chain; see
+    ``make_resnet_train_step``). The returned loss is the last step's.
 
     ``params``/``opt_state`` buffers are DONATED (in-place update on
     device): keep only the returned state — the inputs are invalidated
     after the call on TPU."""
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state, batch):
+    def one_step(params, opt_state, batch):
         def loss_fn(p):
             mlm_logits, nsp_logits = model.apply(
                 {"params": p}, batch["input_ids"], batch["token_type_ids"],
@@ -150,6 +156,12 @@ def make_bert_train_step(model: Bert, optimizer, mesh: Mesh):
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
+
+    chain = multi_step(one_step, n_carry=2, scan_steps=scan_steps)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, batch):
+        return chain(params, opt_state, batch)
 
     return step
 

@@ -17,6 +17,8 @@ from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from horovod_tpu.models.scan_util import multi_step
 import flax.linen as nn
 
 
@@ -168,18 +170,22 @@ def create_inception_state(model: InceptionV3, rng_key,
 
 
 def make_inception_train_step(model: InceptionV3, optimizer, mesh,
-                              dropout_seed: int = 0):
+                              dropout_seed: int = 0, scan_steps: int = 1):
     """``step_idx`` is folded into the dropout key so every step draws a
     fresh mask (callers must pass an incrementing value; it is a traced
     scalar, so varying it does not recompile).
+
+    ``scan_steps > 1`` runs that many optimizer steps per call via
+    ``lax.scan`` in ONE compiled program (one dispatch per chain; see
+    ``make_resnet_train_step``); scanned step ``i`` uses dropout index
+    ``step_idx * scan_steps + i`` so masks stay fresh.
 
     ``params``/``batch_stats``/``opt_state`` buffers are DONATED
     (in-place update on device): keep only the returned state — the
     inputs are invalidated after the call on TPU."""
     import optax
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-    def step(params, batch_stats, opt_state, images, labels, step_idx=0):
+    def one_step(params, batch_stats, opt_state, images, labels, step_idx):
         def loss_fn(p):
             key = jax.random.fold_in(
                 jax.random.PRNGKey(dropout_seed), step_idx)
@@ -195,5 +201,13 @@ def make_inception_train_step(model: InceptionV3, optimizer, mesh,
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, new_stats, opt_state, loss
+
+    chain = multi_step(one_step, n_carry=3, scan_steps=scan_steps,
+                       indexed=True)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step(params, batch_stats, opt_state, images, labels, step_idx=0):
+        return chain(params, batch_stats, opt_state, images, labels,
+                     step_idx)
 
     return step

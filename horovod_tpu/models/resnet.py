@@ -22,6 +22,8 @@ import flax.linen as nn
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from horovod_tpu.models.scan_util import multi_step
+
 
 class BottleneckBlock(nn.Module):
     filters: int
@@ -119,19 +121,25 @@ def create_resnet_state(model: ResNet, rng_key, image_size: int = 224,
     return variables["params"], variables["batch_stats"]
 
 
-def make_resnet_train_step(model: ResNet, optimizer, mesh: Mesh):
+def make_resnet_train_step(model: ResNet, optimizer, mesh: Mesh,
+                           scan_steps: int = 1):
     """Data-parallel train step (GSPMD-auto): batch sharded over every
     data-like axis; gradient reduction inserted by XLA from shardings —
     functionally identical to the reference's DistributedOptimizer loop
     (``torch/optimizer.py:314-325``) with fusion/overlap done by the
     compiler instead of the background thread.
 
+    ``scan_steps > 1`` runs that many optimizer steps per call via
+    ``lax.scan`` inside ONE compiled program: a single dispatch covers
+    the whole chain, taking host→device launch latency (significant
+    through a remote relay) off the critical path. The returned loss is
+    the LAST scanned step's.
+
     ``params``/``batch_stats``/``opt_state`` buffers are DONATED: the
     update happens in place on device, so keep only the returned state
     (the inputs are invalidated after the call on TPU)."""
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-    def step(params, batch_stats, opt_state, images, labels):
+    def one_step(params, batch_stats, opt_state, images, labels):
         def loss_fn(p):
             logits, mut = model.apply(
                 {"params": p, "batch_stats": batch_stats}, images,
@@ -144,6 +152,12 @@ def make_resnet_train_step(model: ResNet, optimizer, mesh: Mesh):
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, new_stats, opt_state, loss
+
+    chain = multi_step(one_step, n_carry=3, scan_steps=scan_steps)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step(params, batch_stats, opt_state, images, labels):
+        return chain(params, batch_stats, opt_state, images, labels)
 
     return step
 

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from horovod_tpu.models.scan_util import multi_step
 from horovod_tpu.parallel.ring_attention import ring_attention_spmd
 from horovod_tpu.parallel.moe import moe_layer_spmd, top_k_gating
 
@@ -394,10 +395,15 @@ def make_grad_fn(cfg: TransformerConfig, mesh: Mesh):
     return grad_fn
 
 
-def make_train_step(cfg: TransformerConfig, mesh: Mesh, optimizer):
+def make_train_step(cfg: TransformerConfig, mesh: Mesh, optimizer,
+                    scan_steps: int = 1):
     """Jitted full train step: manual-SPMD fwd/bwd (shard_map) + optimizer
     update in GSPMD-auto mode (XLA keeps the elementwise update sharded as
     the params are).
+
+    ``scan_steps > 1`` runs that many optimizer steps per call via
+    ``lax.scan`` in ONE compiled program (one dispatch per chain; see
+    ``make_resnet_train_step``). Returned loss/aux are the last step's.
 
     ``params``/``opt_state`` buffers are DONATED (in-place update on
     device): keep only the returned state — the inputs are invalidated
@@ -405,12 +411,17 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, optimizer):
     import optax
     grad_fn = make_grad_fn(cfg, mesh)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state, tokens, targets):
+    def one_step(params, opt_state, tokens, targets):
         loss, aux, grads = grad_fn(params, tokens, targets)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss, aux
+
+    chain = multi_step(one_step, n_carry=2, scan_steps=scan_steps)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens, targets):
+        return chain(params, opt_state, tokens, targets)
 
     return step
 

@@ -16,6 +16,8 @@ from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
+
+from horovod_tpu.models.scan_util import multi_step
 import flax.linen as nn
 
 # (convs per stage, channels) — the classic "D" configuration
@@ -63,20 +65,25 @@ def create_vgg_state(model: VGG, rng_key, image_size: int = 224,
     return params
 
 
-def make_vgg_train_step(model: VGG, optimizer, mesh, dropout_seed: int = 0):
+def make_vgg_train_step(model: VGG, optimizer, mesh, dropout_seed: int = 0,
+                        scan_steps: int = 1):
     """Data-parallel train step; same GSPMD-auto contract as the ResNet
     step (``make_resnet_train_step``). ``step_idx`` is folded into the
     dropout key so every step draws a fresh mask (callers must pass an
     incrementing value; it is a traced scalar, so varying it does not
     recompile).
 
+    ``scan_steps > 1`` runs that many optimizer steps per call via
+    ``lax.scan`` in ONE compiled program (one dispatch per chain; see
+    ``make_resnet_train_step``); scanned step ``i`` uses dropout index
+    ``step_idx * scan_steps + i`` so masks stay fresh.
+
     ``params``/``opt_state`` buffers are DONATED (in-place update on
     device): keep only the returned state — the inputs are invalidated
     after the call on TPU."""
     import optax
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state, images, labels, step_idx=0):
+    def one_step(params, opt_state, images, labels, step_idx):
         def loss_fn(p):
             key = jax.random.fold_in(
                 jax.random.PRNGKey(dropout_seed), step_idx)
@@ -88,5 +95,12 @@ def make_vgg_train_step(model: VGG, optimizer, mesh, dropout_seed: int = 0):
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
+
+    chain = multi_step(one_step, n_carry=2, scan_steps=scan_steps,
+                       indexed=True)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, images, labels, step_idx=0):
+        return chain(params, opt_state, images, labels, step_idx)
 
     return step

@@ -74,6 +74,85 @@ def test_vgg16_trains(hvd):
     assert losses[-1] < losses[0]
 
 
+def test_vgg_scan_steps_matches_sequential_dropout_indices(hvd):
+    """The INDEXED scan variant (dropout models): scanned step i must use
+    dropout index step_idx * scan_steps + i, so a scan_steps=2 dispatch
+    with step_idx=0 equals sequential calls with step_idx=0 then 1."""
+    from horovod_tpu.models.vgg import VGG, create_vgg_state, \
+        make_vgg_train_step
+    mesh = hvd.build_mesh(dp=-1)
+    # real dropout so identical masks would be detectable
+    model = VGG(stages=((1, 8), (1, 16), (1, 16), (1, 32), (1, 32)),
+                num_classes=8, dtype=jnp.float32, dropout=0.5)
+    tx = optax.sgd(0.05, momentum=0.9)
+    rng = np.random.RandomState(0)
+    images = jax.device_put(
+        jnp.asarray(rng.rand(16, 64, 64, 3), jnp.float32),
+        batch_sharding(mesh))
+    labels = jax.device_put(jnp.asarray(rng.randint(0, 8, (16,)), jnp.int32),
+                            batch_sharding(mesh))
+
+    def init():
+        params = create_vgg_state(model, jax.random.PRNGKey(0),
+                                  image_size=64, mesh=mesh)
+        return params, jax.jit(tx.init)(params)
+
+    step1 = make_vgg_train_step(model, tx, mesh)
+    p, o = init()
+    for i in range(2):
+        p, o, loss_seq = step1(p, o, images, labels, step_idx=i)
+        loss_seq.block_until_ready()
+
+    step2 = make_vgg_train_step(model, tx, mesh, scan_steps=2)
+    p2, o2 = init()
+    p2, o2, loss_scan = step2(p2, o2, images, labels, step_idx=0)
+    loss_scan.block_until_ready()
+
+    np.testing.assert_allclose(float(loss_scan), float(loss_seq), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(p),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_scan_steps_matches_sequential(hvd):
+    """scan_steps=2 (one dispatch, two in-graph optimizer steps) must
+    produce the same params/loss as two sequential scan_steps=1 calls —
+    the bench's multi-step chain changes dispatch count, not training."""
+    mesh = hvd.build_mesh(dp=-1)
+    model = ResNet([1, 1, 1, 1], num_classes=8, dtype=jnp.float32)
+    tx = optax.sgd(0.05, momentum=0.9)
+    rng = np.random.RandomState(0)
+    images = jax.device_put(
+        jnp.asarray(rng.rand(16, 64, 64, 3), jnp.float32),
+        batch_sharding(mesh))
+    labels = jax.device_put(jnp.asarray(rng.randint(0, 8, (16,)), jnp.int32),
+                            batch_sharding(mesh))
+
+    def init():
+        params, batch_stats = create_resnet_state(
+            model, jax.random.PRNGKey(0), image_size=64, mesh=mesh)
+        return params, batch_stats, jax.jit(tx.init)(params)
+
+    step1 = make_resnet_train_step(model, tx, mesh)
+    p, bs, o = init()
+    for _ in range(2):
+        p, bs, o, loss_seq = step1(p, bs, o, images, labels)
+        loss_seq.block_until_ready()
+
+    step2 = make_resnet_train_step(model, tx, mesh, scan_steps=2)
+    p2, bs2, o2 = init()
+    p2, bs2, o2, loss_scan = step2(p2, bs2, o2, images, labels)
+    loss_scan.block_until_ready()
+
+    np.testing.assert_allclose(float(loss_scan), float(loss_seq),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(p),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_resnet_s2d_trains(hvd):
     mesh = hvd.build_mesh(dp=-1)
     model = ResNet([1, 1, 1, 1], num_classes=8, dtype=jnp.float32,
