@@ -32,6 +32,26 @@ def _to_pandas(df):
     return df
 
 
+def _split_frame(pdf, shuffle: bool, validation, seed: int):
+    """THE split semantics, shared by both materialization paths:
+    optional seeded shuffle, then either a float-fraction validation cut
+    (at least 1 row when validation > 0) or a boolean-column selection.
+    Returns ``(train_pdf, val_pdf_or_None)``."""
+    if shuffle:
+        pdf = pdf.sample(frac=1.0, random_state=seed)
+    pdf = pdf.reset_index(drop=True)
+    val_pdf = None
+    if isinstance(validation, float) and validation > 0:
+        n_val = max(1, int(round(len(pdf) * validation)))
+        val_pdf, pdf = pdf.iloc[:n_val], pdf.iloc[n_val:]
+    elif isinstance(validation, str):
+        mask = pdf[validation].astype(bool)
+        val_pdf, pdf = pdf[mask], pdf[~mask]
+    if val_pdf is not None:
+        val_pdf = val_pdf.reset_index(drop=True)
+    return pdf.reset_index(drop=True), val_pdf
+
+
 class Params:
     """Getter/setter param surface (reference: the Params mixins in
     ``spark/common/params.py`` — ``setX``/``getX`` returning self)."""
@@ -120,6 +140,73 @@ class HorovodEstimator(Params):
     def _load_trained_model(self, ckpt_dir: str) -> HorovodModel:
         raise NotImplementedError
 
+    # -- data materialization ------------------------------------------------
+    def _materialize_pandas(self, pdf, store: "Store", train_path: str,
+                            val_path: str) -> str:
+        """Driver-local path (pandas input): one parquet per split."""
+        pdf, val_pdf = _split_frame(pdf, self._shuffle, self._validation,
+                                    seed=0)
+        if not len(pdf):
+            raise ValueError("DataFrame produced no training rows")
+        store.makedirs(train_path)
+        store.write(store.join(train_path, "data.parquet"),
+                    _parquet_bytes(pdf))
+        if val_pdf is not None and len(val_pdf):
+            store.makedirs(val_path)
+            store.write(store.join(val_path, "data.parquet"),
+                        _parquet_bytes(val_pdf))
+        else:
+            val_path = ""
+        return val_path
+
+    def _materialize_distributed(self, df, store: "Store", train_path: str,
+                                 val_path: str) -> str:
+        """Spark path: EXECUTORS write one parquet shard per partition
+        through the (pickled) Store — the dataset never moves through the
+        driver (reference: ``spark/common/util.py`` prepare_data, which
+        materializes via distributed ``df.write.parquet``; the previous
+        ``toPandas()`` here collected everything to one node).
+
+        Shuffle/validation-split happen per partition via
+        :func:`_split_frame` (seeded by partition id): a float
+        ``validation`` takes that fraction of each partition — globally
+        equivalent to the reference's random-split semantics as long as
+        partitions are not pathologically skewed. A string ``validation``
+        selects rows where that boolean column is set, exactly as the
+        reference does. A partition whose train split comes up empty
+        writes NO shard (``read_shard`` falls back to row striping when
+        shards are scarce, so no rank ends up with a poisoned 0-row
+        file)."""
+        shuffle, validation = self._shuffle, self._validation
+        store.makedirs(train_path)
+        store.makedirs(val_path)
+
+        def write_partition(idx, row_iter):
+            import pandas as pd
+            rows = [r.asDict() for r in row_iter]
+            if not rows:
+                return iter([(idx, 0, 0)])
+            pdf, val_pdf = _split_frame(pd.DataFrame(rows), shuffle,
+                                        validation, seed=idx)
+            if len(pdf):
+                store.write(
+                    store.join(train_path, f"part-{idx:05d}.parquet"),
+                    _parquet_bytes(pdf))
+            n_val_rows = 0
+            if val_pdf is not None and len(val_pdf):
+                store.write(
+                    store.join(val_path, f"part-{idx:05d}.parquet"),
+                    _parquet_bytes(val_pdf))
+                n_val_rows = len(val_pdf)
+            return iter([(idx, len(pdf), n_val_rows)])
+
+        meta = df.rdd.mapPartitionsWithIndex(write_partition).collect()
+        n_train = sum(m[1] for m in meta)
+        n_val = sum(m[2] for m in meta)
+        if n_train == 0:
+            raise ValueError("DataFrame produced no training rows")
+        return val_path if n_val else ""
+
     # -- fit -----------------------------------------------------------------
     def fit(self, df) -> HorovodModel:
         """Materialize data through the Store, train under the launcher,
@@ -127,33 +214,24 @@ class HorovodEstimator(Params):
         run_id = self._run_id or f"run_{uuid.uuid4().hex[:8]}"
         self._run_id = run_id
         store: Store = self._store
-        pdf = _to_pandas(df)
-        if self._shuffle:
-            pdf = pdf.sample(frac=1.0, random_state=0).reset_index(
-                drop=True)
-        val_pdf = None
-        if isinstance(self._validation, float) and self._validation > 0:
-            n_val = max(1, int(len(pdf) * self._validation))
-            val_pdf, pdf = pdf.iloc[:n_val], pdf.iloc[n_val:]
-        elif isinstance(self._validation, str):
-            mask = pdf[self._validation].astype(bool)
-            val_pdf, pdf = pdf[mask], pdf[~mask]
-
         # ALL artifact IO goes through the Store's path algebra + byte API
         # so gs://-class object stores work identically to local paths
         # (reference: store.py:36-530 — estimators read/write exclusively
         # through the Store)
         train_path = store.get_train_data_path(run_id)
         val_path = store.get_val_data_path(run_id)
-        store.makedirs(train_path)
-        store.write(store.join(train_path, "data.parquet"),
-                    _parquet_bytes(pdf.reset_index(drop=True)))
-        if val_pdf is not None and len(val_pdf):
-            store.makedirs(val_path)
-            store.write(store.join(val_path, "data.parquet"),
-                        _parquet_bytes(val_pdf.reset_index(drop=True)))
+        # a reused run_id must not leave stale shards behind: read_shard
+        # globs the whole directory, so leftovers from a previous fit
+        # (different partition count, or the single-parquet pandas path)
+        # would silently mix into this run's data
+        for stale in store.ls(train_path) + store.ls(val_path):
+            store.delete(stale)
+        if hasattr(df, "rdd"):  # a Spark DataFrame: executors materialize
+            val_path = self._materialize_distributed(
+                df, store, train_path, val_path)
         else:
-            val_path = ""
+            val_path = self._materialize_pandas(
+                _to_pandas(df), store, train_path, val_path)
 
         ckpt_dir = store.get_checkpoint_path(run_id)
         store.makedirs(ckpt_dir)
@@ -186,16 +264,31 @@ def _parquet_bytes(pdf) -> bytes:
 
 
 def read_shard(store: Store, data_path: str, rank: int, size: int):
-    """Worker-side shard read through the Store: rows [rank::size] of the
-    materialized parquet (the reference partitions Petastorm row groups
-    per rank). The store travels to the worker by pickle, so remote
-    backends reconnect there."""
+    """Worker-side shard read through the Store (the reference partitions
+    Petastorm row groups per rank). The store travels to the worker by
+    pickle, so remote backends reconnect there.
+
+    With at least ``size`` part files (the distributed materialization
+    writes one per DataFrame partition), files are assigned round-robin
+    by rank — each worker reads ONLY its own shards. With fewer files
+    (the driver-local single-parquet path), every worker reads the file
+    set and takes rows ``[rank::size]``."""
     import io
 
     import pandas as pd
-    pdf = pd.read_parquet(
-        io.BytesIO(store.read(store.join(data_path, "data.parquet"))))
-    return pdf.iloc[rank::size].reset_index(drop=True)
+    files = [p for p in store.ls(data_path) if p.endswith(".parquet")]
+    if not files:
+        raise FileNotFoundError(f"no parquet shards under {data_path}")
+
+    def load(paths):
+        frames = [pd.read_parquet(io.BytesIO(store.read(p)))
+                  for p in paths]
+        return frames[0] if len(frames) == 1 else pd.concat(
+            frames, ignore_index=True)
+
+    if len(files) >= size:
+        return load(files[rank::size]).reset_index(drop=True)
+    return load(files).iloc[rank::size].reset_index(drop=True)
 
 
 def xy_arrays(pdf, feature_cols: Sequence[str], label_cols: Sequence[str]):

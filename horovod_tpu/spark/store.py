@@ -48,6 +48,16 @@ class Store:
         """Ensure a directory exists (no-op on keyspace-only backends)."""
         raise NotImplementedError
 
+    def ls(self, path: str) -> list:
+        """Entries directly under ``path`` (full store paths, sorted);
+        ``[]`` for a missing directory. Used for shard discovery."""
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        """Remove a file; silently ignore a missing one. Used to clear
+        stale shards when a run_id is reused."""
+        raise NotImplementedError
+
     def read_text(self, path: str) -> str:
         return self.read(path).decode()
 
@@ -105,6 +115,17 @@ class LocalStore(Store):
 
     def makedirs(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
+
+    def ls(self, path: str) -> list:
+        if not os.path.isdir(path):
+            return []
+        return sorted(os.path.join(path, p) for p in os.listdir(path))
+
+    def delete(self, path: str) -> None:
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass
 
 
 class FilesystemStore(Store):
@@ -164,6 +185,18 @@ class FilesystemStore(Store):
             self._fs.makedirs(path, exist_ok=True)
         except NotImplementedError:
             pass  # keyspace-only backend (e.g. s3): directories are implied
+
+    def ls(self, path: str) -> list:
+        try:
+            return sorted(self._fs.ls(path, detail=False))
+        except FileNotFoundError:
+            return []
+
+    def delete(self, path: str) -> None:
+        try:
+            self._fs.rm(path)
+        except FileNotFoundError:
+            pass
 
 
 def checkpoint_handler(store: Store, run_id: str):

@@ -47,6 +47,17 @@ class TorchEstimator(HorovodEstimator):
         loss = loss_value if isinstance(loss_value, str) else None
         store.write(store.join(ckpt_dir, "loss.pkl"),
                     pickle.dumps(loss_value if loss is None else None))
+        # metrics: callables metric(pred, target) -> scalar, evaluated per
+        # epoch on the worker's shard and rank-averaged (reference:
+        # spark/torch/estimator.py metrics param + remote.py aggregation).
+        # cloudpickle serializes them BY VALUE, so user-module / notebook
+        # functions survive the trip to worker processes.
+        try:
+            import cloudpickle as metrics_pickler
+        except ImportError:
+            metrics_pickler = pickle
+        store.write(store.join(ckpt_dir, "metrics.pkl"),
+                    metrics_pickler.dumps(list(self._metrics or [])))
         store.write(store.join(ckpt_dir, "train_spec.json"), json.dumps(
             dict(optimizer=self._optimizer or "SGD",
                  learning_rate=self._learning_rate,
@@ -75,6 +86,8 @@ class TorchEstimator(HorovodEstimator):
             else:
                 loss_fn = pickle.loads(store.read(
                     store.join(ckpt_dir, "loss.pkl")))
+            metric_fns = pickle.loads(store.read(
+                store.join(ckpt_dir, "metrics.pkl")))
             opt_cls = getattr(torch.optim, spec["optimizer"])
             opt = thvd.DistributedOptimizer(
                 opt_cls(model.parameters(),
@@ -93,8 +106,13 @@ class TorchEstimator(HorovodEstimator):
                                    spec["feature_cols"],
                                    spec["label_cols"])
                 val = (torch.from_numpy(vX), torch.from_numpy(vY))
+            def metric_name(i, fn):
+                return getattr(fn, "__name__", None) or f"metric_{i}"
+
             bs = spec["batch_size"]
             history = {"loss": []}
+            for i, fn in enumerate(metric_fns):
+                history[metric_name(i, fn)] = []
             if val is not None:
                 history["val_loss"] = []
             for epoch in range(spec["epochs"]):
@@ -106,13 +124,26 @@ class TorchEstimator(HorovodEstimator):
                     loss.backward()
                     opt.step()
                     losses.append(float(loss.detach()))
-                mean = float(np.mean(losses)) if losses else float("nan")
-                # epoch metric averaged across workers (reference:
-                # remote.py metric aggregation)
-                mean = float(np.asarray(thvd.allreduce(
-                    torch.tensor([mean]), op=thvd.Average,
-                    name=f"ep.{epoch}"))[0])
+                # epoch loss averaged across workers, WEIGHTED by batch
+                # count, so an unequal (or empty) shard can't poison the
+                # mean with a NaN (reference: remote.py metric
+                # aggregation)
+                sums = np.asarray(thvd.allreduce(
+                    torch.tensor([float(np.sum(losses)),
+                                  float(len(losses))]),
+                    op=thvd.Sum, name=f"ep.{epoch}"))
+                mean = float(sums[0] / sums[1]) if sums[1] else 0.0
                 history["loss"].append(mean)
+                if metric_fns:
+                    model.eval()
+                    with torch.no_grad():
+                        pred = model(X_t)
+                    for i, fn in enumerate(metric_fns):
+                        m = float(fn(pred, Y_t))
+                        m = float(np.asarray(thvd.allreduce(
+                            torch.tensor([m]), op=thvd.Average,
+                            name=f"ep.{epoch}.m{i}"))[0])
+                        history[metric_name(i, fn)].append(m)
                 if val is not None:
                     model.eval()
                     with torch.no_grad():

@@ -168,6 +168,120 @@ class _FakeBarrierRDD:
         return results
 
 
+class Row:
+    """Minimal pyspark.sql.Row: attribute access + ``asDict()``."""
+
+    def __init__(self, **kwargs):
+        self.__dict__["_fields"] = dict(kwargs)
+
+    def asDict(self):
+        return dict(self._fields)
+
+    def __getattr__(self, item):
+        try:
+            return self.__dict__["_fields"][item]
+        except KeyError:
+            raise AttributeError(item) from None
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}={v!r}" for k, v in self._fields.items())
+        return f"Row({inner})"
+
+
+class _FakeDataFrame:
+    """Partitioned DataFrame stand-in: rows live in ``n`` contiguous
+    partitions; ``rdd.mapPartitionsWithIndex`` ships the function to one
+    SUBPROCESS PER PARTITION (cloudpickle, like a Spark executor) and
+    ``collect`` returns only what the function yields — so estimator
+    code that materializes data through executors is exercised for real,
+    and a ``toPandas()`` regression (driver collect) is observable via
+    ``toPandas_calls``."""
+
+    def __init__(self, pdf, n_partitions: int = 2):
+        self._pdf = pdf.reset_index(drop=True)
+        self._n = n_partitions
+        self.toPandas_calls = 0
+
+    def repartition(self, n: int) -> "_FakeDataFrame":
+        return _FakeDataFrame(self._pdf, n)
+
+    @property
+    def rdd(self):
+        return _FakeDataFrameRDD(self._pdf, self._n)
+
+    def toPandas(self):
+        self.toPandas_calls += 1
+        return self._pdf.copy()
+
+
+class _FakeDataFrameRDD:
+    def __init__(self, pdf, n: int):
+        self._pdf, self._n = pdf, n
+
+    def getNumPartitions(self) -> int:
+        return self._n
+
+    def mapPartitionsWithIndex(self, fn):
+        return _FakeDataFrameJob(self._pdf, self._n, fn)
+
+
+class _FakeDataFrameJob:
+    def collect(self):
+        import cloudpickle
+        import numpy as np
+
+        tmp = tempfile.mkdtemp(prefix="fake_spark_df_")
+        try:
+            bounds = np.array_split(np.arange(len(self._pdf)), self._n)
+            payloads = []
+            for idx, rows_idx in enumerate(bounds):
+                rows = [Row(**rec) for rec in self._pdf.iloc[rows_idx]
+                        .to_dict(orient="records")]
+                path = os.path.join(tmp, f"task_{idx}.pkl")
+                with open(path, "wb") as f:
+                    cloudpickle.dump((self._fn, idx, rows), f)
+                payloads.append((idx, path))
+
+            procs = []
+            for idx, path in payloads:
+                env = dict(os.environ)
+                env["PYTHONPATH"] = os.pathsep.join(
+                    [os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__)))] +
+                    [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                     if p])
+                out_path = os.path.join(tmp, f"out_{idx}.pkl")
+                procs.append((idx, out_path, subprocess.Popen(
+                    [sys.executable, "-c",
+                     "import sys\n"
+                     "import cloudpickle\n"
+                     "task_path, out_path = sys.argv[1:3]\n"
+                     "with open(task_path, 'rb') as f:\n"
+                     "    fn, idx, rows = cloudpickle.load(f)\n"
+                     "result = list(fn(idx, iter(rows)))\n"
+                     "with open(out_path, 'wb') as f:\n"
+                     "    cloudpickle.dump(result, f)\n",
+                     path, out_path],
+                    env=env)))
+            results = []
+            failed = []
+            for idx, out_path, p in procs:
+                rc = p.wait(timeout=120)
+                if rc != 0:
+                    failed.append((idx, rc))
+                    continue
+                with open(out_path, "rb") as f:
+                    results.extend(cloudpickle.load(f))
+            if failed:
+                raise RuntimeError(f"fake spark df tasks failed: {failed}")
+            return results
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def __init__(self, pdf, n: int, fn):
+        self._pdf, self._n, self._fn = pdf, n, fn
+
+
 class _FakeSparkContext:
     defaultParallelism = 2
 

@@ -2,11 +2,16 @@
 
 from __future__ import annotations
 
-from pyspark import _FakeSparkContext
+from pyspark import Row, _FakeDataFrame, _FakeSparkContext
+
+__all__ = ["Row", "SparkSession"]
 
 
 class _Session:
     sparkContext = _FakeSparkContext()
+
+    def createDataFrame(self, pdf, n_partitions: int = 2):
+        return _FakeDataFrame(pdf, n_partitions)
 
 
 class _Builder:

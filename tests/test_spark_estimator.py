@@ -1,8 +1,14 @@
 """Spark estimator API tests (reference analog: test/integration/
 test_spark.py estimator tests). pyspark is not in this image, so the
-DataFrame boundary is exercised with pandas (the estimators duck-type
-``toPandas``) and training runs under the local launcher — the same code
-path a Spark cluster takes after the barrier-job handshake."""
+DataFrame boundary is exercised two ways: pandas directly (the
+estimators duck-type ``toPandas``) and ``tests/fake_pyspark``'s
+partitioned DataFrame whose ``rdd.mapPartitionsWithIndex`` runs one
+subprocess per partition like a Spark executor; training runs under the
+local launcher — the same code path a Spark cluster takes after the
+barrier-job handshake."""
+
+import os
+import sys
 
 import numpy as np
 import pandas as pd
@@ -14,6 +20,19 @@ from horovod_tpu.spark import (HorovodEstimator, KerasEstimator, LocalStore,
 
 needs_core = pytest.mark.skipif(not core_available(),
                                 reason="libhvdcore.so not built")
+
+FAKE_PYSPARK = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "fake_pyspark")
+
+
+@pytest.fixture
+def fake_pyspark(monkeypatch):
+    monkeypatch.syspath_prepend(FAKE_PYSPARK)
+    for mod in [m for m in sys.modules if m.split(".")[0] == "pyspark"]:
+        monkeypatch.delitem(sys.modules, mod, raising=False)
+    yield
+    for mod in [m for m in sys.modules if m.split(".")[0] == "pyspark"]:
+        sys.modules.pop(mod, None)
 
 
 def _regression_df(n=80, d=4, seed=0):
@@ -53,6 +72,30 @@ def test_torch_estimator_fit_transform(tmp_path):
     err = np.mean((out["y__output"].to_numpy()
                    - out["y"].to_numpy()) ** 2)
     assert err < 0.5
+
+
+@needs_core
+def test_torch_estimator_metrics_param(tmp_path):
+    """The metrics param rides to the workers (cloudpickled BY VALUE, as
+    a user's notebook-defined metric would) and produces per-epoch,
+    rank-averaged history entries under the callable's __name__
+    (reference: torch estimator metrics param)."""
+    torch = pytest.importorskip("torch")
+
+    def mae(pred, target):
+        import torch
+        return torch.mean(torch.abs(pred - target))
+
+    df = _regression_df()
+    est = TorchEstimator(
+        model=torch.nn.Linear(4, 1), optimizer="SGD", loss="MSELoss",
+        feature_cols=[f"x{i}" for i in range(4)], label_cols=["y"],
+        store=LocalStore(str(tmp_path)), num_proc=2, epochs=4,
+        batch_size=16, learning_rate=0.05, verbose=0, metrics=[mae])
+    trained = est.fit(df)
+    assert len(trained.history["mae"]) == 4
+    assert trained.history["mae"][-1] < trained.history["mae"][0]
+    assert all(np.isfinite(v) for v in trained.history["mae"])
 
 
 class _EpochStamp:
@@ -150,6 +193,96 @@ def test_torch_estimator_over_nonlocal_store(tmp_path):
             / "final.pkl").exists()
     assert (root / "artifacts" / f"intermediate_train_data.{run_id}"
             / "data.parquet").exists()
+
+
+@needs_core
+def test_estimator_distributed_materialization(fake_pyspark, tmp_path):
+    """A partitioned (fake-)Spark DataFrame is materialized by the
+    EXECUTORS — one parquet shard per partition written through the
+    pickled Store by subprocess tasks — and the dataset never moves
+    through the driver (``toPandas`` is never called). Validation split
+    and shuffle happen per partition; workers read disjoint shard sets
+    by rank (reference: ``spark/common/util.py`` distributed prepare)."""
+    torch = pytest.importorskip("torch")
+    from pyspark.sql import SparkSession
+
+    df_pandas = _regression_df(n=80)
+    spark = SparkSession.builder.getOrCreate()
+    sdf = spark.createDataFrame(df_pandas).repartition(4)
+
+    est = TorchEstimator(
+        model=torch.nn.Linear(4, 1), optimizer="SGD", loss="MSELoss",
+        feature_cols=[f"x{i}" for i in range(4)], label_cols=["y"],
+        store=LocalStore(str(tmp_path)), num_proc=2, epochs=8,
+        batch_size=16, learning_rate=0.05, validation=0.25, verbose=0)
+    trained = est.fit(sdf)
+
+    assert sdf.toPandas_calls == 0  # the driver never collected the data
+    run_id = est.getRunId()
+    store = est.getStore()
+    train_files = store.ls(store.get_train_data_path(run_id))
+    val_files = store.ls(store.get_val_data_path(run_id))
+    assert len([p for p in train_files if p.endswith(".parquet")]) == 4
+    assert len([p for p in val_files if p.endswith(".parquet")]) == 4
+    # split sizes: 25% of each 20-row partition -> 15 train / 5 val each
+    import pandas as pd2
+    n_train = sum(len(pd2.read_parquet(p)) for p in train_files)
+    n_val = sum(len(pd2.read_parquet(p)) for p in val_files)
+    assert (n_train, n_val) == (60, 20)
+    assert trained.history["loss"][-1] < trained.history["loss"][0] * 0.2
+    out = trained.transform(df_pandas.head(10))
+    assert "y__output" in out.columns
+
+
+@needs_core
+def test_run_id_reuse_clears_stale_shards(fake_pyspark, tmp_path):
+    """Refitting with the SAME run_id must not mix the previous fit's
+    shards into the new dataset: fit clears the data dirs first (the
+    shard glob in read_shard would otherwise pick up leftovers from a
+    different partition count or the single-parquet pandas path)."""
+    torch = pytest.importorskip("torch")
+    from pyspark.sql import SparkSession
+
+    spark = SparkSession.builder.getOrCreate()
+    est = TorchEstimator(
+        model=torch.nn.Linear(4, 1), optimizer="SGD", loss="MSELoss",
+        feature_cols=[f"x{i}" for i in range(4)], label_cols=["y"],
+        store=LocalStore(str(tmp_path)), num_proc=2, epochs=1,
+        batch_size=16, learning_rate=0.05, verbose=0, run_id="fixed")
+    est.fit(spark.createDataFrame(_regression_df(n=80)).repartition(8))
+    store = est.getStore()
+    train_dir = store.get_train_data_path("fixed")
+    assert len(store.ls(train_dir)) == 8
+    est.fit(spark.createDataFrame(_regression_df(n=40)).repartition(2))
+    files = store.ls(train_dir)
+    assert len(files) == 2  # no part-00002..7 leftovers
+    import pandas as pd2
+    assert sum(len(pd2.read_parquet(p)) for p in files) == 40
+
+
+def test_read_shard_file_level_assignment(tmp_path):
+    """With >= size part files, ranks read DISJOINT file sets; the union
+    covers every row exactly once."""
+    from horovod_tpu.spark.estimator import read_shard
+    store = LocalStore(str(tmp_path))
+    path = store.join(str(tmp_path), "shards")
+    store.makedirs(path)
+    all_ids = []
+    for i in range(5):
+        pdf = pd.DataFrame({"id": np.arange(i * 10, i * 10 + 10)})
+        import io
+        buf = io.BytesIO()
+        pdf.to_parquet(buf)
+        store.write(store.join(path, f"part-{i:05d}.parquet"),
+                    buf.getvalue())
+        all_ids.extend(pdf["id"].tolist())
+    shard0 = read_shard(store, path, 0, 2)
+    shard1 = read_shard(store, path, 1, 2)
+    got = sorted(shard0["id"].tolist() + shard1["id"].tolist())
+    assert got == sorted(all_ids)
+    assert set(shard0["id"]).isdisjoint(set(shard1["id"]))
+    # files 0,2,4 -> rank 0 (30 rows); files 1,3 -> rank 1 (20 rows)
+    assert (len(shard0), len(shard1)) == (30, 20)
 
 
 def test_estimator_single_proc_no_core(tmp_path):
