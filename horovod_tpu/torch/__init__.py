@@ -10,7 +10,8 @@ gradient-hook DistributedOptimizer, and parameter/optimizer broadcast.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterator, Optional, Sequence, Tuple
+import os
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -331,10 +332,22 @@ def allgather_object(obj, name: Optional[str] = None):
 
 class _DistributedOptimizer:
     """Wraps a torch optimizer: allreduce gradients before each step
-    (reference: ``_DistributedOptimizer``, ``torch/optimizer.py:35-333``;
-    hook-free variant — gradients are reduced in ``step`` as one grouped
-    (fused) submission, which the core fuses exactly like the reference's
-    per-hook enqueues land in one fusion buffer)."""
+    (reference: ``_DistributedOptimizer``, ``torch/optimizer.py:35-333``).
+
+    HOOK MODE (default, needs torch >= 2.1): a post-accumulate-grad hook
+    on every parameter enqueues its allreduce ASYNCHRONOUSLY the moment
+    its gradient is final during ``.backward()`` — communication overlaps
+    the rest of the backward pass, exactly the reference's
+    grad-accumulator-hook design (``torch/optimizer.py:128-171``); the
+    core's fusion buffer still coalesces the in-flight ops.
+    ``synchronize()`` drains the handles. With
+    ``backward_passes_per_step = k``, a parameter's hook counts down and
+    enqueues on its k-th backward pass.
+
+    FALLBACK (``HVD_TORCH_HOOKS=0``, older torch, or params without
+    hooks): gradients are submitted in ``synchronize`` — same per-tensor
+    names as the hooks would use (so mixed-mode ranks still negotiate),
+    coalesced by the core's fusion buffer into one fused collective."""
 
     def __init__(self, optimizer, named_parameters=None,
                  compression=Compression.none,
@@ -351,6 +364,12 @@ class _DistributedOptimizer:
             self._names = {id(p): n for n, p in named_parameters}
         else:
             self._names = {}
+        self._handles: Dict[int, tuple] = {}   # id(p) -> (p, handle, ctx)
+        self._delay: Dict[int, int] = {}
+        self._hook_handles: List[Any] = []
+        self._use_hooks = (
+            os.environ.get("HVD_TORCH_HOOKS", "1") != "0"
+            and self._register_hooks())
 
     def __getattr__(self, item):
         return getattr(self._opt, item)
@@ -358,37 +377,95 @@ class _DistributedOptimizer:
     def _param_name(self, p, i: int, j: int) -> str:
         return self._names.get(id(p), f"grad.{i}.{j}")
 
+    # -- hook plumbing ------------------------------------------------------
+    def _register_hooks(self) -> bool:
+        hooks = []
+        for i, group in enumerate(self._opt.param_groups):
+            for j, p in enumerate(group["params"]):
+                if not p.requires_grad:
+                    continue
+                if not hasattr(p, "register_post_accumulate_grad_hook"):
+                    for h in hooks:
+                        h.remove()
+                    return False  # torch < 2.1: fall back everywhere
+                self._delay[id(p)] = self.backward_passes_per_step
+                hooks.append(p.register_post_accumulate_grad_hook(
+                    self._make_hook(i, j)))
+        self._hook_handles = hooks
+        return True
+
+    def _make_hook(self, i: int, j: int):
+        def hook(p):
+            if self._delay[id(p)] <= 0:
+                # reference raises the same way (optimizer.py:209-213):
+                # a k+1-th backward would re-enqueue the tensor name
+                # while the k-th op may still be in flight
+                raise ValueError(
+                    "Gradients were computed more than "
+                    "backward_passes_per_step times before call to "
+                    "step(). Increase backward_passes_per_step or call "
+                    "synchronize() between backward passes.")
+            self._delay[id(p)] -= 1
+            if self._delay[id(p)] == 0:
+                self._enqueue_async(p, i, j)
+        return hook
+
+    def _enqueue_async(self, p, i: int, j: int) -> None:
+        """Fire this parameter's allreduce while backward continues.
+
+        The submitted buffer is a PRIVATE COPY: the core reads it
+        asynchronously, and ``p.grad``'s own memory can be mutated
+        between backward and ``synchronize()`` (unscale, another
+        accumulation) — a zero-copy view would race with that read."""
+        if size() <= 1:
+            return  # synchronize() applies the 1/k scale locally
+        c, ctx = self._compression.compress(_to_np(p.grad))
+        h = _C.allreduce_async(
+            np.array(np.asarray(c), copy=True), average=None,
+            name="torchgrad." + self._param_name(p, i, j), op=self._op,
+            prescale_factor=1.0 / self.backward_passes_per_step,
+            process_set=self._process_set)
+        self._handles[id(p)] = (p, h, ctx)
+
     def synchronize(self) -> None:
-        """Allreduce all gradients now (reference: ``synchronize``,
-        ``optimizer.py:249-292``). With ``backward_passes_per_step = k``,
-        the accumulated gradients are additionally scaled by ``1/k`` (the
-        reference's TF aggregation helper divides the same way)."""
+        """Drain in-flight hook enqueues and reduce any remaining grads
+        (reference: ``synchronize``, ``optimizer.py:249-292``). With
+        ``backward_passes_per_step = k``, gradients are scaled by ``1/k``
+        (the reference's TF aggregation helper divides the same way)."""
         params, names = [], []
         for i, group in enumerate(self._opt.param_groups):
             for j, p in enumerate(group["params"]):
-                if p.grad is not None:
+                if p.grad is not None and id(p) not in self._handles:
                     params.append(p)
                     names.append(self._param_name(p, i, j))
-        if size() <= 1 or not params:
+        if size() <= 1:
             # keep the 1/k scale at EVERY world size so training dynamics
             # don't silently change between 1 and N processes
             if self.backward_passes_per_step > 1:
                 for p in params:
                     p.grad.div_(self.backward_passes_per_step)
-            self._synchronized = True
-            return
-        compressed, ctxs = [], []
-        for p in params:
-            c, ctx = self._compression.compress(_to_np(p.grad))
-            compressed.append(np.asarray(c))
-            ctxs.append(ctx)
-        outs = _C.grouped_allreduce(
-            compressed, op=self._op, name="torchgrad." + names[0],
-            prescale_factor=1.0 / self.backward_passes_per_step,
-            process_set=self._process_set)
-        for p, o, ctx in zip(params, outs, ctxs):
-            o = self._compression.decompress(np.asarray(o), ctx)
-            p.grad.copy_(_from_np(np.asarray(o), p.grad))
+        else:
+            # laggards (params whose hook never fired this cycle — unused
+            # in the graph, hook-free mode, or mid-accumulation) submit
+            # now with the SAME per-tensor names the hooks use, so a
+            # param reduced via hook on one rank and here on another
+            # still negotiates — and the core's fusion buffer coalesces
+            # same-cycle submissions into one fused collective anyway
+            late = []
+            for p, name in zip(params, names):
+                c, ctx = self._compression.compress(_to_np(p.grad))
+                h = _C.allreduce_async(
+                    np.array(np.asarray(c), copy=True), average=None,
+                    name="torchgrad." + name, op=self._op,
+                    prescale_factor=1.0 / self.backward_passes_per_step,
+                    process_set=self._process_set)
+                late.append((p, h, ctx))
+            for p, h, ctx in list(self._handles.values()) + late:
+                o = self._compression.decompress(np.asarray(h.wait()), ctx)
+                p.grad.copy_(_from_np(np.asarray(o), p.grad))
+        self._handles.clear()
+        for key in self._delay:
+            self._delay[key] = self.backward_passes_per_step
         self._synchronized = True
 
     def skip_synchronize(self):
@@ -408,14 +485,14 @@ class _DistributedOptimizer:
         """Synchronize (unless already done since the last step) and apply.
 
         One ``step()`` call ends a ``backward_passes_per_step``-backward
-        accumulation cycle: the reference counts *backward passes* via
-        autograd hooks and delays the allreduce until k have run; this
-        adapter has no hooks, so the k-th backward is recognized by the
-        user calling ``step()`` — the accumulated grads are synced (scaled
-        by 1/k) and the wrapped optimizer always steps. A manual
-        ``synchronize()`` (e.g. for gradient clipping) is NOT repeated here
-        — where the reference warns and re-syncs unless wrapped in
-        ``skip_synchronize()``, this adapter just skips the second sync."""
+        accumulation cycle. In hook mode each parameter's allreduce was
+        already enqueued during its k-th backward pass, so ``step()``
+        just drains the in-flight handles (plus any laggards) and applies
+        the update; in fallback mode all grads are submitted here. A
+        manual ``synchronize()`` (e.g. for gradient clipping) is NOT
+        repeated — where the reference warns and re-syncs unless wrapped
+        in ``skip_synchronize()``, this adapter just skips the second
+        sync."""
         if not self._synchronized:
             self.synchronize()
         self._synchronized = False

@@ -105,6 +105,77 @@ def main():
     for a, b in zip(model.parameters(), ref.parameters()):
         assert torch.allclose(a, b, atol=1e-5), (a, b)
 
+    # hook mode: each param's allreduce is enqueued DURING .backward()
+    # (post-accumulate-grad hook), so handles are already in flight when
+    # backward returns; step() drains them (reference: grad-accumulator
+    # hooks, torch/optimizer.py:128-171)
+    hX = torch.from_numpy(rng.randn(8, 4).astype(np.float32))
+    hY = torch.from_numpy(rng.randn(8, 1).astype(np.float32))
+    hmodel = hvd.broadcast_object(torch.nn.Linear(4, 1), 0, name="hm")
+    hopt = hvd.DistributedOptimizer(
+        torch.optim.SGD(hmodel.parameters(), lr=0.1),
+        named_parameters=hmodel.named_parameters())
+    assert hopt._use_hooks
+    hopt.zero_grad()
+    torch.nn.functional.mse_loss(hmodel(hX), hY).backward()
+    if size > 1:
+        assert len(hopt._handles) == 2, hopt._handles  # weight + bias
+    hopt.step()
+    assert not hopt._handles
+
+    # backward_passes_per_step=2 under hooks: the first backward only
+    # counts down; the SECOND enqueues — and the result equals one
+    # full-batch step on the summed gradient scaled by 1/2
+    amodel = hvd.broadcast_object(torch.nn.Linear(4, 1), 0, name="am")
+    aref = torch.nn.Linear(4, 1)
+    aref.load_state_dict(amodel.state_dict())
+    aopt = hvd.DistributedOptimizer(
+        torch.optim.SGD(amodel.parameters(), lr=0.1),
+        named_parameters=amodel.named_parameters(),
+        backward_passes_per_step=2)
+    aopt.zero_grad()
+    torch.nn.functional.mse_loss(amodel(hX[:4]), hY[:4]).backward()
+    assert not aopt._handles  # countdown, nothing in flight yet
+    torch.nn.functional.mse_loss(amodel(hX[4:]), hY[4:]).backward()
+    if size > 1:
+        assert len(aopt._handles) == 2
+    aopt.step()
+    aref_opt = torch.optim.SGD(aref.parameters(), lr=0.1)
+    torch.nn.functional.mse_loss(aref(hX[:4]), hY[:4]).backward()
+    torch.nn.functional.mse_loss(aref(hX[4:]), hY[4:]).backward()
+    for p in aref.parameters():
+        p.grad.div_(2.0)  # same shard on every rank -> avg == local
+    aref_opt.step()
+    for a, b in zip(amodel.parameters(), aref.parameters()):
+        assert torch.allclose(a, b, atol=1e-5), (a, b)
+
+    # more backwards than backward_passes_per_step raises like the
+    # reference (a re-enqueue would collide with the in-flight op)
+    aopt.zero_grad()
+    torch.nn.functional.mse_loss(amodel(hX[:4]), hY[:4]).backward()
+    torch.nn.functional.mse_loss(amodel(hX[4:]), hY[4:]).backward()
+    try:
+        torch.nn.functional.mse_loss(amodel(hX[:4]), hY[:4]).backward()
+        raise AssertionError("expected over-backward error")
+    except (ValueError, RuntimeError) as e:
+        assert "backward_passes_per_step" in str(e), e
+    aopt.synchronize()  # drain the legal in-flight enqueues
+
+    # fallback (HVD_TORCH_HOOKS=0): per-tensor sync in step(), same numerics
+    os.environ["HVD_TORCH_HOOKS"] = "0"
+    try:
+        fmodel = hvd.broadcast_object(torch.nn.Linear(4, 1), 0, name="fm")
+        fopt = hvd.DistributedOptimizer(
+            torch.optim.SGD(fmodel.parameters(), lr=0.1),
+            named_parameters=fmodel.named_parameters())
+        assert not fopt._use_hooks
+        fopt.zero_grad()
+        torch.nn.functional.mse_loss(fmodel(hX), hY).backward()
+        assert not fopt._handles  # nothing enqueued during backward
+        fopt.step()
+    finally:
+        del os.environ["HVD_TORCH_HOOKS"]
+
     # SyncBatchNorm: sharded batch must match plain BN on the full batch
     # for output, input grad, affine grads (after averaging), and running
     # stats (reference: torch/sync_batch_norm.py numerics)
