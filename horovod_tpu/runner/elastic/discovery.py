@@ -23,10 +23,13 @@ class HostDiscovery:
 
 class HostDiscoveryScript(HostDiscovery):
     """Runs the user script; stdout lines ``hostname:slots`` (reference:
-    ``HostDiscoveryScript.find_available_hosts_and_slots``)."""
+    ``HostDiscoveryScript.find_available_hosts_and_slots``). Lines
+    without an explicit ``:slots`` get ``default_slots`` (the launcher's
+    ``--slots-per-host``)."""
 
-    def __init__(self, script_path: str) -> None:
+    def __init__(self, script_path: str, default_slots: int = 1) -> None:
         self._script = script_path
+        self._default_slots = default_slots
 
     def find_available_hosts_and_slots(self) -> Dict[str, int]:
         out = subprocess.run([self._script], capture_output=True,
@@ -40,7 +43,7 @@ class HostDiscoveryScript(HostDiscovery):
                 host, slots = line.rsplit(":", 1)
                 hosts[host] = int(slots)
             else:
-                hosts[line] = 1
+                hosts[line] = self._default_slots
         return hosts
 
 

@@ -52,7 +52,9 @@ class ElasticDriver:
                  target_np: Optional[int] = None,
                  remote_exec=None,
                  world_secret: Optional[bytes] = None,
-                 timestamp_output: bool = False) -> None:
+                 timestamp_output: bool = False,
+                 start_timeout: Optional[float] = None,
+                 elastic_timeout: Optional[float] = None) -> None:
         # remote_exec(slot, command, worker_env, events) -> rc replaces the
         # local/ssh exec when the cluster reaches hosts another way — e.g.
         # Spark tasks acting as host agents (spark/elastic.py). The
@@ -72,6 +74,13 @@ class ElasticDriver:
         self._registry = WorkerStateRegistry(reset_limit)
         self._verbose = verbose
         self._ckpt_dir = ckpt_dir or tempfile.mkdtemp(prefix="hvd_elastic_")
+        # reference: --start-timeout bounds the initial min-host wait,
+        # --elastic-timeout the re-scale waits after a generation ends
+        # (an explicit 0 means "fail fast", so only None gets the default)
+        self._start_timeout = 600.0 if start_timeout is None \
+            else start_timeout
+        self._elastic_timeout = 600.0 if elastic_timeout is None \
+            else elastic_timeout
         self._stop = threading.Event()
         self._hosts_changed = threading.Event()
         self._generation = 0
@@ -346,7 +355,7 @@ class ElasticDriver:
 
     # -- main loop -----------------------------------------------------------
     def run(self) -> int:
-        self._wait_for_min_hosts()
+        self._wait_for_min_hosts(timeout=self._start_timeout)
         disc = threading.Thread(target=self._discovery_loop, daemon=True)
         disc.start()
         try:
@@ -361,7 +370,7 @@ class ElasticDriver:
                     return 1
                 # wait until we have enough usable slots again
                 try:
-                    self._wait_for_min_hosts()
+                    self._wait_for_min_hosts(timeout=self._elastic_timeout)
                 except TimeoutError:
                     return 1
         finally:
@@ -376,8 +385,12 @@ def run_elastic(discovery: HostDiscovery, np: Optional[int],
                 env: Optional[Dict[str, str]] = None,
                 verbose: bool = False,
                 reset_limit: Optional[int] = None,
-                timestamp_output: bool = False) -> int:
+                timestamp_output: bool = False,
+                start_timeout: Optional[float] = None,
+                elastic_timeout: Optional[float] = None) -> int:
     driver = ElasticDriver(discovery, command, min_np=min_np, max_np=max_np,
                            env=env, verbose=verbose, reset_limit=reset_limit,
-                           target_np=np, timestamp_output=timestamp_output)
+                           target_np=np, timestamp_output=timestamp_output,
+                           start_timeout=start_timeout,
+                           elastic_timeout=elastic_timeout)
     return driver.run()

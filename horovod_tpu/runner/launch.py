@@ -41,6 +41,22 @@ def parse_args(argv: List[str]) -> argparse.Namespace:
                         "environment detection); with --min-np, elastic "
                         "discovery re-reads the slice each refresh")
     p.add_argument("--verbose", action="store_true")
+    p.add_argument("--config-file", default=None,
+                   help="YAML file with the reference's config schema "
+                        "(params/autotune/timeline/stall_check/"
+                        "library_options/logging sections); explicit CLI "
+                        "flags override it (reference: config_parser.py)")
+    p.add_argument("--start-timeout", type=float, default=None,
+                   help="seconds for all workers to start and connect "
+                        "(static: mesh-connect deadline; elastic: initial "
+                        "min-host wait; reference flag of the same name)")
+    p.add_argument("--elastic-timeout", type=float, default=None,
+                   help="seconds to re-reach min-np slots after a world "
+                        "change (reference flag of the same name)")
+    p.add_argument("-s", "--slots", "--slots-per-host",
+                   dest="slots_per_host", type=int, default=None,
+                   help="default slots for discovered hosts that do not "
+                        "state their own ':slots' (reference: --slots)")
     # elastic (reference: --min-np/--max-np/--host-discovery-script)
     p.add_argument("--min-np", type=int, default=None)
     p.add_argument("--max-np", type=int, default=None)
@@ -107,9 +123,144 @@ def parse_args(argv: List[str]) -> argparse.Namespace:
                              args.host_discovery_script)) > 1:
         p.error("specify only one of -H/--hosts, --hostfile, --tpu, "
                 "--host-discovery-script")
+    # launcher flags end where the user command begins: the probe below
+    # must never see the command's own options
+    launcher_argv = list(argv)[:len(argv) - len(args.command)]
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
+    if args.config_file:
+        apply_config_file(args, _explicit_flags(p, launcher_argv), p)
+    validate_config_args(args)
     return args
+
+
+def _explicit_flags(parser: argparse.ArgumentParser,
+                    launcher_argv: List[str]) -> set:
+    """Dests the user actually passed on the CLI (these override the
+    config file, reference: ``config_parser``'s override_args). A probe
+    parser with ``SUPPRESS`` defaults leaves only explicitly-given
+    attributes on its namespace; abbreviation rules match the main
+    parser so an abbreviated flag still counts as explicit."""
+    probe = argparse.ArgumentParser(add_help=False)
+    for a in parser._actions:
+        if not a.option_strings or isinstance(
+                a, (argparse._HelpAction, argparse._VersionAction)):
+            continue
+        kwargs = {"dest": a.dest, "default": argparse.SUPPRESS}
+        if a.nargs == 0:  # store_true-style flags take no value
+            kwargs["action"] = "store_true"
+        probe.add_argument(*a.option_strings, **kwargs)
+    ns, _ = probe.parse_known_args(launcher_argv)
+    return set(vars(ns).keys())
+
+
+# YAML section -> {config key -> args attribute}; keys accept both
+# hyphen and underscore spelling. Schema mirrors the reference's
+# (``config_parser.set_args_from_config``) with this launcher's arg names.
+_CONFIG_SECTIONS = {
+    "params": {
+        "fusion_threshold_mb": "fusion_threshold_mb",
+        "cycle_time_ms": "cycle_time_ms",
+        "cache_capacity": "cache_capacity",
+        "hierarchical_allreduce": "hierarchical_allreduce",
+        "hierarchical_allgather": "hierarchical_allgather",
+    },
+    "autotune": {
+        "enabled": "autotune",
+        "log_file": "autotune_log_file",
+        "warmup_samples": "autotune_warmup_samples",
+        "steps_per_sample": "autotune_steps_per_sample",
+        "bayes_opt_max_samples": "autotune_bayes_opt_max_samples",
+        "gaussian_process_noise": "autotune_gaussian_process_noise",
+    },
+    "timeline": {
+        "filename": "timeline_filename",
+        "mark_cycles": "timeline_mark_cycles",
+    },
+    "stall_check": {
+        # "enabled" inverts onto no_stall_check below
+        "warning_time_seconds": "stall_warning_timeout_seconds",
+        "shutdown_time_seconds": "stall_shutdown_timeout_seconds",
+    },
+    "library_options": {
+        "thread_affinity": "thread_affinity",
+        "gloo_timeout_seconds": "gloo_timeout_seconds",
+    },
+    "logging": {
+        "level": "log_level",
+        "hide_timestamp": "log_hide_timestamp",
+    },
+    "": {  # top-level keys
+        "verbose": "verbose",
+        "start_timeout": "start_timeout",
+        "elastic_timeout": "elastic_timeout",
+        "slots": "slots_per_host",
+    },
+}
+
+
+def apply_config_file(args: argparse.Namespace, explicit: set,
+                      parser: argparse.ArgumentParser) -> None:
+    """Fill non-explicit args from the YAML config (reference:
+    ``config_parser.set_args_from_config``). Values are coerced through
+    the flag's own argparse type so ``start-timeout: '120'`` (a quoted
+    number) behaves like the CLI flag would."""
+    import yaml
+
+    with open(args.config_file) as f:
+        config = yaml.safe_load(f) or {}
+
+    types = {a.dest: (bool if a.nargs == 0 else a.type)
+             for a in parser._actions if a.option_strings}
+
+    def norm(d):
+        return {str(k).replace("-", "_"): v for k, v in d.items()} \
+            if isinstance(d, dict) else {}
+
+    config = norm(config)
+    for section, mapping in _CONFIG_SECTIONS.items():
+        values = config if section == "" else norm(config.get(section))
+        for key, dest in mapping.items():
+            if dest in explicit:
+                continue
+            v = values.get(key)
+            if v is not None:
+                coerce = types.get(dest)
+                if coerce is not None:
+                    try:
+                        v = coerce(v)
+                    except (TypeError, ValueError) as e:
+                        raise ValueError(
+                            f"config file {args.config_file}: key "
+                            f"{key!r} = {v!r} is not a valid "
+                            f"{getattr(coerce, '__name__', coerce)}") \
+                            from e
+                setattr(args, dest, v)
+    stall = norm(config.get("stall_check"))
+    if "enabled" in stall and "no_stall_check" not in explicit:
+        args.no_stall_check = not stall["enabled"]
+
+
+def validate_config_args(args: argparse.Namespace) -> None:
+    """Reject negatives the env parser would otherwise carry through
+    (reference: ``config_parser.validate_config_args``)."""
+    for name in ("fusion_threshold_mb", "cycle_time_ms", "cache_capacity",
+                 "autotune_warmup_samples", "autotune_steps_per_sample",
+                 "autotune_bayes_opt_max_samples",
+                 "stall_warning_timeout_seconds",
+                 "stall_shutdown_timeout_seconds", "thread_affinity",
+                 "gloo_timeout_seconds", "start_timeout",
+                 "elastic_timeout"):
+        v = getattr(args, name, None)
+        if v is not None and v < 0:
+            raise ValueError(f"{name}={v} must be >= 0")
+    slots = getattr(args, "slots_per_host", None)
+    if slots is not None and slots < 1:
+        raise ValueError(f"slots_per_host={slots} must be >= 1")
+    noise = getattr(args, "autotune_gaussian_process_noise", None)
+    if noise is not None and not (0 <= noise <= 1):
+        raise ValueError(
+            f"autotune_gaussian_process_noise={noise} must be in [0, 1]")
 
 
 def knobs_to_env(args: argparse.Namespace) -> Dict[str, str]:
@@ -219,7 +370,10 @@ def run_commandline(argv: List[str] = None) -> int:
         from horovod_tpu.runner.elastic.discovery import (
             FixedHosts, HostDiscoveryScript)
         if args.host_discovery_script:
-            discovery = HostDiscoveryScript(args.host_discovery_script)
+            discovery = HostDiscoveryScript(
+                args.host_discovery_script,
+                default_slots=1 if args.slots_per_host is None
+                else args.slots_per_host)
         elif args.tpu:
             from horovod_tpu.runner.tpu_discovery import TpuPodDiscovery
             discovery = TpuPodDiscovery()
@@ -229,8 +383,18 @@ def run_commandline(argv: List[str] = None) -> int:
             discovery, args.num_proc, args.command,
             min_np=args.min_np or 1, max_np=args.max_np,
             env=env, verbose=args.verbose, reset_limit=args.reset_limit,
-            timestamp_output=args.prefix_output_with_timestamp)
+            timestamp_output=args.prefix_output_with_timestamp,
+            start_timeout=args.start_timeout,
+            elastic_timeout=args.elastic_timeout)
 
+    if args.start_timeout is not None:
+        # STATIC path only (elastic generations use --elastic-timeout for
+        # re-scale waits — a short start deadline must not bound their
+        # mesh reconnects): every worker must reach the coordinator mesh
+        # inside this window. An explicit --gloo-timeout-seconds wins —
+        # knobs_to_env already set it above.
+        env.setdefault("HOROVOD_GLOO_TIMEOUT_SECONDS",
+                       str(args.start_timeout))
     hosts = resolve_hosts(args)
     np = args.num_proc or sum(h.slots for h in hosts)
     nics = [n.strip() for n in args.nics.split(",") if n.strip()] \

@@ -67,6 +67,155 @@ def test_parse_args_and_knobs():
         ("localhost", 4)]
 
 
+def test_config_file_yaml_to_env_to_cpp_parser(tmp_path):
+    """Full round trip: YAML --config-file -> parsed args (CLI flags
+    override) -> worker env -> the REAL C++ env parser (hvd_cfg_dump,
+    capi.cc) reports the same values (reference: config_parser.py YAML
+    schema + set_env_from_args)."""
+    cfg = tmp_path / "hvd.yaml"
+    cfg.write_text(textwrap.dedent("""\
+        verbose: true
+        start-timeout: 120
+        elastic-timeout: 300
+        slots: 4
+        params:
+          fusion-threshold-mb: 32
+          cycle-time-ms: 2.5
+          cache-capacity: 512
+          hierarchical-allreduce: true
+        autotune:
+          enabled: true
+          warmup_samples: 7
+          gaussian-process-noise: 0.5
+        timeline:
+          filename: /tmp/tl.json
+          mark-cycles: true
+        stall_check:
+          enabled: true
+          warning_time_seconds: 33
+        library_options:
+          thread-affinity: 1
+          gloo-timeout-seconds: 77
+        logging:
+          level: DEBUG
+          hide-timestamp: true
+        """))
+    # CLI gives cycle-time 9.0 explicitly: it must beat the config's 2.5
+    args = parse_args(["-np", "2", "--config-file", str(cfg),
+                       "--cycle-time-ms", "9.0", "python", "t.py"])
+    assert args.verbose is True
+    assert args.start_timeout == 120
+    assert args.elastic_timeout == 300
+    assert args.slots_per_host == 4
+    assert args.cycle_time_ms == 9.0          # CLI wins
+    assert args.fusion_threshold_mb == 32     # config fills the rest
+    assert args.no_stall_check is False       # enabled: true
+    assert args.autotune is True
+    env = knobs_to_env(args)
+    assert env["HOROVOD_CYCLE_TIME"] == "9.0"
+    assert env["HOROVOD_STALL_CHECK_TIME_SECONDS"] == "33.0"
+    assert env["HOROVOD_LOG_LEVEL"] == "DEBUG"
+    if not core_available():
+        pytest.skip("libhvdcore.so not built: C++ leg skipped")
+    # the C++ parser leg: a fresh process with exactly this env
+    code = textwrap.dedent("""\
+        from horovod_tpu.core.core_backend import _load_lib
+        lib = _load_lib()
+        print(lib.hvd_cfg_dump().decode())
+        """)
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**os.environ, **env, "PYTHONPATH": REPO},
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr[-800:]
+    dump = dict(line.split("=", 1)
+                for line in r.stdout.strip().splitlines() if "=" in line)
+    assert dump["fusion_threshold"] == str(32 * 1024 * 1024)
+    assert float(dump["cycle_time_ms"]) == 9.0
+    assert dump["cache_capacity"] == "512"
+    assert dump["hierarchical_allreduce"] == "1"
+    assert dump["autotune"] == "1"
+    assert dump["autotune_warmup_samples"] == "7"
+    assert float(dump["autotune_gp_noise"]) == 0.5
+    assert float(dump["stall_warning_secs"]) == 33.0
+    assert dump["timeline"] == "/tmp/tl.json"
+    assert dump["timeline_mark_cycles"] == "1"
+    assert dump["thread_affinity"] == "1"
+    assert float(dump["rendezvous_timeout_secs"]) == 77.0
+
+
+def test_config_file_validation_rejects_negative(tmp_path):
+    cfg = tmp_path / "bad.yaml"
+    cfg.write_text("params:\n  cache-capacity: -5\n")
+    with pytest.raises(ValueError, match="cache_capacity"):
+        parse_args(["--config-file", str(cfg), "python", "t.py"])
+
+
+def test_config_file_ignores_command_flags_and_honors_abbrev(tmp_path):
+    """The explicit-flag probe stops at the command boundary (the train
+    script's own flags are not launcher overrides and must not crash the
+    probe) and treats abbreviated launcher flags as explicit."""
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text("verbose: true\nparams:\n  cycle-time-ms: 2.5\n")
+    # the command's own --timeline-filename (valueless, last token) and
+    # --verbose belong to the script, not the launcher
+    args = parse_args(["--config-file", str(cfg),
+                       "python", "t.py", "--timeline-filename",
+                       "--verbose"])
+    assert args.verbose is True                # config applies
+    assert args.cycle_time_ms == 2.5
+    assert args.command == ["python", "t.py", "--timeline-filename",
+                            "--verbose"]
+    # an ABBREVIATED launcher flag still beats the config
+    args2 = parse_args(["--config-file", str(cfg), "--cycle-time", "9.0",
+                        "python", "t.py"])
+    assert args2.cycle_time_ms == 9.0
+
+
+def test_config_file_coerces_quoted_numbers(tmp_path):
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text("start-timeout: '120'\nslots: '4'\n")
+    args = parse_args(["--config-file", str(cfg), "python", "t.py"])
+    assert args.start_timeout == 120.0
+    assert args.slots_per_host == 4
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("start-timeout: abc\n")
+    with pytest.raises(ValueError, match="start_timeout|start-timeout"):
+        parse_args(["--config-file", str(bad), "python", "t.py"])
+
+
+def test_config_file_stall_check_disable_inverts(tmp_path):
+    """stall_check.enabled: false becomes no_stall_check=True (reference
+    inverts the same way); an explicit CLI --no-stall-check wins."""
+    cfg = tmp_path / "s.yaml"
+    cfg.write_text("stall_check:\n  enabled: false\n")
+    args = parse_args(["--config-file", str(cfg), "python", "t.py"])
+    assert args.no_stall_check is True
+    assert knobs_to_env(args)["HOROVOD_STALL_CHECK_DISABLE"] == "1"
+
+
+def test_start_timeout_maps_to_mesh_deadline():
+    """--start-timeout bounds the static mesh connect unless the user set
+    --gloo-timeout-seconds explicitly."""
+    args = parse_args(["--start-timeout", "45", "python", "t.py"])
+    env = knobs_to_env(args)
+    assert "HOROVOD_GLOO_TIMEOUT_SECONDS" not in env  # mapped at launch
+    args2 = parse_args(["--start-timeout", "45",
+                        "--gloo-timeout-seconds", "60", "python", "t.py"])
+    assert knobs_to_env(args2)["HOROVOD_GLOO_TIMEOUT_SECONDS"] == "60.0"
+
+
+def test_slots_per_host_defaults_discovery_lines(tmp_path):
+    """Bare hostnames from a discovery script get --slots-per-host slots
+    (reference: --slots)."""
+    from horovod_tpu.runner.elastic.discovery import HostDiscoveryScript
+    script = tmp_path / "disc.sh"
+    script.write_text("#!/bin/sh\necho hostA\necho hostB:8\n")
+    script.chmod(0o755)
+    d = HostDiscoveryScript(str(script), default_slots=4)
+    assert d.find_available_hosts_and_slots() == {"hostA": 4, "hostB": 8}
+
+
 def test_full_knob_set_mirrors_to_env():
     """Every reference config_parser knob reaches the workers' env
     (docs/KNOBS.md table; reference: config_parser.set_env_from_args)."""
