@@ -137,6 +137,7 @@ const char* hvd_cfg_dump() {
 }
 
 void hvd_shutdown() { Core::Get().Shutdown(); }
+void hvd_shutdown_force() { Core::Get().Shutdown(/*force=*/true); }
 
 int hvd_initialized() { return Core::Get().initialized() ? 1 : 0; }
 int hvd_rank() { return Core::Get().rank(); }

@@ -405,16 +405,19 @@ Status Core::Init(const CoreConfig& cfg) {
   return Status::OK();
 }
 
-void Core::Shutdown() {
+void Core::Shutdown(bool force) {
   if (!initialized_) return;
-  HVD_LOG(Info) << "core shutdown requested";
+  HVD_LOG(Info) << "core shutdown requested" << (force ? " (forced)" : "");
   shutdown_requested_ = true;
   KickCycle();  // cast the shutdown vote without waiting out a cycle
   // Prefer the negotiated shutdown (all ranks vote, coordinator emits a
   // SHUTDOWN response — reference: operations.cc:994-1005); if a peer died
   // mid-collective the loop may be blocked in Recv, so force-close the
-  // transport after a grace period to unblock it.
-  for (int i = 0; i < 100 && !loop_done_.load(); ++i)
+  // transport after a grace period to unblock it. force=true skips the
+  // grace entirely — the caller KNOWS a peer is dead (elastic in-place
+  // shrink), so consensus can never complete and waiting 10s per
+  // survivor would just stall the re-rendezvous.
+  for (int i = 0; !force && i < 100 && !loop_done_.load(); ++i)
     std::this_thread::sleep_for(std::chrono::milliseconds(100));
   if (!loop_done_.load() && transport_) transport_->Shutdown();
   if (loop_.joinable()) loop_.join();

@@ -223,7 +223,7 @@ class Core {
 
   ~Core();
   Status Init(const CoreConfig& cfg);
-  void Shutdown();
+  void Shutdown(bool force = false);
   bool initialized() const { return initialized_; }
 
   int rank() const { return cfg_.rank; }

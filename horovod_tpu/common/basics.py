@@ -164,14 +164,22 @@ def init(ranks: Optional[Sequence[int]] = None,
             type(_state.backend).__name__)
 
 
-def shutdown() -> None:
-    """Tear down (reference: ``horovod_shutdown``, ``operations.cc:994-1005``)."""
+def shutdown(force: bool = False) -> None:
+    """Tear down (reference: ``horovod_shutdown``, ``operations.cc:994-1005``).
+    ``force=True`` skips the negotiated-shutdown grace — used by elastic
+    in-place shrink, where a dead peer makes consensus impossible."""
     with _state.lock:
         if not _state.initialized:
             return
         try:
             if _state.backend is not None:
-                _state.backend.shutdown()
+                import inspect
+                params = inspect.signature(
+                    _state.backend.shutdown).parameters
+                if "force" in params:
+                    _state.backend.shutdown(force=force)
+                else:  # backends without a force knob
+                    _state.backend.shutdown()
         finally:
             if _state.timeline is not None:
                 _state.timeline.close()

@@ -401,8 +401,13 @@ class CoreBackend(Backend):
         be._ranks = ranks
         return be
 
-    def shutdown(self):
+    def shutdown(self, force: bool = False):
         if self._owns_core:
-            self._lib.hvd_shutdown()
+            if force and hasattr(self._lib, "hvd_shutdown_force"):
+                # skip the 10s consensus grace: the caller knows a peer
+                # is dead (elastic in-place shrink)
+                self._lib.hvd_shutdown_force()
+            else:
+                self._lib.hvd_shutdown()
         elif self._domain != 0:
             self._lib.hvd_remove_process_set(self._domain)

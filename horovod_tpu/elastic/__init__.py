@@ -146,22 +146,31 @@ def has_pending_update() -> bool:
     return _world_update(poll=False) is not None
 
 
-def _apply_world_update(update: dict) -> None:
+def _apply_world_update(update: dict, force_shutdown: bool = False) -> None:
     """Re-initialize into the new world IN PLACE (no process restart):
-    survivors keep their stable rank (growth never reshuffles), adopt the
-    new size/topology env, tear the old core down (the shutdown consensus
-    drains as every survivor reaches its next commit) and rendezvous into
-    the new world. Reference analog: ``reset()`` after
-    HostsUpdatedInterrupt, ``common/elastic.py:151-175``."""
+    survivors look up their slot by their CURRENT rank (growth keeps
+    ranks stable; shrink docs are keyed by survivors' old ranks and may
+    assign a smaller new rank), adopt the new size/topology env, tear the
+    old core down and rendezvous into the new world.
+    ``force_shutdown=True`` skips the shutdown-consensus grace — used on
+    the shrink path, where a DEAD peer makes consensus impossible (growth
+    keeps the negotiated drain: every survivor reaches its next commit).
+    Reference analog: ``reset()`` after HostsUpdatedInterrupt,
+    ``common/elastic.py:151-175``."""
     global _current_generation
     import horovod_tpu as hvd
     my_rank = str(rank())
+    old_size = size()
     slot_env = update["slots"].get(my_rank)
     if slot_env is None:  # we are not part of the new world
+        hvd.shutdown(force=True)  # close our sockets for the survivors
         raise RuntimeError(
             f"rank {my_rank} is not in the new world (generation "
-            f"{update['generation']}); exiting for relaunch")
-    hvd.shutdown()
+            f"{update['generation']}); exiting")
+    # a SHRUNKEN world means departed peers: shutdown consensus cannot
+    # complete, so skip its grace instead of stalling every survivor
+    hvd.shutdown(force=force_shutdown
+                 or int(update.get("size", 0)) < old_size)
     os.environ.update({k: str(v) for k, v in slot_env.items()})
     os.environ["HVD_TPU_COORD_ADDR"] = update["coord_addr"]
     os.environ["HVD_TPU_COORD_PORT"] = str(update["coord_port"])
@@ -170,6 +179,23 @@ def _apply_world_update(update: dict) -> None:
     from horovod_tpu.common.config import reset_config
     reset_config()
     hvd.init()
+
+
+def _await_world_update(timeout_s: Optional[float] = None) -> Optional[dict]:
+    """Poll the driver for a newer world document for up to ``timeout_s``
+    (default ``HVD_ELASTIC_SHRINK_WAIT_S`` or 15s). Used after a
+    HorovodInternalError: if a peer died, the driver notices its process
+    exit and publishes the shrunken world within moments — the survivors
+    wait here for it instead of dying for a generation restart."""
+    import time
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("HVD_ELASTIC_SHRINK_WAIT_S", "15"))
+    deadline = time.time() + timeout_s
+    while True:
+        update = _world_update(poll=True)
+        if update is not None or time.time() >= deadline:
+            return update
+        time.sleep(0.5)
 
 
 class State:
@@ -312,6 +338,15 @@ def run(func: Callable) -> Callable:
                 return func(state, *args, **kwargs)
             except HorovodInternalError:
                 state.restore()
+                # peer death? the driver publishes the shrunken world as
+                # soon as it reaps the dead process — re-rendezvous into
+                # it IN PLACE (params stay in host memory, PID unchanged).
+                # No doc inside the window -> transient op error: retry
+                # in the same world like the reference.
+                update = _await_world_update()
+                if update is not None:
+                    _apply_world_update(update, force_shutdown=True)
+                    state.on_reset()
                 state.sync()
             except HostsUpdatedInterrupt as e:
                 if e.update is not None:

@@ -33,6 +33,7 @@ from horovod_tpu.common.logging import get_logger
 _lock = threading.Lock()
 _listener: Optional["WorkerNotificationListener"] = None
 _disabled = False
+_registered_as = None  # (rank, generation) the listener is known to hold
 
 
 class WorkerNotificationListener:
@@ -70,11 +71,22 @@ def ensure_listener(driver_addr: str, driver_port: int) -> \
         Optional[WorkerNotificationListener]:
     """Start + register the singleton listener on first use; returns None
     when push is disabled or registration failed (poll-only fallback)."""
-    global _listener, _disabled
+    global _listener, _disabled, _registered_as
     with _lock:
         if _disabled or os.environ.get("HVD_ELASTIC_PUSH", "1") == "0":
             return None
         if _listener is not None:
+            # rank/generation changed (in-place recovery renumbered us and
+            # the driver cleared its registrations): re-register the SAME
+            # listener under the new identity so pushes — and the driver's
+            # recovery-viability check — keep seeing this worker
+            ident = _identity()
+            if ident != _registered_as:
+                try:
+                    _listener.register(driver_addr, driver_port)
+                    _registered_as = ident
+                except OSError:
+                    pass  # poll-at-commit still works
             return _listener
         try:
             listener = WorkerNotificationListener()
@@ -92,7 +104,14 @@ def ensure_listener(driver_addr: str, driver_port: int) -> \
                 pass
             return None
         _listener = listener
+        _registered_as = _identity()
         return _listener
+
+
+def _identity():
+    return (os.environ.get("HOROVOD_RANK",
+                           os.environ.get("HVD_TPU_RANK", "0")),
+            os.environ.get("HVD_ELASTIC_GENERATION", "0"))
 
 
 def current_listener() -> Optional[WorkerNotificationListener]:
@@ -104,9 +123,10 @@ def current_listener() -> Optional[WorkerNotificationListener]:
 
 def reset_listener() -> None:
     """Tear down the singleton (tests / full shutdown)."""
-    global _listener, _disabled
+    global _listener, _disabled, _registered_as
     with _lock:
         if _listener is not None:
             _listener.stop()
         _listener = None
         _disabled = False
+        _registered_as = None

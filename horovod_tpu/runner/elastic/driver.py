@@ -5,21 +5,32 @@ Reference: ``horovod/runner/elastic/driver.py`` (``ElasticDriver``: discovery
 thread :181-201, stable rank assignment :233-275, worker spawn per slot
 :277-295, blacklist + exit handling :297-313).
 
-TPU-native design:
+TPU-native design — every world change keeps SURVIVORS in-process
+(reference: the reset loop, ``common/elastic.py:151-175``); the
+generation-restart path is the backstop, not the norm:
 
-* **Failures and shrink are process-restart based**: the driver terminates
-  the generation, recomputes assignments (stable ranks, failed hosts
-  blacklisted), and relaunches; workers resume from their last committed
-  :class:`horovod_tpu.elastic.State` checkpoint (``HVD_ELASTIC_CKPT``).
+* **Crashes recover in place** (round 5): the lost worker's peers catch
+  ``HorovodInternalError``, the driver publishes a recovery world and
+  respawns a REPLACEMENT for the dead rank onto free discovery capacity
+  (shrinking to the survivors when capacity is gone); survivors
+  re-rendezvous under their (possibly renumbered) ranks with parameters
+  still in host memory. Viability requires every survivor to hold a
+  fresh elastic-listener registration (proof it can apply a world doc);
+  recoveries share the ``--reset-limit`` budget with restarts.
+* **Planned capacity loss shrinks in place**: discovery dropping slots
+  publishes the kept-worker world; dropped workers exit via the
+  not-in-new-world path at their next commit.
 * **Growth keeps survivors running** (VERDICT r1 #6): when discovery only
   ADDS capacity, the driver publishes a new world document (generation,
   size, per-rank env, fresh rendezvous port) to its KV server and spawns
   workers for the new slots only. Survivors pick the update up at their
-  next ``state.commit()`` (``HostsUpdatedInterrupt`` → in-place re-init,
-  no process restart: no re-import, no spawn, parameters stay in host
-  memory — only the core re-rendezvous and the XLA recompile that any
-  world change requires). Ranks are stable under growth, so survivors
-  keep their rank and shard assignments.
+  next ``state.commit()`` (``HostsUpdatedInterrupt`` → in-place re-init).
+  Ranks are stable under growth, so survivors keep their shard
+  assignments.
+* **Restart backstop**: jobs without committed elastic state, completion
+  races, reshuffled assignments, or too-few survivors terminate the
+  generation and relaunch from the last ``HVD_ELASTIC_CKPT`` commit
+  (stable ranks, failed hosts blacklisted).
 """
 
 from __future__ import annotations
@@ -36,7 +47,7 @@ from horovod_tpu.runner.elastic.registration import (FAILURE, SUCCESS,
                                                      TERMINATED,
                                                      WorkerStateRegistry)
 from horovod_tpu.runner.exec_run import (free_port, slot_command)
-from horovod_tpu.runner.hosts import get_host_assignments
+from horovod_tpu.runner.hosts import HostInfo, get_host_assignments
 from horovod_tpu.runner.safe_exec import safe_execute
 
 DISCOVERY_INTERVAL_S = 1.0
@@ -139,12 +150,18 @@ class ElasticDriver:
                    self._hosts.slot_count())
 
     def _publish_world(self, gen: int, slots, coord_addr: str,
-                       coord_port: int) -> None:
+                       coord_port: int, keyed_slots=None) -> None:
+        """Publish a signed world doc. ``slots`` keys the doc by each
+        slot's own (stable) rank — the growth case. ``keyed_slots``
+        overrides with an explicit ``{lookup_rank: env}`` mapping — the
+        shrink case, where survivors look themselves up by their OLD
+        rank but adopt a smaller new one from the env."""
         import json
         from horovod_tpu.elastic import world_doc_signature
         doc = {"generation": gen, "size": len(slots),
                "coord_addr": coord_addr, "coord_port": coord_port,
-               "slots": {str(s.rank): s.to_env() for s in slots}}
+               "slots": keyed_slots if keyed_slots is not None
+               else {str(s.rank): s.to_env() for s in slots}}
         doc["sig"] = world_doc_signature(self._world_secret, doc)
         body = json.dumps(doc).encode()
         self._kv.put("world", "current", body)
@@ -178,6 +195,126 @@ class ElasticDriver:
             threading.Thread(target=push, args=(host, port_num),
                              daemon=True).start()
 
+    # -- in-place crash recovery --------------------------------------------
+    def _try_inplace_recovery(self, survivors, results, threads,
+                              slot_by_key, current_rank, target_np,
+                              host_crashes, charge_reset=True):
+        """A worker died mid-generation: publish a new world around the
+        SURVIVORS so they re-rendezvous IN PLACE (params stay in host
+        memory, PIDs unchanged — reference: the reset loop after
+        HorovodInternalError, ``common/elastic.py:151-175``) instead of
+        paying a process restart + checkpoint reload. Replacement
+        workers for the lost ranks are respawned onto free discovery
+        capacity (the reference spawns missing ranks the same way); if
+        capacity is gone (host dead / removed), the world SHRINKS to the
+        survivors + whatever fits. Hosts that have already eaten as many
+        crashes as they have slots get no replacements.
+
+        Returns ``(new_slots, generation, replacement_slots, coord_addr,
+        coord_port)`` on success, ``None`` when not viable — too few
+        survivors+capacity, an essential worker already FINISHED (its
+        result was published under the old generation; the restart path
+        handles that completion race), or the --reset-limit budget is
+        spent. ``charge_reset=False`` (planned capacity-loss shrinks)
+        leaves the crash budget untouched — routine autoscaler
+        downscales must never exhaust it."""
+        if any(results.get(k) is not None or not threads[k].is_alive()
+               for k in survivors):
+            get_logger().info("in-place recovery not viable: an "
+                              "essential worker already finished")
+            return None
+        # every survivor must have REGISTERED its notification listener
+        # (done at its first elastic commit): that proves it runs an
+        # elastic.run loop able to apply a new world doc. A worker still
+        # inside hvd.init — or a job without elastic state at all — can
+        # only be recovered by the generation-restart path; publishing a
+        # world it will never read would deadlock the rendezvous.
+        notify = {str(k) for k in self._kv.scope("notify")}
+        unready = [k for k in survivors
+                   if str(current_rank[k]) not in notify]
+        if unready:
+            get_logger().info(
+                "in-place recovery not viable: survivors %s have no "
+                "elastic listener registration (no committed elastic "
+                "state)", [current_rank[k] for k in unready])
+            return None
+        surv_on: Dict[str, int] = {}
+        for k in survivors:
+            h = slot_by_key[k].hostname
+            surv_on[h] = surv_on.get(h, 0) + 1
+        # replacements go onto free capacity of healthy discovered hosts
+        hosts_now = self._hosts.current_hosts()
+        placement: List[str] = []
+        n_repl = max(0, target_np - len(survivors))
+        for h in hosts_now:
+            if len(placement) >= n_repl:
+                break
+            if host_crashes.get(h.hostname, 0) >= h.slots:
+                continue  # this host just keeps killing workers
+            free = h.slots - surv_on.get(h.hostname, 0)
+            placement.extend([h.hostname] * max(0, min(
+                free, n_repl - len(placement))))
+        new_np = len(survivors) + len(placement)
+        if new_np < max(self._min_np, 1):
+            get_logger().info(
+                "in-place recovery not viable: %d survivors + %d "
+                "replacements < min_np %d", len(survivors),
+                len(placement), self._min_np)
+            return None
+        if charge_reset:
+            # charged only once viability is established — a non-viable
+            # attempt already pays for its generation restart
+            self._registry.note_reset()
+            if self._registry.reset_limit_reached():
+                get_logger().info("in-place recovery not viable: reset "
+                                  "limit reached")
+                return None
+        # per-host entries: survivors (in current-rank order) first, then
+        # replacements — block assignment then aligns host-wise
+        host_order: List[str] = []
+        entries: Dict[str, list] = {}
+        for k in sorted(survivors, key=lambda k: current_rank[k]):
+            h = slot_by_key[k].hostname
+            if h not in entries:
+                host_order.append(h)
+                entries[h] = []
+            entries[h].append(k)
+        for h in placement:
+            if h not in entries:
+                host_order.append(h)
+                entries[h] = []
+            entries[h].append(None)  # replacement marker
+        hosts2 = [HostInfo(h, len(entries[h])) for h in host_order]
+        new_slots = get_host_assignments(hosts2, new_np)
+        flat = [e for h in host_order for e in entries[h]]
+        keyed = {}
+        replacements = []
+        for e, ns in zip(flat, new_slots):
+            if e is None:
+                replacements.append(ns)
+                continue
+            assert ns.hostname == slot_by_key[e].hostname, (e, ns)
+            # survivors look the doc up by the rank they CURRENTLY hold;
+            # the env inside hands them their new one
+            keyed[str(current_rank[e])] = ns.to_env()
+            current_rank[e] = ns.rank
+        coord_port = free_port()
+        coord_addr = "127.0.0.1" if new_slots[0].hostname in (
+            "localhost", "127.0.0.1") else new_slots[0].hostname
+        gen = self._generation
+        self._generation += 1
+        get_logger().info(
+            "elastic generation %d (in-place crash recovery): np=%d "
+            "(%d survivors + %d replacements)", gen, new_np,
+            len(survivors), len(replacements))
+        self._publish_world(gen, new_slots, coord_addr, coord_port,
+                            keyed_slots=keyed)
+        # registrations are stale the moment ranks renumber: survivors
+        # re-register at their first commit in the new world, and a crash
+        # BEFORE that commit conservatively takes the restart path
+        self._kv.clear("notify")
+        return new_slots, gen, replacements, coord_addr, coord_port
+
     # -- one generation ------------------------------------------------------
     def _run_generation(self) -> str:
         """Launch workers for the current host set; returns SUCCESS /
@@ -205,8 +342,18 @@ class ElasticDriver:
         self._publish_world(gen, slots, coord_addr, coord_port)
 
         failure = threading.Event()
-        teardown = threading.Event()  # shrink: kill survivors for restart
+        teardown = threading.Event()  # restart path: kill survivors
+        worker_lost = threading.Event()  # crash: try in-place shrink first
         fail_lock = threading.Lock()
+        # per-worker bookkeeping keyed by (spawn_generation, rank): ranks
+        # are reused across in-generation worlds (shrink renumbers, growth
+        # appends), so the rank alone is not a stable identity
+        results: Dict[tuple, str] = {}
+        lost_keys: set = set()
+        host_crashes: Dict[str, int] = {}
+        # workers a capacity-loss shrink dropped from the world: their
+        # exit (the not-in-new-world path) is EXPECTED, not a crash
+        expected_exits: set = set()
 
         def run_slot(slot, slot_gen):
             extra_env = {
@@ -240,39 +387,96 @@ class ElasticDriver:
                 rc = safe_execute(cmd, env=env, prefix=prefix,
                                   events=[failure, teardown],
                                   timestamp=self._timestamp_output)
+            key = (slot_gen, slot.rank)
             if rc == 0:
+                results[key] = SUCCESS
                 self._registry.record(slot.rank, slot.hostname, SUCCESS)
                 return
-            # distinguish the originating failure from workers the driver
-            # tore down because of it (those must not poison the blacklist)
+            # Distinguish the ORIGINATING failure from its fallout:
+            # workers the driver tore down, and CASUALTIES — workers that
+            # died from the collective error the originator caused (a job
+            # without elastic state has no way to ride out a peer loss).
+            # Only the originator counts as FAILURE, so the blacklist and
+            # the restart decision see one crash, not a cascade. A crash
+            # does not fail the generation outright anymore: the main
+            # loop first tries to recover the world in place.
             with fail_lock:
                 torn_down = failure.is_set() or teardown.is_set()
-                failure.set()
-            self._registry.record(slot.rank, slot.hostname,
-                                  TERMINATED if torn_down else FAILURE)
+                expected = key in expected_exits
+                casualty = bool(lost_keys) and not torn_down \
+                    and not expected
+                if not torn_down and not expected:
+                    lost_keys.add(key)
+                    worker_lost.set()
+            state = TERMINATED if (torn_down or casualty or expected) \
+                else FAILURE
+            results[key] = state
+            self._registry.record(slot.rank, slot.hostname, state)
 
-        threads = {}
-        for s in slots:
-            t = threading.Thread(target=run_slot, args=(s, gen),
+        threads: Dict[tuple, threading.Thread] = {}
+        slot_by_key: Dict[tuple, object] = {}
+        current_rank: Dict[tuple, int] = {}  # rank in the CURRENT world
+
+        def spawn(slot, slot_gen):
+            key = (slot_gen, slot.rank)
+            t = threading.Thread(target=run_slot, args=(slot, slot_gen),
                                  daemon=True)
-            threads[s.rank] = t
+            threads[key] = t
+            slot_by_key[key] = slot
+            current_rank[key] = slot.rank
             t.start()
-        # the job is DONE when every rank of the generation it started
-        # with succeeds — growth-spawned stragglers whose world the
-        # survivors never joined (completion raced the scale-up) must not
-        # hold the driver hostage
-        essential_ranks = [s.rank for s in slots]
+
+        for s in slots:
+            spawn(s, gen)
+        # the job is DONE when every worker of the generation it started
+        # with succeeds (minus crash-shrunken ones) — growth-spawned
+        # stragglers whose world the survivors never joined (completion
+        # raced the scale-up) must not hold the driver hostage
+        essential_keys = [(gen, s.rank) for s in slots]
         essential_gen = gen  # growth below reuses the name `gen`
 
         while any(t.is_alive() for t in threads.values()):
             time.sleep(0.25)
             if not failure.is_set() and not teardown.is_set() and \
-                    self._registry.count(SUCCESS) >= len(essential_ranks) \
-                    and all(not threads[r].is_alive()
-                            for r in essential_ranks):
+                    all(results.get(k) == SUCCESS for k in essential_keys):
                 # survivors finished; kill growth stragglers still waiting
                 # for a rendezvous that will never complete
                 teardown.set()
+            # -- a worker crashed: recover the world in place --------------
+            if worker_lost.is_set() and not failure.is_set() and \
+                    not teardown.is_set():
+                with fail_lock:
+                    worker_lost.clear()
+                    lost_now = set(lost_keys)
+                    # this round handles exactly lost_now; clearing lets
+                    # the NEXT crash classify as an originator again and
+                    # keeps host_crashes from re-counting old losses
+                    lost_keys.clear()
+                    survivors = [k for k in essential_keys
+                                 if k not in lost_now]
+                for k in lost_now:
+                    h = slot_by_key[k].hostname
+                    host_crashes[h] = host_crashes.get(h, 0) + 1
+                recovered = self._try_inplace_recovery(
+                    survivors, results, threads, slot_by_key,
+                    current_rank, np, host_crashes)
+                if recovered is None:
+                    failure.set()  # not viable: generation-restart path
+                else:
+                    # rebind the coordinator BEFORE spawning: run_slot
+                    # reads these closure variables at call time, and a
+                    # replacement pointed at the dead world's port would
+                    # never find the new mesh
+                    new_slots2, rec_gen, replacements, coord_addr, \
+                        coord_port = recovered
+                    for s in replacements:
+                        spawn(s, rec_gen)
+                    essential_keys = survivors + [
+                        (rec_gen, s.rank) for s in replacements]
+                    essential_gen = rec_gen
+                    slots = new_slots2
+                    np = len(new_slots2)
+                continue
             if failure.is_set() or not self._hosts_changed.is_set():
                 continue
             # -- membership changed mid-generation -------------------------
@@ -283,8 +487,50 @@ class ElasticDriver:
             still_there = old_hostnames.issubset(
                 {h.hostname for h in new_hosts})
             if not still_there or new_np < np:
-                # shrink / host lost: restart path
-                teardown.set()
+                # capacity loss: keep the remaining workers IN PLACE when
+                # they can all apply a world doc (elastic state committed
+                # at least once); dropped workers exit via the
+                # not-in-new-world path at their next commit. Anything
+                # else — a finished essential, unregistered workers, too
+                # little capacity — takes the generation-restart path.
+                if any(results.get(k) is not None
+                       for k in essential_keys):
+                    teardown.set()
+                    continue
+                # keep workers per host up to that host's NEW slot count
+                # (the downscaled host must actually lose workers) in
+                # current-rank order, capped at the new world size
+                new_caps = {h.hostname: h.slots for h in new_hosts}
+                alive = [k for k in essential_keys
+                         if threads[k].is_alive()]
+                kept, used = [], {}
+                for k in sorted(alive, key=lambda k: current_rank[k]):
+                    h = slot_by_key[k].hostname
+                    if len(kept) < new_np and \
+                            used.get(h, 0) < new_caps.get(h, 0):
+                        kept.append(k)
+                        used[h] = used.get(h, 0) + 1
+                dropped = [k for k in essential_keys if k not in kept]
+                with fail_lock:
+                    # BEFORE the publish: a dropped worker can read the
+                    # pushed doc and exit before this loop resumes, and
+                    # that exit must not be classified as a crash
+                    expected_exits.update(dropped)
+                recovered = self._try_inplace_recovery(
+                    kept, results, threads, slot_by_key, current_rank,
+                    new_np, host_crashes, charge_reset=False)
+                if recovered is None:
+                    teardown.set()
+                    continue
+                new_slots2, rec_gen, replacements, coord_addr, \
+                    coord_port = recovered
+                for s in replacements:
+                    spawn(s, rec_gen)
+                essential_keys = kept + [(rec_gen, s.rank)
+                                         for s in replacements]
+                essential_gen = rec_gen
+                slots = new_slots2
+                np = len(new_slots2)
                 continue
             if new_np <= np:
                 continue  # capacity we are not using anyway
@@ -308,20 +554,19 @@ class ElasticDriver:
                 gen, np, new_np)
             self._publish_world(gen, new_slots, coord_addr, coord_port)
             for s in new_slots[np:]:
-                t = threading.Thread(target=run_slot, args=(s, gen),
-                                     daemon=True)
-                threads[s.rank] = t
-                t.start()
+                spawn(s, gen)
             slots = new_slots
             np = new_np
 
         ess_ok = all(
-            self._registry.state_of(r) == SUCCESS for r in essential_ranks)
-        if ess_ok and self._registry.count(FAILURE) == 0:
-            # only the ESSENTIAL ranks are guaranteed complete — in-place
-            # growth may have raised np while its stragglers were torn
-            # down after the survivors finished in the old world
-            self._final_np = len(essential_ranks)
+            results.get(k) == SUCCESS for k in essential_keys)
+        if ess_ok:
+            # only the ESSENTIAL workers are guaranteed complete —
+            # in-place growth may have raised np while its stragglers
+            # were torn down after the survivors finished in the old
+            # world, and crash-shrunken workers' FAILURE records were
+            # absorbed by the in-place re-mesh
+            self._final_np = len(essential_keys)
             self._final_gen = essential_gen
             return SUCCESS
         if (teardown.is_set() or self._hosts_changed.is_set()) and \
@@ -335,7 +580,7 @@ class ElasticDriver:
                 if n >= host_slots:
                     self._hosts.blacklist(host)
             return FAILURE
-        self._final_np = len(essential_ranks)
+        self._final_np = len(essential_keys)
         self._final_gen = essential_gen
         return SUCCESS
 

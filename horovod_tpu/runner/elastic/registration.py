@@ -34,6 +34,13 @@ class WorkerStateRegistry:
     def reset_count(self) -> int:
         return self._reset_count
 
+    def note_reset(self) -> None:
+        """Count an IN-PLACE recovery against the same ``--reset-limit``
+        budget as generation restarts: a deterministically-crashing
+        worker must not respawn forever."""
+        with self._lock:
+            self._reset_count += 1
+
     def reset_limit_reached(self) -> bool:
         return (self._reset_limit is not None
                 and self._reset_count > self._reset_limit)

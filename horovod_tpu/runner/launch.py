@@ -199,6 +199,22 @@ _CONFIG_SECTIONS = {
 }
 
 
+def _coerce_bool(v):
+    """YAML booleans plus their common string spellings — ``bool('false')``
+    would silently be True."""
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)) and v in (0, 1):
+        return bool(v)
+    if isinstance(v, str):
+        s = v.strip().lower()
+        if s in ("1", "true", "yes", "on"):
+            return True
+        if s in ("0", "false", "no", "off"):
+            return False
+    raise ValueError(f"not a boolean: {v!r}")
+
+
 def apply_config_file(args: argparse.Namespace, explicit: set,
                       parser: argparse.ArgumentParser) -> None:
     """Fill non-explicit args from the YAML config (reference:
@@ -210,7 +226,7 @@ def apply_config_file(args: argparse.Namespace, explicit: set,
     with open(args.config_file) as f:
         config = yaml.safe_load(f) or {}
 
-    types = {a.dest: (bool if a.nargs == 0 else a.type)
+    types = {a.dest: (_coerce_bool if a.nargs == 0 else a.type)
              for a in parser._actions if a.option_strings}
 
     def norm(d):
