@@ -2,4 +2,5 @@ from horovod_tpu.data.data_loader import (  # noqa: F401
     AsyncDataLoaderMixin,
     BaseDataLoader,
     ShardedDataset,
+    device_prefetch,
 )

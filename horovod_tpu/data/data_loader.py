@@ -9,9 +9,12 @@ prefetch hooks (host→HBM transfer overlapped with compute).
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 from typing import Any, Iterator, Optional, Sequence
+
+import jax
 
 
 class BaseDataLoader:
@@ -95,6 +98,47 @@ class AsyncDataLoaderMixin:
                     raise item.error
                 break
             yield item
+
+
+def device_prefetch(iterator, sharding=None, buffer_size: int = 2):
+    """Keep ``buffer_size`` batches RESIDENT ON DEVICE ahead of the
+    consumer (double-buffered by default): ``jax.device_put`` is
+    asynchronous, so the host→HBM transfer of the next batches overlaps
+    the compute consuming the current one and H2D drops off the step's
+    critical path (docs/PERF.md headroom (c); the reference's analog is
+    the CUDA-stream prefetch users pair with its AsyncDataLoaderMixin).
+
+    ``sharding`` places each leaf (e.g. ``hvd.batch_sharding(mesh)`` for
+    dp-sharded batches); ``None`` uses the default device. Works on any
+    iterator of pytrees — stack with :class:`AsyncDataLoaderMixin` so the
+    HOST side (decode/augment) is also off the critical path:
+    background thread feeds ``device_prefetch`` feeds the step."""
+    if buffer_size < 1:
+        # eager: a generator would defer this to the first next() deep
+        # inside the training loop, far from the misconfigured call
+        raise ValueError(f"buffer_size={buffer_size} must be >= 1")
+    return _device_prefetch_gen(iter(iterator), sharding, buffer_size)
+
+
+def _device_prefetch_gen(it, sharding, buffer_size: int):
+    q: "collections.deque" = collections.deque()
+
+    def put_next() -> bool:
+        try:
+            batch = next(it)
+        except StopIteration:
+            return False
+        # device_put takes the whole pytree: one dispatch for the batch
+        q.append(jax.device_put(batch, sharding))
+        return True
+
+    for _ in range(buffer_size):
+        if not put_next():
+            break
+    while q:
+        out = q.popleft()
+        put_next()  # enqueue the NEXT transfer before handing this one out
+        yield out
 
 
 class ShardedDataset(BaseDataLoader):

@@ -158,6 +158,54 @@ def test_async_loader_prefetch():
     assert list(sync_loader) == list(range(10))
 
 
+def test_device_prefetch_pipeline():
+    """device_prefetch keeps batches on device ahead of the consumer:
+    values arrive in order, already device-resident, honoring a mesh
+    sharding, and the buffer never holds more than buffer_size items."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.data import ShardedDataset, device_prefetch
+
+    batches = [np.full((8, 4), i, np.float32) for i in range(6)]
+    got = list(device_prefetch(iter(batches)))
+    assert len(got) == 6
+    for i, b in enumerate(got):
+        assert isinstance(b, jax.Array)  # already on device
+        np.testing.assert_array_equal(np.asarray(b), batches[i])
+
+    mesh = hvd.build_mesh(dp=-1)
+    sh = NamedSharding(mesh, P("dp"))
+    got = list(device_prefetch(iter(batches), sharding=sh))
+    assert got[0].sharding == sh  # placed per the requested sharding
+
+    # composes with ShardedDataset + pytree batches
+    ds = ShardedDataset([{"x": np.ones(2) * i} for i in range(8)],
+                        rank=0, size=2, shuffle=False)
+    out = list(device_prefetch(ds, buffer_size=3))
+    assert [float(np.asarray(b["x"])[0]) for b in out] == [0.0, 2.0, 4.0,
+                                                           6.0]
+
+    # boundedness: never pulls more than buffer_size ahead of the consumer
+    pulled = []
+
+    def counting():
+        for i in range(10):
+            pulled.append(i)
+            yield np.float32(i)
+
+    gen = device_prefetch(counting(), buffer_size=2)
+    for n_consumed, _ in enumerate(gen, start=1):
+        assert len(pulled) <= n_consumed + 2, (len(pulled), n_consumed)
+    assert len(pulled) == 10
+
+    # misconfiguration fails AT THE CALL, not at first iteration
+    import pytest
+    with pytest.raises(ValueError, match="buffer_size"):
+        device_prefetch(iter(batches), buffer_size=0)
+
+
 # -- callbacks ---------------------------------------------------------------
 
 def test_metric_average_callback_single(hvd):
