@@ -109,10 +109,20 @@ def device_prefetch(iterator, sharding=None, buffer_size: int = 2):
     the CUDA-stream prefetch users pair with its AsyncDataLoaderMixin).
 
     ``sharding`` places each leaf (e.g. ``hvd.batch_sharding(mesh)`` for
-    dp-sharded batches); ``None`` uses the default device. Works on any
-    iterator of pytrees — stack with :class:`AsyncDataLoaderMixin` so the
-    HOST side (decode/augment) is also off the critical path:
-    background thread feeds ``device_prefetch`` feeds the step."""
+    dp-sharded batches); ``None`` uses the default device. When the
+    sharding spans devices of OTHER processes too (a multi-host mesh),
+    each process's batch is treated as its process-local shard and the
+    global array is assembled with
+    ``jax.make_array_from_process_local_data`` — so the documented
+    ShardedDataset-per-rank + ``batch_sharding(mesh)`` stack is correct
+    on pods as well. Works on any iterator of pytrees — stack with
+    :class:`AsyncDataLoaderMixin` so the HOST side (decode/augment) is
+    also off the critical path: background thread feeds
+    ``device_prefetch`` feeds the step.
+
+    If the source iterator raises mid-stream, batches already
+    transferred are yielded first; the error surfaces at its true
+    position in the stream."""
     if buffer_size < 1:
         # eager: a generator would defer this to the first next() deep
         # inside the training loop, far from the misconfigured call
@@ -122,14 +132,34 @@ def device_prefetch(iterator, sharding=None, buffer_size: int = 2):
 
 def _device_prefetch_gen(it, sharding, buffer_size: int):
     q: "collections.deque" = collections.deque()
+    pending_error = None
+
+    if sharding is not None and not getattr(
+            sharding, "is_fully_addressable", True):
+        # multi-host mesh: this process holds only ITS shard of the
+        # global batch
+        def place(batch):
+            return jax.tree_util.tree_map(
+                lambda x: jax.make_array_from_process_local_data(
+                    sharding, x), batch)
+    else:
+        def place(batch):
+            # device_put takes the whole pytree: one dispatch per batch
+            return jax.device_put(batch, sharding)
 
     def put_next() -> bool:
+        nonlocal pending_error
+        if pending_error is not None:
+            return False
         try:
             batch = next(it)
         except StopIteration:
             return False
-        # device_put takes the whole pytree: one dispatch for the batch
-        q.append(jax.device_put(batch, sharding))
+        except BaseException as e:
+            # drain the already-transferred batches before surfacing it
+            pending_error = e
+            return False
+        q.append(place(batch))
         return True
 
     for _ in range(buffer_size):
@@ -139,6 +169,8 @@ def _device_prefetch_gen(it, sharding, buffer_size: int):
         out = q.popleft()
         put_next()  # enqueue the NEXT transfer before handing this one out
         yield out
+    if pending_error is not None:
+        raise pending_error
 
 
 class ShardedDataset(BaseDataLoader):

@@ -162,7 +162,6 @@ def test_device_prefetch_pipeline():
     """device_prefetch keeps batches on device ahead of the consumer:
     values arrive in order, already device-resident, honoring a mesh
     sharding, and the buffer never holds more than buffer_size items."""
-    import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     import horovod_tpu as hvd
@@ -201,9 +200,23 @@ def test_device_prefetch_pipeline():
     assert len(pulled) == 10
 
     # misconfiguration fails AT THE CALL, not at first iteration
-    import pytest
     with pytest.raises(ValueError, match="buffer_size"):
         device_prefetch(iter(batches), buffer_size=0)
+
+    # mid-stream source error: already-transferred batches drain first,
+    # then the error surfaces at its true stream position
+    def flaky():
+        for i in range(5):
+            if i == 3:
+                raise RuntimeError("decode failed")
+            yield np.float32(i)
+
+    gen = device_prefetch(flaky(), buffer_size=2)
+    seen = []
+    with pytest.raises(RuntimeError, match="decode failed"):
+        for b in gen:
+            seen.append(float(np.asarray(b)))
+    assert seen == [0.0, 1.0, 2.0]
 
 
 # -- callbacks ---------------------------------------------------------------
