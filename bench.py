@@ -418,14 +418,20 @@ def _child_cnn(which: str) -> None:
                  "image_size": image_size, "scan_steps": scan}
     else:
         mk = ResNet101 if which == "resnet101" else ResNet50
-        model = mk(num_classes=1000, dtype=jnp.bfloat16, stem=stem)
+        # HVD_BENCH_REMAT=1: jax.checkpoint each block — HBM for
+        # recompute, for exploring larger per-chip batches (PERF.md (b)).
+        # Inside a scanned chain the CSE barrier is unnecessary (flax
+        # docs) and costs — drop it when scan_steps > 1.
+        remat = os.environ.get("HVD_BENCH_REMAT", "0") == "1"
+        model = mk(num_classes=1000, dtype=jnp.bfloat16, stem=stem,
+                   remat=remat, remat_prevent_cse=scan <= 1)
         params, batch_stats = create_resnet_state(
             model, jax.random.PRNGKey(0), image_size=image_size, mesh=mesh)
         tx = optax.sgd(0.1, momentum=0.9)
         opt_state = jax.jit(tx.init)(params)
         step = make_resnet_train_step(model, tx, mesh, scan_steps=scan)
         extra = {"batch_per_chip": batch_per_chip, "stem": stem,
-                 "scan_steps": scan}
+                 "scan_steps": scan, "remat": remat}
 
     rng = np.random.RandomState(0)
     images = jax.device_put(
@@ -504,7 +510,11 @@ def _child_resnet50_bare() -> None:
     batch = int(os.environ.get("HVD_BENCH_BATCH", "256"))
     stem = os.environ.get("HVD_BENCH_STEM", "s2d")
     scan = max(1, int(os.environ.get("HVD_BENCH_SCAN", "8")))
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem=stem)
+    # the control honors the SAME remat knob so framework-vs-bare always
+    # compares identical programs (apples-to-apples promise)
+    remat = os.environ.get("HVD_BENCH_REMAT", "0") == "1"
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem=stem,
+                     remat=remat, remat_prevent_cse=scan <= 1)
     variables = model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3), jnp.bfloat16),
         train=True)
@@ -562,7 +572,7 @@ def _child_resnet50_bare() -> None:
         metric="resnet50_bare_images_per_sec_per_chip", unit="img/s/chip",
         vs_baseline_per_unit=REFERENCE_IMG_PER_SEC_PER_DEVICE,
         extra={"batch_per_chip": batch, "stem": stem, "scan_steps": scan,
-               "control": True})
+               "remat": remat, "control": True})
 
 
 def _enable_compile_cache() -> None:

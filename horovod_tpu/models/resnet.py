@@ -72,6 +72,11 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
     stem: str = "conv"
+    remat: bool = False  # jax.checkpoint each block: HBM for recompute,
+    #                      unlocking larger per-chip batches (PERF.md (b))
+    remat_prevent_cse: bool = True  # pass False when the step runs inside
+    #                      lax.scan (scan_steps>1): flax documents the CSE
+    #                      barrier as unnecessary there, and it costs
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -89,24 +94,39 @@ class ResNet(nn.Module):
                          epsilon=1e-5, dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        # static_argnums counts (self, x, train): train must be passed
+        # POSITIONALLY for the lifted remat to see it as static. The
+        # explicit name pins the param path to the PLAIN class's
+        # auto-name, so init RNG streams and checkpoints are identical
+        # whether remat is on or off.
+        block_cls = nn.remat(
+            BottleneckBlock, static_argnums=(2,),
+            prevent_cse=self.remat_prevent_cse) \
+            if self.remat else BottleneckBlock
+        block_idx = 0
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = BottleneckBlock(64 * 2 ** i, strides, self.dtype)(
-                    x, train=train)
+                x = block_cls(64 * 2 ** i, strides, self.dtype,
+                              name=f"BottleneckBlock_{block_idx}")(x, train)
+                block_idx += 1
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
         return x
 
 
 def ResNet50(num_classes: int = 1000, dtype=jnp.bfloat16,
-             stem: str = "conv") -> ResNet:
-    return ResNet([3, 4, 6, 3], num_classes, dtype, stem)
+             stem: str = "conv", remat: bool = False,
+             remat_prevent_cse: bool = True) -> ResNet:
+    return ResNet([3, 4, 6, 3], num_classes, dtype, stem, remat,
+                  remat_prevent_cse)
 
 
 def ResNet101(num_classes: int = 1000, dtype=jnp.bfloat16,
-              stem: str = "conv") -> ResNet:
-    return ResNet([3, 4, 23, 3], num_classes, dtype, stem)
+              stem: str = "conv", remat: bool = False,
+              remat_prevent_cse: bool = True) -> ResNet:
+    return ResNet([3, 4, 23, 3], num_classes, dtype, stem, remat,
+                  remat_prevent_cse)
 
 
 def create_resnet_state(model: ResNet, rng_key, image_size: int = 224,

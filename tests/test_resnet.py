@@ -153,6 +153,40 @@ def test_scan_steps_matches_sequential(hvd):
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_resnet_remat_matches_plain(hvd):
+    """remat=True (jax.checkpoint per block) changes memory, not math:
+    one train step produces the same loss and params as the plain model."""
+    mesh = hvd.build_mesh(dp=-1)
+    tx = optax.sgd(0.05, momentum=0.9)
+    rng = np.random.RandomState(0)
+    images = jax.device_put(
+        jnp.asarray(rng.rand(8, 64, 64, 3), jnp.float32),
+        batch_sharding(mesh))
+    labels = jax.device_put(jnp.asarray(rng.randint(0, 8, (8,)), jnp.int32),
+                            batch_sharding(mesh))
+
+    outs = []
+    for remat in (False, True):
+        model = ResNet([1, 1, 1, 1], num_classes=8, dtype=jnp.float32,
+                       remat=remat)
+        params, batch_stats = create_resnet_state(
+            model, jax.random.PRNGKey(0), image_size=64, mesh=mesh)
+        step = make_resnet_train_step(model, tx, mesh)
+        p, bs, _, loss = step(params, batch_stats,
+                              jax.jit(tx.init)(params), images, labels)
+        loss.block_until_ready()
+        outs.append((p, bs, float(loss)))
+    (p0, bs0, l0), (p1, bs1, l1) = outs
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+    # params AND the mutable batch_stats (running mean/var updated inside
+    # the checkpointed blocks) must agree
+    for tree0, tree1 in ((p0, p1), (bs0, bs1)):
+        for a, b in zip(jax.tree_util.tree_leaves(tree0),
+                        jax.tree_util.tree_leaves(tree1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-7)
+
+
 def test_resnet_s2d_trains(hvd):
     mesh = hvd.build_mesh(dp=-1)
     model = ResNet([1, 1, 1, 1], num_classes=8, dtype=jnp.float32,
