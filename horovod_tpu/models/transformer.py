@@ -202,6 +202,17 @@ def _sharded_softmax_xent(logits_local, targets):
     return jnp.log(se) + m - corr     # [B, S]
 
 
+def _softmax_xent(logits_local, targets):
+    """Dispatch: tp-sharded vocab takes the psum algebra above; a full
+    local vocab takes the fused Pallas kernel (one HBM pass over the
+    logits; auto-falls back off-TPU / untiled — same self-gating pattern
+    as ``pallas_attention.attend``)."""
+    if _axis_live("tp"):
+        return _sharded_softmax_xent(logits_local, targets)
+    from horovod_tpu.ops.pallas_xent import fused_softmax_xent
+    return fused_softmax_xent(logits_local, targets)
+
+
 def _attention_block(p, x, positions, cfg: TransformerConfig):
     """x: [B', S', M] local. Heads sharded over tp; sequence over sp."""
     B, S, M = x.shape
@@ -326,7 +337,7 @@ def forward_loss_spmd(params, tokens, targets, cfg: TransformerConfig):
 
     x = _rmsnorm(x, params["ln_f"])
     logits_local = x @ params["embed"].astype(cfg.dtype).T    # [B,S,V/tp]
-    nll = _sharded_softmax_xent(logits_local, targets)        # [B,S]
+    nll = _softmax_xent(logits_local, targets)                # [B,S]
     loss = jnp.mean(nll)
     # average over data-like axes so every shard reports the global loss
     # (ep subdivides the batch — see data_sharding_spec)
