@@ -116,13 +116,13 @@ class HorovodEstimator(Params):
                     "metrics", "feature_cols", "label_cols", "validation",
                     "batch_size", "epochs", "verbose", "run_id",
                     "callbacks", "custom_objects", "shuffle",
-                    "learning_rate")
+                    "learning_rate", "sample_weight_col")
 
     def __init__(self, **kwargs) -> None:
         defaults = dict(num_proc=1, metrics=[], validation=None,
                         batch_size=32, epochs=1, verbose=1, shuffle=True,
                         callbacks=[], custom_objects={},
-                        learning_rate=1e-3)
+                        learning_rate=1e-3, sample_weight_col=None)
         defaults.update(kwargs)
         self._init_params(defaults)
         if self._store is None:
@@ -130,6 +130,10 @@ class HorovodEstimator(Params):
                 os.path.join(os.path.expanduser("~"), ".hvd_tpu_store"))
 
     # -- backend hooks -------------------------------------------------------
+    def _validate_params(self) -> None:
+        """Config errors detectable up front raise HERE, before any data
+        is materialized or artifacts written (fail fast on a cluster)."""
+
     def _save_model_spec(self, ckpt_dir: str) -> None:
         raise NotImplementedError
 
@@ -211,6 +215,7 @@ class HorovodEstimator(Params):
     def fit(self, df) -> HorovodModel:
         """Materialize data through the Store, train under the launcher,
         return the trained model (reference: ``Estimator.fit``)."""
+        self._validate_params()
         run_id = self._run_id or f"run_{uuid.uuid4().hex[:8]}"
         self._run_id = run_id
         store: Store = self._store

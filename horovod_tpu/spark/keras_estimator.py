@@ -71,6 +71,7 @@ class KerasEstimator(HorovodEstimator):
                  label_cols=list(self._label_cols),
                  batch_size=self._batch_size,
                  epochs=self._epochs,
+                 sample_weight_col=self._sample_weight_col,
                  verbose=self._verbose)).encode())
 
     def _make_remote_fn(self, ckpt_dir: str, train_path: str,
@@ -98,6 +99,10 @@ class KerasEstimator(HorovodEstimator):
 
             pdf = read_shard(store, train_path, hvd.rank(), hvd.size())
             X, Y = xy_arrays(pdf, spec["feature_cols"], spec["label_cols"])
+            sample_weight = None
+            if spec.get("sample_weight_col"):
+                sample_weight = pdf[spec["sample_weight_col"]].to_numpy(
+                    dtype=np.float32)
             val = None
             if val_path:
                 vX, vY = xy_arrays(read_shard(store, val_path, 0, 1),
@@ -110,6 +115,7 @@ class KerasEstimator(HorovodEstimator):
             # along after the distributed ones (spark/keras/estimator.py)
             hist = model.fit(X, Y, batch_size=spec["batch_size"],
                              epochs=spec["epochs"], validation_data=val,
+                             sample_weight=sample_weight,
                              verbose=spec["verbose"] if hvd.rank() == 0
                              else 0, callbacks=cb)
             if hvd.rank() == 0:

@@ -39,6 +39,14 @@ class TorchEstimator(HorovodEstimator):
     ``"MSELoss"``).
     """
 
+    def _validate_params(self) -> None:
+        loss_value = self._loss if self._loss is not None else "MSELoss"
+        if self._sample_weight_col and not isinstance(loss_value, str):
+            raise ValueError(
+                "sample_weight_col needs a NAMED torch loss (it is "
+                "rebuilt with reduction='none' on the workers); weight "
+                "inside your custom loss callable instead")
+
     def _save_model_spec(self, ckpt_dir: str) -> None:
         store = self._store
         store.write(store.join(ckpt_dir, "initial.pkl"),
@@ -66,6 +74,7 @@ class TorchEstimator(HorovodEstimator):
                  label_cols=list(self._label_cols),
                  batch_size=self._batch_size,
                  epochs=self._epochs,
+                 sample_weight_col=self._sample_weight_col,
                  verbose=self._verbose)).encode())
 
     def _make_remote_fn(self, ckpt_dir: str, train_path: str,
@@ -81,11 +90,29 @@ class TorchEstimator(HorovodEstimator):
                 store.join(ckpt_dir, "train_spec.json")))
             model = pickle.loads(store.read(
                 store.join(ckpt_dir, "initial.pkl")))
+            weight_col = spec.get("sample_weight_col")
+            eval_loss_fn = None
             if spec["loss_name"]:
-                loss_fn = getattr(torch.nn, spec["loss_name"])()
+                # validation stays UNWEIGHTED (reference semantics:
+                # sample weights shape training only)
+                eval_loss_fn = getattr(torch.nn, spec["loss_name"])()
+                if weight_col:
+                    # per-row losses, weighted mean below (reference:
+                    # torch estimator sample_weight_col)
+                    per_row = getattr(torch.nn, spec["loss_name"])(
+                        reduction="none")
+
+                    def loss_fn(pred, target, w):
+                        r = per_row(pred, target)
+                        r = r.reshape(r.shape[0], -1).mean(dim=1)
+                        return (r * w).sum() / w.sum().clamp_min(1e-12)
+                else:
+                    loss_fn = getattr(torch.nn, spec["loss_name"])()
             else:
                 loss_fn = pickle.loads(store.read(
                     store.join(ckpt_dir, "loss.pkl")))
+            if eval_loss_fn is None:
+                eval_loss_fn = loss_fn
             metric_fns = pickle.loads(store.read(
                 store.join(ckpt_dir, "metrics.pkl")))
             opt_cls = getattr(torch.optim, spec["optimizer"])
@@ -100,6 +127,8 @@ class TorchEstimator(HorovodEstimator):
             X, Y = xy_arrays(pdf, spec["feature_cols"], spec["label_cols"])
             X_t = torch.from_numpy(X)
             Y_t = torch.from_numpy(Y)
+            W_t = torch.from_numpy(pdf[weight_col].to_numpy(
+                dtype=np.float32)) if weight_col else None
             val = None
             if val_path:
                 vX, vY = xy_arrays(read_shard(store, val_path, 0, 1),
@@ -120,7 +149,12 @@ class TorchEstimator(HorovodEstimator):
                 losses = []
                 for i in range(0, len(X_t), bs):
                     opt.zero_grad()
-                    loss = loss_fn(model(X_t[i:i + bs]), Y_t[i:i + bs])
+                    pred = model(X_t[i:i + bs])
+                    if W_t is not None:
+                        loss = loss_fn(pred, Y_t[i:i + bs],
+                                       W_t[i:i + bs])
+                    else:
+                        loss = loss_fn(pred, Y_t[i:i + bs])
                     loss.backward()
                     opt.step()
                     losses.append(float(loss.detach()))
@@ -147,7 +181,7 @@ class TorchEstimator(HorovodEstimator):
                 if val is not None:
                     model.eval()
                     with torch.no_grad():
-                        vloss = float(loss_fn(model(val[0]), val[1]))
+                        vloss = float(eval_loss_fn(model(val[0]), val[1]))
                     history["val_loss"].append(vloss)
                 if spec["verbose"] and hvd.rank() == 0:
                     print(f"[torch-estimator] epoch {epoch}: loss={mean}",

@@ -75,6 +75,56 @@ def test_torch_estimator_fit_transform(tmp_path):
 
 
 @needs_core
+def test_torch_estimator_sample_weight_col(tmp_path):
+    """sample_weight_col: zero-weight rows (with deliberately corrupted
+    labels) must not influence training (reference: sample_weight_col)."""
+    torch = pytest.importorskip("torch")
+    df = _regression_df(n=80)
+    df["w"] = 1.0
+    corrupt = np.arange(0, 80, 2)
+    df.loc[corrupt, "y"] = 100.0   # poison...
+    df.loc[corrupt, "w"] = 0.0     # ...but weightless
+    est = TorchEstimator(
+        model=torch.nn.Linear(4, 1), optimizer="SGD", loss="MSELoss",
+        feature_cols=[f"x{i}" for i in range(4)], label_cols=["y"],
+        store=LocalStore(str(tmp_path)), num_proc=2, epochs=10,
+        batch_size=16, learning_rate=0.05, verbose=0,
+        sample_weight_col="w")
+    trained = est.fit(df)
+    clean = df[df["w"] == 1.0]
+    out = trained.transform(clean.head(10))
+    err = np.mean((out["y__output"].to_numpy()
+                   - out["y"].to_numpy()) ** 2)
+    assert err < 0.5, err  # poisoned rows would blow this up
+
+
+@needs_core
+def test_keras_estimator_sample_weight_col(tmp_path):
+    """Keras backend: the weight column rides to model.fit's
+    sample_weight on each worker."""
+    tf = pytest.importorskip("tensorflow")
+    df = _regression_df(n=60)
+    df["w"] = 1.0
+    corrupt = np.arange(0, 60, 2)
+    df.loc[corrupt, "y"] = 100.0
+    df.loc[corrupt, "w"] = 0.0
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Input((4,)), tf.keras.layers.Dense(1)])
+    est = KerasEstimator(
+        model=model, optimizer="SGD", loss="mse",
+        feature_cols=[f"x{i}" for i in range(4)], label_cols=["y"],
+        store=LocalStore(str(tmp_path)), num_proc=2, epochs=8,
+        batch_size=16, learning_rate=0.05, verbose=0,
+        sample_weight_col="w")
+    trained = est.fit(df)
+    clean = df[df["w"] == 1.0]
+    out = trained.transform(clean.head(10))
+    err = np.mean((out["y__output"].to_numpy()
+                   - out["y"].to_numpy()) ** 2)
+    assert err < 1.0, err
+
+
+@needs_core
 def test_torch_estimator_metrics_param(tmp_path):
     """The metrics param rides to the workers (cloudpickled BY VALUE, as
     a user's notebook-defined metric would) and produces per-epoch,
