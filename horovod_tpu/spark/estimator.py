@@ -116,13 +116,16 @@ class HorovodEstimator(Params):
                     "metrics", "feature_cols", "label_cols", "validation",
                     "batch_size", "epochs", "verbose", "run_id",
                     "callbacks", "custom_objects", "shuffle",
-                    "learning_rate", "sample_weight_col")
+                    "learning_rate", "sample_weight_col",
+                    "train_steps_per_epoch", "validation_steps_per_epoch")
 
     def __init__(self, **kwargs) -> None:
         defaults = dict(num_proc=1, metrics=[], validation=None,
                         batch_size=32, epochs=1, verbose=1, shuffle=True,
                         callbacks=[], custom_objects={},
-                        learning_rate=1e-3, sample_weight_col=None)
+                        learning_rate=1e-3, sample_weight_col=None,
+                        train_steps_per_epoch=None,
+                        validation_steps_per_epoch=None)
         defaults.update(kwargs)
         self._init_params(defaults)
         if self._store is None:

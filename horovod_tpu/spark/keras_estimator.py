@@ -72,6 +72,9 @@ class KerasEstimator(HorovodEstimator):
                  batch_size=self._batch_size,
                  epochs=self._epochs,
                  sample_weight_col=self._sample_weight_col,
+                 train_steps_per_epoch=self._train_steps_per_epoch,
+                 validation_steps_per_epoch=self
+                 ._validation_steps_per_epoch,
                  verbose=self._verbose)).encode())
 
     def _make_remote_fn(self, ckpt_dir: str, train_path: str,
@@ -116,6 +119,11 @@ class KerasEstimator(HorovodEstimator):
             hist = model.fit(X, Y, batch_size=spec["batch_size"],
                              epochs=spec["epochs"], validation_data=val,
                              sample_weight=sample_weight,
+                             steps_per_epoch=spec.get(
+                                 "train_steps_per_epoch"),
+                             validation_steps=spec.get(
+                                 "validation_steps_per_epoch")
+                             if val is not None else None,
                              verbose=spec["verbose"] if hvd.rank() == 0
                              else 0, callbacks=cb)
             if hvd.rank() == 0:

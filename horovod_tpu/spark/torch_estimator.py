@@ -39,9 +39,13 @@ class TorchEstimator(HorovodEstimator):
     ``"MSELoss"``).
     """
 
+    def _loss_value(self):
+        """The effective loss param (single source of the default)."""
+        return self._loss if self._loss is not None else "MSELoss"
+
     def _validate_params(self) -> None:
-        loss_value = self._loss if self._loss is not None else "MSELoss"
-        if self._sample_weight_col and not isinstance(loss_value, str):
+        if self._sample_weight_col and \
+                not isinstance(self._loss_value(), str):
             raise ValueError(
                 "sample_weight_col needs a NAMED torch loss (it is "
                 "rebuilt with reduction='none' on the workers); weight "
@@ -51,7 +55,7 @@ class TorchEstimator(HorovodEstimator):
         store = self._store
         store.write(store.join(ckpt_dir, "initial.pkl"),
                     pickle.dumps(self._model))
-        loss_value = self._loss if self._loss is not None else "MSELoss"
+        loss_value = self._loss_value()
         loss = loss_value if isinstance(loss_value, str) else None
         store.write(store.join(ckpt_dir, "loss.pkl"),
                     pickle.dumps(loss_value if loss is None else None))
@@ -75,6 +79,9 @@ class TorchEstimator(HorovodEstimator):
                  batch_size=self._batch_size,
                  epochs=self._epochs,
                  sample_weight_col=self._sample_weight_col,
+                 train_steps_per_epoch=self._train_steps_per_epoch,
+                 validation_steps_per_epoch=self
+                 ._validation_steps_per_epoch,
                  verbose=self._verbose)).encode())
 
     def _make_remote_fn(self, ckpt_dir: str, train_path: str,
@@ -107,7 +114,7 @@ class TorchEstimator(HorovodEstimator):
                         r = r.reshape(r.shape[0], -1).mean(dim=1)
                         return (r * w).sum() / w.sum().clamp_min(1e-12)
                 else:
-                    loss_fn = getattr(torch.nn, spec["loss_name"])()
+                    loss_fn = eval_loss_fn
             else:
                 loss_fn = pickle.loads(store.read(
                     store.join(ckpt_dir, "loss.pkl")))
@@ -139,6 +146,27 @@ class TorchEstimator(HorovodEstimator):
                 return getattr(fn, "__name__", None) or f"metric_{i}"
 
             bs = spec["batch_size"]
+            # optional per-epoch step caps (reference:
+            # train_steps_per_epoch / validation_steps_per_epoch). The
+            # train window ROTATES through the shard across epochs, like
+            # a dataloader that keeps advancing — a fixed prefix would
+            # silently never train the tail rows.
+            n_train = len(X_t)
+            if spec.get("train_steps_per_epoch"):
+                n_train = min(n_train,
+                              spec["train_steps_per_epoch"] * bs)
+            if val is not None and spec.get("validation_steps_per_epoch"):
+                cap = spec["validation_steps_per_epoch"] * bs
+                val = (val[0][:cap], val[1][:cap])
+
+            def epoch_window(epoch):
+                if n_train == len(X_t):
+                    return X_t, Y_t, W_t
+                idx = (torch.arange(n_train)
+                       + epoch * n_train) % len(X_t)
+                return (X_t[idx], Y_t[idx],
+                        W_t[idx] if W_t is not None else None)
+
             history = {"loss": []}
             for i, fn in enumerate(metric_fns):
                 history[metric_name(i, fn)] = []
@@ -147,14 +175,15 @@ class TorchEstimator(HorovodEstimator):
             for epoch in range(spec["epochs"]):
                 model.train()
                 losses = []
-                for i in range(0, len(X_t), bs):
+                Xe, Ye, We = epoch_window(epoch)
+                for i in range(0, n_train, bs):
                     opt.zero_grad()
-                    pred = model(X_t[i:i + bs])
-                    if W_t is not None:
-                        loss = loss_fn(pred, Y_t[i:i + bs],
-                                       W_t[i:i + bs])
+                    pred = model(Xe[i:i + bs])
+                    if We is not None:
+                        loss = loss_fn(pred, Ye[i:i + bs],
+                                       We[i:i + bs])
                     else:
-                        loss = loss_fn(pred, Y_t[i:i + bs])
+                        loss = loss_fn(pred, Ye[i:i + bs])
                     loss.backward()
                     opt.step()
                     losses.append(float(loss.detach()))

@@ -125,6 +125,32 @@ def test_keras_estimator_sample_weight_col(tmp_path):
 
 
 @needs_core
+def test_torch_estimator_train_steps_cap(tmp_path):
+    """train_steps_per_epoch bounds each epoch's optimizer steps
+    (reference param of the same name): with identical seeds and epochs,
+    the capped fit (1 step/epoch) must end at a clearly WORSE loss than
+    the uncapped one — a cap regression would make them equal."""
+    torch = pytest.importorskip("torch")
+    df = _regression_df(n=160)
+
+    def run(cap, sub):
+        torch.manual_seed(0)
+        est = TorchEstimator(
+            model=torch.nn.Linear(4, 1), optimizer="SGD", loss="MSELoss",
+            feature_cols=[f"x{i}" for i in range(4)], label_cols=["y"],
+            store=LocalStore(str(tmp_path / sub)), num_proc=2, epochs=2,
+            batch_size=16, learning_rate=0.05, verbose=0,
+            train_steps_per_epoch=cap)
+        return est.fit(df)
+
+    capped = run(1, "capped")      # 2 steps total per worker
+    full = run(None, "full")       # 10 steps total per worker
+    assert len(capped.history["loss"]) == 2
+    assert capped.history["loss"][-1] > full.history["loss"][-1] * 2, (
+        capped.history["loss"], full.history["loss"])
+
+
+@needs_core
 def test_torch_estimator_metrics_param(tmp_path):
     """The metrics param rides to the workers (cloudpickled BY VALUE, as
     a user's notebook-defined metric would) and produces per-epoch,
