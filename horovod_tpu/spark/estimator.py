@@ -32,6 +32,16 @@ def _to_pandas(df):
     return df
 
 
+def _by_value_pickler():
+    """cloudpickle when available (serializes notebook/nested functions BY
+    VALUE); stdlib pickle otherwise."""
+    try:
+        import cloudpickle
+        return cloudpickle
+    except ImportError:
+        return pickle
+
+
 def _split_frame(pdf, shuffle: bool, validation, seed: int):
     """THE split semantics, shared by both materialization paths:
     optional seeded shuffle, then either a float-fraction validation cut
@@ -117,7 +127,8 @@ class HorovodEstimator(Params):
                     "batch_size", "epochs", "verbose", "run_id",
                     "callbacks", "custom_objects", "shuffle",
                     "learning_rate", "sample_weight_col",
-                    "train_steps_per_epoch", "validation_steps_per_epoch")
+                    "train_steps_per_epoch", "validation_steps_per_epoch",
+                    "transformation_fn")
 
     def __init__(self, **kwargs) -> None:
         defaults = dict(num_proc=1, metrics=[], validation=None,
@@ -125,7 +136,8 @@ class HorovodEstimator(Params):
                         callbacks=[], custom_objects={},
                         learning_rate=1e-3, sample_weight_col=None,
                         train_steps_per_epoch=None,
-                        validation_steps_per_epoch=None)
+                        validation_steps_per_epoch=None,
+                        transformation_fn=None)
         defaults.update(kwargs)
         self._init_params(defaults)
         if self._store is None:
@@ -219,6 +231,10 @@ class HorovodEstimator(Params):
         """Materialize data through the Store, train under the launcher,
         return the trained model (reference: ``Estimator.fit``)."""
         self._validate_params()
+        # serialize the transformation up front: an unpicklable closure
+        # must fail in seconds, not after a full-dataset materialization
+        transform_bytes = _by_value_pickler().dumps(
+            self._transformation_fn)
         run_id = self._run_id or f"run_{uuid.uuid4().hex[:8]}"
         self._run_id = run_id
         store: Store = self._store
@@ -244,6 +260,11 @@ class HorovodEstimator(Params):
         ckpt_dir = store.get_checkpoint_path(run_id)
         store.makedirs(ckpt_dir)
         self._save_model_spec(ckpt_dir)
+        # transformation_fn: fn(pdf) -> pdf applied to every worker's
+        # shard before train AND validation (reference: the param of the
+        # same name, spark/common/params.py); serialized above, fail-fast
+        store.write(store.join(ckpt_dir, "transform.pkl"),
+                    transform_bytes)
 
         remote = self._make_remote_fn(ckpt_dir, train_path, val_path)
         in_spark = False
@@ -271,10 +292,18 @@ def _parquet_bytes(pdf) -> bytes:
     return buf.getvalue()
 
 
-def read_shard(store: Store, data_path: str, rank: int, size: int):
+def load_transform(store: Store, ckpt_dir: str):
+    """Worker-side: the estimator's transformation_fn (or None)."""
+    return pickle.loads(store.read(store.join(ckpt_dir, "transform.pkl")))
+
+
+def read_shard(store: Store, data_path: str, rank: int, size: int,
+               transform=None):
     """Worker-side shard read through the Store (the reference partitions
     Petastorm row groups per rank). The store travels to the worker by
-    pickle, so remote backends reconnect there.
+    pickle, so remote backends reconnect there. ``transform`` (the
+    estimator's transformation_fn) is applied to the shard before it is
+    returned — ONE site, so train/val and keras/torch can't drift.
 
     With at least ``size`` part files (the distributed materialization
     writes one per DataFrame partition), files are assigned round-robin
@@ -295,8 +324,12 @@ def read_shard(store: Store, data_path: str, rank: int, size: int):
             frames, ignore_index=True)
 
     if len(files) >= size:
-        return load(files[rank::size]).reset_index(drop=True)
-    return load(files).iloc[rank::size].reset_index(drop=True)
+        pdf = load(files[rank::size]).reset_index(drop=True)
+    else:
+        pdf = load(files).iloc[rank::size].reset_index(drop=True)
+    if transform is not None:
+        pdf = transform(pdf).reset_index(drop=True)
+    return pdf
 
 
 def xy_arrays(pdf, feature_cols: Sequence[str], label_cols: Sequence[str]):

@@ -17,7 +17,8 @@ from typing import Callable
 import numpy as np
 
 from horovod_tpu.spark.estimator import (HorovodEstimator, HorovodModel,
-                                         read_shard, xy_arrays)
+                                         load_transform, read_shard,
+                                         xy_arrays)
 
 
 def _save_keras(store, ckpt_dir: str, model, tag: str,
@@ -100,7 +101,9 @@ class KerasEstimator(HorovodEstimator):
                 optimizer=hvd_keras.DistributedOptimizer(opt),
                 loss=spec["loss"], metrics=spec["metrics"])
 
-            pdf = read_shard(store, train_path, hvd.rank(), hvd.size())
+            transform = load_transform(store, ckpt_dir)
+            pdf = read_shard(store, train_path, hvd.rank(), hvd.size(),
+                             transform=transform)
             X, Y = xy_arrays(pdf, spec["feature_cols"], spec["label_cols"])
             sample_weight = None
             if spec.get("sample_weight_col"):
@@ -108,8 +111,9 @@ class KerasEstimator(HorovodEstimator):
                     dtype=np.float32)
             val = None
             if val_path:
-                vX, vY = xy_arrays(read_shard(store, val_path, 0, 1),
-                                   spec["feature_cols"],
+                vpdf = read_shard(store, val_path, 0, 1,
+                                  transform=transform)
+                vX, vY = xy_arrays(vpdf, spec["feature_cols"],
                                    spec["label_cols"])
                 val = (vX, vY)
             cb = [hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),

@@ -17,7 +17,8 @@ from typing import Callable
 import numpy as np
 
 from horovod_tpu.spark.estimator import (HorovodEstimator, HorovodModel,
-                                         read_shard, xy_arrays)
+                                         load_transform, read_shard,
+                                         xy_arrays)
 
 
 class TorchModel(HorovodModel):
@@ -64,12 +65,9 @@ class TorchEstimator(HorovodEstimator):
         # spark/torch/estimator.py metrics param + remote.py aggregation).
         # cloudpickle serializes them BY VALUE, so user-module / notebook
         # functions survive the trip to worker processes.
-        try:
-            import cloudpickle as metrics_pickler
-        except ImportError:
-            metrics_pickler = pickle
+        from horovod_tpu.spark.estimator import _by_value_pickler
         store.write(store.join(ckpt_dir, "metrics.pkl"),
-                    metrics_pickler.dumps(list(self._metrics or [])))
+                    _by_value_pickler().dumps(list(self._metrics or [])))
         store.write(store.join(ckpt_dir, "train_spec.json"), json.dumps(
             dict(optimizer=self._optimizer or "SGD",
                  learning_rate=self._learning_rate,
@@ -130,7 +128,9 @@ class TorchEstimator(HorovodEstimator):
             thvd.broadcast_parameters(model.state_dict(), root_rank=0)
             thvd.broadcast_optimizer_state(opt, root_rank=0)
 
-            pdf = read_shard(store, train_path, hvd.rank(), hvd.size())
+            transform = load_transform(store, ckpt_dir)
+            pdf = read_shard(store, train_path, hvd.rank(), hvd.size(),
+                             transform=transform)
             X, Y = xy_arrays(pdf, spec["feature_cols"], spec["label_cols"])
             X_t = torch.from_numpy(X)
             Y_t = torch.from_numpy(Y)
@@ -138,8 +138,9 @@ class TorchEstimator(HorovodEstimator):
                 dtype=np.float32)) if weight_col else None
             val = None
             if val_path:
-                vX, vY = xy_arrays(read_shard(store, val_path, 0, 1),
-                                   spec["feature_cols"],
+                vpdf = read_shard(store, val_path, 0, 1,
+                                  transform=transform)
+                vX, vY = xy_arrays(vpdf, spec["feature_cols"],
                                    spec["label_cols"])
                 val = (torch.from_numpy(vX), torch.from_numpy(vY))
             def metric_name(i, fn):

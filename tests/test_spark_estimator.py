@@ -125,6 +125,63 @@ def test_keras_estimator_sample_weight_col(tmp_path):
 
 
 @needs_core
+def test_torch_estimator_transformation_fn(tmp_path):
+    """transformation_fn (cloudpickled by value) runs on each worker's
+    shard before training: here it UNDOES a deliberate label corruption,
+    so convergence proves it really executed (reference param)."""
+    torch = pytest.importorskip("torch")
+    df = _regression_df()
+    df["y"] = df["y"] + 1000.0  # corrupted at materialization time
+
+    def fix(pdf):
+        out = pdf.copy()
+        out["y"] = out["y"] - 1000.0
+        return out
+
+    est = TorchEstimator(
+        model=torch.nn.Linear(4, 1), optimizer="SGD", loss="MSELoss",
+        feature_cols=[f"x{i}" for i in range(4)], label_cols=["y"],
+        store=LocalStore(str(tmp_path)), num_proc=2, epochs=8,
+        batch_size=16, learning_rate=0.05, verbose=0,
+        transformation_fn=fix)
+    trained = est.fit(df)
+    out = trained.transform(df.head(10))
+    err = np.mean((out["y__output"].to_numpy()
+                   - (out["y"].to_numpy() - 1000.0)) ** 2)
+    assert err < 0.5, err  # without the transform, labels are +1000 off
+
+
+@needs_core
+def test_keras_estimator_transformation_fn(tmp_path):
+    """Keras backend: the transform runs before sample-weight extraction
+    too — it SETS the weight column that zeroes poisoned rows."""
+    tf = pytest.importorskip("tensorflow")
+    df = _regression_df(n=60)
+    corrupt = np.arange(0, 60, 2)
+    df.loc[corrupt, "y"] = 100.0
+
+    def add_weights(pdf):
+        out = pdf.copy()
+        out["w"] = (out["y"] < 50.0).astype("float32")
+        return out
+
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Input((4,)), tf.keras.layers.Dense(1)])
+    est = KerasEstimator(
+        model=model, optimizer="SGD", loss="mse",
+        feature_cols=[f"x{i}" for i in range(4)], label_cols=["y"],
+        store=LocalStore(str(tmp_path)), num_proc=2, epochs=8,
+        batch_size=16, learning_rate=0.05, verbose=0,
+        sample_weight_col="w", transformation_fn=add_weights)
+    trained = est.fit(df)
+    clean = df[df["y"] < 50.0]
+    out = trained.transform(clean.head(10))
+    err = np.mean((out["y__output"].to_numpy()
+                   - out["y"].to_numpy()) ** 2)
+    assert err < 1.0, err
+
+
+@needs_core
 def test_torch_estimator_train_steps_cap(tmp_path):
     """train_steps_per_epoch bounds each epoch's optimizer steps
     (reference param of the same name): with identical seeds and epochs,
