@@ -128,7 +128,7 @@ class HorovodEstimator(Params):
                     "callbacks", "custom_objects", "shuffle",
                     "learning_rate", "sample_weight_col",
                     "train_steps_per_epoch", "validation_steps_per_epoch",
-                    "transformation_fn")
+                    "transformation_fn", "backward_passes_per_step")
 
     def __init__(self, **kwargs) -> None:
         defaults = dict(num_proc=1, metrics=[], validation=None,
@@ -137,7 +137,8 @@ class HorovodEstimator(Params):
                         learning_rate=1e-3, sample_weight_col=None,
                         train_steps_per_epoch=None,
                         validation_steps_per_epoch=None,
-                        transformation_fn=None)
+                        transformation_fn=None,
+                        backward_passes_per_step=1)
         defaults.update(kwargs)
         self._init_params(defaults)
         if self._store is None:

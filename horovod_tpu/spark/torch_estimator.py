@@ -80,6 +80,7 @@ class TorchEstimator(HorovodEstimator):
                  train_steps_per_epoch=self._train_steps_per_epoch,
                  validation_steps_per_epoch=self
                  ._validation_steps_per_epoch,
+                 backward_passes_per_step=self._backward_passes_per_step,
                  verbose=self._verbose)).encode())
 
     def _make_remote_fn(self, ckpt_dir: str, train_path: str,
@@ -121,10 +122,12 @@ class TorchEstimator(HorovodEstimator):
             metric_fns = pickle.loads(store.read(
                 store.join(ckpt_dir, "metrics.pkl")))
             opt_cls = getattr(torch.optim, spec["optimizer"])
+            bpps = max(1, int(spec.get("backward_passes_per_step") or 1))
             opt = thvd.DistributedOptimizer(
                 opt_cls(model.parameters(),
                         lr=spec["learning_rate"] * hvd.size()),
-                named_parameters=model.named_parameters())
+                named_parameters=model.named_parameters(),
+                backward_passes_per_step=bpps)
             thvd.broadcast_parameters(model.state_dict(), root_rank=0)
             thvd.broadcast_optimizer_state(opt, root_rank=0)
 
@@ -173,12 +176,19 @@ class TorchEstimator(HorovodEstimator):
                 history[metric_name(i, fn)] = []
             if val is not None:
                 history["val_loss"] = []
+            # with gradient accumulation, only FULL k-backward groups
+            # step (a trailing partial group would leave hook enqueues
+            # mid-countdown across the epoch boundary)
+            batch_starts = list(range(0, n_train, bs))
+            if bpps > 1:
+                batch_starts = batch_starts[
+                    :(len(batch_starts) // bpps) * bpps]
             for epoch in range(spec["epochs"]):
                 model.train()
                 losses = []
                 Xe, Ye, We = epoch_window(epoch)
-                for i in range(0, n_train, bs):
-                    opt.zero_grad()
+                opt.zero_grad()
+                for k, i in enumerate(batch_starts, start=1):
                     pred = model(Xe[i:i + bs])
                     if We is not None:
                         loss = loss_fn(pred, Ye[i:i + bs],
@@ -186,7 +196,9 @@ class TorchEstimator(HorovodEstimator):
                     else:
                         loss = loss_fn(pred, Ye[i:i + bs])
                     loss.backward()
-                    opt.step()
+                    if k % bpps == 0:
+                        opt.step()
+                        opt.zero_grad()
                     losses.append(float(loss.detach()))
                 # epoch loss averaged across workers, WEIGHTED by batch
                 # count, so an unequal (or empty) shard can't poison the
