@@ -47,13 +47,6 @@ import time
 
 REFERENCE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # docs/benchmarks.rst:32-43
 
-# Peak dense bf16 FLOPs per chip by device-kind substring (public specs).
-PEAK_BF16_FLOPS = (
-    ("v5 lite", 197e12), ("v5litepod", 197e12), ("v5e", 197e12),
-    ("v5p", 459e12), ("v6", 918e12), ("v4", 275e12), ("v3", 123e12),
-    ("v2", 45e12),
-)
-
 # fwd GMACs per image (224 input; inception3 at its native 299);
 # FLOPs = 2x MACs, training ~3x forward.
 FWD_MACS_PER_IMG = {"resnet50": 4.09e9, "resnet101": 7.6e9,
@@ -93,11 +86,51 @@ def _log(msg: str) -> None:
 
 
 def _peak_flops(device_kind: str):
-    kind = device_kind.lower()
-    for sub, peak in PEAK_BF16_FLOPS:
-        if sub in kind:
-            return peak
-    return None
+    # single source of truth shared with the train-loop telemetry
+    from horovod_tpu.metrics.mfu import peak_flops
+    return peak_flops(device_kind)
+
+
+# -- per-phase timing (child side) -------------------------------------------
+# Cumulative phase -> seconds, persisted to HVD_BENCH_PHASE_FILE at every
+# boundary so a deadline-killed child still leaves a record of WHERE the
+# wall clock went (device init vs compile vs measure). The file also names
+# the phase in flight at kill time. Every emitted result doc embeds the
+# same dict under "phases".
+_PHASES = {}
+_PHASE_IN_PROGRESS = None
+
+
+def _flush_phase_file() -> None:
+    path = os.environ.get("HVD_BENCH_PHASE_FILE")
+    if not path:
+        return
+    try:
+        # atomic replace: a kill landing mid-write must not truncate the
+        # record this side channel exists to preserve
+        with open(path + ".tmp", "w") as f:
+            json.dump({"phases": _PHASES,
+                       "in_progress": _PHASE_IN_PROGRESS}, f)
+        os.replace(path + ".tmp", path)
+    except OSError:
+        pass
+
+
+def _begin_phase(name: str) -> float:
+    global _PHASE_IN_PROGRESS
+    _PHASE_IN_PROGRESS = name
+    _flush_phase_file()
+    return time.perf_counter()
+
+
+def _end_phase(name: str, t0: float) -> float:
+    global _PHASE_IN_PROGRESS
+    dt = time.perf_counter() - t0
+    _PHASES[name] = round(_PHASES.get(name, 0.0) + dt, 2)
+    _PHASE_IN_PROGRESS = None
+    _flush_phase_file()
+    _log(f"phase {name}: {dt:.1f}s")
+    return dt
 
 
 def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
@@ -144,26 +177,32 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
             "compile_s": round(compile_s, 1),
             "timing_iters": n_iters,
             "commit": _git_commit(),
+            "phases": dict(_PHASES),
             **ex,
         }
         if provisional:
             doc["provisional"] = True
         print(json.dumps(doc), flush=True)
 
+    global _T_SETUP0
+    if _T_SETUP0 is not None:
+        # model/optimizer/data construction since the device_init phase
+        _end_phase("setup", _T_SETUP0)
+        _T_SETUP0 = None
     _log("compiling (first step)...")
-    t_c0 = time.perf_counter()
+    t_c0 = _begin_phase("compile")
     state, loss = step_fn(state)
     readback(loss)
-    compile_s = time.perf_counter() - t_c0
+    compile_s = _end_phase("compile", t_c0)
     _log(f"first step (compile+run) took {compile_s:.1f}s; warmup window...")
 
     # measured warmup window -> provisional result (analytic FLOPs: cheap)
     warmup_iters = 2
-    t_w0 = time.perf_counter()
+    t_w0 = _begin_phase("warmup")
     for _ in range(warmup_iters):
         state, loss = step_fn(state)
     readback(loss)
-    dt_w = time.perf_counter() - t_w0
+    dt_w = _end_phase("warmup", t_w0)
     emit(per_step_units * warmup_iters / dt_w / n_chips, dt_w, warmup_iters,
          provisional=True, flops_per_device=analytic_flops_per_device(),
          flops_src="analytic", compile_s=compile_s)
@@ -185,11 +224,11 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
              "exiting cleanly")
         sys.exit(0)
 
-    t0 = time.perf_counter()
+    t0 = _begin_phase("measure")
     for _ in range(iters):
         state, loss = step_fn(state)
     readback(loss)  # forces completion of the whole chain
-    dt = time.perf_counter() - t0
+    dt = _end_phase("measure", t0)
     _log(f"timing window {dt:.2f}s for {iters} iters")
 
     per_chip = per_step_units * iters / dt / n_chips
@@ -215,6 +254,11 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
     emit(per_chip, dt, iters, provisional=False,
          flops_per_device=flops_per_device, flops_src=flops_src,
          compile_s=compile_s)
+
+
+# wall-clock start of model/data setup, stamped by _child() after device
+# init; consumed (into the "setup" phase) by _measure_and_report
+_T_SETUP0 = None
 
 
 class _Run:
@@ -604,10 +648,20 @@ def _child() -> None:
     # selects the TPU plugin: a CPU-targeted child must never hang waiting
     # on the TPU relay (env var alone loses to a config.update made at
     # interpreter startup)
+    global _T_SETUP0
     if os.environ.get("JAX_PLATFORMS"):
         import jax
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     _enable_compile_cache()
+    # device_init: first backend touch claims the chips (through the TPU
+    # relay this alone can eat minutes — make it attributable)
+    t0 = _begin_phase("device_init")
+    import jax
+    jax.devices()
+    _end_phase("device_init", t0)
+    # setup phase (model/optimizer/data construction) stays open until
+    # _measure_and_report closes it — a kill in here must be attributable
+    _T_SETUP0 = _begin_phase("setup")
     which = os.environ.get("HVD_BENCH_MODEL", "resnet50").lower()
     if which in ("bert", "bert_large"):  # zoo key and short form
         _child_bert()
@@ -625,16 +679,58 @@ def _child() -> None:
         sys.exit(2)
 
 
+# Latest per-phase timing record recovered from a child (via its
+# HVD_BENCH_PHASE_FILE), so even a deadline-killed attempt's failure JSON
+# says where the wall clock went: {"phases": {...}, "in_progress": name}.
+_LAST_PHASES = None
+
+
+def _read_phase_file(path) -> None:
+    global _LAST_PHASES
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        # a child killed INSIDE its first phase has phases == {} but
+        # in_progress set — that record is the whole point (it names the
+        # phase that ate the deadline, e.g. a wedged device_init)
+        if isinstance(doc, dict) and (doc.get("phases") or
+                                      doc.get("in_progress")):
+            _LAST_PHASES = doc
+    except (OSError, ValueError):
+        pass
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def _attach_phases(doc: dict) -> dict:
+    """Fold the recovered per-phase timings into an outgoing result doc
+    (no-op for docs that already carry their own "phases")."""
+    if "phases" not in doc:
+        doc["phases"] = (_LAST_PHASES or {}).get("phases", {})
+    in_progress = (_LAST_PHASES or {}).get("in_progress")
+    if in_progress and "phase_in_progress" not in doc:
+        doc["phase_in_progress"] = in_progress
+    return doc
+
+
 def _run_attempt(deadline_s):
     """Run one child attempt, STREAMING its stdout so lines emitted before
     a deadline kill survive. Returns ``(final_line | None,
     provisional_line | None, error | None)`` — ``final_line`` is the
     non-provisional result; ``provisional_line`` the warmup-window one."""
+    import tempfile
     lines = []
     env = dict(os.environ)
     # child exits cleanly 90s before we would have to kill it (a killed
     # TPU child can wedge the relay lease for the following run)
     env["HVD_BENCH_CHILD_DEADLINE"] = str(time.time() + deadline_s - 90)
+    # side-channel for per-phase timings: survives a SIGKILLed child
+    phase_fd, phase_path = tempfile.mkstemp(prefix="hvd_bench_phases_",
+                                            suffix=".json")
+    os.close(phase_fd)
+    env["HVD_BENCH_PHASE_FILE"] = phase_path
     proc = subprocess.Popen(
         [sys.executable, "-u", os.path.abspath(__file__), "--child"],
         stdout=subprocess.PIPE, stderr=sys.stderr, text=True, bufsize=1,
@@ -687,6 +783,8 @@ def _run_attempt(deadline_s):
     except OSError:
         pass
     reader.join(timeout=10)
+
+    _read_phase_file(phase_path)
 
     final = provisional = None
     for line in list(lines):  # snapshot: drain thread may yet be alive
@@ -751,7 +849,7 @@ def main() -> None:
         attempts_run += 1
         line, provisional, err = _run_attempt(deadline_s=remaining)
         if line is not None:
-            print(line, flush=True)
+            print(json.dumps(_attach_phases(json.loads(line))), flush=True)
             return
         if provisional is not None:
             best_provisional = provisional
@@ -768,7 +866,7 @@ def main() -> None:
         doc = json.loads(best_provisional)
         doc["note"] = ("final timing window did not complete: "
                        + "; ".join(errors)[-400:])
-        print(json.dumps(doc), flush=True)
+        print(json.dumps(_attach_phases(doc)), flush=True)
         return
     # Persistent failure: still emit one parseable JSON line, rc 0.
     # last_measured carries the most recent REAL-hardware result for this
@@ -787,7 +885,7 @@ def main() -> None:
                         last = run
     except (OSError, ValueError, KeyError):
         pass
-    print(json.dumps({
+    print(json.dumps(_attach_phases({
         "metric": metric,
         "value": None,
         "unit": unit,
@@ -796,7 +894,7 @@ def main() -> None:
         "error": "; ".join(errors)[-800:],
         "attempts": attempts_run,
         "last_measured": last,
-    }), flush=True)
+    })), flush=True)
 
 
 if __name__ == "__main__":

@@ -30,12 +30,33 @@ def main() -> int:
         print(f"expected 1 stdout line, got {len(lines)}:\n{out.stdout}")
         return 1
     doc = json.loads(lines[0])
-    for key in ("metric", "value", "unit", "vs_baseline", "mfu"):
+    for key in ("metric", "value", "unit", "vs_baseline", "mfu", "phases"):
         if key not in doc:
             print(f"missing key {key!r} in {doc}")
             return 1
     if doc["value"] is None and "error" not in doc:
         print(f"null value without diagnostic error: {doc}")
+        return 1
+    # per-phase timing contract: a run that got as far as touching devices
+    # must say WHERE the wall clock went — either completed phases
+    # (device_init, setup, compile, warmup, measure: cumulative seconds) or
+    # at minimum the phase in flight at kill time. A child that died
+    # BEFORE its first phase boundary (import crash, unwritable tmpdir)
+    # legitimately has neither — there the diagnostic is doc["error"],
+    # already required above.
+    phases = doc["phases"]
+    if not isinstance(phases, dict):
+        print(f"'phases' is not a dict: {doc}")
+        return 1
+    if not any(isinstance(v, (int, float)) for v in phases.values()) \
+            and not doc.get("phase_in_progress") \
+            and not doc.get("error"):
+        print(f"no per-phase timings and no phase_in_progress: {doc}")
+        return 1
+    known = {"device_init", "setup", "compile", "warmup", "measure"}
+    bogus = set(phases) - known
+    if bogus:
+        print(f"unknown phase names {sorted(bogus)} in {doc}")
         return 1
     print(f"bench contract OK: {doc}")
     return 0

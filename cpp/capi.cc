@@ -97,6 +97,9 @@ CoreConfig ParseEnvConfig() {
                  "HOROVOD_GLOO_TIMEOUT_SECONDS", "30"));
   cfg.thread_affinity = atoi(EnvOr("HVD_TPU_THREAD_AFFINITY",
                                    "HOROVOD_THREAD_AFFINITY", "-1"));
+  cfg.straggler_report_secs =
+      atof(EnvOr("HVD_TPU_STRAGGLER_REPORT_SECONDS",
+                 "HOROVOD_STRAGGLER_REPORT_SECONDS", "0"));
   return cfg;
 }
 
@@ -120,6 +123,7 @@ const char* hvd_cfg_dump() {
      << "\ncache_capacity=" << c.cache_capacity
      << "\nstall_warning_secs=" << c.stall_warning_secs
      << "\nstall_shutdown_secs=" << c.stall_shutdown_secs
+     << "\nstraggler_report_secs=" << c.straggler_report_secs
      << "\nautotune=" << (c.autotune ? 1 : 0)
      << "\nautotune_warmup_samples=" << c.autotune_warmup_samples
      << "\nautotune_max_samples=" << c.autotune_max_samples
@@ -284,6 +288,15 @@ const char* hvd_counters_json() {
      << ",\"hier_allgathers\":" << c.hier_allgathers.load() << "}";
   g_counters_json = os.str();
   return g_counters_json.c_str();
+}
+
+// Coordinator-side straggler report as one JSON object: per-rank totals of
+// negotiation wait charged to the last-announcing rank (who held whom up).
+// Non-coordinator ranks accumulate nothing and return an empty report.
+static thread_local std::string g_stragglers_json;
+const char* hvd_stragglers_json() {
+  g_stragglers_json = Core::Get().StragglersJson();
+  return g_stragglers_json.c_str();
 }
 
 }  // extern "C"

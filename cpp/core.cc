@@ -398,6 +398,7 @@ Status Core::Init(const CoreConfig& cfg) {
   }
   shutdown_requested_ = false;
   loop_done_ = false;
+  last_straggler_report_ = std::chrono::steady_clock::now();
   initialized_ = true;
   loop_ = std::thread([this] { Loop(); });
   HVD_LOG(Debug) << "background loop started"
@@ -792,6 +793,7 @@ void Core::HandleRequests(CoordDomain& d, int from_rank,
     auto& slot = d.ready_table_[r.name];
     if (slot.second.empty()) {
       slot.first = r;
+      d.announce_time_[r.name] = std::chrono::steady_clock::now();
       // per-tensor negotiation phase opens at the FIRST announcement and
       // closes when all ranks are in (CollectReady) — the coordinator's
       // view of who is holding whom up (reference: timeline.h:48-183)
@@ -832,6 +834,8 @@ void Core::HandleCacheBits(CoordDomain& d, int from_rank,
                            const std::vector<int32_t>& bits) {
   for (auto b : bits) {
     auto& ranks = d.bit_ready_[b];
+    if (ranks.empty())
+      d.bit_time_[b] = std::chrono::steady_clock::now();
     if (ranks.empty() && timeline_ && timeline_->enabled()) {
       // cached tensors skip negotiation; the wait for the remaining
       // ranks' bits is still visible (reference activity name:
@@ -850,6 +854,16 @@ std::vector<Response> Core::CollectReady(CoordDomain& d) {
   int needed = 0;
   for (size_t i = 0; i < d.joined_ranks.size(); ++i)
     if (!d.joined_ranks[i]) needed++;
+  auto now = std::chrono::steady_clock::now();
+  // negotiation wait = first announce -> all in, charged to the LAST
+  // announcing rank — the one everyone else waited on
+  auto charge = [&](const std::vector<int>& ranks,
+                    std::chrono::steady_clock::time_point first_seen) {
+    if (ranks.empty()) return;
+    ChargeStraggler(
+        ranks.back(),
+        std::chrono::duration<double>(now - first_seen).count());
+  };
 
   std::vector<Response> out;
   // 1) steady-state fast path: common cache bits, ascending (identical
@@ -858,6 +872,11 @@ std::vector<Response> Core::CollectReady(CoordDomain& d) {
   for (auto it = d.bit_ready_.begin(); it != d.bit_ready_.end();) {
     if ((int)it->second.size() >= needed && needed > 0) {
       ready_bits.push_back(it->first);
+      auto ts = d.bit_time_.find(it->first);
+      if (ts != d.bit_time_.end()) {
+        charge(it->second, ts->second);
+        d.bit_time_.erase(ts);
+      }
       it = d.bit_ready_.erase(it);
     } else {
       ++it;
@@ -888,6 +907,11 @@ std::vector<Response> Core::CollectReady(CoordDomain& d) {
     if ((int)it->second.second.size() >= needed && needed > 0) {
       ready.emplace_back(it->first, it->second.first);
       d.stall.RemoveReady(it->second.first.name);
+      auto ts = d.announce_time_.find(it->first);
+      if (ts != d.announce_time_.end()) {
+        charge(it->second.second, ts->second);
+        d.announce_time_.erase(ts);
+      }
       it = d.ready_table_.erase(it);
     } else {
       d.stall.RecordPending(it->second.first.name, it->second.second,
@@ -1245,12 +1269,14 @@ bool Core::RunOnce() {
           group_id = rit->second.first.group_id;
           d->ready_table_.erase(rit);
         }
+        d->announce_time_.erase(name);
         // the stalled submission may be a partial CACHE BIT
         for (auto it2 = d->bit_ready_.begin();
              it2 != d->bit_ready_.end();) {
           const Response& cr = d->cache->Get(it2->first);
           if (!cr.names.empty() && cr.names[0] == name) {
             group_id = cr.group_id;
+            d->bit_time_.erase(it2->first);
             it2 = d->bit_ready_.erase(it2);
           } else {
             ++it2;
@@ -1372,11 +1398,36 @@ bool Core::RunOnce() {
           continue;
         Request q = RequestFromSingleResponse(evicted);
         auto& slot = d->ready_table_[q.name];
-        if (slot.second.empty()) slot.first = q;
+        auto bt = d->bit_time_.find(bit);
+        // keep the straggler clock running across the bit->request
+        // migration: the wait started at the EARLIEST announcement on
+        // either path, and bit ranks that announced before the full
+        // request must stay ahead of it in slot order — charge() blames
+        // ranks.back(), so appending early announcers last would pin the
+        // wait on the wrong rank
+        bool bits_first = false;
+        if (slot.second.empty()) {
+          slot.first = q;
+          d->announce_time_[q.name] =
+              bt != d->bit_time_.end() ? bt->second
+                                       : std::chrono::steady_clock::now();
+        } else if (bt != d->bit_time_.end()) {
+          auto at = d->announce_time_.find(q.name);
+          if (at == d->announce_time_.end() || bt->second < at->second) {
+            d->announce_time_[q.name] = bt->second;
+            bits_first = true;
+          }
+        }
+        d->bit_time_.erase(bit);
+        size_t pos = 0;
         for (int rk : bit_it->second)
           if (std::find(slot.second.begin(), slot.second.end(), rk) ==
-              slot.second.end())
-            slot.second.push_back(rk);
+              slot.second.end()) {
+            if (bits_first)
+              slot.second.insert(slot.second.begin() + pos++, rk);
+            else
+              slot.second.push_back(rk);
+          }
         d->bit_ready_.erase(bit_it);
       }
     }
@@ -1417,7 +1468,65 @@ bool Core::RunOnce() {
       has_pending_knobs_ = true;
     }
   }
+  // periodic rank-attributed negotiation-wait summary (coordinator only
+  // accumulates attribution; HVD_TPU_STRAGGLER_REPORT_SECONDS)
+  if (cfg_.rank == 0) MaybeReportStragglers();
   return true;
+}
+
+// -- straggler attribution --------------------------------------------------
+
+void Core::ChargeStraggler(int last_rank, double waited) {
+  if (waited < 0) waited = 0;
+  std::lock_guard<std::mutex> lk(straggler_mu_);
+  auto& pr = stragglers_.ranks[last_rank];
+  pr.wait_seconds += waited;
+  pr.held_count++;
+  stragglers_.tensors_timed++;
+  stragglers_.total_wait_seconds += waited;
+}
+
+void Core::MaybeReportStragglers() {
+  if (cfg_.straggler_report_secs <= 0) return;
+  auto now = std::chrono::steady_clock::now();
+  if (std::chrono::duration<double>(now - last_straggler_report_).count() <
+      cfg_.straggler_report_secs)
+    return;
+  last_straggler_report_ = now;
+  std::ostringstream os;
+  uint64_t timed = 0;
+  {
+    std::lock_guard<std::mutex> lk(straggler_mu_);
+    timed = stragglers_.tensors_timed;
+    for (auto& kv : stragglers_.ranks) {
+      if (kv.second.held_count == 0) continue;
+      os << " rank " << kv.first << ": last-in for "
+         << kv.second.held_count << " tensors, peers waited "
+         << kv.second.wait_seconds << "s total;";
+    }
+  }
+  if (timed > 0) {
+    HVD_LOG(Info) << "straggler report (" << timed
+                  << " tensors timed since init):" << os.str();
+  }
+}
+
+std::string Core::StragglersJson() const {
+  std::ostringstream os;
+  std::lock_guard<std::mutex> lk(straggler_mu_);
+  os << "{\"tensors_timed\":" << stragglers_.tensors_timed
+     << ",\"total_wait_seconds\":" << stragglers_.total_wait_seconds
+     << ",\"ranks\":{";
+  bool first = true;
+  for (auto& kv : stragglers_.ranks) {
+    if (!first) os << ',';
+    first = false;
+    os << '"' << kv.first << "\":{\"wait_seconds\":"
+       << kv.second.wait_seconds << ",\"held_count\":"
+       << kv.second.held_count << '}';
+  }
+  os << "}}";
+  return os.str();
 }
 
 uint8_t Core::KnobFlags() const {

@@ -176,6 +176,10 @@ struct CoreConfig {
   double autotune_window_secs = 2.0;   // scoring window per sample
   std::string autotune_log;            // AUTOTUNE_LOG sample trace file
   double rendezvous_timeout_secs = 30.0;  // GLOO_TIMEOUT_SECONDS analog
+  // > 0: the coordinator logs a rank-attributed negotiation-wait summary
+  // every this many seconds (HVD_TPU_STRAGGLER_REPORT_SECONDS); the
+  // snapshot is queryable via hvd_stragglers_json either way
+  double straggler_report_secs = 0.0;
   int thread_affinity = -1;            // pin background loop to this CPU
   bool timeline_mark_cycles = false;
   std::string timeline_path;
@@ -206,6 +210,11 @@ struct CoordDomain {
       ready_table_;
   // coordinator: cache-bit -> ranks that hit it this steady-state round
   std::unordered_map<int, std::vector<int>> bit_ready_;
+  // coordinator: first-announcement stamps feeding straggler attribution
+  // (wait = last announce - first announce, charged to the last rank)
+  std::unordered_map<std::string, std::chrono::steady_clock::time_point>
+      announce_time_;
+  std::unordered_map<int, std::chrono::steady_clock::time_point> bit_time_;
   // coordinator: tensors whose ranks disagreed on dtype/shape/op
   std::unordered_map<std::string, std::string> error_table_;
   // coordinator: group id -> (expected member count, ready singles held
@@ -285,6 +294,13 @@ class Core {
   };
   const Counters& counters() const { return counters_; }
 
+  // Coordinator-side straggler attribution: per-rank totals of how long
+  // the rest of the world waited on that rank being the LAST to announce
+  // a tensor (the per-tensor negotiation wait the timeline shows as
+  // NEGOTIATE_*/WAIT_FOR_OTHER_TENSOR_DATA spans, aggregated per rank).
+  // Non-coordinator ranks have no data and serialize an empty report.
+  std::string StragglersJson() const;
+
   Transport* transport() { return transport_.get(); }
 
  private:
@@ -312,6 +328,23 @@ class Core {
 
   CoreConfig cfg_;
   Counters counters_;
+  // straggler attribution state (coordinator-only writes, any-thread
+  // reads through StragglersJson)
+  struct StragglerStats {
+    struct PerRank {
+      double wait_seconds = 0.0;
+      uint64_t held_count = 0;
+    };
+    std::map<int, PerRank> ranks;
+    uint64_t tensors_timed = 0;
+    double total_wait_seconds = 0.0;
+  };
+  mutable std::mutex straggler_mu_;
+  StragglerStats stragglers_;
+  std::chrono::steady_clock::time_point last_straggler_report_;
+  // charge `waited` seconds to `last_rank` (the rank everyone waited on)
+  void ChargeStraggler(int last_rank, double waited);
+  void MaybeReportStragglers();
   std::atomic<bool> initialized_{false};
   std::atomic<bool> shutdown_requested_{false};
   std::atomic<bool> loop_done_{false};

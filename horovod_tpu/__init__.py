@@ -37,6 +37,8 @@ from horovod_tpu.common.basics import (  # noqa: F401
     start_timeline,
     stop_timeline,
     counters,
+    metrics_snapshot,
+    stragglers,
     xla_built,
     tcp_core_built,
     gloo_built,
@@ -118,6 +120,10 @@ from horovod_tpu.train.compression import Compression  # noqa: F401
 from horovod_tpu.train.sync_batch_norm import SyncBatchNorm  # noqa: F401
 from horovod_tpu.train.checkpoint import Checkpointer  # noqa: F401
 from horovod_tpu.train import callbacks  # noqa: F401
+
+# Metrics & telemetry subsystem (docs/OBSERVABILITY.md; no reference
+# analog — the reference's only runtime introspection is the timeline)
+from horovod_tpu import metrics  # noqa: F401
 
 # Elastic worker API (reference: horovod.elastic)
 from horovod_tpu import elastic  # noqa: F401

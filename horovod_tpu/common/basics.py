@@ -50,6 +50,7 @@ class _GlobalState:
         self.config: Optional[Config] = None
         self.process_set_table = None  # common.process_sets._ProcessSetTable
         self.timeline = None
+        self.metrics_exporter = None  # metrics.exporter.MetricsExporter
         self.elastic_enabled = False
         self.jax_distributed_initialized = False
 
@@ -157,6 +158,12 @@ def init(ranks: Optional[Sequence[int]] = None,
         _state.timeline = Timeline(_state.rank, own_file)
 
         _state.initialized = True
+
+        # Per-worker /metrics + /healthz exporter (HVD_TPU_METRICS_PORT;
+        # docs/OBSERVABILITY.md). After the initialized flag: /healthz
+        # reports live state, and a bind failure only warns.
+        from horovod_tpu.metrics.exporter import start_worker_exporter
+        _state.metrics_exporter = start_worker_exporter(_state)
         get_logger().info(
             "initialized: rank=%d size=%d local=%d/%d cross=%d/%d backend=%s",
             _state.rank, _state.size, _state.local_rank, _state.local_size,
@@ -181,6 +188,12 @@ def shutdown(force: bool = False) -> None:
                 else:  # backends without a force knob
                     _state.backend.shutdown()
         finally:
+            if _state.metrics_exporter is not None:
+                try:
+                    _state.metrics_exporter.stop()
+                except Exception:
+                    pass
+                _state.metrics_exporter = None
             if _state.timeline is not None:
                 _state.timeline.close()
             _state.backend = None
@@ -233,6 +246,37 @@ def counters() -> dict:
     negotiating control plane (single-process / XLA-eager)."""
     st = _require_init()
     return st.backend.counters() if st.backend is not None else {}
+
+
+def stragglers() -> dict:
+    """Coordinator-side rank-attributed negotiation-wait report: for each
+    rank, total seconds the others spent waiting on it being the LAST to
+    announce a tensor, and how many tensors it held up (the C++ core's
+    per-tensor negotiation tracking aggregated per rank; reference
+    surfaces this only as per-tensor timeline NEGOTIATE_* spans). Only the
+    coordinator (rank 0 of the core world) accumulates data; other ranks
+    and non-core backends return an empty report."""
+    st = _require_init()
+    fn = getattr(st.backend, "stragglers", None)
+    return fn() if fn is not None else {}
+
+
+def metrics_snapshot() -> dict:
+    """One-call observability snapshot: raw engine counters, derived
+    ratios (cache-hit rate, fusion efficiency), the coordinator's
+    straggler report, and the process-local metrics registry (step-time
+    histograms, throughput/MFU gauges from the train-loop telemetry).
+    The same data the per-worker ``/metrics`` endpoint serves, as a dict.
+    """
+    from horovod_tpu.metrics.engine import derived_ratios
+    from horovod_tpu.metrics.registry import default_registry
+    engine = counters()
+    return {
+        "engine": engine,
+        "derived": derived_ratios(engine),
+        "stragglers": stragglers(),
+        "registry": default_registry().snapshot(),
+    }
 
 
 def rank() -> int:

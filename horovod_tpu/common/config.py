@@ -95,6 +95,14 @@ class Config:
     gloo_timeout_seconds: float = 30.0
     # Background-thread CPU pinning (reference: HOROVOD_THREAD_AFFINITY)
     thread_affinity: int = -1
+    # Metrics / telemetry (docs/OBSERVABILITY.md)
+    # Per-worker Prometheus exporter base port; 0 = disabled. Worker i on a
+    # host binds metrics_port + local_rank(i).
+    metrics_port: int = 0
+    # Coordinator logs a rank-attributed negotiation-wait summary every
+    # this many seconds; 0 = disabled (snapshot stays queryable via
+    # hvd.metrics_snapshot() either way).
+    straggler_report_secs: float = 0.0
     # Misc
     log_level: str = "WARNING"
     log_hide_timestamp: bool = False
@@ -139,6 +147,9 @@ class Config:
                 "COMPRESSION_FP16_ON_TPU", d.compression_fp16_on_tpu),
             gloo_timeout_seconds=env_float("GLOO_TIMEOUT_SECONDS",
                                            d.gloo_timeout_seconds),
+            metrics_port=env_int("METRICS_PORT", d.metrics_port),
+            straggler_report_secs=env_float(
+                "STRAGGLER_REPORT_SECONDS", d.straggler_report_secs),
             thread_affinity=env_int("THREAD_AFFINITY", d.thread_affinity),
             log_level=env_str("LOG_LEVEL", d.log_level).upper(),
             log_hide_timestamp=env_bool("LOG_HIDE_TIME",

@@ -10,6 +10,21 @@ import sys
 _LOGGER = None
 
 
+class _RankFilter(logging.Filter):
+    """Resolve the rank lazily, per record.
+
+    The logger is frequently touched before the launcher's env setup (any
+    import-time ``get_logger()`` call), and the old read-once-at-creation
+    scheme then stamped ``[rank ?]`` on every later line. Per-record
+    resolution follows the config precedence (``HVD_TPU_`` beats
+    ``HOROVOD_``) and picks up the identity whenever it appears."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.rank = os.environ.get(
+            "HVD_TPU_RANK", os.environ.get("HOROVOD_RANK", "?"))
+        return True
+
+
 def get_logger() -> logging.Logger:
     global _LOGGER
     if _LOGGER is None:
@@ -17,11 +32,11 @@ def get_logger() -> logging.Logger:
         logger = logging.getLogger("horovod_tpu")
         if not logger.handlers:
             h = logging.StreamHandler(sys.stderr)
-            rank = os.environ.get("HOROVOD_RANK", os.environ.get("HVD_TPU_RANK", "?"))
+            h.addFilter(_RankFilter())
             # HOROVOD_LOG_HIDE_TIME drops the timestamp (reference knob)
             ts = "" if get_config().log_hide_timestamp else "[%(asctime)s] "
             h.setFormatter(logging.Formatter(
-                f"{ts}[hvd-tpu] [rank {rank}] %(levelname)s: %(message)s"))
+                f"{ts}[hvd-tpu] [rank %(rank)s] %(levelname)s: %(message)s"))
             logger.addHandler(h)
         name = get_config().log_level
         if name == "TRACE":  # python logging has no TRACE tier
@@ -32,3 +47,13 @@ def get_logger() -> logging.Logger:
         logger.setLevel(level)
         _LOGGER = logger
     return _LOGGER
+
+
+def reset_logger() -> None:
+    """Drop the cached logger + handlers so the next ``get_logger()``
+    re-reads level/format config (tests and elastic re-init)."""
+    global _LOGGER
+    logger = logging.getLogger("horovod_tpu")
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+    _LOGGER = None

@@ -67,26 +67,50 @@ class Timeline:
                 self._file = open(file_path, "w")
             except OSError:
                 return
+            # fresh queue per generation: a writer thread that outlived a
+            # timed-out stop() keeps its OLD queue/file and can never
+            # steal (or corrupt) this generation's events
+            self._q = queue.Queue()
             self._file.write("[\n")
             self._thread = threading.Thread(
-                target=self._writer_loop, name="hvd-tpu-timeline", daemon=True)
+                target=self._writer_loop, args=(self._q, self._file),
+                name="hvd-tpu-timeline", daemon=True)
             self._thread.start()
             self._started = True
 
     def stop(self) -> None:
+        # Phase 1 (under the lock): flip _started so no new emission can
+        # begin, and detach the writer thread handle. The join happens
+        # OUTSIDE the lock — _emit now serializes on the same lock, and a
+        # join while holding it would deadlock an emitter waiting to bail.
         with self._lock:
             if not self._started:
                 return
             self._started = False
-            if self._thread is not None:
-                self._q.put(None)
-                self._thread.join(timeout=5)
-                self._thread = None
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            self._q.put(None)
+            thread.join(timeout=5)
+        # Phase 2: drain stragglers that slipped in before _started
+        # flipped — the old stop/emit race dropped those events silently
+        # with the file already closed. Only safe once the writer has
+        # actually exited: draining concurrently with a writer that
+        # outlived the join would interleave writes into the same file
+        # and could swallow its shutdown sentinel.
+        with self._lock:
             if self._file is not None:
                 try:
-                    self._file.write("{}]\n")
+                    if thread is None or not thread.is_alive():
+                        while True:
+                            try:
+                                ev = self._q.get_nowait()
+                            except queue.Empty:
+                                break
+                            if ev is not None:
+                                self._file.write(json.dumps(ev) + ",\n")
+                        self._file.write("{}]\n")
                     self._file.close()
-                except OSError:
+                except (OSError, ValueError):
                     pass
                 self._file = None
 
@@ -100,13 +124,22 @@ class Timeline:
     # -- event emission ----------------------------------------------------
     def _emit(self, ph: str, name: str, cat: str, tid: str,
               args: Optional[dict] = None) -> None:
+        # cheap unguarded pre-check keeps the disabled path lock-free...
         if not self._started or self._file is None:
             return
         ev = {"ph": ph, "name": name, "cat": cat, "pid": self._rank,
               "tid": tid, "ts": (time.monotonic() - self._t0) * 1e6}
         if args:
             ev["args"] = args
-        self._q.put(ev)
+        # ...but enqueueing re-checks under the lock: stop() flips
+        # _started under the same lock before draining, so an event that
+        # makes it into the queue here is guaranteed to be written (either
+        # by the writer thread or by stop()'s drain), never dropped into a
+        # closed file.
+        with self._lock:
+            if not self._started or self._file is None:
+                return
+            self._q.put(ev)
 
     def activity_start(self, tensor_name: str, activity: str) -> None:
         self._emit("B", activity, "activity", tensor_name)
@@ -129,12 +162,14 @@ class Timeline:
         self._emit("i", name, "marker", "marker", args)
 
     # -- writer thread -----------------------------------------------------
-    def _writer_loop(self) -> None:
+    def _writer_loop(self, q: "queue.Queue[Optional[dict]]", file) -> None:
+        # q/file are bound at thread start: a writer leaked past stop()'s
+        # join timeout must keep writing ITS generation, never a new one
         while True:
-            ev = self._q.get()
+            ev = q.get()
             if ev is None:
                 return
             try:
-                self._file.write(json.dumps(ev) + ",\n")
+                file.write(json.dumps(ev) + ",\n")
             except (OSError, ValueError):
                 return

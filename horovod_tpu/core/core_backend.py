@@ -83,6 +83,10 @@ def _load_lib():
         lib.hvd_add_process_set.restype = ctypes.c_int
         lib.hvd_last_join_rank.restype = ctypes.c_int
         lib.hvd_counters_json.restype = ctypes.c_char_p
+        # tolerate an older/sanitizer build of the lib (HVD_TPU_CORE_LIB
+        # override) that predates the straggler API
+        if hasattr(lib, "hvd_stragglers_json"):
+            lib.hvd_stragglers_json.restype = ctypes.c_char_p
         lib.hvd_start_timeline.restype = ctypes.c_int
         lib.hvd_start_timeline.argtypes = [ctypes.c_char_p, ctypes.c_int]
         lib.hvd_stop_timeline.restype = ctypes.c_int
@@ -370,6 +374,17 @@ class CoreBackend(Backend):
         bytes moved."""
         import json
         return json.loads(self._lib.hvd_counters_json().decode())
+
+    def stragglers(self) -> dict:
+        """Coordinator-side rank-attributed negotiation-wait report
+        (cpp hvd_stragglers_json): per rank, the total seconds peers
+        spent waiting on it being the last to announce a tensor, and the
+        count of tensors it held up. Empty ``ranks`` away from the
+        coordinator (only rank 0 sees every announcement)."""
+        import json
+        if not hasattr(self._lib, "hvd_stragglers_json"):
+            return {}
+        return json.loads(self._lib.hvd_stragglers_json().decode())
 
     def start_core_timeline(self, file_path: str,
                             mark_cycles: bool = False) -> bool:

@@ -1,0 +1,32 @@
+"""Unified metrics & telemetry subsystem.
+
+Four layers (see ``docs/OBSERVABILITY.md``):
+
+* :mod:`~horovod_tpu.metrics.registry` — dependency-free Counter / Gauge /
+  Histogram with mergeable snapshots and Prometheus text rendering.
+* :mod:`~horovod_tpu.metrics.engine` — derived view over the C++ engine's
+  control-plane counters (cache-hit rate, fusion efficiency, bytes/s) and
+  the coordinator's straggler attribution.
+* :mod:`~horovod_tpu.metrics.exporter` — per-worker HTTP ``/metrics`` +
+  ``/healthz`` endpoints, enabled by ``HVD_TPU_METRICS_PORT``.
+* :mod:`~horovod_tpu.metrics.mfu` — chip peak FLOPs + compiled-HLO FLOPs
+  counting shared by ``bench.py`` and the train-loop telemetry.
+"""
+
+from horovod_tpu.metrics.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    DEFAULT_BUCKETS,
+    default_registry,
+    render_prometheus,
+)
+from horovod_tpu.metrics.engine import (  # noqa: F401
+    EngineCollector,
+    derived_ratios,
+)
+from horovod_tpu.metrics.exporter import (  # noqa: F401
+    MetricsExporter,
+    start_worker_exporter,
+)

@@ -1,0 +1,106 @@
+"""Derived view over the engine's control-plane counters.
+
+The C++ core exports raw monotonic counters (``hvd_counters_json`` →
+``hvd.counters()``: cycles, cache hits/misses/evictions, fused units,
+bytes moved). This module turns them into the rates and ratios an operator
+actually watches — cache-hit rate, fusion efficiency, bytes/s — and mirrors
+the raw counters into the registry so one ``/metrics`` scrape carries both.
+
+Rates are computed between successive ``collect()`` calls (scrapes), so a
+Prometheus server polling every 15s sees 15s-window rates without the
+engine keeping any windowed state.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from horovod_tpu.metrics.registry import Registry, default_registry
+
+# engine counter -> rate gauge derived from its delta
+_RATE_KEYS = ("bytes_allreduced", "bytes_allgathered", "responses_executed")
+
+
+def derived_ratios(c: Dict[str, float]) -> Dict[str, float]:
+    """Pure ratios from one cumulative counters dict (no windowing):
+    ``cache_hit_rate`` (hits / negotiated submissions), ``fusion_ratio``
+    (fraction of executed responses that were multi-tensor units) and
+    ``tensors_per_fused_unit``."""
+    out: Dict[str, float] = {}
+    hits = float(c.get("cache_hits", 0))
+    misses = float(c.get("cache_misses", 0))
+    if hits + misses > 0:
+        out["cache_hit_rate"] = hits / (hits + misses)
+    executed = float(c.get("responses_executed", 0))
+    fused_units = float(c.get("fused_units", 0))
+    if executed > 0:
+        out["fusion_ratio"] = fused_units / executed
+    tensors_fused = float(c.get("tensors_fused", 0))
+    if fused_units > 0:
+        out["tensors_per_fused_unit"] = tensors_fused / fused_units
+    return out
+
+
+class EngineCollector:
+    """Scrape-time collector: pulls ``counters_fn()`` (and optionally
+    ``stragglers_fn()``), refreshes ``hvd_engine_*`` metrics in the
+    registry. Safe to call when the engine is not initialized — a failing
+    or empty pull leaves the previous values in place."""
+
+    def __init__(self, counters_fn: Callable[[], dict],
+                 registry: Optional[Registry] = None,
+                 stragglers_fn: Optional[Callable[[], dict]] = None
+                 ) -> None:
+        self._counters_fn = counters_fn
+        self._stragglers_fn = stragglers_fn
+        self._reg = registry or default_registry()
+        self._prev: Optional[Dict[str, float]] = None
+        self._prev_t = 0.0
+
+    def collect(self) -> None:
+        try:
+            c = self._counters_fn()
+        except Exception:
+            return
+        now = time.monotonic()
+        if c:
+            for key, val in c.items():
+                self._reg.gauge(
+                    f"hvd_engine_{key}",
+                    help=f"engine counter {key} (cumulative)",
+                    agg="sum").set(float(val))
+            for key, val in derived_ratios(c).items():
+                self._reg.gauge(
+                    f"hvd_engine_{key}",
+                    help=f"engine derived ratio {key}",
+                    agg="mean").set(val)
+            if self._prev is not None and now > self._prev_t:
+                dt = now - self._prev_t
+                for key in _RATE_KEYS:
+                    if key in c and key in self._prev:
+                        delta = float(c[key]) - float(self._prev[key])
+                        self._reg.gauge(
+                            f"hvd_engine_{key}_per_second",
+                            help=f"engine {key} rate over the last "
+                                 "scrape interval",
+                            agg="sum").set(max(delta, 0.0) / dt)
+            self._prev, self._prev_t = dict(c), now
+        if self._stragglers_fn is None:
+            return
+        try:
+            s = self._stragglers_fn()
+        except Exception:
+            return
+        for rank, info in (s.get("ranks") or {}).items():
+            self._reg.gauge(
+                "hvd_straggler_wait_seconds",
+                help="total negotiation wait attributed to this rank "
+                     "being last to announce",
+                labels={"rank": str(rank)}, agg="max").set(
+                float(info.get("wait_seconds", 0.0)))
+            self._reg.gauge(
+                "hvd_straggler_held_count",
+                help="tensors for which this rank was the last announcer",
+                labels={"rank": str(rank)}, agg="max").set(
+                float(info.get("held_count", 0)))

@@ -1,0 +1,157 @@
+"""Per-worker HTTP metrics exporter: ``/metrics`` + ``/healthz``.
+
+Prometheus-compatible scrape endpoint over the same threaded HTTP server
+machinery as the rendezvous KV plane (:mod:`horovod_tpu.runner.http_kv`).
+One exporter per worker process; on a multi-worker host each worker binds
+``HVD_TPU_METRICS_PORT + local_rank`` so a pod-wide scrape config is just
+``host:base_port+i`` (reference analog: none — the reference's only
+runtime introspection is the timeline file).
+
+Collectors registered with the exporter run at scrape time (pull model):
+each is a zero-arg callable that refreshes gauges in the registry before
+rendering. A failing collector is logged and skipped — scrapes must never
+take down training.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler
+from typing import Callable, Iterable, Optional
+
+from horovod_tpu.common.logging import get_logger
+from horovod_tpu.metrics.registry import (Registry, default_registry,
+                                          render_prometheus)
+from horovod_tpu.runner.http_kv import ThreadedHTTPServer
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # silence per-scrape access lines
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        exporter: "MetricsExporter" = self.server.exporter
+        if path in ("/metrics", "/"):
+            body = exporter.render().encode()
+            self._send(200, body, CONTENT_TYPE)
+        elif path == "/healthz":
+            doc = exporter.health()
+            code = 200 if doc.get("status") == "ok" else 503
+            self._send(code, json.dumps(doc).encode(), "application/json")
+        else:
+            self._send(404, b"not found\n", "text/plain")
+
+
+class MetricsExporter:
+    """Threaded scrape server for one worker process.
+
+    Args:
+      registry: registry to render (default: the process-wide one).
+      port: TCP port; 0 binds an ephemeral port (tests).
+      collectors: callables run before each render to refresh derived
+        gauges (e.g. :class:`horovod_tpu.metrics.engine.EngineCollector`).
+      health_fn: optional callable returning the ``/healthz`` JSON doc;
+        default reports ``{"status": "ok"}``.
+    """
+
+    def __init__(self, registry: Optional[Registry] = None, port: int = 0,
+                 collectors: Iterable[Callable[[], None]] = (),
+                 health_fn: Optional[Callable[[], dict]] = None) -> None:
+        self._registry = registry or default_registry()
+        self._collectors = list(collectors)
+        self._health_fn = health_fn
+        self._httpd = ThreadedHTTPServer(("0.0.0.0", port), _MetricsHandler)
+        self._httpd.exporter = self
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def add_collector(self, fn: Callable[[], None]) -> None:
+        self._collectors.append(fn)
+
+    def render(self) -> str:
+        for fn in self._collectors:
+            try:
+                fn()
+            except Exception as e:  # scrapes must never crash training
+                get_logger().debug("metrics collector %r failed: %r", fn, e)
+        return render_prometheus(self._registry.snapshot())
+
+    def health(self) -> dict:
+        if self._health_fn is not None:
+            try:
+                return self._health_fn()
+            except Exception as e:
+                return {"status": "error", "error": repr(e)}
+        return {"status": "ok"}
+
+    def start(self) -> int:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="hvd-tpu-metrics",
+            daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        # shutdown() handshakes with serve_forever() and blocks forever if
+        # the serving thread was never started — only call it after start()
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._httpd.server_close()
+
+
+def start_worker_exporter(state) -> Optional[MetricsExporter]:
+    """Start the per-worker exporter for an initialized ``_GlobalState``
+    when ``HVD_TPU_METRICS_PORT`` is set (>0). Called from ``hvd.init``;
+    never raises — a port squat degrades to a warning, not a failed init.
+    """
+    cfg = state.config
+    base = getattr(cfg, "metrics_port", 0)
+    if not base or base <= 0:
+        return None
+    port = base + max(state.local_rank, 0)
+    from horovod_tpu.metrics.engine import EngineCollector
+
+    def counters_fn():
+        be = state.backend
+        return be.counters() if be is not None else {}
+
+    def stragglers_fn():
+        fn = getattr(state.backend, "stragglers", None)
+        return fn() if fn is not None else {}
+
+    def health():
+        return {"status": "ok" if state.initialized else "shutdown",
+                "rank": state.rank, "size": state.size,
+                "hostname": state.hostname}
+
+    registry = default_registry()
+    collector = EngineCollector(counters_fn, registry=registry,
+                                stragglers_fn=stragglers_fn)
+    try:
+        exp = MetricsExporter(registry=registry, port=port,
+                              collectors=[collector.collect],
+                              health_fn=health)
+        exp.start()
+    except (OSError, OverflowError) as e:  # squat or base+local_rank > 65535
+        get_logger().warning(
+            "metrics exporter could not bind port %d (%s); metrics "
+            "disabled for this worker", port, e)
+        return None
+    get_logger().info("metrics exporter serving on :%d/metrics", exp.port)
+    return exp

@@ -1,0 +1,63 @@
+"""MFU accounting helpers: chip peak FLOPs + compiled-HLO FLOPs counting.
+
+Shared between the benchmark harness (``bench.py``) and the train-loop
+telemetry (:class:`horovod_tpu.train.callbacks.TelemetryCallback`), so the
+two report the same MFU for the same program (MLPerf TPU-pod scaling work
+emphasizes step-time/MFU accounting as the scaling metric — PAPERS.md,
+arXiv:1909.09756).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# Peak dense bf16 FLOPs per chip by device-kind substring (public specs).
+PEAK_BF16_FLOPS = (
+    ("v5 lite", 197e12), ("v5litepod", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12), ("v6", 918e12), ("v4", 275e12), ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def peak_flops(device_kind: str) -> Optional[float]:
+    """Peak dense bf16 FLOPs/s for a device-kind string, or None when the
+    chip is unknown (CPU hosts, future TPUs not yet tabled)."""
+    kind = device_kind.lower()
+    for sub, peak in PEAK_BF16_FLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+def device_peak_flops() -> Optional[float]:
+    """Peak FLOPs of the first local device (None off-TPU)."""
+    import jax
+    devs = jax.devices()
+    return peak_flops(devs[0].device_kind) if devs else None
+
+
+def hlo_flops_per_device(jitted, args, factor: int = 1) -> Optional[float]:
+    """Per-device FLOPs of one dispatch of ``jitted(*args)`` from the
+    compiled executable's ``cost_analysis()`` (post-SPMD, so per-device by
+    construction). ``factor`` scales for in-graph multi-step: XLA counts a
+    while-loop (``lax.scan``) body ONCE, not trip-count times. Returns
+    None when cost analysis is unavailable (caller falls back to an
+    analytic estimate)."""
+    try:
+        cost = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return (float(cost.get("flops", 0.0)) * factor) or None
+    except Exception:
+        return None
+
+
+def mfu(flops_per_device_per_step: float, step_seconds: float,
+        peak: Optional[float] = None) -> Optional[float]:
+    """Model FLOPs utilization for one step; None when the peak is
+    unknown or inputs are degenerate."""
+    if peak is None:
+        peak = device_peak_flops()
+    if not peak or not flops_per_device_per_step or step_seconds <= 0:
+        return None
+    return flops_per_device_per_step / step_seconds / peak

@@ -1,7 +1,7 @@
 """Flagship model: GPT-style (optionally MoE) transformer with full 5-axis
 parallelism — dp (batch), pp (stages), ep (experts), sp (sequence/ring
 attention), tp (tensor) — written as ONE manual-SPMD program under
-``jax.shard_map`` over the canonical mesh.
+``shard_map`` over the canonical mesh.
 
 The reference framework scales *batch only* (SURVEY.md §2.6); its model zoo
 is "whatever TF/Torch model you wrap". This module is the TPU-native
@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from horovod_tpu._compat import axis_size, shard_map
 
 from horovod_tpu.models.scan_util import multi_step
 from horovod_tpu.parallel.ring_attention import ring_attention_spmd
@@ -138,7 +140,7 @@ def shard_params(params: Dict, cfg: TransformerConfig, mesh: Mesh) -> Dict:
 def _axis_live(name: str) -> bool:
     """True if ``name`` is a manual axis of size > 1 in the current context."""
     try:
-        return lax.axis_size(name) > 1
+        return axis_size(name) > 1
     except NameError:
         return False
 
@@ -391,7 +393,7 @@ def make_grad_fn(cfg: TransformerConfig, mesh: Mesh):
     pspec = jax.tree_util.tree_map(lambda s: s.spec, psh)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(pspec, data_spec, data_spec),
         out_specs=(P(), P(), pspec),
         check_vma=False)
@@ -443,7 +445,7 @@ def make_forward(cfg: TransformerConfig, mesh: Mesh):
     psh = param_shardings(cfg, mesh)
     pspec = jax.tree_util.tree_map(lambda s: s.spec, psh)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(pspec, data_spec, data_spec),
                        out_specs=P(), check_vma=False)
     def fwd(params, tokens, targets):

@@ -26,8 +26,26 @@ import jax.numpy as jnp
 
 from horovod_tpu.common.basics import _require_init
 from horovod_tpu.common.process_sets import ProcessSet, global_process_set
+from horovod_tpu.metrics.registry import default_registry
 from horovod_tpu.ops.backend import Backend, HvdHandle, check_scale_dtype
 from horovod_tpu.ops.reduce_op import Adasum, Average, ReduceOp, Sum
+
+
+_CALL_COUNTERS: dict = {}
+
+
+def _count_call(kind: str) -> None:
+    """Per-kind eager-API call counter (``docs/OBSERVABILITY.md``): the
+    registry-side complement of the engine's submitted/executed counters —
+    visible on ``/metrics`` even for backends without native counters.
+    The Counter is resolved once per kind: the submission hot path pays
+    one dict hit + the counter's own lock, not a registry lookup."""
+    counter = _CALL_COUNTERS.get(kind)
+    if counter is None:
+        counter = _CALL_COUNTERS.setdefault(kind, default_registry().counter(
+            "hvd_collective_calls_total", help="eager collective API calls",
+            labels={"kind": kind}))
+    counter.inc()
 
 
 def _check_scales(values, prescale: float, postscale: float,
@@ -82,6 +100,7 @@ def allreduce_async(value, average: Optional[bool] = None,
                     process_set: ProcessSet = global_process_set) -> HvdHandle:
     op = _check_op(op, average)
     _check_scales([value], prescale_factor, postscale_factor, op)
+    _count_call("allreduce")
     be = _backend_for(process_set)
     st = _require_init()
     name = _auto_name("allreduce", name)
@@ -111,6 +130,7 @@ def grouped_allreduce_async(values: Sequence, average: Optional[bool] = None,
     (``GroupTable``, ``horovod/common/group_table.h:30-60``)."""
     op = _check_op(op, average)
     _check_scales(values, prescale_factor, postscale_factor, op)
+    _count_call("grouped_allreduce")
     be = _backend_for(process_set)
     base = _auto_name("grouped_allreduce", name)
     names = [f"{base}.{i}" for i in range(len(values))]
@@ -135,6 +155,7 @@ def allgather_async(value, name: Optional[str] = None,
     """Concat along dim 0 across ranks; ranks may differ in dim 0 (reference:
     ``EnqueueTensorAllgather`` ``operations.cc:1504-1556`` with per-rank
     first-dim sizes in the Response)."""
+    _count_call("allgather")
     be = _backend_for(process_set)
     return be.allgather_async(_auto_name("allgather", name), value)
 
@@ -150,6 +171,7 @@ def broadcast_async(value, root_rank: int, name: Optional[str] = None,
                     process_set: ProcessSet = global_process_set) -> HvdHandle:
     """``root_rank`` is the GLOBAL rank, also under process sets (reference:
     ``operations.cc:1560-1592`` converts global → set-relative internally)."""
+    _count_call("broadcast")
     be = _backend_for(process_set)
     return be.broadcast_async(_auto_name("broadcast", name), value, root_rank)
 
@@ -167,6 +189,7 @@ def alltoall_async(value, splits: Optional[Sequence[int]] = None,
     """Uneven alltoallv (reference: ``EnqueueTensorAlltoall``
     ``operations.cc:1630-1710``): ``splits[i]`` rows of dim 0 go to rank i;
     result is (received tensor, received splits)."""
+    _count_call("alltoall")
     be = _backend_for(process_set)
     return be.alltoall_async(_auto_name("alltoall", name), value, splits)
 
@@ -187,6 +210,7 @@ def reducescatter_async(value, op: Optional[ReduceOp] = None,
     first-class here because ``reduce_scatter`` is the cheap half of a TPU
     ring allreduce and the core of ZeRO-style sharded optimizers)."""
     op = op if op is not None else Sum
+    _count_call("reducescatter")
     be = _backend_for(process_set)
     name = _auto_name("reducescatter", name)
     if be.size == 1:

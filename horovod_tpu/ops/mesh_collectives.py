@@ -4,7 +4,7 @@ This replaces the reference's NCCL op implementations
 (``horovod/common/ops/nccl_operations.cc:156-420``): instead of launching
 ``ncclAllReduce`` on a stream, collectives are expressed as
 ``jax.lax.psum``/``all_gather``/``all_to_all``/``ppermute`` inside
-``jax.shard_map`` over a named mesh and compiled by XLA onto ICI/DCN links.
+``shard_map`` over a named mesh and compiled by XLA onto ICI/DCN links.
 Jitted callables are cached per (shape, dtype, mesh, axis, op) exactly the way
 the reference caches NCCL communicators per (process set, device map, stream)
 (``nccl_operations.cc:65-107``) — first call compiles, steady state replays.
@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from horovod_tpu._compat import axis_size, shard_map
 
 from horovod_tpu.ops.reduce_op import ReduceOp
 
@@ -97,7 +99,7 @@ def palltoall(x: jax.Array, axis_name: str, split_axis: int = 0,
 def pring_shift(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
     """Ring permute — the building block for ring attention / ring allreduce
     overlap patterns (no reference analog; NCCL rings are internal to NCCL)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 
@@ -115,7 +117,7 @@ def _cached_collective(kind: str, mesh: Mesh, axis_name: str,
         def fn(x):
             # PRODUCT and ADASUM use all_gather whose replication across the
             # axis can't be statically inferred — disable the VMA check.
-            @functools.partial(jax.shard_map, mesh=mesh,
+            @functools.partial(shard_map, mesh=mesh,
                                in_specs=P(axis_name), out_specs=P(),
                                check_vma=(op not in (ReduceOp.PRODUCT,
                                                      ReduceOp.ADASUM)))
@@ -124,7 +126,7 @@ def _cached_collective(kind: str, mesh: Mesh, axis_name: str,
             return body(x)
     elif kind == "allgather":
         def fn(x):
-            @functools.partial(jax.shard_map, mesh=mesh,
+            @functools.partial(shard_map, mesh=mesh,
                                in_specs=P(axis_name), out_specs=P(),
                                check_vma=False)
             def body(shard):
@@ -133,21 +135,21 @@ def _cached_collective(kind: str, mesh: Mesh, axis_name: str,
     elif kind == "broadcast":
         (root,) = extra
         def fn(x):
-            @functools.partial(jax.shard_map, mesh=mesh,
+            @functools.partial(shard_map, mesh=mesh,
                                in_specs=P(axis_name), out_specs=P())
             def body(shard):
                 return pbroadcast(shard[0], axis_name, root)
             return body(x)
     elif kind == "alltoall":
         def fn(x):
-            @functools.partial(jax.shard_map, mesh=mesh,
+            @functools.partial(shard_map, mesh=mesh,
                                in_specs=P(axis_name), out_specs=P(axis_name))
             def body(shard):
                 return palltoall(shard, axis_name, 0, 0)
             return body(x)
     elif kind == "reducescatter":
         def fn(x):
-            @functools.partial(jax.shard_map, mesh=mesh,
+            @functools.partial(shard_map, mesh=mesh,
                                in_specs=P(axis_name), out_specs=P(axis_name))
             def body(shard):
                 # shard: [1, k, ...] — contribution of this shard; scatter

@@ -38,6 +38,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from horovod_tpu._compat import shard_map
 from horovod_tpu.ops.backend import Backend, HvdHandle, _scale
 from horovod_tpu.ops.reduce_op import ReduceOp
 
@@ -134,19 +135,19 @@ class _XlaGroup:
         from horovod_tpu.ops.mesh_collectives import preduce
 
         if kind == "allreduce":
-            @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("proc"),
+            @functools.partial(shard_map, mesh=mesh, in_specs=P("proc"),
                                out_specs=P(), check_vma=False)
             def body(x):
                 return preduce(x[0], "proc", op)
         elif kind == "allgather":
-            @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("proc"),
+            @functools.partial(shard_map, mesh=mesh, in_specs=P("proc"),
                                out_specs=P(), check_vma=False)
             def body(x):
                 return jax.lax.all_gather(x[0], "proc", axis=0, tiled=True)
         elif kind == "broadcast":
             (root,) = extra
 
-            @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("proc"),
+            @functools.partial(shard_map, mesh=mesh, in_specs=P("proc"),
                                out_specs=P(), check_vma=False)
             def body(x):
                 idx = jax.lax.axis_index("proc")
@@ -155,7 +156,7 @@ class _XlaGroup:
                 # psum promotes bool -> int; cast back to the input dtype
                 return jax.lax.psum(masked, "proc").astype(x.dtype)
         elif kind == "alltoall":
-            @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("proc"),
+            @functools.partial(shard_map, mesh=mesh, in_specs=P("proc"),
                                out_specs=P("proc"), check_vma=False)
             def body(x):
                 return jax.lax.all_to_all(x, "proc", split_axis=1,
@@ -187,7 +188,7 @@ class _XlaGroup:
 
         from horovod_tpu.ops.reduce_op import ReduceOp as _R
 
-        @functools.partial(jax.shard_map, mesh=self._mesh,
+        @functools.partial(shard_map, mesh=self._mesh,
                            in_specs=tuple(P("proc") for _ in range(n)),
                            out_specs=tuple(P() for _ in range(n)),
                            check_vma=False)
@@ -240,7 +241,7 @@ class _XlaGroup:
             try:
                 zeros = np.zeros(self.size, np.int32)
 
-                @functools.partial(jax.shard_map, mesh=self._mesh,
+                @functools.partial(shard_map, mesh=self._mesh,
                                    in_specs=P("proc"), out_specs=P("proc"),
                                    check_vma=False)
                 def probe(x):
@@ -277,7 +278,7 @@ class _XlaGroup:
         jax, jnp, P = self._jax, self._jnp, self._P
 
         @functools.partial(
-            jax.shard_map, mesh=self._mesh,
+            shard_map, mesh=self._mesh,
             in_specs=(P("proc"), P("proc"), P("proc"), P("proc"), P("proc")),
             out_specs=P("proc"), check_vma=False)
         def body(x, in_off, send_sz, out_off, recv_sz):

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from horovod_tpu._compat import axis_size, shard_map
+
 
 class MoEMetrics(NamedTuple):
     aux_loss: jax.Array       # load-balancing loss (Switch aux loss)
@@ -85,7 +87,7 @@ def moe_layer_spmd(x: jax.Array, router_w: jax.Array,
     pytree with leading dim E_local = E/ep (this shard's experts).
     expert_fn(params_e, tokens [N, M]) -> [N, M], vmapped over local experts.
     """
-    n = lax.axis_size(axis_name) if axis_name else 1
+    n = axis_size(axis_name) if axis_name else 1
     G, M = x.shape
     E = router_w.shape[1]
     if E % max(n, 1) != 0:
@@ -129,7 +131,7 @@ def moe_layer(x: jax.Array, router_w: jax.Array, expert_fn: Callable,
     metric_axes = tuple(tok_ax or ()) + ((axis_name,) if n > 1 else ())
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(tok_spec, P(), P(ep_ax)),
         out_specs=(tok_spec, P()), check_vma=False)
     def run(xl, rw, ep_params):

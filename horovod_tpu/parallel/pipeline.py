@@ -19,6 +19,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from horovod_tpu._compat import axis_size, shard_map
+
 
 def pipeline_spmd(stage_fn: Callable, stage_params, x_microbatches: jax.Array,
                   axis_name: str = "pp") -> jax.Array:
@@ -31,7 +33,7 @@ def pipeline_spmd(stage_fn: Callable, stage_params, x_microbatches: jax.Array,
     Returns ``[M, mb, ...]`` outputs (valid on every shard after the final
     cross-stage reduction).
     """
-    S = lax.axis_size(axis_name)
+    S = axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     M = x_microbatches.shape[0]
     my_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
@@ -101,7 +103,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array, mesh: Mesh,
     x_spec = P(None, b_ax)
     out_spec = P(None, b_ax)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(axis_name), x_spec),
                        out_specs=out_spec, check_vma=False)
     def run(params_l, xm_l):
@@ -144,7 +146,7 @@ def pipeline_1f1b_spmd(stage_fn: Callable, loss_fn: Callable, stage_params,
     Returns ``(mean_loss, grads)`` where grads has this stage's parameter
     gradients (summed over microbatches, caller scales).
     """
-    S = lax.axis_size(axis_name)
+    S = axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     M = x_microbatches.shape[0]
     my_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
@@ -222,7 +224,7 @@ def pipeline_1f1b_apply(stage_fn: Callable, loss_fn: Callable, stage_params,
         return loss, jax.tree_util.tree_map(lambda v: v[None], g)
     data_spec = P(None, b_ax)
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(axis_name), data_spec, data_spec),
                        out_specs=(P(), P(axis_name)), check_vma=False)
     def run(params_l, xm_l, tm_l):

@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from horovod_tpu._compat import axis_size, shard_map
+
 NEG_INF = -1e30
 
 
@@ -79,7 +81,7 @@ def ring_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array,
     causal), or fully masked (later block: skipped).
     """
     B, Sq, H, D = q.shape
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     scale = scale if scale is not None else (1.0 / (D ** 0.5))
 
@@ -201,7 +203,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
         else None
     spec = P(b_ax, axis_name)
 
-    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec,) * 3,
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec,) * 3,
                        out_specs=spec, check_vma=False)
     def run(ql, kl, vl):
         return ring_attention_spmd(ql, kl, vl, axis_name, causal, scale,

@@ -19,6 +19,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from horovod_tpu._compat import axis_size, shard_map
+
 from horovod_tpu.parallel.ring_attention import _plain_attention
 
 
@@ -31,7 +33,7 @@ def ulysses_attention_spmd(q: jax.Array, k: jax.Array, v: jax.Array,
     exact attention on full sequence for the local head group;
     all_to_all #2: scatter sequence, gather heads → ``[B, S/sp, H, D]``.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     B, Sl, H, D = q.shape
     if H % n != 0:
         raise ValueError(f"Ulysses needs heads ({H}) divisible by axis ({n})")
@@ -60,7 +62,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
         else None
     spec = P(b_ax, axis_name)
 
-    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec,) * 3,
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec,) * 3,
                        out_specs=spec, check_vma=False)
     def run(ql, kl, vl):
         return ulysses_attention_spmd(ql, kl, vl, axis_name, causal, scale)

@@ -60,10 +60,19 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
 
-class _KVServer(ThreadingHTTPServer):
-    # many agents poll concurrently; the socketserver default backlog of
-    # 5 resets connections under bursts on slow machines
+class ThreadedHTTPServer(ThreadingHTTPServer):
+    """Shared server base for the repo's tiny HTTP planes (KV/rendezvous
+    here, the per-worker metrics exporter in
+    :mod:`horovod_tpu.metrics.exporter`): threaded, daemonized, with a
+    deep accept backlog — many agents poll concurrently and the
+    socketserver default backlog of 5 resets connections under bursts on
+    slow machines."""
+
     request_queue_size = 128
+
+
+class _KVServer(ThreadedHTTPServer):
+    pass
 
 
 class KVStoreServer:

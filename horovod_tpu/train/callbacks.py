@@ -9,11 +9,15 @@ callables for JAX training loops (works with any loop that calls
 
 from __future__ import annotations
 
+import re
+import time
 from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
 from horovod_tpu.common.basics import rank, size
+from horovod_tpu.common.logging import get_logger
+from horovod_tpu.metrics.registry import Gauge, Registry, default_registry
 from horovod_tpu.ops import collectives as C
 from horovod_tpu.ops.reduce_op import Average
 
@@ -51,6 +55,187 @@ class MetricAverageCallback:
             else:
                 out[k] = v
         return out
+
+
+class StepTimer:
+    """Step-time + throughput recorder feeding the metrics registry.
+
+    Records every step into ``hvd_step_time_seconds`` (log-scale
+    histogram), counts steps and processed units (images / tokens /
+    sequences — your choice of ``unit``), and keeps live gauges for
+    units/s and, when FLOPs are known, MFU. Everything it writes appears
+    on the worker's ``/metrics`` endpoint and in
+    ``hvd.metrics_snapshot()["registry"]``.
+
+    Use directly::
+
+        timer = StepTimer(unit="images")
+        for batch in data:
+            with timer.step(units=batch_size):
+                state, loss = train_step(state, batch)
+            # or: timer.start_step(); ...; timer.end_step(units=...)
+
+    ``flops_per_step`` is per-device FLOPs for ONE step (see
+    :func:`horovod_tpu.metrics.mfu.hlo_flops_per_device`); the peak is
+    looked up from the local chip on first use.
+    """
+
+    def __init__(self, unit: str = "examples",
+                 flops_per_step: Optional[float] = None,
+                 registry: Optional[Registry] = None) -> None:
+        reg = registry or default_registry()
+        self._reg = reg
+        self.unit = unit
+        # "tokens/s" or "img-sec" would break the Prometheus metric-name
+        # charset and take the whole /metrics response down with it
+        metric_unit = re.sub(r"[^a-zA-Z0-9_]", "_", unit)
+        self.step_time = reg.histogram(
+            "hvd_step_time_seconds", help="training step wall time")
+        self.steps = reg.counter("hvd_steps_total",
+                                 help="training steps completed")
+        self.units = reg.counter(f"hvd_{metric_unit}_total",
+                                 help=f"{unit} processed")
+        self.throughput = reg.gauge(
+            f"hvd_{metric_unit}_per_second",
+            help=f"{unit}/s over the last step (sum across workers)",
+            agg="sum")
+        # registered lazily on the first computed MFU: an eager gauge
+        # would export 0.0 from workers that never compute MFU and drag
+        # the mean-merged fleet value toward zero
+        self.mfu_gauge: Optional[Gauge] = None
+        self.flops_per_step = flops_per_step
+        self._peak: Any = _UNSET
+        self._t0: Optional[float] = None
+        self.last_step_seconds: Optional[float] = None
+        # MFU actually computed for the most recent step, None when it
+        # could not be (flops or device peak unknown) — the gauge's 0.0
+        # default is indistinguishable from a measured zero
+        self.last_mfu: Optional[float] = None
+
+    def set_flops_per_step(self, flops: Optional[float]) -> None:
+        self.flops_per_step = flops
+
+    def start_step(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def end_step(self, units: float = 0.0) -> Optional[float]:
+        """Close the step opened by :meth:`start_step`; returns the step
+        seconds (None if no step was open)."""
+        if self._t0 is None:
+            return None
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        self.last_step_seconds = dt
+        self.step_time.observe(dt)
+        self.steps.inc()
+        if units:
+            self.units.inc(units)
+            if dt > 0:
+                self.throughput.set(units / dt)
+        self.last_mfu = None
+        if self.flops_per_step and dt > 0:
+            if self._peak is _UNSET:
+                from horovod_tpu.metrics.mfu import device_peak_flops
+                try:
+                    self._peak = device_peak_flops()
+                except Exception:
+                    self._peak = None
+            if self._peak:
+                self.last_mfu = self.flops_per_step / dt / self._peak
+                if self.mfu_gauge is None:
+                    self.mfu_gauge = self._reg.gauge(
+                        "hvd_mfu",
+                        help="model FLOPs utilization of the last step",
+                        agg="mean")
+                self.mfu_gauge.set(self.last_mfu)
+        return dt
+
+    class _StepCtx:
+        def __init__(self, timer: "StepTimer", units: float) -> None:
+            self._timer = timer
+            self._units = units
+
+        def __enter__(self):
+            self._timer.start_step()
+            return self._timer
+
+        def __exit__(self, exc_type, exc, tb):
+            if exc_type is None:
+                self._timer.end_step(self._units)
+            else:
+                self._timer._t0 = None  # failed step: don't pollute stats
+            return False
+
+    def step(self, units: float = 0.0) -> "StepTimer._StepCtx":
+        return StepTimer._StepCtx(self, units)
+
+
+_UNSET = object()
+
+
+class TelemetryCallback:
+    """Train-loop hook bundle around :class:`StepTimer`.
+
+    Call ``on_step_begin()`` / ``on_step_end()`` from any loop (same hook
+    style as the other callbacks in this module). FLOPs for MFU are
+    resolved lazily on the first completed step from ``lowerable`` — a
+    zero-arg callable returning ``(jitted, args)`` exactly like
+    ``bench.py``'s ``_Run.lowerable`` — via the compiled executable's
+    cost analysis (:func:`horovod_tpu.metrics.mfu.hlo_flops_per_device`);
+    a failure there just leaves MFU unset, never breaks the loop.
+
+    ``log_every_n_steps`` > 0 logs a one-line telemetry summary (step
+    time, units/s, MFU) through the rank-tagged logger.
+    """
+
+    def __init__(self, units_per_step: float = 0.0,
+                 unit: str = "examples",
+                 lowerable: Optional[Callable[[], tuple]] = None,
+                 flops_per_step: Optional[float] = None,
+                 hlo_flops_factor: int = 1,
+                 log_every_n_steps: int = 0,
+                 registry: Optional[Registry] = None) -> None:
+        self.timer = StepTimer(unit=unit, flops_per_step=flops_per_step,
+                               registry=registry)
+        self.units_per_step = units_per_step
+        self._lowerable = lowerable
+        self._hlo_factor = hlo_flops_factor
+        self._log_every = log_every_n_steps
+        self._steps = 0
+
+    def on_train_begin(self, *args, **kwargs):
+        return args[0] if len(args) == 1 else (args or None)
+
+    def on_step_begin(self) -> None:
+        self.timer.start_step()
+
+    def on_step_end(self, units: Optional[float] = None) -> None:
+        dt = self.timer.end_step(
+            self.units_per_step if units is None else units)
+        self._steps += 1
+        if self.timer.flops_per_step is None and self._lowerable is not None:
+            from horovod_tpu.metrics.mfu import hlo_flops_per_device
+            try:
+                jitted, fargs = self._lowerable()
+                self.timer.set_flops_per_step(hlo_flops_per_device(
+                    jitted, fargs, factor=self._hlo_factor))
+            except Exception:
+                pass
+            finally:
+                self._lowerable = None  # one attempt: lowering isn't free
+        if self._log_every > 0 and self._steps % self._log_every == 0 \
+                and dt is not None:
+            get_logger().info(
+                "telemetry: step %d took %.4fs (%.1f %s/s, mfu=%s)",
+                self._steps, dt,
+                self.timer.throughput.value, self.timer.unit,
+                f"{self.timer.last_mfu:.3f}"
+                if self.timer.last_mfu is not None else "n/a")
+
+    def on_epoch_end(self, logs: Dict[str, Any]) -> Dict[str, Any]:
+        """Pass-through hook so the callback can ride the same list as
+        :class:`MetricAverageCallback`."""
+        return logs
 
 
 class LearningRateWarmupCallback:

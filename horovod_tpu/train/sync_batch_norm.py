@@ -24,12 +24,14 @@ from jax import lax
 
 import flax.linen as nn
 
+from horovod_tpu._compat import axis_size
+
 
 def _axes_live(axis_names: Sequence[str]) -> Tuple[str, ...]:
     out = []
     for name in axis_names:
         try:
-            if lax.axis_size(name) > 1:
+            if axis_size(name) > 1:
                 out.append(name)
         except NameError:
             pass
@@ -103,7 +105,7 @@ class SyncBatchNorm(nn.Module):
                 # (sync_batch_norm.py:~190); the biased var still normalizes.
                 n = int(np.prod([x.shape[d] for d in red]))
                 for a in live:
-                    n *= lax.axis_size(a)
+                    n *= axis_size(a)
                 corr = n / (n - 1) if n > 1 else 1.0
                 ra_mean.value = (self.momentum * ra_mean.value
                                  + (1 - self.momentum) * mean)

@@ -3,6 +3,12 @@ With HOROVOD_STALL_SHUTDOWN_TIME_SECONDS set, rank 0's wait must fail with
 a clear stall error instead of hanging (reference:
 stall_inspector.h shutdown path; here surfaced per-tensor as
 HorovodInternalError). Afterwards the domain keeps working.
+
+Straggler mode (HVD_TEST_STRAGGLER_SECS set): instead of the stall
+scenario, rank 1 deliberately sleeps before each submission and the
+coordinator's rank-attributed negotiation-wait report
+(``CoreBackend.stragglers`` → ``hvd_stragglers_json``) must name rank 1
+as the rank everyone waited on (docs/OBSERVABILITY.md).
 """
 import os
 import sys
@@ -17,9 +23,45 @@ from horovod_tpu.core.core_backend import CoreBackend  # noqa: E402
 from horovod_tpu.ops.reduce_op import ReduceOp  # noqa: E402
 
 
+def straggle(be, rank):
+    delay = float(os.environ["HVD_TEST_STRAGGLER_SECS"])
+    rounds = 3
+    # warm-up: both ranks roughly in sync, clean slate for attribution
+    be.allreduce_async("warm", np.ones(2, np.float32),
+                       ReduceOp.SUM).wait(60)
+    for i in range(rounds):
+        if rank == 1:
+            time.sleep(delay)  # rank 1 is deliberately the last announcer
+        be.allreduce_async(f"slow_{i}", np.ones(4, np.float32),
+                           ReduceOp.SUM).wait(60)
+    be.barrier()
+    s = be.stragglers()
+    if rank == 0:
+        # the coordinator saw every announcement: rank 1 must be charged
+        # ~rounds * delay of peer wait, strictly more than rank 0
+        r1 = s["ranks"].get("1")
+        assert r1 is not None, s
+        assert r1["held_count"] >= rounds, s
+        min_wait = rounds * delay * 0.5
+        assert r1["wait_seconds"] > min_wait, s
+        r0 = s["ranks"].get("0", {"wait_seconds": 0.0})
+        assert r1["wait_seconds"] > r0["wait_seconds"], s
+        assert s["tensors_timed"] >= rounds, s
+        assert s["total_wait_seconds"] >= r1["wait_seconds"], s
+    else:
+        # attribution is coordinator-only state
+        assert s.get("ranks", {}) == {}, s
+    be.barrier()
+    be.shutdown()
+    print(f"straggler worker {rank}: OK", flush=True)
+
+
 def main():
     be = CoreBackend()
     rank = be.rank
+    if os.environ.get("HVD_TEST_STRAGGLER_SECS"):
+        straggle(be, rank)
+        return
     if rank == 0:
         h = be.allreduce_async("lonely", np.ones(4, np.float32),
                                ReduceOp.SUM)

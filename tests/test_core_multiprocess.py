@@ -25,6 +25,7 @@ STALL_WORKER = os.path.join(os.path.dirname(__file__), "stall_worker.py")
 TORCH_WORKER = os.path.join(os.path.dirname(__file__), "torch_worker.py")
 TF_WORKER = os.path.join(os.path.dirname(__file__), "tf_worker.py")
 CACHE_WORKER = os.path.join(os.path.dirname(__file__), "cache_worker.py")
+METRICS_WORKER = os.path.join(os.path.dirname(__file__), "metrics_worker.py")
 
 
 def _free_port():
@@ -134,6 +135,43 @@ def test_stall_shutdown_errors_waiters():
     _launch(2, {"HOROVOD_STALL_CHECK_TIME_SECONDS": "1",
                 "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "4"},
             timeout=180, worker=STALL_WORKER)
+
+
+@needs_core
+def test_straggler_attribution_names_slow_rank():
+    """The coordinator's straggler report charges per-tensor negotiation
+    wait to the LAST announcing rank: with rank 1 deliberately sleeping
+    before each submission, the report must name rank 1 (tentpole
+    acceptance: who-is-holding-whom-up, aggregated per rank — the
+    reference only ever showed this as per-tensor timeline spans)."""
+    _launch(2, {"HVD_TEST_STRAGGLER_SECS": "0.6"},
+            timeout=180, worker=STALL_WORKER)
+
+
+def _free_port_pair():
+    """Base port with base+1 also free — worker i binds base+local_rank,
+    so reserving only the base leaves rank 1's bind to luck."""
+    for _ in range(50):
+        base = _free_port()
+        s = socket.socket()
+        try:
+            s.bind(("127.0.0.1", base + 1))
+        except OSError:
+            continue
+        finally:
+            s.close()
+        return base
+    raise RuntimeError("no adjacent free port pair found")
+
+
+@needs_core
+def test_metrics_exporter_live_scrape():
+    """2-process live run with HVD_TPU_METRICS_PORT: each worker's
+    ``/metrics`` serves Prometheus text with the engine cache-hit rate,
+    step-time histogram buckets and throughput gauges, ``/healthz``
+    reports rank identity, and the exporter goes down with shutdown."""
+    _launch(2, {"HVD_TPU_METRICS_PORT": str(_free_port_pair())},
+            timeout=480, worker=METRICS_WORKER)
 
 
 @needs_core

@@ -7,6 +7,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from horovod_tpu._compat import shard_map
 from horovod_tpu.ops import mesh_collectives as mc
 from horovod_tpu.ops.reduce_op import ReduceOp
 from horovod_tpu.parallel import build_mesh
@@ -90,7 +91,7 @@ def test_ring_shift_spmd(mesh):
     from functools import partial
     from jax.sharding import PartitionSpec as P
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    @partial(shard_map, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
     def shift(x):
         return mc.pring_shift(x, "dp", 1)
 

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 import optax
 
 import horovod_tpu as hvd_mod
+from horovod_tpu._compat import shard_map
 from horovod_tpu.ops.adasum import adasum_combine, adasum_tree_reduce
 
 
@@ -65,7 +66,7 @@ def test_grad_transform_shard_map_axis(hvd, mesh8):
 
     tx = hvd_mod.DistributedGradTransform(op=hvd_mod.Average, axis_name="dp")
 
-    @partial(jax.shard_map, mesh=mesh8, in_specs=P("dp"), out_specs=P())
+    @partial(shard_map, mesh=mesh8, in_specs=P("dp"), out_specs=P())
     def sync(g):
         upd, _ = tx.update({"g": g}, optax.EmptyState())
         return upd["g"]

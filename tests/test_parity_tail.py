@@ -8,6 +8,7 @@ import optax
 from functools import partial
 from jax.sharding import PartitionSpec as P
 
+from horovod_tpu._compat import shard_map
 from horovod_tpu.parallel import build_mesh
 
 
@@ -21,7 +22,7 @@ def test_sync_batch_norm_spmd_matches_global():
     scale = jnp.ones(4)
     bias = jnp.zeros(4)
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"), P(), P()),
+    @partial(shard_map, mesh=mesh, in_specs=(P("dp"), P(), P()),
              out_specs=P("dp"))
     def synced(xl, s, b):
         return sync_batch_norm_spmd(xl, s, b, axis_names=("dp",))
