@@ -14,7 +14,11 @@ horovod_tpu anywhere — the framework-overhead control) /
 pretraining, the BASELINE north-star secondary model) / ``gpt`` (decoder
 LM on the flagship transformer; shape via ``HVD_BENCH_GPT_{LAYERS,DMODEL,
 HEADS,DFF}``). ``HVD_BENCH_BATCH`` / ``HVD_BENCH_SEQ`` / ``HVD_BENCH_STEM``
-tune shapes. See docs/PERF.md for recorded numbers.
+tune shapes. ``--compression int8|fp8|onebit|fp16|bf16`` (or
+``HVD_BENCH_COMPRESSION``) wraps the optimizer in error-feedback
+gradient compression so the codec's in-graph cost lands in the measured
+step (docs/PERF.md "Gradient compression"). See docs/PERF.md for
+recorded numbers.
 
 Hardened for the driver contract:
 - the measurement runs in a CHILD process, so every retry gets a fresh JAX
@@ -272,6 +276,24 @@ class _Run:
         return self.jitted, tuple(self.args)
 
 
+def _wrap_compression(tx):
+    """Wrap the optax optimizer per HVD_BENCH_COMPRESSION (the
+    ``--compression`` flag): error-feedback quantized gradient sync
+    through ``hvd.DistributedOptimizer`` (docs/PERF.md "Gradient
+    compression"). Returns ``(tx, codec_name_or_None)``; the in-graph
+    quantize∘dequantize cost lands in the measured step either way, so
+    the number answers "what does the codec cost on this model"."""
+    name = os.environ.get("HVD_BENCH_COMPRESSION", "").strip().lower()
+    if not name or name == "none":
+        return tx, None
+    import horovod_tpu as hvd
+    from horovod_tpu.compression import ErrorFeedback, resolve_compressor
+    codec = resolve_compressor(name)
+    _log(f"gradient compression enabled: {name} (error feedback)")
+    return hvd.DistributedOptimizer(
+        tx, compression=ErrorFeedback(codec)), name
+
+
 def _child_bert() -> None:
     """BERT-Large pretraining throughput (HVD_BENCH_MODEL=bert)."""
     import numpy as np
@@ -294,7 +316,7 @@ def _child_bert() -> None:
     cfg = bert_large()
     model = Bert(cfg)
     params = init_bert(model, jax.random.PRNGKey(0), S, mesh)
-    tx = optax.adamw(1e-4)
+    tx, compression = _wrap_compression(optax.adamw(1e-4))
     opt_state = jax.jit(tx.init)(params)
     step = make_bert_train_step(model, tx, mesh, scan_steps=scan)
 
@@ -334,8 +356,9 @@ def _child_bert() -> None:
         unit="seq/s/chip",
         vs_baseline_per_unit=None,  # reference publishes no BERT absolute
         extra={"batch_per_chip": B // n_chips, "seq_len": S,
-               "scan_steps": scan,
-               "tokens_per_sec_per_chip": lambda v: round(v * S, 1)})
+               "scan_steps": scan, "compression": compression,
+               "tokens_per_sec_per_chip": lambda v: round(v * S, 1)},
+        hlo_flops_factor=scan)
 
 
 def _child_gpt() -> None:
@@ -372,7 +395,7 @@ def _child_gpt() -> None:
                           cfg, mesh)
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     _log(f"gpt params: {n_params/1e6:.1f}M, batch {B} x seq {S}")
-    tx = optax.adamw(1e-4)
+    tx, compression = _wrap_compression(optax.adamw(1e-4))
     opt_state = init_opt_state(tx, params, mesh, cfg)
     scan = max(1, int(os.environ.get("HVD_BENCH_SCAN", "8")))
     step = make_train_step(cfg, mesh, tx, scan_steps=scan)
@@ -397,8 +420,9 @@ def _child_gpt() -> None:
         metric="gpt_tokens_per_sec_per_chip", unit="tokens/s/chip",
         vs_baseline_per_unit=None,  # reference publishes no LM absolute
         extra={"batch_per_chip": B // n_chips, "seq_len": S,
-               "scan_steps": scan,
-               "n_params_m": round(n_params / 1e6, 1)})
+               "scan_steps": scan, "compression": compression,
+               "n_params_m": round(n_params / 1e6, 1)},
+        hlo_flops_factor=scan)
 
 
 def _child_cnn(which: str) -> None:
@@ -447,19 +471,21 @@ def _child_cnn(which: str) -> None:
                                   image_size=image_size, mesh=mesh)
         batch_stats = None
         has_batch_stats = False
-        tx = optax.sgd(0.01, momentum=0.9)
+        tx, compression = _wrap_compression(optax.sgd(0.01, momentum=0.9))
         opt_state = jax.jit(tx.init)(params)
         step = make_vgg_train_step(model, tx, mesh, scan_steps=scan)
-        extra = {"batch_per_chip": batch_per_chip, "scan_steps": scan}
+        extra = {"batch_per_chip": batch_per_chip, "scan_steps": scan,
+                 "compression": compression}
     elif which == "inception3":
         model = InceptionV3(num_classes=1000, dtype=jnp.bfloat16)
         params, batch_stats = create_inception_state(
             model, jax.random.PRNGKey(0), image_size=image_size, mesh=mesh)
-        tx = optax.sgd(0.1, momentum=0.9)
+        tx, compression = _wrap_compression(optax.sgd(0.1, momentum=0.9))
         opt_state = jax.jit(tx.init)(params)
         step = make_inception_train_step(model, tx, mesh, scan_steps=scan)
         extra = {"batch_per_chip": batch_per_chip,
-                 "image_size": image_size, "scan_steps": scan}
+                 "image_size": image_size, "scan_steps": scan,
+                 "compression": compression}
     else:
         mk = ResNet101 if which == "resnet101" else ResNet50
         # HVD_BENCH_REMAT=1: jax.checkpoint each block — HBM for
@@ -471,11 +497,12 @@ def _child_cnn(which: str) -> None:
                    remat=remat, remat_prevent_cse=scan <= 1)
         params, batch_stats = create_resnet_state(
             model, jax.random.PRNGKey(0), image_size=image_size, mesh=mesh)
-        tx = optax.sgd(0.1, momentum=0.9)
+        tx, compression = _wrap_compression(optax.sgd(0.1, momentum=0.9))
         opt_state = jax.jit(tx.init)(params)
         step = make_resnet_train_step(model, tx, mesh, scan_steps=scan)
         extra = {"batch_per_chip": batch_per_chip, "stem": stem,
-                 "scan_steps": scan, "remat": remat}
+                 "scan_steps": scan, "remat": remat,
+                 "compression": compression}
 
     rng = np.random.RandomState(0)
     images = jax.device_put(
@@ -518,6 +545,7 @@ def _child_cnn(which: str) -> None:
         analytic_flops_per_device=lambda:
             3 * 2 * FWD_MACS_PER_IMG[which] * B * scan / n_chips,
         iters=20, per_step_units=B * scan, n_chips=n_chips,
+        hlo_flops_factor=scan,
         metric=f"{which}_images_per_sec_per_chip", unit="img/s/chip",
         # the published 1656.82/16 figure is a ResNet-101 measurement
         # (docs/benchmarks.rst:32-43): it is the apples-to-apples baseline
@@ -613,6 +641,7 @@ def _child_resnet50_bare() -> None:
         analytic_flops_per_device=lambda:
             3 * 2 * FWD_MACS_PER_IMG["resnet50"] * batch * scan,
         iters=20, per_step_units=batch * scan, n_chips=1,
+        hlo_flops_factor=scan,
         metric="resnet50_bare_images_per_sec_per_chip", unit="img/s/chip",
         vs_baseline_per_unit=REFERENCE_IMG_PER_SEC_PER_DEVICE,
         extra={"batch_per_chip": batch, "stem": stem, "scan_steps": scan,
@@ -898,6 +927,16 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    # --compression int8|fp8|onebit|fp16|bf16: error-feedback gradient
+    # compression in the measured step (env HVD_BENCH_COMPRESSION is the
+    # equivalent knob and the parent→child channel)
+    if "--compression" in sys.argv:
+        i = sys.argv.index("--compression")
+        if i + 1 >= len(sys.argv):
+            print("[bench] --compression requires a value (int8|fp8|"
+                  "onebit|fp16|bf16|none)", file=sys.stderr)
+            sys.exit(2)
+        os.environ["HVD_BENCH_COMPRESSION"] = sys.argv[i + 1]
     if "--child" in sys.argv:
         _child()
     else:

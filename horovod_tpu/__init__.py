@@ -116,7 +116,20 @@ from horovod_tpu.train.optimizer import (  # noqa: F401
     broadcast_object,
     allgather_object,
 )
-from horovod_tpu.train.compression import Compression  # noqa: F401
+# Gradient compression subsystem (quantizers + error feedback +
+# quantized wire paths; reference analog: horovod/torch/compression.py,
+# grown per EQuARX — see docs/PERF.md "Gradient compression")
+from horovod_tpu.compression import (  # noqa: F401
+    Compression,
+    Compressor,
+    ErrorFeedback,
+)
+from horovod_tpu.ops.collectives import (  # noqa: F401
+    quantized_allreduce,
+    quantized_allreduce_async,
+    quantized_grouped_allreduce,
+    quantized_grouped_allreduce_async,
+)
 from horovod_tpu.train.sync_batch_norm import SyncBatchNorm  # noqa: F401
 from horovod_tpu.train.checkpoint import Checkpointer  # noqa: F401
 from horovod_tpu.train import callbacks  # noqa: F401

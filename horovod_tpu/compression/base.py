@@ -1,0 +1,86 @@
+"""Compressor interface + dtype-cast compressors.
+
+Reference: ``horovod/torch/compression.py:20-75`` — the ``Compressor``
+base with ``Compression.none`` / ``.fp16`` compress/decompress pairs
+around allreduce. These casts halve wire bytes at most; the real
+bandwidth recovery lives in :mod:`horovod_tpu.compression.quantizers`
+(block-wise int8 / fp8 / 1-bit, EQuARX-style).
+
+The contract every compressor honors::
+
+    payload, ctx = comp.compress(tensor)   # payload is what moves
+    tensor ≈ comp.decompress(payload, ctx)
+
+For the cast family the payload is a plain array the backend can
+allreduce directly (sum in fp16/bf16 is well-defined). Quantizers
+subclass :class:`Quantizer` instead — their payloads carry per-block
+scales and sum on the wire is NOT meaningful, so the transport layers
+route them through quantized allgather paths
+(:func:`horovod_tpu.ops.collectives.quantized_allreduce`,
+``device_allreduce(compression=)``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def _astype(tensor, dtype):
+    """Dtype cast for numpy and jax arrays alike (both honor .astype)."""
+    return tensor.astype(dtype)
+
+
+class Compressor:
+    """Interface (reference: ``Compressor`` base, ``compression.py:20-33``)."""
+
+    @staticmethod
+    def compress(tensor) -> Tuple:
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Compress float32/float64 to float16 for transport
+    (reference: ``compression.py:42-62``)."""
+
+    @staticmethod
+    def compress(tensor):
+        dtype = tensor.dtype
+        if jnp.issubdtype(dtype, jnp.floating) and dtype != jnp.float16:
+            return _astype(tensor, jnp.float16), dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor if ctx is None else _astype(tensor, ctx)
+
+
+class BF16Compressor(Compressor):
+    """TPU-native 16-bit compression (no reference analog; bf16 keeps fp32's
+    exponent range so gradient overflow handling is unnecessary)."""
+
+    @staticmethod
+    def compress(tensor):
+        dtype = tensor.dtype
+        if jnp.issubdtype(dtype, jnp.floating) and dtype != jnp.bfloat16:
+            return _astype(tensor, jnp.bfloat16), dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor if ctx is None else _astype(tensor, ctx)

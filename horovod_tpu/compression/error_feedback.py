@@ -1,0 +1,118 @@
+"""Error feedback (EF) for lossy gradient compression.
+
+Lossy codecs bias SGD: whatever the quantizer rounds away this step is
+gone forever, and for aggressive codecs (1-bit) the bias kills
+convergence outright. EF (1-bit SGD, Seide et al.; EF-SGD, Karimireddy
+et al.) fixes this by carrying the compression error forward::
+
+    acc      = grad + residual          # re-inject last step's error
+    compressed = C(acc)                 # what the wire moves
+    residual = acc - compressed         # carried to the next step
+
+Every worker keeps its OWN residual (the error of compressing its own
+contribution); the synchronized gradient is the reduction of the
+compressed contributions.
+
+Two ways to use it:
+
+* ``DistributedGradTransform(compression=ErrorFeedback(Compression.int8))``
+  — the :class:`ErrorFeedback` marker threads EF through the existing
+  ``compression=`` seam: the transform's state grows a per-leaf residual
+  pytree and the transport still moves quantized bytes where the regime
+  allows (eager multi-process → quantized allgather wire; traced
+  global-SPMD → in-graph quantize∘dequantize, since XLA already reduced
+  the gradients from shardings).
+* :func:`error_feedback_transform` — a standalone optax
+  ``GradientTransformation`` composable anywhere in a chain.
+
+Residuals live in fp32 regardless of the gradient dtype (the whole point
+is keeping what the codec cannot represent), and non-floating leaves
+pass through untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from horovod_tpu.compression.base import Compressor
+from horovod_tpu.compression.quantizers import Quantizer
+
+
+class ErrorFeedback:
+    """Marker wrapper for the ``compression=`` seam: ``inner`` is the
+    actual codec; the consuming transform owns the residual state."""
+
+    def __init__(self, inner: Compressor):
+        if isinstance(inner, ErrorFeedback):
+            raise ValueError("ErrorFeedback cannot wrap ErrorFeedback")
+        self.inner = inner
+
+    def __repr__(self):
+        return f"ErrorFeedback({self.inner!r})"
+
+
+class EFState(NamedTuple):
+    residual: Any  # pytree matching params; None leaves = passthrough
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def init_residual(params):
+    """fp32 zeros for every floating leaf, None for the rest."""
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros(jnp.shape(p), jnp.float32)
+        if _is_float(p) else None, params)
+
+
+def _qdq(comp: Compressor, x):
+    """In-graph quantize∘dequantize through whichever codec interface
+    ``comp`` exposes (Quantizer.qdq or cast compress/decompress)."""
+    if isinstance(comp, Quantizer):
+        return comp.qdq(x)
+    payload, ctx = comp.compress(x)
+    return comp.decompress(payload, ctx)
+
+
+def ef_apply(comp: Compressor, updates, residual):
+    """One EF round over a pytree: returns ``(compressed_updates,
+    new_residual)``. Leaves with a None residual pass through."""
+
+    def one(u, r):
+        if r is None:
+            return u, None
+        acc = u.astype(jnp.float32) + r
+        out = _qdq(comp, acc).astype(u.dtype)
+        # residual measures the error of what the caller actually GETS —
+        # including the cast back to the gradient dtype (for bf16 grads
+        # that rounding is comparable to the int8 step itself)
+        return out, acc - out.astype(jnp.float32)
+
+    flat_u, treedef = jax.tree_util.tree_flatten(updates)
+    flat_r = treedef.flatten_up_to(residual)
+    pairs = [one(u, r) for u, r in zip(flat_u, flat_r)]
+    new_u = jax.tree_util.tree_unflatten(treedef, [p[0] for p in pairs])
+    new_r = jax.tree_util.tree_unflatten(treedef, [p[1] for p in pairs])
+    return new_u, new_r
+
+
+def error_feedback_transform(comp: Compressor
+                             ) -> optax.GradientTransformation:
+    """Standalone optax transform: compress updates with ``comp`` under
+    error feedback. Chain it BEFORE the gradient sync so the residual is
+    per-worker local (``optax.chain(error_feedback_transform(c), ...)``)."""
+
+    def init_fn(params):
+        return EFState(residual=init_residual(params))
+
+    def update_fn(updates, state, params=None):
+        del params
+        new_updates, new_residual = ef_apply(comp, updates, state.residual)
+        return new_updates, EFState(residual=new_residual)
+
+    return optax.GradientTransformation(init_fn, update_fn)

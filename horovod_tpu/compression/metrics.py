@@ -1,0 +1,61 @@
+"""Compression observability: byte counters + ratio gauges.
+
+Registered in the process-wide metrics registry
+(:mod:`horovod_tpu.metrics.registry`), so the per-worker ``/metrics``
+exporter and ``hvd.metrics_snapshot()`` pick them up with no extra
+wiring:
+
+* ``hvd_compression_pre_bytes_total{codec=...}`` — bytes the caller
+  would have moved uncompressed,
+* ``hvd_compression_wire_bytes_total{codec=...}`` — bytes actually
+  put on the wire (values + scales),
+* ``hvd_compression_ratio{codec=...}`` — cumulative pre/wire ratio
+  (gauge, merged as ``mean`` across workers).
+
+Byte accounting happens at the host boundary of each transport path
+(eager enqueue, array-level mesh collective) from STATIC shapes —
+nothing is recorded from inside traced code.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from horovod_tpu.metrics.registry import default_registry
+
+_INSTRUMENTS: Dict[str, Tuple] = {}
+
+
+def _codec_instruments(codec: str):
+    inst = _INSTRUMENTS.get(codec)
+    if inst is None:
+        reg = default_registry()
+        labels = {"codec": codec}
+        inst = _INSTRUMENTS.setdefault(codec, (
+            reg.counter("hvd_compression_pre_bytes_total",
+                        help="bytes before gradient compression",
+                        labels=labels),
+            reg.counter("hvd_compression_wire_bytes_total",
+                        help="bytes actually moved on the wire",
+                        labels=labels),
+            reg.gauge("hvd_compression_ratio",
+                      help="cumulative pre/wire compression ratio",
+                      labels=labels, agg="mean"),
+        ))
+    return inst
+
+
+def record_compression(codec: str, pre_bytes: int, wire_bytes: int) -> None:
+    """Account one compressed transfer; updates the cumulative ratio."""
+    pre, wire, ratio = _codec_instruments(codec)
+    pre.inc(pre_bytes)
+    wire.inc(wire_bytes)
+    if wire.value > 0:
+        ratio.set(pre.value / wire.value)
+
+
+def compression_ratio(codec: str) -> float:
+    """Cumulative ratio recorded so far for ``codec`` (0.0 if nothing
+    was recorded yet)."""
+    pre, wire, _ = _codec_instruments(codec)
+    return (pre.value / wire.value) if wire.value > 0 else 0.0

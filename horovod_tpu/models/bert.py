@@ -138,7 +138,10 @@ def make_bert_train_step(model: Bert, optimizer, mesh: Mesh,
 
     ``scan_steps > 1`` runs that many optimizer steps per call via
     ``lax.scan`` in ONE compiled program (one dispatch per chain; see
-    ``make_resnet_train_step``). The returned loss is the last step's.
+    ``make_resnet_train_step``). All scanned steps consume the SAME
+    batch (``scan_util.multi_step`` same-batch semantics — a throughput
+    construct, not multi-batch training). The returned loss is the last
+    step's.
 
     ``params``/``opt_state`` buffers are DONATED (in-place update on
     device): keep only the returned state — the inputs are invalidated

@@ -178,7 +178,9 @@ def make_inception_train_step(model: InceptionV3, optimizer, mesh,
     ``scan_steps > 1`` runs that many optimizer steps per call via
     ``lax.scan`` in ONE compiled program (one dispatch per chain; see
     ``make_resnet_train_step``); scanned step ``i`` uses dropout index
-    ``step_idx * scan_steps + i`` so masks stay fresh.
+    ``step_idx * scan_steps + i`` so masks stay fresh. All scanned steps
+    consume the SAME batch (``scan_util.multi_step`` same-batch
+    semantics — a throughput construct, not multi-batch training).
 
     ``params``/``batch_stats``/``opt_state`` buffers are DONATED
     (in-place update on device): keep only the returned state — the

@@ -152,8 +152,12 @@ def make_resnet_train_step(model: ResNet, optimizer, mesh: Mesh,
     ``scan_steps > 1`` runs that many optimizer steps per call via
     ``lax.scan`` inside ONE compiled program: a single dispatch covers
     the whole chain, taking host→device launch latency (significant
-    through a remote relay) off the critical path. The returned loss is
-    the LAST scanned step's.
+    through a remote relay) off the critical path. Every scanned step
+    consumes the SAME ``images``/``labels`` batch (the scan carries only
+    the training state — ``scan_util.multi_step``): right for
+    throughput measurement, NOT a substitute for multi-batch training —
+    feed a fresh batch per call with ``scan_steps=1`` for real epochs.
+    The returned loss is the LAST scanned step's.
 
     ``params``/``batch_stats``/``opt_state`` buffers are DONATED: the
     update happens in place on device, so keep only the returned state

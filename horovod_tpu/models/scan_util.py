@@ -27,6 +27,16 @@ def multi_step(one_step, n_carry: int, scan_steps: int,
     step ``i`` receives ``step_idx * scan_steps + i``, so per-step dropout
     keys stay fresh across both the chain and successive dispatches.
 
+    SAME-BATCH semantics: the non-carry inputs (the batch) are
+    loop-invariant — every scanned step consumes the SAME batch, so
+    ``scan_steps > 1`` means K optimizer steps on one batch per
+    dispatch. That is the right construct for throughput benchmarking
+    (device-rate measurement with dispatch latency off the critical
+    path) and deliberate multi-epoch-per-batch training; it is NOT
+    multi-batch training — a training loop that wants a fresh batch per
+    optimizer step must keep ``scan_steps=1`` (or restructure the batch
+    as a scanned ``[K, ...]`` input itself).
+
     ``scan_steps <= 1`` returns ``one_step`` behavior unchanged (guarding
     0/negative values: a zero-length scan would run no steps at all).
     """

@@ -416,7 +416,10 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, optimizer,
 
     ``scan_steps > 1`` runs that many optimizer steps per call via
     ``lax.scan`` in ONE compiled program (one dispatch per chain; see
-    ``make_resnet_train_step``). Returned loss/aux are the last step's.
+    ``make_resnet_train_step``). All scanned steps consume the SAME
+    ``tokens``/``targets`` batch (``scan_util.multi_step`` same-batch
+    semantics — a throughput construct, not multi-batch training).
+    Returned loss/aux are the last step's.
 
     ``params``/``opt_state`` buffers are DONATED (in-place update on
     device): keep only the returned state — the inputs are invalidated

@@ -76,7 +76,9 @@ def make_vgg_train_step(model: VGG, optimizer, mesh, dropout_seed: int = 0,
     ``scan_steps > 1`` runs that many optimizer steps per call via
     ``lax.scan`` in ONE compiled program (one dispatch per chain; see
     ``make_resnet_train_step``); scanned step ``i`` uses dropout index
-    ``step_idx * scan_steps + i`` so masks stay fresh.
+    ``step_idx * scan_steps + i`` so masks stay fresh. All scanned steps
+    consume the SAME batch (``scan_util.multi_step`` same-batch
+    semantics — a throughput construct, not multi-batch training).
 
     ``params``/``opt_state`` buffers are DONATED (in-place update on
     device): keep only the returned state — the inputs are invalidated

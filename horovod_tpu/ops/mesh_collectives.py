@@ -96,6 +96,47 @@ def palltoall(x: jax.Array, axis_name: str, split_axis: int = 0,
                           concat_axis=concat_axis, tiled=True)
 
 
+def preduce_quantized(x: jax.Array, axis_name: str, quantizer,
+                      op: ReduceOp = ReduceOp.SUM) -> jax.Array:
+    """Quantized allreduce along a named axis: ``reduce_scatter →
+    quantize → all_gather → dequantize`` (EQuARX, arxiv 2506.17615).
+
+    Quantizing only the GATHERED phase keeps the reduction itself exact:
+    every shard's slice is summed in full precision by ``psum_scatter``,
+    and only the already-reduced slices move quantized through the
+    all-gather — so the end-to-end error is one quantization step, never
+    a sum of per-rank quantization errors, while the gather (half the
+    bytes of a ring allreduce) moves ~4x less with the int8 codec.
+
+    Requires ``x.shape[0]`` divisible by the axis size (the scatter
+    split); SUM and AVERAGE only.
+    """
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"quantized allreduce supports Sum/Average, got {op}")
+    n = axis_size(axis_name)
+    if x.ndim == 0:
+        raise ValueError(
+            "quantized allreduce needs at least a 1-D per-shard tensor "
+            "(the scatter splits dim 0); use the exact path for scalars")
+    if x.shape[0] % n != 0:
+        raise ValueError(
+            f"quantized allreduce needs dim 0 ({x.shape[0]}) divisible by "
+            f"the axis size ({n}); pad the tensor or use the exact path")
+    part = lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+    if op == ReduceOp.AVERAGE:
+        part = part / n
+    q, spec = quantizer.quantize(part)
+    g_values = lax.all_gather(q.values, axis_name)  # [n, ...codes]
+    g_scales = lax.all_gather(q.scales, axis_name)
+    from horovod_tpu.compression.quantizers import Quantized
+    parts = jax.vmap(
+        lambda v, s: quantizer.dequantize(Quantized(v, s), spec)
+    )(g_values, g_scales)
+    return parts.reshape((n * part.shape[0],) + part.shape[1:]) \
+        .astype(x.dtype)
+
+
 def pring_shift(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
     """Ring permute — the building block for ring attention / ring allreduce
     overlap patterns (no reference analog; NCCL rings are internal to NCCL)."""
@@ -123,6 +164,17 @@ def _cached_collective(kind: str, mesh: Mesh, axis_name: str,
                                                      ReduceOp.ADASUM)))
             def body(shard):
                 return preduce(shard[0], axis_name, op)
+            return body(x)
+    elif kind == "allreduce_q":
+        (quantizer,) = extra
+        def fn(x):
+            # quantize/dequantize shapes can't be VMA-inferred across the
+            # gather — disable the check like the PRODUCT/ADASUM paths
+            @functools.partial(shard_map, mesh=mesh,
+                               in_specs=P(axis_name), out_specs=P(),
+                               check_vma=False)
+            def body(shard):
+                return preduce_quantized(shard[0], axis_name, quantizer, op)
             return body(x)
     elif kind == "allgather":
         def fn(x):
@@ -166,12 +218,55 @@ def _axis_n(mesh: Mesh, axis_name: str) -> int:
 
 
 def device_allreduce(x: jax.Array, mesh: Mesh, axis_name: str = "dp",
-                     op: ReduceOp = ReduceOp.SUM) -> jax.Array:
+                     op: ReduceOp = ReduceOp.SUM,
+                     compression=None) -> jax.Array:
     """Reduce over mesh-axis shards. ``x`` has leading dim == axis size; shard
-    ``i`` is ``x[i]``; returns the reduction with that dim removed."""
+    ``i`` is ``x[i]``; returns the reduction with that dim removed.
+
+    ``compression`` (a :class:`horovod_tpu.compression.Quantizer`)
+    selects the quantized path: reduce_scatter (exact) → quantize →
+    all_gather → dequantize (:func:`preduce_quantized`), moving ~4x
+    fewer gather bytes for the int8 codec. Requires the per-shard
+    leading dim divisible by the axis size; Sum/Average only. Pre/wire
+    byte accounting lands on the compression metrics from the static
+    shapes here (host side — nothing recorded inside the jit)."""
     n = _axis_n(mesh, axis_name)
     assert x.shape[0] == n, (x.shape, n)
-    return _cached_collective("allreduce", mesh, axis_name, op, ())(x)
+    if compression is None:
+        return _cached_collective("allreduce", mesh, axis_name, op, ())(x)
+    from horovod_tpu.compression.metrics import record_compression
+    from horovod_tpu.compression.quantizers import Quantizer
+    if not isinstance(compression, Quantizer):
+        raise TypeError(
+            "device_allreduce(compression=) takes a Quantizer (int8/fp8/"
+            f"onebit); for dtype casts ({compression!r}) cast the input — "
+            "the reduction runs natively in fp16/bf16")
+    if x.ndim < 2:
+        raise ValueError(
+            "device_allreduce(compression=) needs at least 1-D shards "
+            f"(got stacked shape {x.shape}: scalar per shard); the "
+            "scatter phase splits the shard's dim 0 — use the exact path")
+    out = _cached_collective("allreduce_q", mesh, axis_name, op,
+                             (compression,))(x)
+    # the gather phase moves the reduced tensor as n quantized slices
+    # (each shard contributes its scatter slice); static-shape accounting
+    slice_shape = (x.shape[1] // n,) + tuple(x.shape[2:])
+    record_compression(compression.name,
+                       int(x.size) // n * x.dtype.itemsize,
+                       _quantized_wire_bytes(compression, slice_shape,
+                                             jnp.dtype(x.dtype).name) * n)
+    return out
+
+
+@functools.lru_cache(maxsize=1024)
+def _quantized_wire_bytes(quantizer, shape: Tuple, dtype: str) -> int:
+    """Payload bytes ``quantizer`` puts on the wire for one ``shape``
+    tensor — an abstract trace, cached on exactly the keys that determine
+    it so the per-step hot path never re-traces the codec."""
+    q_shape = jax.eval_shape(lambda s: quantizer.quantize(s)[0],
+                             jax.ShapeDtypeStruct(shape, dtype))
+    return (q_shape.values.size * q_shape.values.dtype.itemsize
+            + q_shape.scales.size * q_shape.scales.dtype.itemsize)
 
 
 def device_allgather(x: jax.Array, mesh: Mesh, axis_name: str = "dp"

@@ -350,6 +350,11 @@ class ElasticDriver:
         # appends), so the rank alone is not a stable identity
         results: Dict[tuple, str] = {}
         lost_keys: set = set()
+        # keys whose exit was classified as the ORIGINATING failure (not
+        # a casualty of someone else's crash): only these charge their
+        # host's crash budget — a cascade must not blocklist every host
+        # whose healthy workers died from the collective error
+        originators: set = set()
         host_crashes: Dict[str, int] = {}
         # workers a capacity-loss shrink dropped from the world: their
         # exit (the not-in-new-world path) is EXPECTED, not a crash
@@ -407,6 +412,8 @@ class ElasticDriver:
                     and not expected
                 if not torn_down and not expected:
                     lost_keys.add(key)
+                    if not casualty:
+                        originators.add(key)
                     worker_lost.set()
             state = TERMINATED if (torn_down or casualty or expected) \
                 else FAILURE
@@ -448,13 +455,20 @@ class ElasticDriver:
                 with fail_lock:
                     worker_lost.clear()
                     lost_now = set(lost_keys)
+                    blamed = lost_now & originators
                     # this round handles exactly lost_now; clearing lets
                     # the NEXT crash classify as an originator again and
                     # keeps host_crashes from re-counting old losses
+                    # (originators pruned alongside: keys are
+                    # per-instance, a handled one can never recur)
                     lost_keys.clear()
+                    originators -= lost_now
                     survivors = [k for k in essential_keys
                                  if k not in lost_now]
-                for k in lost_now:
+                # only the originating FAILURE charges its host's crash
+                # budget; casualties are fallout, not evidence the host
+                # is bad (their replacement still respawns below)
+                for k in blamed:
                     h = slot_by_key[k].hostname
                     host_crashes[h] = host_crashes.get(h, 0) + 1
                 recovered = self._try_inplace_recovery(

@@ -42,17 +42,28 @@ def _by_value_pickler():
         return pickle
 
 
-def _split_frame(pdf, shuffle: bool, validation, seed: int):
+def _split_frame(pdf, shuffle: bool, validation, seed: int,
+                 min_one: bool = True):
     """THE split semantics, shared by both materialization paths:
     optional seeded shuffle, then either a float-fraction validation cut
-    (at least 1 row when validation > 0) or a boolean-column selection.
-    Returns ``(train_pdf, val_pdf_or_None)``."""
+    or a boolean-column selection. Returns ``(train_pdf,
+    val_pdf_or_None)``.
+
+    ``min_one`` floors a float-fraction cut at 1 row — right for the
+    local path (the WHOLE dataset must yield a validation split when one
+    was asked for), wrong per partition on the distributed path: a
+    per-partition floor over many small partitions inflates
+    ``validation=0.01`` far past 1% (each 20-row partition would donate
+    a row = 5%), so that path passes ``min_one=False`` and lets the
+    global fraction emerge from honest per-partition rounding."""
     if shuffle:
         pdf = pdf.sample(frac=1.0, random_state=seed)
     pdf = pdf.reset_index(drop=True)
     val_pdf = None
     if isinstance(validation, float) and validation > 0:
-        n_val = max(1, int(round(len(pdf) * validation)))
+        n_val = int(round(len(pdf) * validation))
+        if min_one:
+            n_val = max(1, n_val)
         val_pdf, pdf = pdf.iloc[:n_val], pdf.iloc[n_val:]
     elif isinstance(validation, str):
         mask = pdf[validation].astype(bool)
@@ -207,7 +218,8 @@ class HorovodEstimator(Params):
             if not rows:
                 return iter([(idx, 0, 0)])
             pdf, val_pdf = _split_frame(pd.DataFrame(rows), shuffle,
-                                        validation, seed=idx)
+                                        validation, seed=idx,
+                                        min_one=False)
             if len(pdf):
                 store.write(
                     store.join(train_path, f"part-{idx:05d}.parquet"),
@@ -225,6 +237,16 @@ class HorovodEstimator(Params):
         n_val = sum(m[2] for m in meta)
         if n_train == 0:
             raise ValueError("DataFrame produced no training rows")
+        if n_val == 0 and isinstance(validation, float) and validation > 0:
+            # honest per-partition rounding (no 1-row floor) can land on
+            # zero when every partition is tiny relative to the fraction;
+            # don't silently train without the requested validation set
+            from horovod_tpu.common.logging import get_logger
+            get_logger().warning(
+                "validation=%s yielded 0 rows across %d partitions "
+                "(partitions too small for the fraction); training "
+                "proceeds WITHOUT a validation set — repartition the "
+                "DataFrame or raise the fraction", validation, len(meta))
         return val_path if n_val else ""
 
     # -- fit -----------------------------------------------------------------

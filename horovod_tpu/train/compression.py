@@ -1,84 +1,21 @@
-"""Gradient compression for transport (reference:
-``horovod/torch/compression.py:20-75`` — ``Compression.none`` / ``fp16``
-compress/decompress pairs around allreduce).
+"""Back-compat shim: the compression subsystem moved to
+:mod:`horovod_tpu.compression` (quantizers, error feedback, Pallas
+kernels, wire paths — see docs/PERF.md "Gradient compression").
 
-On TPU, bf16 is the native 16-bit format (MXU-friendly, same exponent range
-as fp32), so ``Compression.bf16`` is the recommended choice; ``fp16`` is kept
-for parity with the reference.
+This module keeps the original import surface
+(``horovod_tpu.train.compression.Compression`` et al., mirroring the
+reference's ``horovod/torch/compression.py``) alive for existing
+callers; new code should import from ``horovod_tpu.compression``.
 """
 
 from __future__ import annotations
 
-from typing import Tuple
-
-import jax.numpy as jnp
-import numpy as np
-
-
-def _astype(tensor, dtype):
-    if isinstance(tensor, np.ndarray):
-        return tensor.astype(dtype)
-    return tensor.astype(dtype)
-
-
-class Compressor:
-    """Interface (reference: ``Compressor`` base, ``compression.py:20-33``)."""
-
-    @staticmethod
-    def compress(tensor) -> Tuple:
-        raise NotImplementedError
-
-    @staticmethod
-    def decompress(tensor, ctx):
-        raise NotImplementedError
-
-
-class NoneCompressor(Compressor):
-    @staticmethod
-    def compress(tensor):
-        return tensor, None
-
-    @staticmethod
-    def decompress(tensor, ctx):
-        return tensor
-
-
-class FP16Compressor(Compressor):
-    """Compress float32/float64 to float16 for transport
-    (reference: ``compression.py:42-62``)."""
-
-    @staticmethod
-    def compress(tensor):
-        dtype = tensor.dtype
-        if jnp.issubdtype(dtype, jnp.floating) and dtype != jnp.float16:
-            return _astype(tensor, jnp.float16), dtype
-        return tensor, None
-
-    @staticmethod
-    def decompress(tensor, ctx):
-        return tensor if ctx is None else _astype(tensor, ctx)
-
-
-class BF16Compressor(Compressor):
-    """TPU-native 16-bit compression (no reference analog; bf16 keeps fp32's
-    exponent range so gradient overflow handling is unnecessary)."""
-
-    @staticmethod
-    def compress(tensor):
-        dtype = tensor.dtype
-        if jnp.issubdtype(dtype, jnp.floating) and dtype != jnp.bfloat16:
-            return _astype(tensor, jnp.bfloat16), dtype
-        return tensor, None
-
-    @staticmethod
-    def decompress(tensor, ctx):
-        return tensor if ctx is None else _astype(tensor, ctx)
-
-
-class Compression:
-    """Namespace matching the reference's public API
-    (``hvd.Compression.none`` / ``.fp16``; ``compression.py:65-75``)."""
-
-    none = NoneCompressor
-    fp16 = FP16Compressor
-    bf16 = BF16Compressor
+from horovod_tpu.compression import (  # noqa: F401
+    BF16Compressor,
+    Compression,
+    Compressor,
+    ErrorFeedback,
+    FP16Compressor,
+    NoneCompressor,
+)
+from horovod_tpu.compression.base import _astype  # noqa: F401

@@ -26,6 +26,8 @@ TORCH_WORKER = os.path.join(os.path.dirname(__file__), "torch_worker.py")
 TF_WORKER = os.path.join(os.path.dirname(__file__), "tf_worker.py")
 CACHE_WORKER = os.path.join(os.path.dirname(__file__), "cache_worker.py")
 METRICS_WORKER = os.path.join(os.path.dirname(__file__), "metrics_worker.py")
+QUANTIZED_WORKER = os.path.join(os.path.dirname(__file__),
+                                "quantized_worker.py")
 
 
 def _free_port():
@@ -125,6 +127,21 @@ def test_hvd_full_stack(size):
     """Public hvd API over the core with jax-cpu arrays."""
     # generous timeout: N jax processes compiling on this 1-core box
     _launch(size, timeout=480, worker=HVD_WORKER)
+
+
+@needs_core
+# size 3 (odd-world ragged blocks) is slow-marked: the tier-1 budget is
+# tight and the protocol is size-agnostic; ci/run.py's parallel tier
+# still runs it (no marker filter there)
+@pytest.mark.parametrize("size", [2, pytest.param(3,
+                                                  marks=pytest.mark.slow)])
+def test_quantized_eager_allreduce(size):
+    """int8-quantized eager allreduce over the TCP core: payloads move as
+    int8 codes + fp32 scales (allgather-of-codes, local reduce), numerics
+    match the per-rank qdq expectation, the EF-wrapped optimizer syncs in
+    the eager regime, and the metrics registry reports > 3.5x compression
+    for the int8 path (ISSUE 2 acceptance)."""
+    _launch(size, timeout=480, worker=QUANTIZED_WORKER)
 
 
 @needs_core
