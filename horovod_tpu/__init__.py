@@ -131,6 +131,12 @@ from horovod_tpu.ops.collectives import (  # noqa: F401
     quantized_grouped_allreduce_async,
 )
 from horovod_tpu.train.sync_batch_norm import SyncBatchNorm  # noqa: F401
+# Durable sharded checkpointing (native subsystem; Checkpointer is the
+# same class via the train.checkpoint back-compat shim, orbax optional)
+from horovod_tpu.checkpoint import (  # noqa: F401
+    CheckpointError,
+    ShardedCheckpointer,
+)
 from horovod_tpu.train.checkpoint import Checkpointer  # noqa: F401
 from horovod_tpu.train import callbacks  # noqa: F401
 

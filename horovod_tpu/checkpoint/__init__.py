@@ -1,0 +1,29 @@
+"""TPU-native durable sharded checkpointing.
+
+The elastic design's missing leg (ROADMAP robustness): the reference's
+``State.commit()`` snapshots to host memory and our port's per-host
+pickle dies with the host that wrote it.  This subsystem gives
+``commit()`` a durable, dependency-free backend:
+
+* :class:`ShardedCheckpointer` — async two-phase-commit store: each
+  rank writes only its shards (npz + sha256 marker), rank 0 writes the
+  manifest and atomically renames ``step_N.tmp`` → ``step_N``; restore
+  reassembles global arrays and re-slices them onto the *current*
+  mesh/world size (elastic resharding).
+* :mod:`~horovod_tpu.checkpoint.format` — the on-disk contract (spec
+  version, manifests, shard markers, GC helpers).
+* :mod:`~horovod_tpu.checkpoint.metrics` — save/restore bytes and
+  duration histograms + inflight gauge on the process-wide ``/metrics``
+  registry.
+
+Integration points: ``elastic.ObjectState`` commits through this store
+when ``HVD_TPU_ELASTIC_DURABLE`` is on (docs/ELASTIC.md),
+``train.callbacks.CheckpointCallback`` wires it into training loops,
+and ``train.checkpoint`` is a back-compat shim whose orbax path is now
+optional.
+"""
+
+from horovod_tpu.checkpoint.format import CheckpointError  # noqa: F401
+from horovod_tpu.checkpoint.store import ShardedCheckpointer  # noqa: F401
+
+__all__ = ["CheckpointError", "ShardedCheckpointer"]

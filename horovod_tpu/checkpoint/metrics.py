@@ -1,0 +1,77 @@
+"""Checkpoint observability on the process-wide metrics registry.
+
+Everything lands in :mod:`horovod_tpu.metrics.registry`'s default
+registry, so the per-worker ``/metrics`` exporter and
+``hvd.metrics_snapshot()`` pick it up with no extra wiring
+(docs/OBSERVABILITY.md "Checkpoint metrics"):
+
+* ``hvd_checkpoint_save_bytes_total`` — payload bytes THIS rank
+  serialized (its shards only, not the global state),
+* ``hvd_checkpoint_restore_bytes_total`` — bytes read reassembling
+  global arrays at restore,
+* ``hvd_checkpoint_save_seconds`` / ``hvd_checkpoint_restore_seconds``
+  — histograms; save time is the background write (serialize + fsync +
+  rank-0 commit wait), NOT the inline device→host snapshot,
+* ``hvd_checkpoint_inflight`` — async saves queued or being written,
+* ``hvd_checkpoint_last_step`` — last step this rank committed or
+  restored (gauge, merged as ``max``),
+* ``hvd_checkpoint_failures_total`` — saves/commits that errored.
+
+Instruments register lazily on first use so workers that never
+checkpoint export nothing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from horovod_tpu.metrics.registry import default_registry
+
+_INSTRUMENTS: Optional[Tuple] = None
+
+
+def _instruments():
+    global _INSTRUMENTS
+    if _INSTRUMENTS is None:
+        reg = default_registry()
+        _INSTRUMENTS = (
+            reg.counter("hvd_checkpoint_save_bytes_total",
+                        help="checkpoint shard bytes written by this rank"),
+            reg.counter("hvd_checkpoint_restore_bytes_total",
+                        help="checkpoint bytes read at restore"),
+            reg.histogram("hvd_checkpoint_save_seconds",
+                          help="background shard write + commit wall time"),
+            reg.histogram("hvd_checkpoint_restore_seconds",
+                          help="restore wall time (read + reassemble)"),
+            reg.gauge("hvd_checkpoint_inflight",
+                      help="async checkpoint saves not yet on disk",
+                      agg="max"),
+            reg.gauge("hvd_checkpoint_last_step",
+                      help="last checkpoint step committed or restored",
+                      agg="max"),
+            reg.counter("hvd_checkpoint_failures_total",
+                        help="checkpoint saves that failed to commit"),
+        )
+    return _INSTRUMENTS
+
+
+def record_save(nbytes: int, seconds: float, step: int) -> None:
+    save_b, _, save_s, _, _, last, _ = _instruments()
+    save_b.inc(nbytes)
+    save_s.observe(seconds)
+    last.set(step)
+
+
+def record_restore(nbytes: int, seconds: float, step: int) -> None:
+    _, rest_b, _, rest_s, _, last, _ = _instruments()
+    rest_b.inc(nbytes)
+    rest_s.observe(seconds)
+    last.set(step)
+
+
+def record_failure() -> None:
+    _instruments()[6].inc()
+
+
+def set_inflight(n: int) -> None:
+    _instruments()[4].set(n)

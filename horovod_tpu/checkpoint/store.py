@@ -1,0 +1,621 @@
+"""The native sharded checkpoint store.
+
+``ShardedCheckpointer`` is the durable backend the elastic design needs
+(reference: ``State.commit()`` semantics in ``common/elastic.py``; here
+commits survive host loss, which the reference's host-memory snapshots
+and our per-host pickle cannot).  Design (docs/ELASTIC.md "Durable
+commits"):
+
+* **Sharded** — each rank writes only its shards: slices of globally
+  replicated arrays are partitioned by rank along axis 0 (so W ranks
+  each write ~1/W of the bytes), and multi-controller ``jax.Array``\\ s
+  that are NOT fully addressable contribute exactly the shards this
+  process owns (``addressable_shards`` with ``replica_id == 0``).
+* **Two-phase commit** — shards + per-file sha256 markers first, then a
+  rank-0 manifest and an atomic ``step_N.tmp`` → ``step_N`` rename
+  (:mod:`horovod_tpu.checkpoint.format`).  The commit barrier is the
+  filesystem itself (rank 0 waits for all W markers), so no collective
+  is needed and a kill -9 anywhere leaves the previous checkpoint
+  intact.
+* **Async** — the device→host snapshot (the consistent cut) is inline;
+  serialization/fsync/commit run on a background writer with an
+  inflight cap (:mod:`horovod_tpu.checkpoint.writer`).
+* **Elastic resharding restore** — restore reassembles global arrays
+  from the manifest's shard map and re-slices them onto the CURRENT
+  mesh via ``like`` shardings; the manifest's world size need not match
+  the current one, which is exactly what ``hvd.elastic`` re-meshing
+  needs.
+
+Replication contract: leaves that are not multi-controller
+``jax.Array``\\ s must hold the same value on every rank when ``save``
+is called (true for anything that went through ``State.sync()`` /
+allreduce-averaged training state) — rank r's axis-0 slice stands in
+for everyone's.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import io
+import json
+import os
+import pickle
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu.checkpoint import format as fmt
+from horovod_tpu.checkpoint import metrics as ckpt_metrics
+from horovod_tpu.checkpoint.format import CheckpointError
+from horovod_tpu.checkpoint.writer import AsyncWriter
+from horovod_tpu.common.config import env_float, env_int
+from horovod_tpu.common.logging import get_logger
+
+_INLINE_KINDS = ("bool", "int", "float", "str")
+
+
+def _default_rank() -> int:
+    try:
+        from horovod_tpu.common.basics import is_initialized, rank
+        if is_initialized():
+            return rank()
+    except Exception:
+        pass
+    return int(os.environ.get("HOROVOD_RANK",
+                              os.environ.get("HVD_TPU_RANK", "0")))
+
+
+def _default_world() -> int:
+    try:
+        from horovod_tpu.common.basics import is_initialized, size
+        if is_initialized():
+            return size()
+    except Exception:
+        pass
+    return int(os.environ.get("HOROVOD_SIZE",
+                              os.environ.get("HVD_TPU_SIZE", "1")))
+
+
+def _path_parts(path) -> List[dict]:
+    """JSON-safe serialization of a key path (used by the ``like``-less
+    restore fallback to rebuild nesting)."""
+    import jax.tree_util as jtu
+    out: List[dict] = []
+    for e in path:
+        if isinstance(e, jtu.DictKey):
+            key = e.key
+            if not isinstance(key, (str, int, float, bool)):
+                key = repr(key)
+            out.append({"k": key})
+        elif isinstance(e, jtu.SequenceKey):
+            out.append({"i": int(e.idx)})
+        elif isinstance(e, jtu.GetAttrKey):
+            out.append({"a": e.name})
+        else:  # FlattenedIndexKey and friends
+            out.append({"i": int(getattr(e, "key", 0))})
+    return out
+
+
+def _full_index(shape: Tuple[int, ...]) -> List[List[int]]:
+    return [[0, int(d)] for d in shape]
+
+
+def _is_multicontroller(value: Any) -> bool:
+    import jax
+    return isinstance(value, jax.Array) and \
+        not getattr(value, "is_fully_addressable", True)
+
+
+class _Plan:
+    """One rank's share of one save: manifest leaf records (rank 0 uses
+    them), the npz payload, and the per-entry index map for the shard
+    marker."""
+
+    def __init__(self) -> None:
+        self.leaves: List[dict] = []
+        self.arrays: Dict[str, np.ndarray] = {}
+        self.entries: List[dict] = []
+        self.nbytes = 0
+        self.treedef: Optional[str] = None
+
+    def add_entry(self, leaf_idx: int, index: List[List[int]],
+                  data: np.ndarray) -> None:
+        key = f"L{leaf_idx}S{len(self.entries)}"
+        self.arrays[key] = data
+        self.entries.append({"key": key, "leaf": leaf_idx, "index": index})
+        self.nbytes += int(data.nbytes)
+
+
+class ShardedCheckpointer:
+    """Durable (step → pytree) checkpoint store; drop-in for the old
+    orbax wrapper's surface (``save``/``restore``/``restore_latest``/
+    ``latest_step``/``close``) with async saves by default.
+
+    Usage::
+
+        ckpt = ShardedCheckpointer("/ckpt/run1")
+        ckpt.save(step, {"params": params, "opt_state": opt_state})
+        ...
+        state = ckpt.restore_latest(like=state)   # onto the CURRENT mesh
+    """
+
+    def __init__(self, directory: str,
+                 max_to_keep: Optional[int] = None,
+                 max_inflight: Optional[int] = None,
+                 commit_timeout_s: Optional[float] = None,
+                 rank: Optional[int] = None,
+                 world_size: Optional[int] = None,
+                 verify: bool = True) -> None:
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._max_to_keep = env_int("CHECKPOINT_MAX_TO_KEEP", 3) \
+            if max_to_keep is None else int(max_to_keep)
+        self._commit_timeout = env_float("CHECKPOINT_COMMIT_TIMEOUT_S", 120.0) \
+            if commit_timeout_s is None else float(commit_timeout_s)
+        self._rank = _default_rank() if rank is None else int(rank)
+        self._world = _default_world() if world_size is None else \
+            max(1, int(world_size))
+        self._verify = verify
+        self._lock = threading.Lock()
+        self._inflight_steps: set = set()
+        inflight = env_int("CHECKPOINT_INFLIGHT", 2) \
+            if max_inflight is None else int(max_inflight)
+        self._writer = AsyncWriter(max_inflight=inflight,
+                                   on_inflight=ckpt_metrics.set_inflight)
+
+    @property
+    def directory(self) -> str:
+        return self._dir
+
+    # ------------------------------------------------------------- save
+
+    def save(self, step: int, state: Any, wait: bool = False) -> None:
+        """Snapshot ``state`` device→host NOW (the consistent cut) and
+        write it in the background; ``wait=True`` blocks until this
+        rank's shard is durable — and, on rank 0, until the checkpoint
+        is committed."""
+        step = int(step)
+        with self._lock:
+            if step in self._inflight_steps:
+                raise CheckpointError(f"step {step} is already being saved")
+            if os.path.isdir(fmt.step_dir(self._dir, step)):
+                raise CheckpointError(
+                    f"step {step} already committed under {self._dir}")
+            self._inflight_steps.add(step)
+        tmp = fmt.tmp_dir(self._dir, step)
+        if self._rank == 0 and os.path.isdir(tmp):
+            # a tmp dir for this step means a crashed earlier attempt:
+            # its shard markers must NOT satisfy the commit barrier (they
+            # describe another generation's state).  Clearing the slate
+            # here, before phase 1 starts, means the worst race — a fast
+            # fresh peer already wrote here — costs a LOUD commit
+            # timeout this round, never a silently mixed checkpoint.
+            fmt.remove_tree(tmp)
+        try:
+            plan = self._snapshot(state)
+        except BaseException:
+            with self._lock:
+                self._inflight_steps.discard(step)
+            raise
+        import uuid
+        job = self._make_job(step, plan, uuid.uuid4().hex)
+        try:
+            self._writer.submit(job)
+        except BaseException:
+            with self._lock:
+                self._inflight_steps.discard(step)
+            raise
+        if wait:
+            self.wait()
+
+    def wait(self) -> None:
+        """Drain queued saves; re-raises the first background failure."""
+        self._writer.wait()
+
+    def check_error(self) -> None:
+        """Re-raise (and clear) a pending background-save failure
+        without waiting for in-flight saves."""
+        self._writer.check()
+
+    # orbax-API parity for callers of the old wrapper
+    wait_until_finished = wait
+
+    def close(self, wait: bool = True) -> None:
+        """``wait=False`` abandons queued saves (in-flight commits are
+        nonce-protected; their tmp dirs fall to GC) — for callers that
+        must not stall behind a commit waiting on a dead peer."""
+        self._writer.close(wait=wait)
+
+    def _snapshot(self, state: Any) -> _Plan:
+        import jax
+        import jax.tree_util as jtu
+        flat, treedef = jtu.tree_flatten_with_path(state)
+        plan = _Plan()
+        for li, (path, value) in enumerate(flat):
+            rec = {"path": jtu.keystr(path), "parts": _path_parts(path)}
+            # arrays/np scalars FIRST: np.float64 subclasses python
+            # float, and the inline branch would strip its dtype
+            if _is_multicontroller(value):
+                self._plan_global_array(plan, li, rec, value)
+            elif isinstance(value, (jax.Array, np.ndarray, np.generic)):
+                self._plan_replicated_array(plan, li, rec, value)
+            elif isinstance(value, bool):
+                rec.update(kind="bool", value=value)
+            elif isinstance(value, int):
+                rec.update(kind="int", value=value)
+            elif isinstance(value, float):
+                rec.update(kind="float", value=value)
+            elif isinstance(value, str):
+                rec.update(kind="str", value=value)
+            else:
+                self._plan_pickle(plan, li, rec, value)
+            plan.leaves.append(rec)
+        try:
+            rec_td = base64.b64encode(pickle.dumps(treedef)).decode("ascii")
+        except Exception:
+            rec_td = None  # like=/parts fallback still restores
+        plan.treedef = rec_td
+        return plan
+
+    def _plan_global_array(self, plan: _Plan, li: int, rec: dict,
+                           value: Any) -> None:
+        """Multi-controller ``jax.Array``: this process contributes
+        exactly the shards it owns."""
+        shape = tuple(int(d) for d in value.shape)
+        dtype_name = None
+        for shard in value.addressable_shards:
+            if shard.replica_id != 0:
+                continue  # one owner per shard across the replica group
+            # copy=True: the cut must own its bytes — a zero-copy view
+            # of a device buffer is unsafe once the caller donates it
+            host = np.array(shard.data, copy=True)
+            store_arr, dtype_name = fmt.storage_view(host)
+            plan.add_entry(li, fmt.normalize_index(shard.index, shape),
+                           store_arr)
+        if dtype_name is None:  # no owned shards; still record the leaf
+            _, dtype_name = fmt.storage_view(
+                np.empty((), fmt.np_dtype(str(value.dtype))))
+        rec.update(kind="array", shape=list(shape), dtype=dtype_name,
+                   scalar=False)
+
+    def _plan_replicated_array(self, plan: _Plan, li: int, rec: dict,
+                               value: Any) -> None:
+        """Replicated array: rank r owns the r-th contiguous axis-0
+        slice (rank 0 owns small/0-d arrays whole).  The slice is taken
+        BEFORE the host copy, so each rank moves ~1/W of the bytes
+        device→host and the writer queue pins only the slice — and
+        copy=True throughout: np.ndarray leaves may be mutated by the
+        caller before the background write lands, and a zero-copy view
+        of a jax CPU buffer is unsafe once the caller donates it."""
+        scalar = isinstance(value, np.generic)
+        shape = tuple(int(d) for d in np.shape(value))
+        dt = value.dtype if hasattr(value, "dtype") else \
+            np.asarray(value).dtype
+        _, dtype_name = fmt.storage_view(np.empty((), dt))
+        rec.update(kind="array", shape=list(shape), dtype=dtype_name,
+                   scalar=scalar)
+        if self._world == 1 or len(shape) == 0 or shape[0] == 0:
+            if self._rank == 0:
+                store_arr, _ = fmt.storage_view(np.array(value, copy=True))
+                plan.add_entry(li, _full_index(shape), store_arr)
+            return
+        start, stop = fmt.shard_bounds(shape[0], self._world)[self._rank]
+        if stop > start:
+            store_arr, _ = fmt.storage_view(
+                np.array(value[start:stop], copy=True))
+            index = [[start, stop]] + _full_index(shape[1:])
+            plan.add_entry(li, index, store_arr)
+
+    def _plan_pickle(self, plan: _Plan, li: int, rec: dict,
+                     value: Any) -> None:
+        payload = pickle.dumps(value)
+        rec.update(kind="pickle", shape=[len(payload)], dtype="uint8",
+                   scalar=False)
+        if self._rank == 0:
+            plan.add_entry(li, [[0, len(payload)]],
+                           np.frombuffer(payload, np.uint8))
+
+    def _make_job(self, step: int, plan: _Plan, nonce: str):
+        def job() -> None:
+            t0 = time.monotonic()
+            tmp = fmt.tmp_dir(self._dir, step)
+            try:
+                if self._rank == 0:
+                    fmt.open_attempt(tmp, nonce)
+                else:
+                    nonce_seen = self._await_attempt(step, tmp)
+                fmt.write_shard(tmp, self._rank, plan.arrays, plan.entries,
+                                attempt=nonce if self._rank == 0
+                                else nonce_seen)
+                if self._rank == 0:
+                    self._commit(step, plan, tmp, nonce)
+            except BaseException:
+                ckpt_metrics.record_failure()
+                raise
+            finally:
+                with self._lock:
+                    self._inflight_steps.discard(step)
+            ckpt_metrics.record_save(plan.nbytes, time.monotonic() - t0,
+                                     step)
+            if self._rank == 0:
+                try:
+                    self.gc()
+                except Exception:
+                    pass  # GC is advisory; never fail a commit over it
+
+        return job
+
+    def _await_attempt(self, step: int, tmp: str) -> str:
+        """Non-zero ranks write only into an attempt rank 0 has opened —
+        the nonce handshake is what makes a crashed generation's
+        leftovers inert."""
+        deadline = time.monotonic() + self._commit_timeout
+        while True:
+            nonce = fmt.read_attempt(tmp)
+            if nonce is not None:
+                return nonce
+            if time.monotonic() >= deadline:
+                raise CheckpointError(
+                    f"rank {self._rank}: no attempt token from rank 0 "
+                    f"for step {step} after {self._commit_timeout:.0f}s")
+            time.sleep(0.05)
+
+    def _commit(self, step: int, plan: _Plan, tmp: str,
+                nonce: str) -> None:
+        """Rank 0's phase 2: wait for every rank's shard marker FROM
+        THIS ATTEMPT, then manifest + atomic rename.  On timeout the
+        tmp dir is LEFT IN PLACE — a peer may still be writing; GC
+        reclaims it once idle."""
+        deadline = time.monotonic() + self._commit_timeout
+        metas: Dict[int, dict] = {}
+        while True:
+            for r in range(self._world):
+                if r not in metas:
+                    meta = fmt.read_shard_meta(tmp, r)
+                    if meta is not None and meta.get("attempt") == nonce:
+                        metas[r] = meta
+            if len(metas) == self._world:
+                break
+            if time.monotonic() >= deadline:
+                missing = sorted(set(range(self._world)) - set(metas))
+                raise CheckpointError(
+                    f"commit of step {step} timed out after "
+                    f"{self._commit_timeout:.0f}s waiting for shard "
+                    f"markers from ranks {missing}; leaving {tmp} for GC")
+            time.sleep(0.05)
+        leaves = []
+        for rec in plan.leaves:
+            rec = dict(rec)
+            if rec["kind"] not in _INLINE_KINDS:
+                rec["shards"] = []
+            leaves.append(rec)
+        files = {}
+        for r, meta in sorted(metas.items()):
+            files[fmt.shard_npz(r)] = meta["sha256"]
+            for e in meta["entries"]:
+                leaves[e["leaf"]]["shards"].append(
+                    {"rank": r, "key": e["key"], "index": e["index"]})
+        manifest = {"version": fmt.SPEC_VERSION, "step": step,
+                    "world_size": self._world, "created": time.time(),
+                    "treedef": plan.treedef, "files": files,
+                    "leaves": leaves}
+        fmt.commit(self._dir, step, manifest)
+
+    # ---------------------------------------------------------- restore
+
+    def latest_step(self) -> Optional[int]:
+        steps = fmt.list_steps(self._dir)
+        return steps[-1] if steps else None
+
+    def all_steps(self) -> List[int]:
+        return fmt.list_steps(self._dir)
+
+    def restore_latest(self, like: Any = None) -> Optional[Any]:
+        step = self.latest_step()
+        if step is None:
+            self._warn_if_foreign_layout()
+            return None
+        return self.restore(step, like)
+
+    def _warn_if_foreign_layout(self) -> None:
+        """Nothing restorable, but the directory isn't empty: most
+        likely checkpoints from the old orbax default (plain numeric
+        step dirs).  Restarting from scratch silently would throw away
+        a run's progress — say so once."""
+        try:
+            foreign = [n for n in os.listdir(self._dir)
+                       if n.isdigit() and
+                       os.path.isdir(os.path.join(self._dir, n))]
+        except OSError:
+            return
+        if foreign:
+            get_logger().warning(
+                "checkpoint dir %s holds no native checkpoints but has "
+                "step dirs %s in another layout (orbax?): the native "
+                "store cannot read them — restore with "
+                "horovod_tpu.train.checkpoint.OrbaxCheckpointer and "
+                "re-save, or point the store at a fresh directory",
+                self._dir, sorted(foreign)[:4])
+
+    def restore(self, step: int, like: Any = None) -> Any:
+        """Reassemble global state from the manifest's shard map.  With
+        ``like`` (a pytree of arrays or ``ShapeDtypeStruct`` with
+        shardings), each array is placed onto the current mesh — the
+        elastic resharding path; the checkpoint's world size is
+        irrelevant here.  Without ``like``, host (numpy) state in the
+        saved structure is returned."""
+        t0 = time.monotonic()
+        step = int(step)
+        manifest = fmt.read_manifest(self._dir, step)
+        sdir = fmt.step_dir(self._dir, step)
+        cache: Dict[int, Any] = {}
+        nbytes = [0]
+
+        def rank_payload(r: int):
+            if r not in cache:
+                name = fmt.shard_npz(r)
+                path = os.path.join(sdir, name)
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                except OSError as e:
+                    raise CheckpointError(
+                        f"missing shard file {path} for committed step "
+                        f"{step}") from e
+                if self._verify:
+                    expect = manifest.get("files", {}).get(name)
+                    got = hashlib.sha256(data).hexdigest()
+                    if expect is not None and got != expect:
+                        raise CheckpointError(
+                            f"sha256 mismatch for {path}: manifest "
+                            f"{expect[:12]}…, file {got[:12]}…")
+                nbytes[0] += len(data)
+                cache[r] = np.load(io.BytesIO(data), allow_pickle=False)
+            return cache[r]
+
+        values = [self._restore_leaf(rec, rank_payload, step)
+                  for rec in manifest["leaves"]]
+        out = self._rebuild(manifest, values, like, step)
+        ckpt_metrics.record_restore(nbytes[0], time.monotonic() - t0, step)
+        return out
+
+    def _restore_leaf(self, rec: dict, rank_payload, step: int) -> Any:
+        kind = rec["kind"]
+        if kind in _INLINE_KINDS:
+            return {"bool": bool, "int": int, "float": float,
+                    "str": str}[kind](rec["value"])
+        shards = rec.get("shards", [])
+        if kind == "pickle":
+            if len(shards) != 1:
+                raise CheckpointError(
+                    f"step {step}: pickled leaf {rec['path']!r} has "
+                    f"{len(shards)} shards, expected 1")
+            s = shards[0]
+            raw = np.asarray(rank_payload(s["rank"])[s["key"]])
+            return pickle.loads(raw.tobytes())
+        if kind != "array":
+            raise CheckpointError(
+                f"step {step}: unknown leaf kind {kind!r} for "
+                f"{rec['path']!r}")
+        shape = tuple(int(d) for d in rec["shape"])
+        dtype = fmt.np_dtype(rec["dtype"])
+        out = np.empty(shape, dtype)
+        covered = 0
+        for s in shards:
+            data = fmt.logical_view(
+                np.asarray(rank_payload(s["rank"])[s["key"]]), rec["dtype"])
+            if shape == ():
+                out = data.reshape(())
+                covered = 1
+                continue
+            sl = fmt.index_slices(s["index"])
+            out[sl] = data
+            covered += int(np.prod([e - b for b, e in s["index"]]))
+        expect = 1 if shape == () else int(np.prod(shape))
+        if covered < expect:
+            raise CheckpointError(
+                f"step {step}: leaf {rec['path']!r} is missing shards "
+                f"({covered}/{expect} elements present)")
+        return out[()] if rec.get("scalar") else out
+
+    def _rebuild(self, manifest: dict, values: List[Any], like: Any,
+                 step: int) -> Any:
+        import jax.tree_util as jtu
+        if like is not None:
+            flat, treedef = jtu.tree_flatten_with_path(like)
+            # match by the serialized parts (stable across jax versions),
+            # not keystr's display format
+            by_path = {json.dumps(rec["parts"]): v
+                       for rec, v in zip(manifest["leaves"], values)}
+            out_leaves = []
+            for path, lk in flat:
+                key = json.dumps(_path_parts(path))
+                if key not in by_path:
+                    stored = [r["path"] for r in manifest["leaves"][:8]]
+                    raise CheckpointError(
+                        f"step {step} has no value for {jtu.keystr(path)} "
+                        f"(checkpoint holds: {stored}…)")
+                out_leaves.append(_place(by_path[key], lk))
+            return jtu.tree_unflatten(treedef, out_leaves)
+        td64 = manifest.get("treedef")
+        if td64:
+            try:
+                treedef = pickle.loads(base64.b64decode(td64))
+                if treedef.num_leaves == len(values):
+                    return jtu.tree_unflatten(treedef, values)
+            except Exception:
+                pass  # structure drift: fall back to recorded paths
+        records = [(rec["parts"], v)
+                   for rec, v in zip(manifest["leaves"], values)]
+        return _rebuild_from_parts(records)
+
+    # --------------------------------------------------------------- gc
+
+    def gc(self, tmp_ttl: Optional[float] = None) -> None:
+        """Reclaim old committed steps beyond ``max_to_keep`` and
+        abandoned tmp dirs.  A tmp dir is abandoned when its step is
+        already committed, or when nothing inside it has been touched
+        for ``tmp_ttl`` seconds (default: the commit timeout) — an
+        actively-writing peer keeps bumping mtimes, a kill -9 victim
+        does not."""
+        ttl = self._commit_timeout if tmp_ttl is None else float(tmp_ttl)
+        steps = fmt.list_steps(self._dir)
+        if self._max_to_keep > 0 and len(steps) > self._max_to_keep:
+            for s in steps[:-self._max_to_keep]:
+                fmt.remove_tree(fmt.step_dir(self._dir, s))
+        now = time.time()
+        with self._lock:
+            inflight = set(self._inflight_steps)
+        stale = list(fmt.list_tmp_steps(self._dir)) + \
+            list(fmt.list_broken_steps(self._dir))
+        for step, path in stale:
+            if step in inflight:
+                continue
+            committed = os.path.isfile(os.path.join(
+                fmt.step_dir(self._dir, step), fmt.MANIFEST))
+            if committed or now - fmt.newest_mtime(path) >= ttl:
+                fmt.remove_tree(path)
+                get_logger().info("checkpoint gc: removed abandoned %s",
+                                  path)
+
+
+def _place(value: Any, like_leaf: Any) -> Any:
+    """Put a restored host array where ``like``'s leaf says it lives:
+    ``sharding``-carrying leaves go onto the current mesh (only the
+    addressable pieces materialize on device), plain ``jax.Array`` /
+    ``ShapeDtypeStruct`` leaves go to the default device, anything else
+    stays host-side."""
+    if not isinstance(value, np.ndarray):
+        return value
+    import jax
+    sharding = getattr(like_leaf, "sharding", None)
+    if sharding is not None:
+        return jax.make_array_from_callback(value.shape, sharding,
+                                            lambda idx: value[idx])
+    if isinstance(like_leaf, (jax.Array, jax.ShapeDtypeStruct)):
+        return jax.device_put(value)
+    return value
+
+
+def _rebuild_from_parts(records: List[Tuple[List[dict], Any]]) -> Any:
+    """``like``-less, treedef-less fallback: rebuild nesting from the
+    recorded key paths.  Dicts/attrs become dicts, sequences become
+    lists (tuple-ness is only preserved by the treedef path)."""
+    if len(records) == 1 and not records[0][0]:
+        return records[0][1]
+    groups: Dict[Any, List[Tuple[List[dict], Any]]] = {}
+    seq = True
+    for parts, v in records:
+        head, rest = parts[0], parts[1:]
+        if "i" not in head:
+            seq = False
+        key = head.get("k", head.get("a", head.get("i")))
+        groups.setdefault(key, []).append((rest, v))
+    children = {k: _rebuild_from_parts(g) for k, g in groups.items()}
+    if seq and all(isinstance(k, int) for k in children):
+        size = max(children) + 1
+        return [children.get(i) for i in range(size)]
+    return children

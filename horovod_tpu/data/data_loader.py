@@ -187,10 +187,38 @@ class ShardedDataset(BaseDataLoader):
         self._shuffle = shuffle
         self._seed = seed
         self._epoch = 0
+        self._cursor = 0  # items this worker yielded in the current epoch
+        self._resume_skip = 0
 
     def set_epoch(self, epoch: int) -> None:
-        """Reshuffle per epoch (reference: ``ElasticSampler.set_epoch``)."""
+        """Reshuffle per epoch (reference: ``ElasticSampler.set_epoch``).
+        Re-announcing the CURRENT epoch keeps the restored cursor — the
+        standard resume loop (``load_state_dict`` then ``set_epoch``
+        inside the epoch loop) must not replay committed items."""
+        if epoch != self._epoch:
+            self._cursor = 0
+            self._resume_skip = 0
         self._epoch = epoch
+
+    def state_dict(self) -> dict:
+        """Checkpointable data position: ``{"epoch", "cursor"}`` —
+        ``cursor`` counts the items THIS worker has yielded in the
+        current epoch, so data position rides the same commit as model
+        state (reference analog: ``ElasticSampler.state_dict``).  With a
+        prefetching wrapper the cursor counts items handed to the
+        prefetcher, which can run a few batches ahead of the consumer —
+        commit ordering, not a correctness issue."""
+        return {"epoch": self._epoch, "cursor": self._cursor}
+
+    def load_state_dict(self, state: dict) -> None:
+        """Resume mid-epoch: the next iteration replays the epoch's
+        deterministic order and skips the first ``cursor`` items.  The
+        cursor is per-worker: after an elastic world-size change start
+        from the next epoch boundary instead (the shard stride changed,
+        so mid-epoch positions don't map)."""
+        self._epoch = int(state["epoch"])
+        self._cursor = int(state.get("cursor", 0))
+        self._resume_skip = self._cursor
 
     def __len__(self) -> int:
         return len(self._data) // self._size
@@ -202,5 +230,13 @@ class ShardedDataset(BaseDataLoader):
             rng = np.random.RandomState(self._seed + self._epoch)
             rng.shuffle(idx)
         n = len(self) * self._size  # drop remainder so all workers agree
-        for i in idx[self._rank:n:self._size]:
+        skip, self._resume_skip = self._resume_skip, 0
+        self._cursor = skip
+        for pos, i in enumerate(idx[self._rank:n:self._size]):
+            if pos < skip:
+                continue
+            self._cursor = pos + 1
             yield self._data[int(i)]
+        # a completed epoch resets the position (an abandoned iterator —
+        # e.g. a mid-epoch checkpoint + crash — keeps its cursor)
+        self._cursor = 0

@@ -247,19 +247,91 @@ def _ckpt_path(name: str) -> Optional[str]:
     return os.path.join(base, f"hvd_state_{name}.pkl")
 
 
+def _durable_base() -> str:
+    """Directory for DURABLE (sharded, shared-filesystem) commits:
+    ``CHECKPOINT_DIR`` knob first, the driver's ``HVD_ELASTIC_CKPT``
+    otherwise."""
+    from horovod_tpu.common.config import env_str
+    return env_str("CHECKPOINT_DIR") or os.environ.get(
+        "HVD_ELASTIC_CKPT") or ""
+
+
 class ObjectState(State):
     """Arbitrary-attribute state with pickle persistence + rank-0 broadcast
-    sync (reference: ``ObjectState``, ``common/elastic.py:99-148``)."""
+    sync (reference: ``ObjectState``, ``common/elastic.py:99-148``).
 
-    def __init__(self, name: str = "default", **kwargs: Any) -> None:
+    Persistence is two-tier: the per-host pickle is the fast local path
+    (rank 0 only — it dies with the host that wrote it), and when
+    durable commits are on (``durable=True`` or the
+    ``HVD_TPU_ELASTIC_DURABLE`` knob) every commit ALSO lands in the
+    native sharded store (:mod:`horovod_tpu.checkpoint`) under the
+    shared checkpoint directory — each rank writes its shard, so the
+    state survives the loss of any host and restores at a different
+    world size (docs/ELASTIC.md "Durable commits")."""
+
+    def __init__(self, name: str = "default",
+                 durable: Optional[bool] = None, **kwargs: Any) -> None:
         super().__init__()
         self._name = name
         self._saved: Dict[str, Any] = {}
+        self._durable_opt = durable
+        self._durable_store = None
+        self._durable_key = None
+        self._durable_step: Optional[int] = None
+        self._warned_no_durable_dir = False
         for k, v in kwargs.items():
             setattr(self, k, v)
         self._attrs = list(kwargs)
         if not self._maybe_load():
             self._snapshot()
+
+    def _durable(self):
+        """The sharded store for durable commits, rebuilt whenever the
+        world (rank/size) changes under us — an elastic re-mesh means a
+        new shard partition.  ``None`` when durable commits are off."""
+        from horovod_tpu.common.config import env_bool
+        enabled = env_bool("ELASTIC_DURABLE", False) \
+            if self._durable_opt is None else self._durable_opt
+        if not enabled:
+            return None
+        base = _durable_base()
+        if not base:
+            if self._durable_opt:
+                raise RuntimeError(
+                    "durable elastic commits need a checkpoint directory: "
+                    "set CHECKPOINT_DIR / HVD_TPU_CHECKPOINT_DIR (or run "
+                    "under the elastic driver, which exports "
+                    "HVD_ELASTIC_CKPT)")
+            if not self._warned_no_durable_dir:
+                # the env knob promised durability — failing silent would
+                # be discovered only at the next host loss
+                self._warned_no_durable_dir = True
+                from horovod_tpu.common.logging import get_logger
+                get_logger().warning(
+                    "ELASTIC_DURABLE is set but no checkpoint directory "
+                    "is configured (CHECKPOINT_DIR / HVD_ELASTIC_CKPT): "
+                    "commits of state %r are NOT durable", self._name)
+            return None
+        key = (base, rank(), size())
+        if self._durable_store is not None and self._durable_key != key:
+            try:
+                # wait=False: a world change usually means a peer DIED —
+                # the old store's in-flight commit may be waiting out the
+                # full commit timeout on that peer's marker, and recovery
+                # must not stall behind it (the abandoned tmp dir is
+                # nonce-protected and GC'd later)
+                self._durable_store.close(wait=False)
+            except Exception:
+                pass
+            self._durable_store = None
+        if self._durable_store is None:
+            from horovod_tpu.checkpoint import ShardedCheckpointer
+            self._durable_store = ShardedCheckpointer(
+                os.path.join(base, f"hvd_state_{self._name}.sharded"),
+                rank=key[1], world_size=key[2])
+            self._durable_key = key
+            self._durable_step = None
+        return self._durable_store
 
     def _public(self) -> Dict[str, Any]:
         return {k: getattr(self, k) for k in self._attrs}
@@ -268,13 +340,25 @@ class ObjectState(State):
         self._saved = {k: _copy_leaf(v) for k, v in self._public().items()}
 
     def _maybe_load(self) -> bool:
+        data = None
         path = _ckpt_path(self._name)
-        if path is None or not os.path.exists(path):
-            return False
-        try:
-            with open(path, "rb") as f:
-                data = pickle.load(f)
-        except Exception:
+        if path is not None and os.path.exists(path):
+            try:
+                with open(path, "rb") as f:
+                    data = pickle.load(f)
+            except Exception:
+                data = None
+        if data is None:
+            # pickle gone or torn (e.g. the host that wrote it is the
+            # one that died): fall back to the durable sharded store
+            store = self._durable()
+            if store is not None:
+                try:
+                    data = store.restore_latest()
+                    self._durable_step = store.latest_step()
+                except Exception:
+                    data = None
+        if not isinstance(data, dict):
             return False
         for k, v in data.items():
             setattr(self, k, v)
@@ -291,6 +375,45 @@ class ObjectState(State):
             with open(tmp, "wb") as f:
                 pickle.dump(self._saved, f)
             os.replace(tmp, path)
+        store = self._durable()
+        if store is not None:
+            try:
+                # drain a pending async failure NOW, attributed to the
+                # save that caused it — submit() would re-raise it under
+                # THIS commit's step number and silently drop this one
+                store.check_error()
+            except Exception:
+                from horovod_tpu.common.logging import get_logger
+                get_logger().warning(
+                    "an earlier durable commit of state %r failed in the "
+                    "background", self._name, exc_info=True)
+                try:
+                    self._durable_step = max(self._durable_step or 0,
+                                             store.latest_step() or 0)
+                except Exception:
+                    pass
+            if self._durable_step is None:
+                self._durable_step = store.latest_step() or 0
+            self._durable_step += 1
+            try:
+                # async sharded commit: every rank writes its shard; the
+                # train loop doesn't block on disk
+                store.save(self._durable_step, self._saved)
+            except Exception:
+                # pickle (or host memory) still holds the commit; a
+                # flaky shared filesystem must not kill training
+                from horovod_tpu.common.logging import get_logger
+                get_logger().warning(
+                    "durable commit of state %r step %s failed",
+                    self._name, self._durable_step, exc_info=True)
+                # self-heal a desynced counter (e.g. this rank raced a
+                # commit it read as not-yet-landed): next save targets
+                # past everything already on disk
+                try:
+                    self._durable_step = max(self._durable_step,
+                                             store.latest_step() or 0)
+                except Exception:
+                    pass
 
     def restore(self) -> None:
         for k, v in self._saved.items():
@@ -300,10 +423,22 @@ class ObjectState(State):
     def sync(self) -> None:
         if size() > 1:
             from horovod_tpu.train.optimizer import broadcast_object
-            data = broadcast_object(self._public(), root_rank=0,
-                                    name=f"elastic.{self._name}")
-            for k, v in data.items():
+            # the durable step counter rides the same broadcast: every
+            # rank must target the SAME next step or rank 0's commit
+            # barrier waits on shards that never come (a fresh worker
+            # reading latest_step() can lag an in-flight commit)
+            step = self._durable_step
+            if step is None:
+                store = self._durable()
+                if store is not None and rank() == 0:
+                    step = store.latest_step() or 0
+            data = broadcast_object(
+                {"state": self._public(), "durable_step": step},
+                root_rank=0, name=f"elastic.{self._name}")
+            for k, v in data["state"].items():
                 setattr(self, k, v)
+            if data.get("durable_step") is not None:
+                self._durable_step = int(data["durable_step"])
         self._snapshot()
 
 

@@ -238,6 +238,90 @@ class TelemetryCallback:
         return logs
 
 
+class CheckpointCallback:
+    """Durable periodic checkpointing through the native sharded store
+    (:class:`horovod_tpu.checkpoint.ShardedCheckpointer`; docs/ELASTIC.md
+    "Durable commits").  Every rank must run the callback — each writes
+    only its shard of the state.
+
+    Hooks follow this module's convention::
+
+        ckpt = CheckpointCallback("/ckpt/run1", every_n_steps=200)
+        state = ckpt.on_train_begin(state)      # resume if possible
+        for step in range(ckpt.next_step, total_steps):
+            state = train_step(state, batch)
+            ckpt.on_step_end(step, state)       # async save every N
+        ckpt.on_train_end(step, state)          # final synchronous save
+
+    Saves are asynchronous (device→host snapshot inline, disk on the
+    store's writer thread); save/restore bytes + durations land on
+    ``/metrics``.  ``directory`` defaults to the ``CHECKPOINT_DIR`` env
+    knob (docs/KNOBS.md).
+    """
+
+    def __init__(self, directory: Optional[str] = None,
+                 every_n_steps: int = 100,
+                 max_to_keep: Optional[int] = None,
+                 max_inflight: Optional[int] = None,
+                 store=None) -> None:
+        if store is None:
+            from horovod_tpu.checkpoint import ShardedCheckpointer
+            from horovod_tpu.common.config import env_str
+            directory = directory or env_str("CHECKPOINT_DIR")
+            if not directory:
+                raise ValueError(
+                    "CheckpointCallback needs a directory (argument or "
+                    "the CHECKPOINT_DIR / HVD_TPU_CHECKPOINT_DIR env "
+                    "knob)")
+            store = ShardedCheckpointer(directory, max_to_keep=max_to_keep,
+                                        max_inflight=max_inflight)
+        self.store = store
+        self.every_n_steps = int(every_n_steps)
+        self.restored_step: Optional[int] = None
+        self._last_saved = -1
+
+    @property
+    def next_step(self) -> int:
+        """First step the loop should run: 0 on a fresh start,
+        ``restored_step + 1`` after a restore (restored_step can BE 0 —
+        don't use ``restored_step or -1``, 0 is falsy)."""
+        return 0 if self.restored_step is None else self.restored_step + 1
+
+    def on_train_begin(self, state):
+        """Restore the latest checkpoint onto the CURRENT mesh (``state``
+        is the ``like=`` template) or return ``state`` untouched."""
+        out = self.store.restore_latest(like=state)
+        if out is None:
+            return state
+        self.restored_step = self.store.latest_step()
+        self._last_saved = self.restored_step
+        return out
+
+    def on_step_end(self, step: int, state) -> None:
+        if self.every_n_steps > 0 and step > self._last_saved \
+                and step % self.every_n_steps == 0:
+            self.store.save(step, state)
+            self._last_saved = step
+
+    def on_epoch_end(self, logs: Dict[str, Any]) -> Dict[str, Any]:
+        """Pass-through so the callback rides the same list as
+        :class:`MetricAverageCallback`."""
+        return logs
+
+    def on_train_end(self, step: Optional[int] = None,
+                     state: Any = None) -> None:
+        """Final synchronous save (when ``step``/``state`` are given and
+        newer than the last save), then drain the writer."""
+        if state is not None and step is not None \
+                and step > self._last_saved:
+            self.store.save(step, state)
+            self._last_saved = step
+        self.store.wait()
+
+    def close(self) -> None:
+        self.store.close()
+
+
 class LearningRateWarmupCallback:
     """Linear LR warmup from ``initial_lr/size`` to ``initial_lr * size``
     over warmup epochs (reference: ``LearningRateWarmupCallbackImpl:118-192``

@@ -1,11 +1,17 @@
-"""Checkpoint/resume for JAX training state.
+"""Back-compat shim: checkpointing moved to :mod:`horovod_tpu.checkpoint`.
 
-The reference has NO core checkpoint subsystem (SURVEY.md §5: elastic
-``State`` objects commit to host memory; Spark estimators write framework
-files through the Store). Here checkpointing is first-class and TPU-native:
-orbax writes sharded arrays directly from device memory (each host saves
-its shards — no gather), and restore places shards onto the current mesh,
-which is exactly what elastic re-meshing needs.
+``Checkpointer`` is now the NATIVE sharded store
+(:class:`horovod_tpu.checkpoint.ShardedCheckpointer`) — dependency-free,
+async two-phase commit, elastic resharding restore (docs/ELASTIC.md
+"Durable commits").  It keeps the old wrapper's surface
+(``save``/``latest_step``/``restore``/``restore_latest``/``close`` and
+the ``like=`` re-meshing contract), so existing callers keep working
+with no orbax installed.
+
+The orbax path survives as :class:`OrbaxCheckpointer` for users who
+need orbax's format (e.g. to interoperate with flax/orbax tooling); its
+import is optional — precedent: ``train/compression.py`` shimming the
+compression subsystem.
 """
 
 from __future__ import annotations
@@ -13,21 +19,32 @@ from __future__ import annotations
 import os
 from typing import Any, Optional
 
-import jax
+from horovod_tpu.checkpoint import CheckpointError  # noqa: F401
+from horovod_tpu.checkpoint import ShardedCheckpointer
+
+# The native store is the default checkpointer.
+Checkpointer = ShardedCheckpointer
 
 
-class Checkpointer:
+class OrbaxCheckpointer:
     """Thin orbax wrapper for (step → pytree) training state.
 
-    Usage::
-
-        ckpt = Checkpointer("/path/run1")
-        ckpt.save(step, {"params": params, "opt_state": opt_state})
-        state = ckpt.restore_latest(like={"params": params_shape, ...})
+    Optional: needs the ``orbax-checkpoint`` package.  The default
+    ``Checkpointer`` (:class:`horovod_tpu.checkpoint.ShardedCheckpointer`)
+    covers sharded save / cross-mesh restore without it.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3) -> None:
-        import orbax.checkpoint as ocp
+        try:
+            import orbax.checkpoint as ocp
+        except ImportError as e:
+            raise ImportError(
+                "orbax-checkpoint is not installed. The native sharded "
+                "store is the default and needs no extra dependency — "
+                "use horovod_tpu.Checkpointer "
+                "(horovod_tpu.checkpoint.ShardedCheckpointer); "
+                "OrbaxCheckpointer exists only for orbax-format "
+                "interoperability.") from e
         self._dir = os.path.abspath(directory)
         os.makedirs(self._dir, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
@@ -47,6 +64,7 @@ class Checkpointer:
     def restore(self, step: int, like: Any = None) -> Any:
         """Restore ``step``; ``like`` (a pytree of arrays or ShapeDtypeStruct
         with shardings) places shards onto the current mesh."""
+        import jax
         import orbax.checkpoint as ocp
         if like is not None:
             def abstractify(x):

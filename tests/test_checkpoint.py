@@ -1,13 +1,16 @@
 """Checkpoint/resume tests: save sharded training state, restore onto the
-same and onto a DIFFERENT mesh layout (the elastic re-meshing contract)."""
+same and onto a DIFFERENT mesh layout (the elastic re-meshing contract).
+The default ``Checkpointer`` is the native sharded store; the orbax
+wrapper survives as an optional back-compat path (gated test at the
+bottom)."""
 
 import numpy as np
 import jax
 import jax.numpy as jnp
-import optax
+import pytest
 
 from horovod_tpu.parallel import build_mesh
-from horovod_tpu.train.checkpoint import Checkpointer
+from horovod_tpu.train.checkpoint import Checkpointer, OrbaxCheckpointer
 
 
 def _state(mesh):
@@ -64,5 +67,21 @@ def test_max_to_keep(tmp_path):
     for step in range(4):
         ckpt.save(step, {"params": params}, wait=True)
     assert ckpt.latest_step() == 3
-    assert len(ckpt._mgr.all_steps()) <= 2
+    assert len(ckpt.all_steps()) <= 2
+    ckpt.close()
+
+
+def test_orbax_wrapper_roundtrip(tmp_path):
+    """The optional orbax path keeps working when orbax is installed
+    (without it, OrbaxCheckpointer raises an ImportError that names the
+    native store as the default)."""
+    pytest.importorskip("orbax.checkpoint")
+    mesh = build_mesh(dp=8)
+    params = _state(mesh)
+    ckpt = OrbaxCheckpointer(str(tmp_path / "run"))
+    ckpt.save(1, {"params": params}, wait=True)
+    assert ckpt.latest_step() == 1
+    out = ckpt.restore_latest(like={"params": params})
+    np.testing.assert_allclose(np.asarray(out["params"]["w"]),
+                               np.arange(64.0).reshape(8, 8))
     ckpt.close()

@@ -1,0 +1,527 @@
+"""Unit battery for the native sharded checkpoint subsystem
+(``horovod_tpu/checkpoint/``): format roundtrips across leaf kinds,
+two-phase-commit crash artifacts, GC, integrity checking, async error
+propagation, multi-rank save + different-world restore simulated
+in-process, the elastic durable-commit backend, CheckpointCallback, and
+ShardedDataset data-position checkpointing."""
+
+import collections
+import json
+import os
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from horovod_tpu.checkpoint import CheckpointError, ShardedCheckpointer
+from horovod_tpu.checkpoint import format as fmt
+from horovod_tpu.parallel import build_mesh
+
+
+def _store(path, **kw):
+    kw.setdefault("rank", 0)
+    kw.setdefault("world_size", 1)
+    return ShardedCheckpointer(str(path), **kw)
+
+
+def _rich_state():
+    return {
+        "params": {"w": jnp.arange(64.0).reshape(8, 8),
+                   "b": jnp.ones(8, jnp.bfloat16)},
+        "step": 7, "lr": 0.5, "name": "run1", "flag": True,
+        # np.float64 subclasses python float — must stay a np scalar
+        "hist": [1, 2, (3.5, np.float32(2.0)), np.float64(4.0)],
+        "blob": collections.deque([1, 2]),  # pickle-fallback leaf
+    }
+
+
+# ---------------------------------------------------------------- format
+
+
+def test_roundtrip_all_leaf_kinds(tmp_path):
+    """Python scalars stay python, np scalars stay np, tuples stay
+    tuples (treedef path), bf16 survives the uint-view storage, and
+    arbitrary picklable leaves ride along."""
+    ck = _store(tmp_path)
+    state = _rich_state()
+    ck.save(3, state, wait=True)
+    out = ck.restore_latest()
+    np.testing.assert_allclose(out["params"]["w"],
+                               np.arange(64.0).reshape(8, 8))
+    assert out["params"]["b"].dtype == jnp.bfloat16
+    assert out["step"] == 7 and type(out["step"]) is int
+    assert out["lr"] == 0.5 and out["flag"] is True
+    assert out["name"] == "run1"
+    assert isinstance(out["hist"][2], tuple)
+    assert type(out["hist"][2][1]) is np.float32
+    assert type(out["hist"][3]) is np.float64 and out["hist"][3] == 4.0
+    assert isinstance(out["blob"], collections.deque)
+    ck.close()
+
+
+def test_restore_with_like_places_on_mesh(tmp_path):
+    """``like`` shardings re-slice the global arrays onto the CURRENT
+    mesh — the elastic re-meshing contract."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    ck = _store(tmp_path)
+    state = _rich_state()
+    ck.save(0, state, wait=True)
+    mesh = build_mesh(dp=2, tp=4)
+    like = dict(state)
+    like["params"] = {
+        "w": jax.ShapeDtypeStruct((8, 8), jnp.float32,
+                                  sharding=NamedSharding(mesh,
+                                                         P("dp", "tp"))),
+        "b": jax.ShapeDtypeStruct((8,), jnp.bfloat16,
+                                  sharding=NamedSharding(mesh, P())),
+    }
+    out = ck.restore(0, like=like)
+    assert out["params"]["w"].sharding.spec == P("dp", "tp")
+    np.testing.assert_allclose(np.asarray(out["params"]["w"]),
+                               np.arange(64.0).reshape(8, 8))
+    ck.close()
+
+
+def test_restore_missing_leaf_raises(tmp_path):
+    ck = _store(tmp_path)
+    ck.save(0, {"a": jnp.ones(4)}, wait=True)
+    with pytest.raises(CheckpointError, match="has no value"):
+        ck.restore(0, like={"a": jnp.ones(4), "extra": jnp.ones(2)})
+    ck.close()
+
+
+def test_manifest_contract(tmp_path):
+    """The on-disk manifest carries what an external reader (or a future
+    spec version) needs: world size, per-file sha256, global
+    shapes/dtypes, shard→rank map."""
+    ck = _store(tmp_path)
+    ck.save(5, {"w": jnp.arange(16.0)}, wait=True)
+    man = fmt.read_manifest(str(tmp_path), 5)
+    assert man["version"] == fmt.SPEC_VERSION
+    assert man["world_size"] == 1 and man["step"] == 5
+    assert set(man["files"]) == {"shard_0.npz"}
+    sha = fmt.file_sha256(os.path.join(fmt.step_dir(str(tmp_path), 5),
+                                       "shard_0.npz"))
+    assert man["files"]["shard_0.npz"] == sha
+    (leaf,) = man["leaves"]
+    assert leaf["kind"] == "array" and leaf["shape"] == [16]
+    assert leaf["dtype"] == "float32"
+    assert leaf["shards"][0]["rank"] == 0
+    ck.close()
+
+
+# --------------------------------------------- multi-rank save / reshard
+
+
+def test_two_rank_save_restores_at_other_world_sizes(tmp_path):
+    """Both ranks of a world-2 save write only their axis-0 slices; the
+    committed checkpoint reassembles identically under stores configured
+    for world sizes 1 and 3 (restore reads the manifest's world, not the
+    current one)."""
+    state = {"w": np.arange(24.0).reshape(6, 4), "b": np.ones(5), "k": 3}
+    s1 = _store(tmp_path, rank=1, world_size=2, commit_timeout_s=30)
+    s1.save(10, state)  # queued: rank 1 waits for rank 0's attempt token
+    assert s1.latest_step() is None
+    s0 = _store(tmp_path, rank=0, world_size=2, commit_timeout_s=30)
+    s0.save(10, state, wait=True)  # opens the attempt, then commits
+    s1.wait()
+    assert s0.latest_step() == 10
+
+    # each rank really wrote a strict subset of the bytes
+    man = fmt.read_manifest(str(tmp_path), 10)
+    w_leaf = [rec for rec in man["leaves"] if rec["path"] == "['w']"][0]
+    by_rank = {s["rank"]: s["index"] for s in w_leaf["shards"]}
+    assert by_rank[0][0] == [0, 3] and by_rank[1][0] == [3, 6]
+
+    for world in (1, 3):
+        r = _store(tmp_path, rank=0, world_size=world)
+        out = r.restore_latest()
+        np.testing.assert_allclose(out["w"], state["w"])
+        np.testing.assert_allclose(out["b"], state["b"])
+        assert out["k"] == 3
+    s0.close()
+    s1.close()
+
+
+def test_commit_times_out_without_peer_marker(tmp_path):
+    """Rank 0 of a world-2 save whose peer never writes: the commit
+    times out with an error, the tmp dir stays (a peer might be slow,
+    not dead), and no checkpoint appears."""
+    s0 = _store(tmp_path, rank=0, world_size=2, commit_timeout_s=0.5)
+    with pytest.raises(CheckpointError, match="timed out"):
+        s0.save(4, {"w": np.ones(4)}, wait=True)
+    assert s0.latest_step() is None
+    assert fmt.list_tmp_steps(str(tmp_path)) != []
+    # once idle past the ttl, GC reclaims it
+    time.sleep(0.05)
+    s0.gc(tmp_ttl=0.01)
+    assert fmt.list_tmp_steps(str(tmp_path)) == []
+
+
+def test_stale_attempt_marker_cannot_satisfy_commit(tmp_path):
+    """A crashed generation's shard marker sitting in ``step_N.tmp``
+    must never satisfy a NEW attempt's commit barrier: rank 0 clears
+    the stale attempt, so alone it times out loudly instead of
+    committing a checkpoint that mixes two generations."""
+    stale = np.zeros(8)
+    fmt.write_shard(fmt.tmp_dir(str(tmp_path), 7), 1,
+                    {"L0S0": stale[4:]},
+                    [{"key": "L0S0", "leaf": 0, "index": [[4, 8]]}])
+    s0 = _store(tmp_path, rank=0, world_size=2, commit_timeout_s=0.5)
+    with pytest.raises(CheckpointError, match="timed out"):
+        s0.save(7, {"w": np.arange(8.0)}, wait=True)
+    assert s0.latest_step() is None
+    s0.close()
+
+
+def test_fresh_peer_marker_after_stale_cleanup_commits(tmp_path):
+    """Same wreckage, but the peer writes its FRESH shard after rank 0
+    cleared the stale attempt: the commit succeeds and restores the new
+    state, not the dead generation's."""
+    stale = np.zeros(8)
+    fmt.write_shard(fmt.tmp_dir(str(tmp_path), 7), 1,
+                    {"L0S0": stale[4:]},
+                    [{"key": "L0S0", "leaf": 0, "index": [[4, 8]]}])
+    fresh = {"w": np.arange(8.0)}
+    s0 = _store(tmp_path, rank=0, world_size=2, commit_timeout_s=20)
+    s1 = _store(tmp_path, rank=1, world_size=2)
+    s0.save(7, fresh)          # clears the stale tmp, commit pending
+    s1.save(7, fresh, wait=True)
+    s0.wait()
+    out = s0.restore(7)
+    np.testing.assert_array_equal(out["w"], np.arange(8.0))
+    s0.close()
+    s1.close()
+
+
+# ------------------------------------------------- crash artifacts + GC
+
+
+def test_crash_artifacts_ignored_and_gced(tmp_path):
+    """A leftover ``step_N.tmp`` and a manifest-less step dir are
+    invisible to ``latest_step``/``restore_latest`` and reclaimed by
+    GC; the committed checkpoint stays restorable."""
+    ck = _store(tmp_path)
+    ck.save(1, {"w": jnp.ones(4)}, wait=True)
+    # crash wreckage: a half-written tmp and a manifest-less dir
+    os.makedirs(str(tmp_path / "step_2.tmp"))
+    with open(str(tmp_path / "step_2.tmp" / "shard_0.npz"), "wb") as f:
+        f.write(b"partial")
+    os.makedirs(str(tmp_path / "step_3"))
+    with open(str(tmp_path / "step_3" / "shard_0.npz"), "wb") as f:
+        f.write(b"no manifest")
+
+    assert ck.latest_step() == 1
+    out = ck.restore_latest()
+    np.testing.assert_allclose(out["w"], np.ones(4))
+    time.sleep(0.05)
+    ck.gc(tmp_ttl=0.01)
+    assert not os.path.exists(str(tmp_path / "step_2.tmp"))
+    assert not os.path.exists(str(tmp_path / "step_3"))
+    assert os.path.isdir(str(tmp_path / "step_1"))
+    ck.close()
+
+
+def test_restore_latest_warns_on_foreign_layout(tmp_path, caplog):
+    """A directory full of old-default orbax checkpoints (plain numeric
+    step dirs) must not silently restart training from scratch."""
+    import logging
+    os.makedirs(str(tmp_path / "12"))
+    ck = _store(tmp_path)
+    with caplog.at_level(logging.WARNING):
+        assert ck.restore_latest() is None
+    assert any("another layout" in r.message for r in caplog.records)
+    ck.close()
+
+
+def test_gc_keeps_max_to_keep(tmp_path):
+    ck = _store(tmp_path, max_to_keep=2)
+    for step in range(5):
+        ck.save(step, {"w": jnp.ones(4)}, wait=True)
+    assert ck.all_steps() == [3, 4]
+    assert ck.latest_step() == 4
+    ck.close()
+
+
+def test_gc_never_touches_active_tmp(tmp_path):
+    """A tmp dir with recent writes is live (a slow peer), not
+    wreckage."""
+    ck = _store(tmp_path)
+    os.makedirs(str(tmp_path / "step_9.tmp"))
+    with open(str(tmp_path / "step_9.tmp" / "shard_1.npz"), "wb") as f:
+        f.write(b"still coming")
+    ck.gc(tmp_ttl=60.0)
+    assert os.path.isdir(str(tmp_path / "step_9.tmp"))
+    ck.close()
+
+
+# ---------------------------------------------------- integrity + errors
+
+
+def test_corrupt_shard_detected(tmp_path):
+    ck = _store(tmp_path)
+    ck.save(0, {"w": jnp.arange(32.0)}, wait=True)
+    npz = os.path.join(fmt.step_dir(str(tmp_path), 0), "shard_0.npz")
+    data = bytearray(open(npz, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    with open(npz, "wb") as f:
+        f.write(data)
+    with pytest.raises(CheckpointError, match="sha256 mismatch"):
+        ck.restore(0)
+    ck.close()
+
+
+def test_unknown_spec_version_refused(tmp_path):
+    ck = _store(tmp_path)
+    ck.save(0, {"w": jnp.ones(2)}, wait=True)
+    path = os.path.join(fmt.step_dir(str(tmp_path), 0), fmt.MANIFEST)
+    man = json.loads(open(path, "rb").read())
+    man["version"] = 999
+    with open(path, "w") as f:
+        json.dump(man, f)
+    with pytest.raises(CheckpointError, match="spec version"):
+        ck.restore(0)
+    ck.close()
+
+
+def test_async_error_surfaces_on_wait(tmp_path, monkeypatch):
+    """A background write failure is re-raised at the next wait/save,
+    never swallowed."""
+    ck = _store(tmp_path)
+    def boom(*a, **k):
+        raise OSError("disk gone")
+    monkeypatch.setattr(fmt, "write_shard", boom)
+    ck.save(0, {"w": jnp.ones(2)})
+    with pytest.raises(OSError, match="disk gone"):
+        ck.wait()
+    monkeypatch.undo()
+    ck.save(1, {"w": jnp.ones(2)}, wait=True)  # store still usable
+    assert ck.latest_step() == 1
+    ck.close()
+
+
+def test_double_save_same_step_rejected(tmp_path):
+    ck = _store(tmp_path)
+    ck.save(0, {"w": jnp.ones(2)}, wait=True)
+    with pytest.raises(CheckpointError, match="already committed"):
+        ck.save(0, {"w": jnp.ones(2)})
+    ck.close()
+
+
+def test_checkpoint_metrics_recorded(tmp_path):
+    from horovod_tpu.metrics.registry import default_registry
+    ck = _store(tmp_path)
+    ck.save(2, {"w": jnp.ones(128)}, wait=True)
+    ck.restore(2)
+    snap = default_registry().snapshot()
+    assert snap["hvd_checkpoint_save_bytes_total"]["value"] >= 128 * 4
+    assert snap["hvd_checkpoint_restore_bytes_total"]["value"] > 0
+    assert snap["hvd_checkpoint_save_seconds"]["count"] >= 1
+    assert snap["hvd_checkpoint_restore_seconds"]["count"] >= 1
+    assert snap["hvd_checkpoint_last_step"]["value"] >= 2
+    ck.close()
+
+
+# ------------------------------------------------ elastic durable commit
+
+
+def test_objectstate_durable_commit_survives_pickle_loss(tmp_path,
+                                                         monkeypatch):
+    """The per-host pickle evaporates with its host; the durable sharded
+    backend restores the last commit anyway (ISSUE 3 motivation)."""
+    import horovod_tpu.elastic as elastic
+    monkeypatch.setenv("HVD_ELASTIC_CKPT", str(tmp_path))
+    monkeypatch.setenv("HVD_TPU_ELASTIC_DURABLE", "1")
+    state = elastic.ObjectState(name="t", params={"w": np.arange(4.0)},
+                                epoch=0)
+    state.epoch = 3
+    state.params = {"w": np.arange(4.0) * 2}
+    state.commit()
+    state._durable().wait()  # drain the async writer before "crashing"
+    os.remove(str(tmp_path / "hvd_state_t.pkl"))  # the host died
+
+    fresh = elastic.ObjectState(name="t", params={"w": np.zeros(4)},
+                                epoch=0)
+    assert fresh.epoch == 3
+    np.testing.assert_allclose(fresh.params["w"], np.arange(4.0) * 2)
+
+
+def test_objectstate_durable_steps_resume_monotonic(tmp_path, monkeypatch):
+    """A restarted process keeps committing AFTER the stored steps —
+    no collision with the previous generation's checkpoints."""
+    import horovod_tpu.elastic as elastic
+    monkeypatch.setenv("HVD_ELASTIC_CKPT", str(tmp_path))
+    monkeypatch.setenv("HVD_TPU_ELASTIC_DURABLE", "1")
+    s1 = elastic.ObjectState(name="m", count=1)
+    s1.commit()
+    s1.commit()
+    s1._durable().wait()
+    assert s1._durable().latest_step() == 2
+
+    s2 = elastic.ObjectState(name="m", count=0)
+    assert s2.count == 1  # restored from the durable store
+    s2.commit()
+    s2._durable().wait()
+    assert s2._durable().latest_step() == 3
+
+
+def test_objectstate_durable_step_self_heals(tmp_path, monkeypatch):
+    """A desynced durable step counter (raced commit, NFS attr-cache
+    lag) collides with an existing step — the save warns and the
+    counter jumps past everything on disk instead of failing forever."""
+    import horovod_tpu.elastic as elastic
+    monkeypatch.setenv("HVD_ELASTIC_CKPT", str(tmp_path))
+    monkeypatch.setenv("HVD_TPU_ELASTIC_DURABLE", "1")
+    s = elastic.ObjectState(name="h", v=1)
+    s.commit()
+    s.commit()
+    s._durable().wait()
+    assert s._durable().latest_step() == 2
+    s._durable_step = 0  # simulate the desync
+    s.commit()           # targets step 1 (committed) → warns + heals
+    s._durable().wait()
+    s.commit()
+    s._durable().wait()
+    assert s._durable().latest_step() == 3
+
+
+def test_objectstate_durable_recovers_after_background_failure(
+        tmp_path, monkeypatch, caplog):
+    """One transient background IO failure costs ONE durable commit
+    (the failed one), not two: the next commit drains the pending
+    error, attributes it to the earlier save, and still lands."""
+    import logging
+    import time as _time
+    import horovod_tpu.elastic as elastic
+    monkeypatch.setenv("HVD_ELASTIC_CKPT", str(tmp_path))
+    monkeypatch.setenv("HVD_TPU_ELASTIC_DURABLE", "1")
+    s = elastic.ObjectState(name="flaky", v=1)
+    orig = fmt.write_shard
+    failed = []
+
+    def once(*a, **k):
+        if not failed:
+            failed.append(1)
+            raise OSError("transient")
+        return orig(*a, **k)
+
+    monkeypatch.setattr(fmt, "write_shard", once)
+    s.v = 2
+    s.commit()  # background write fails
+    _time.sleep(0.5)  # let the writer hit the error
+    s.v = 3
+    with caplog.at_level(logging.WARNING):
+        s.commit()  # drains the pending error, still commits
+    s._durable().wait()
+    assert any("earlier durable commit" in r.message for r in caplog.records)
+    fresh = elastic.ObjectState(name="flaky", v=0)
+    os.remove(str(tmp_path / "hvd_state_flaky.pkl"))
+    fresh2 = elastic.ObjectState(name="flaky", v=0)
+    assert fresh.v == 3 and fresh2.v == 3
+
+
+def test_objectstate_durable_without_dir_warns(monkeypatch, caplog):
+    """The env knob promising durability with no directory configured
+    must say so, not silently downgrade to pickle-only."""
+    import logging
+    import horovod_tpu.elastic as elastic
+    monkeypatch.delenv("HVD_ELASTIC_CKPT", raising=False)
+    monkeypatch.delenv("HVD_TPU_CHECKPOINT_DIR", raising=False)
+    monkeypatch.delenv("HOROVOD_CHECKPOINT_DIR", raising=False)
+    monkeypatch.setenv("HVD_TPU_ELASTIC_DURABLE", "1")
+    state = elastic.ObjectState(name="nodirs", v=1)
+    with caplog.at_level(logging.WARNING):
+        assert state._durable() is None
+        state.commit()
+    assert any("NOT durable" in r.message for r in caplog.records)
+
+
+def test_objectstate_durable_off_by_default(tmp_path, monkeypatch):
+    import horovod_tpu.elastic as elastic
+    monkeypatch.setenv("HVD_ELASTIC_CKPT", str(tmp_path))
+    monkeypatch.delenv("HVD_TPU_ELASTIC_DURABLE", raising=False)
+    monkeypatch.delenv("HOROVOD_ELASTIC_DURABLE", raising=False)
+    state = elastic.ObjectState(name="off", v=1)
+    state.commit()
+    assert state._durable() is None
+    assert not os.path.isdir(str(tmp_path / "hvd_state_off.sharded"))
+
+
+# --------------------------------------------------- CheckpointCallback
+
+
+def test_checkpoint_callback_roundtrip(tmp_path):
+    from horovod_tpu.train.callbacks import CheckpointCallback
+    cb = CheckpointCallback(str(tmp_path / "cb"), every_n_steps=2)
+    state = {"w": jnp.zeros(4), "step": 0}
+    state = cb.on_train_begin(state)  # nothing to restore
+    assert cb.restored_step is None
+    for step in range(5):
+        state = {"w": state["w"] + 1, "step": step}
+        cb.on_step_end(step, state)
+    cb.on_train_end(4, state)
+    assert cb.store.latest_step() == 4
+    cb.close()
+
+    cb2 = CheckpointCallback(str(tmp_path / "cb"), every_n_steps=2)
+    out = cb2.on_train_begin({"w": jnp.zeros(4), "step": 0})
+    assert cb2.restored_step == 4
+    np.testing.assert_allclose(np.asarray(out["w"]), np.full(4, 5.0))
+    assert int(out["step"]) == 4
+    # the next periodic step (6) saves; already-stored steps don't re-save
+    cb2.on_step_end(6, {"w": out["w"], "step": 6})
+    cb2.store.wait()
+    assert cb2.store.latest_step() == 6
+    cb2.close()
+
+
+def test_checkpoint_callback_needs_directory(monkeypatch):
+    from horovod_tpu.train.callbacks import CheckpointCallback
+    monkeypatch.delenv("HVD_TPU_CHECKPOINT_DIR", raising=False)
+    monkeypatch.delenv("HOROVOD_CHECKPOINT_DIR", raising=False)
+    with pytest.raises(ValueError, match="CHECKPOINT_DIR"):
+        CheckpointCallback()
+
+
+# ------------------------------------- ShardedDataset data position
+
+
+def test_sharded_dataset_state_dict_resume():
+    from horovod_tpu.data import ShardedDataset
+    data = list(range(32))
+    ds = ShardedDataset(data, rank=1, size=4, shuffle=True, seed=7)
+    ds.set_epoch(2)
+    full = list(ds)
+    assert len(full) == 8
+
+    ds2 = ShardedDataset(data, rank=1, size=4, shuffle=True, seed=7)
+    ds2.set_epoch(2)
+    it = iter(ds2)
+    consumed = [next(it) for _ in range(3)]
+    sd = ds2.state_dict()
+    assert sd == {"epoch": 2, "cursor": 3}
+
+    ds3 = ShardedDataset(data, rank=1, size=4, shuffle=True, seed=7)
+    ds3.load_state_dict(sd)
+    rest = list(ds3)
+    assert consumed + rest == full
+
+    # the STANDARD resume loop re-announces the current epoch before
+    # iterating — that must keep the restored cursor, not replay
+    ds4 = ShardedDataset(data, rank=1, size=4, shuffle=True, seed=7)
+    ds4.load_state_dict(sd)
+    ds4.set_epoch(sd["epoch"])
+    assert list(ds4) == rest
+    # a NEW epoch does reset the position
+    ds4.set_epoch(sd["epoch"] + 1)
+    assert len(list(ds4)) == 8
+
+
+def test_sharded_dataset_cursor_resets_after_full_epoch():
+    from horovod_tpu.data import ShardedDataset
+    ds = ShardedDataset(list(range(16)), rank=0, size=2, shuffle=False)
+    first = list(ds)
+    assert ds.state_dict() == {"epoch": 0, "cursor": 0}
+    assert list(ds) == first  # a second full pass is identical
