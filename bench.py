@@ -169,6 +169,7 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
         ex = {k: (v(value) if callable(v) else v) for k, v in extra.items()}
         doc = {
             "metric": metric,
+            "trace_dir": os.environ.get("HVD_BENCH_TRACE_DIR") or None,
             "value": round(value, 2),
             "unit": unit,
             "vs_baseline": round(value / vs_baseline_per_unit, 3)
@@ -228,11 +229,20 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
              "exiting cleanly")
         sys.exit(0)
 
+    # --trace-dir / HVD_BENCH_TRACE_DIR: per-rank timeline shard over
+    # the measured phase, merged into the artifact dir afterwards so a
+    # perf regression ships with its trace (docs/OBSERVABILITY.md)
+    tracer = _start_measure_trace()
     t0 = _begin_phase("measure")
-    for _ in range(iters):
+    for i in range(iters):
+        if tracer is not None:
+            tracer.collective_begin("measure_step", "step", f"step#{i+1}")
         state, loss = step_fn(state)
+        if tracer is not None:
+            tracer.collective_end("measure_step", f"step#{i+1}")
     readback(loss)  # forces completion of the whole chain
     dt = _end_phase("measure", t0)
+    _finish_measure_trace(tracer)
     _log(f"timing window {dt:.2f}s for {iters} iters")
 
     per_chip = per_step_units * iters / dt / n_chips
@@ -263,6 +273,42 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
 # wall-clock start of model/data setup, stamped by _child() after device
 # init; consumed (into the "setup" phase) by _measure_and_report
 _T_SETUP0 = None
+
+
+def _start_measure_trace():
+    """HVD_BENCH_TRACE_DIR (--trace-dir): open this rank's timeline
+    shard for the measured phase. Returns the Timeline or None."""
+    trace_dir = os.environ.get("HVD_BENCH_TRACE_DIR")
+    if not trace_dir:
+        return None
+    try:
+        from horovod_tpu.common.timeline import Timeline, shard_path
+        os.makedirs(trace_dir, exist_ok=True)
+        rank = int(os.environ.get(
+            "HVD_TPU_RANK", os.environ.get("HOROVOD_RANK", "0")))
+        tl = Timeline(rank)
+        tl.start_shard(shard_path(trace_dir + os.sep, rank))
+        _log(f"measure-phase trace shard: {trace_dir} (rank {rank})")
+        return tl
+    except Exception as e:  # tracing must never fail the measurement
+        _log(f"trace-dir setup failed ({e!r}); continuing untraced")
+        return None
+
+
+def _finish_measure_trace(tracer) -> None:
+    """Close the shard and merge every shard in the trace dir into
+    ``merged_trace.json`` (multi-rank runs on a shared FS fold into one
+    Perfetto trace; single-rank still yields a loadable artifact)."""
+    if tracer is None:
+        return
+    try:
+        tracer.stop()
+        from horovod_tpu.diagnostics.merge import merge_directory
+        out = merge_directory(os.environ["HVD_BENCH_TRACE_DIR"])
+        if out:
+            _log(f"merged measure-phase trace: {out}")
+    except Exception as e:
+        _log(f"trace merge failed ({e!r})")
 
 
 class _Run:
@@ -937,6 +983,16 @@ if __name__ == "__main__":
                   "onebit|fp16|bf16|none)", file=sys.stderr)
             sys.exit(2)
         os.environ["HVD_BENCH_COMPRESSION"] = sys.argv[i + 1]
+    # --trace-dir DIR: per-rank timeline shards during the measured
+    # phase, merged into DIR/merged_trace.json (env channel:
+    # HVD_BENCH_TRACE_DIR — inherited by the measurement child)
+    if "--trace-dir" in sys.argv:
+        i = sys.argv.index("--trace-dir")
+        if i + 1 >= len(sys.argv):
+            print("[bench] --trace-dir requires a directory",
+                  file=sys.stderr)
+            sys.exit(2)
+        os.environ["HVD_BENCH_TRACE_DIR"] = sys.argv[i + 1]
     if "--child" in sys.argv:
         _child()
     else:

@@ -285,7 +285,9 @@ const char* hvd_counters_json() {
      << ",\"bytes_allreduced\":" << c.bytes_allreduced.load()
      << ",\"bytes_allgathered\":" << c.bytes_allgathered.load()
      << ",\"hier_allreduces\":" << c.hier_allreduces.load()
-     << ",\"hier_allgathers\":" << c.hier_allgathers.load() << "}";
+     << ",\"hier_allgathers\":" << c.hier_allgathers.load()
+     << ",\"stall_warnings\":" << c.stall_warnings.load()
+     << ",\"stalled_tensors\":" << c.stalled_tensors.load() << "}";
   g_counters_json = os.str();
   return g_counters_json.c_str();
 }
@@ -297,6 +299,29 @@ static thread_local std::string g_stragglers_json;
 const char* hvd_stragglers_json() {
   g_stragglers_json = Core::Get().StragglersJson();
   return g_stragglers_json.c_str();
+}
+
+// Engine-state snapshot for hang autopsies: per-domain pending tensors
+// with ready/missing ranks, queue depth, join state (the stall
+// inspector's view, serialized — the reference only LOGS this). The
+// loop thread publishes it; this returns the latest copy, so it stays
+// readable from any thread even mid-hang.
+static thread_local std::string g_engine_state_json;
+const char* hvd_engine_state_json() {
+  g_engine_state_json = Core::Get().EngineStateJson();
+  return g_engine_state_json.c_str();
+}
+
+// Span plumbing for the diagnostics cross-rank trace: the Python eager
+// layer stamps its per-collective span id into the engine timeline as
+// an instant marker, correlating the host shard with the negotiation
+// trace without any wire traffic.
+int hvd_timeline_enabled() {
+  return Core::Get().TimelineEnabled() ? 1 : 0;
+}
+
+void hvd_timeline_mark(const char* name, const char* span) {
+  Core::Get().TimelineMark(name ? name : "", span ? span : "");
 }
 
 }  // extern "C"

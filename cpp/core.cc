@@ -148,21 +148,41 @@ void StallInspector::RemoveReady(const std::string& name) {
   pending_.erase(name);
 }
 
-std::string StallInspector::Check(double warn_seconds) {
+std::string StallInspector::Check(double warn_seconds, int* newly_warned,
+                                  int* currently_stalled) {
   auto now = std::chrono::steady_clock::now();
   std::ostringstream os;
+  int warned = 0, stalled = 0;
   for (auto& kv : pending_) {
     double waited =
         std::chrono::duration<double>(now - kv.second.first_seen).count();
-    if (waited > warn_seconds && !kv.second.warned) {
+    if (waited <= warn_seconds) continue;
+    stalled++;
+    if (!kv.second.warned) {
       kv.second.warned = true;
+      warned++;
       os << "tensor '" << kv.first << "' stalled " << (int)waited
          << "s; ready ranks: ";
       for (int r : kv.second.ready_ranks) os << r << ' ';
       os << '\n';
     }
   }
+  if (newly_warned) *newly_warned = warned;
+  if (currently_stalled) *currently_stalled = stalled;
   return os.str();
+}
+
+std::vector<StallInspector::PendingEntry> StallInspector::Pending() const {
+  auto now = std::chrono::steady_clock::now();
+  std::vector<PendingEntry> out;
+  out.reserve(pending_.size());
+  for (auto& kv : pending_) {
+    out.push_back(
+        {kv.first,
+         std::chrono::duration<double>(now - kv.second.first_seen).count(),
+         kv.second.ready_ranks});
+  }
+  return out;
 }
 
 std::vector<std::string> StallInspector::FatallyStalled(
@@ -303,6 +323,16 @@ std::shared_ptr<Core::HandleState> Core::GetHandle(int h) {
 }
 
 void Core::PushToDomain(int domain, TensorTableEntry e, Request r) {
+  // span bookkeeping FIRST, before any rejection path: the Python layer
+  // allocates its span id per eager call unconditionally (spans.py), so
+  // the engine must count every attempt too — a DUPLICATE_NAME
+  // rejection that only one side counted would desynchronize the two
+  // per-name counters for the rest of the run.  Internal names
+  // (__barrier__/__join__, _hvd.* plumbing like the clock-sync
+  // allgathers) never get Python-side spans and are excluded.
+  if (timeline_ && e.name.rfind("__", 0) != 0 &&
+      e.name.rfind("_hvd.", 0) != 0)
+    timeline_->NoteEnqueue(e.name);
   if (loop_done_.load()) {
     if (e.callback)
       e.callback(Status::Aborted("hvdcore background loop is not running"));
@@ -1142,6 +1172,7 @@ bool Core::RunOnce() {
   }
 
   bool got_shutdown_response = false;
+  int cycle_stalled = 0;  // tensors past the warn threshold this cycle
   for (int id : domain_ids) {
     CoordDomain* d;
     {
@@ -1337,8 +1368,14 @@ bool Core::RunOnce() {
         ApplyKnobFlags(pending_knob_flags_);
         has_pending_knobs_ = false;
       }
-      // stall check (reference: controller.cc:132-143)
-      auto warn = d->stall.Check(cfg_.stall_warning_secs);
+      // stall check (reference: controller.cc:132-143); counts feed the
+      // hvd_stall_warnings_total counter and stalled-tensor gauge on
+      // /metrics (docs/OBSERVABILITY.md)
+      int newly_warned = 0, stalled_now = 0;
+      auto warn = d->stall.Check(cfg_.stall_warning_secs, &newly_warned,
+                                 &stalled_now);
+      if (newly_warned > 0) counters_.stall_warnings += newly_warned;
+      cycle_stalled += stalled_now;
       if (!warn.empty()) {
         HVD_LOG(Warning) << "STALL:\n" << warn;
       }
@@ -1468,10 +1505,76 @@ bool Core::RunOnce() {
       has_pending_knobs_ = true;
     }
   }
+  counters_.stalled_tensors.store(cycle_stalled);
   // periodic rank-attributed negotiation-wait summary (coordinator only
   // accumulates attribution; HVD_TPU_STRAGGLER_REPORT_SECONDS)
   if (cfg_.rank == 0) MaybeReportStragglers();
+  PublishEngineState();
   return true;
+}
+
+// Serialize per-domain negotiation state into the published snapshot
+// (<=2 Hz; EngineStateJson readers get the latest copy). Runs on the
+// loop thread, the only mutator of domain internals.
+void Core::PublishEngineState() {
+  auto now = std::chrono::steady_clock::now();
+  if (std::chrono::duration<double>(now - last_state_pub_).count() < 0.5)
+    return;
+  last_state_pub_ = now;
+  std::ostringstream os;
+  os << "{\"rank\":" << cfg_.rank << ",\"size\":" << cfg_.size
+     << ",\"coordinator\":" << (cfg_.rank == 0 ? "true" : "false")
+     << ",\"domains\":[";
+  bool first_d = true;
+  {
+    std::lock_guard<std::mutex> lk(domains_mu_);
+    for (auto& kv : domains_) {
+      CoordDomain* d = kv.second.get();
+      if (!first_d) os << ",";
+      first_d = false;
+      os << "{\"id\":" << kv.first << ",\"active\":"
+         << (d->active ? "true" : "false")
+         << ",\"queue_pending\":" << d->queue.pending()
+         << ",\"joined_count\":" << d->join_count << ",\"pending\":[";
+      bool first_p = true;
+      for (auto& p : d->stall.Pending()) {
+        if (!first_p) os << ",";
+        first_p = false;
+        os << "{\"name\":\"" << JsonEscape(p.name) << "\",\"waited_s\":"
+           << p.waited_s << ",\"ready_ranks\":[";
+        for (size_t i = 0; i < p.ready_ranks.size(); ++i)
+          os << (i ? "," : "") << p.ready_ranks[i];
+        os << "],\"missing_ranks\":[";
+        // missing = domain members that have not announced this tensor
+        bool first_m = true;
+        for (int r : d->group.ranks) {
+          if (std::find(p.ready_ranks.begin(), p.ready_ranks.end(), r) !=
+              p.ready_ranks.end())
+            continue;
+          os << (first_m ? "" : ",") << r;
+          first_m = false;
+        }
+        os << "]}";
+      }
+      os << "]}";
+    }
+  }
+  os << "]}";
+  std::lock_guard<std::mutex> lk(engine_state_mu_);
+  engine_state_json_ = os.str();
+}
+
+std::string Core::EngineStateJson() const {
+  std::lock_guard<std::mutex> lk(engine_state_mu_);
+  return engine_state_json_;
+}
+
+bool Core::TimelineEnabled() const {
+  return timeline_ && timeline_->enabled();
+}
+
+void Core::TimelineMark(const std::string& name, const std::string& span) {
+  if (timeline_) timeline_->MarkSpan(name, span);
 }
 
 // -- straggler attribution --------------------------------------------------

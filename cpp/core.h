@@ -91,8 +91,20 @@ class StallInspector {
   void RecordPending(const std::string& name, const std::vector<int>& ranks,
                      int size);
   void RemoveReady(const std::string& name);
-  // returns warning string if stalled tensors exist past the threshold
-  std::string Check(double warn_seconds);
+  // returns warning string if stalled tensors exist past the threshold;
+  // newly_warned counts tensors first warned about this call (feeds the
+  // hvd_stall_warnings_total counter), currently_stalled the tensors
+  // past the threshold right now (feeds the stalled-tensor gauge)
+  std::string Check(double warn_seconds, int* newly_warned = nullptr,
+                    int* currently_stalled = nullptr);
+  // snapshot of every pending (not-yet-ready-everywhere) tensor, for
+  // the engine-state autopsy JSON (hvd_engine_state_json)
+  struct PendingEntry {
+    std::string name;
+    double waited_s;
+    std::vector<int> ready_ranks;
+  };
+  std::vector<PendingEntry> Pending() const;
   // names stalled past the (stricter) shutdown threshold; caller errors
   // them out (reference: STALL_SHUTDOWN_TIME aborts, stall_inspector.h)
   std::vector<std::string> FatallyStalled(double shutdown_seconds);
@@ -291,6 +303,11 @@ class Core {
     // two-level paths actually taken (proof the topology dispatch ran)
     std::atomic<uint64_t> hier_allreduces{0};
     std::atomic<uint64_t> hier_allgathers{0};
+    // stall inspector surfaced as metrics (docs/OBSERVABILITY.md):
+    // cumulative count of stall warnings issued, and the CURRENT number
+    // of tensors past the warning threshold (a gauge, not a counter)
+    std::atomic<uint64_t> stall_warnings{0};
+    std::atomic<int64_t> stalled_tensors{0};
   };
   const Counters& counters() const { return counters_; }
 
@@ -300,6 +317,21 @@ class Core {
   // NEGOTIATE_*/WAIT_FOR_OTHER_TENSOR_DATA spans, aggregated per rank).
   // Non-coordinator ranks have no data and serialize an empty report.
   std::string StragglersJson() const;
+
+  // Engine-state snapshot for hang autopsies (hvd_engine_state_json):
+  // per-domain pending tensors with who announced / who is missing,
+  // queue depth, join state. The loop thread PUBLISHES the snapshot
+  // (PublishEngineState, <=2 Hz) because domain internals are
+  // loop-thread-only; readers get the latest published copy — mid-hang
+  // the loop keeps cycling (peers keep sending empty request lists), so
+  // the snapshot stays fresh exactly when it matters.
+  std::string EngineStateJson() const;
+
+  // Span plumbing for the Python layer (hvd_timeline_mark /
+  // hvd_timeline_enabled): stamps eager-enqueue markers with the
+  // caller's span id into the engine timeline.
+  bool TimelineEnabled() const;
+  void TimelineMark(const std::string& name, const std::string& span);
 
   Transport* transport() { return transport_.get(); }
 
@@ -342,6 +374,12 @@ class Core {
   mutable std::mutex straggler_mu_;
   StragglerStats stragglers_;
   std::chrono::steady_clock::time_point last_straggler_report_;
+  // engine-state snapshot published by the loop thread (see
+  // EngineStateJson); the mutex guards only the string swap
+  mutable std::mutex engine_state_mu_;
+  std::string engine_state_json_ = "{}";
+  std::chrono::steady_clock::time_point last_state_pub_;
+  void PublishEngineState();
   // charge `waited` seconds to `last_rank` (the rank everyone waited on)
   void ChargeStraggler(int last_rank, double waited);
   void MaybeReportStragglers();

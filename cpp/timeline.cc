@@ -17,6 +17,22 @@ bool Timeline::Start(const std::string& path, bool mark_cycles) {
   FILE* f = fopen(path.c_str(), "w");
   if (!f) return false;
   fputs("[\n", f);
+  // SHARD_META: wall-clock anchor so the shard merger
+  // (python -m horovod_tpu.diagnostics merge) can align this trace with
+  // the per-rank host shards — epoch_us is the wall clock at an instant
+  // whose shard-relative timestamp is this event's own ts.
+  {
+    double epoch_us =
+        std::chrono::duration<double, std::micro>(
+            std::chrono::system_clock::now().time_since_epoch())
+            .count();
+    fprintf(f,
+            "{\"ph\":\"i\",\"name\":\"SHARD_META\",\"pid\":%d,"
+            "\"tid\":\"meta\",\"ts\":%.3f,\"s\":\"g\",\"args\":"
+            "{\"epoch_us\":%.3f,\"rank\":%d,\"source\":\"core\","
+            "\"wall_offset_us\":0}},\n",
+            rank_, Now(), epoch_us, rank_);
+  }
   {
     std::lock_guard<std::mutex> lk(mu_);
     file_ = f;
@@ -61,11 +77,30 @@ double Timeline::Now() {
       .count();
 }
 
+// caller holds mu_: current span id for a tensor name ("" before its
+// first NoteEnqueue — e.g. another rank's process-set-only tensor)
+std::string Timeline::SpanLocked(const std::string& name) {
+  auto it = span_seq_.find(name);
+  if (it == span_seq_.end() || it->second == 0) return "";
+  return name + "#" + std::to_string(it->second);
+}
+
+void Timeline::NoteEnqueue(const std::string& name) {
+  std::lock_guard<std::mutex> lk(mu_);
+  // auto-named eager tensors mint a fresh name per call: cap the map so
+  // a long run can't grow it unboundedly. Every rank enqueues the same
+  // name sequence (negotiation requires it), so the reset happens at
+  // the same enqueue on every rank and ids stay aligned (spans.py
+  // applies the same bound).
+  if (span_seq_.size() >= 65536) span_seq_.clear();
+  ++span_seq_[name];
+}
+
 void Timeline::Begin(const std::string& tid, const std::string& name) {
   if (!enabled()) return;
   std::lock_guard<std::mutex> lk(mu_);
   if (!file_) return;
-  q_.push({'B', tid, name, Now()});
+  q_.push({'B', tid, name, Now(), SpanLocked(tid)});
   cv_.notify_one();
 }
 
@@ -73,7 +108,7 @@ void Timeline::End(const std::string& tid) {
   if (!enabled()) return;
   std::lock_guard<std::mutex> lk(mu_);
   if (!file_) return;
-  q_.push({'E', tid, "", Now()});
+  q_.push({'E', tid, "", Now(), ""});
   cv_.notify_one();
 }
 
@@ -81,7 +116,15 @@ void Timeline::Instant(const std::string& name) {
   if (!enabled()) return;
   std::lock_guard<std::mutex> lk(mu_);
   if (!file_) return;
-  q_.push({'i', "marker", name, Now()});
+  q_.push({'i', "marker", name, Now(), ""});
+  cv_.notify_one();
+}
+
+void Timeline::MarkSpan(const std::string& name, const std::string& span) {
+  if (!enabled()) return;
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!file_) return;
+  q_.push({'i', "marker", name, Now(), span});
   cv_.notify_one();
 }
 
@@ -95,10 +138,27 @@ void Timeline::WriterLoop(FILE* file) {
       ev = q_.front();
       q_.pop();
     }
-    fprintf(file,
-            "{\"ph\":\"%c\",\"name\":\"%s\",\"pid\":%d,\"tid\":\"%s\","
-            "\"ts\":%.3f},\n",
-            ev.ph, ev.name.c_str(), rank_, ev.tid.c_str(), ev.ts_us);
+    std::string name = JsonEscape(ev.name), tid = JsonEscape(ev.tid);
+    if (ev.span.empty()) {
+      fprintf(file,
+              "{\"ph\":\"%c\",\"name\":\"%s\",\"pid\":%d,\"tid\":\"%s\","
+              "\"ts\":%.3f},\n",
+              ev.ph, name.c_str(), rank_, tid.c_str(), ev.ts_us);
+    } else {
+      fprintf(file,
+              "{\"ph\":\"%c\",\"name\":\"%s\",\"pid\":%d,\"tid\":\"%s\","
+              "\"ts\":%.3f,\"args\":{\"span\":\"%s\"}},\n",
+              ev.ph, name.c_str(), rank_, tid.c_str(), ev.ts_us,
+              JsonEscape(ev.span).c_str());
+    }
+    // flush on drain, not per event: batches syscalls under load while
+    // an idle (or hung) trace still has a fresh tail for the autopsy
+    bool drained;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      drained = q_.empty();
+    }
+    if (drained) fflush(file);
   }
 }
 

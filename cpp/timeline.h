@@ -12,8 +12,36 @@
 #include <queue>
 #include <string>
 #include <thread>
+#include <unordered_map>
 
 namespace hvd {
+
+// Tensor names come from user code: escape them before embedding in
+// hand-rolled JSON (timeline events, engine-state snapshots) or a name
+// with a quote/backslash corrupts the whole document exactly when a
+// post-mortem needs it.
+inline std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += (char)c;
+        }
+    }
+  }
+  return out;
+}
 
 class Timeline {
  public:
@@ -32,6 +60,15 @@ class Timeline {
   void Begin(const std::string& tid, const std::string& name);
   void End(const std::string& tid);
   void Instant(const std::string& name);
+  // Per-collective span ids (diagnostics cross-rank trace): every rank
+  // counts enqueues per tensor name, so "<name>#<count>" is the SAME id
+  // the Python layer computes (horovod_tpu/diagnostics/spans.py) — no
+  // wire traffic, correlation by construction. NoteEnqueue bumps the
+  // counter; Begin/End attach the current span as event args.
+  void NoteEnqueue(const std::string& name);
+  // Explicit-span instant for the C API (hvd_timeline_mark): the Python
+  // enqueue path stamps its span id straight into the engine trace.
+  void MarkSpan(const std::string& name, const std::string& span);
   void Close() { Stop(); }
 
  private:
@@ -39,7 +76,9 @@ class Timeline {
     char ph;
     std::string tid, name;
     double ts_us;
+    std::string span;  // "" = no args emitted
   };
+  std::string SpanLocked(const std::string& name);  // caller holds mu_
   void WriterLoop(FILE* file);
   void StopUnlocked();  // caller holds lifecycle_mu_
   double Now();
@@ -56,6 +95,10 @@ class Timeline {
   std::mutex mu_;  // queue (+ file_ presence check on the event path)
   std::condition_variable cv_;
   std::queue<Event> q_;
+  // per-name enqueue counts -> span ids; counted even while disabled so
+  // a timeline started mid-run still agrees with the Python layer's
+  // per-name counters (both count from process start)
+  std::unordered_map<std::string, uint64_t> span_seq_;
   bool closing_ = false;
   std::thread writer_;
 };

@@ -37,6 +37,7 @@ from horovod_tpu.common.basics import (  # noqa: F401
     start_timeline,
     stop_timeline,
     counters,
+    engine_state,
     metrics_snapshot,
     stragglers,
     xla_built,
@@ -143,6 +144,11 @@ from horovod_tpu.train import callbacks  # noqa: F401
 # Metrics & telemetry subsystem (docs/OBSERVABILITY.md; no reference
 # analog — the reference's only runtime introspection is the timeline)
 from horovod_tpu import metrics  # noqa: F401
+
+# Flight recorder & hang autopsy (docs/OBSERVABILITY.md "Flight
+# recorder & hang autopsy"): cross-rank trace merging, bounded event
+# ring, hang watchdog with autopsy bundles
+from horovod_tpu import diagnostics  # noqa: F401
 
 # Elastic worker API (reference: horovod.elastic)
 from horovod_tpu import elastic  # noqa: F401

@@ -200,6 +200,8 @@ class ShardedCheckpointer:
             with self._lock:
                 self._inflight_steps.discard(step)
             raise
+        from horovod_tpu.diagnostics.flight_recorder import record_event
+        record_event("ckpt_save", step=step, rank=self._rank)
         import uuid
         job = self._make_job(step, plan, uuid.uuid4().hex)
         try:
@@ -403,6 +405,10 @@ class ShardedCheckpointer:
                     "treedef": plan.treedef, "files": files,
                     "leaves": leaves}
         fmt.commit(self._dir, step, manifest)
+        from horovod_tpu.diagnostics.flight_recorder import record_event
+        from horovod_tpu.diagnostics.watchdog import notify_progress
+        record_event("ckpt_commit", step=step, world=self._world)
+        notify_progress()  # a committed checkpoint IS forward progress
 
     # ---------------------------------------------------------- restore
 
@@ -480,6 +486,11 @@ class ShardedCheckpointer:
                   for rec in manifest["leaves"]]
         out = self._rebuild(manifest, values, like, step)
         ckpt_metrics.record_restore(nbytes[0], time.monotonic() - t0, step)
+        from horovod_tpu.diagnostics.flight_recorder import record_event
+        from horovod_tpu.diagnostics.watchdog import notify_progress
+        record_event("ckpt_restore", step=step, bytes=nbytes[0])
+        # a long restore before step 1 must not read as a hang
+        notify_progress()
         return out
 
     def _restore_leaf(self, rec: dict, rank_payload, step: int) -> Any:

@@ -152,10 +152,43 @@ def init(ranks: Optional[Sequence[int]] = None,
         # In multi-process mode the C++ core writes the timeline file (it
         # sees the same env var); opening it here too would interleave two
         # writers into one path — so the Python timeline only owns the file
-        # single-process.
-        from horovod_tpu.common.timeline import Timeline
-        own_file = _state.config.timeline if _state.launched_size == 1 else ""
+        # single-process.  With HVD_TPU_TIMELINE_ALL_RANKS every rank
+        # ALSO writes a per-rank shard (<timeline>.rank<r>.json — a
+        # distinct path, never shared with the core's file) carrying
+        # per-collective span ids and a wall-clock anchor, merged
+        # post-hoc via `python -m horovod_tpu.diagnostics merge`.
+        from horovod_tpu.common.timeline import Timeline, shard_path
+        cfg = _state.config
+        all_shards = bool(cfg.timeline) and cfg.timeline_all_ranks
+        own_file = cfg.timeline \
+            if (_state.launched_size == 1 and not all_shards) else ""
         _state.timeline = Timeline(_state.rank, own_file)
+        if all_shards:
+            # wall-clock offset vs the coordinator, piggybacked on the
+            # just-built collective plane, so shards from skew-clocked
+            # hosts align in the merged trace
+            from horovod_tpu.diagnostics.clock import estimate_wall_offset
+            offset = estimate_wall_offset(_state.backend)
+            _state.timeline.start_shard(
+                shard_path(cfg.timeline, _state.rank),
+                wall_offset_s=offset,
+                mark_cycles=cfg.timeline_mark_cycles)
+
+        # Flight recorder: always on (bounded ring, docs/OBSERVABILITY.md
+        # "Flight recorder & hang autopsy"); crash hooks make an uncaught
+        # exception leave a dump next to the autopsy bundle.  Span
+        # counters restart with the world: after an elastic re-mesh the
+        # new engine counts enqueues from zero, and the Python ids must
+        # keep agreeing with it.
+        from horovod_tpu.diagnostics import spans as _spans
+        _spans.reset()
+        from horovod_tpu.diagnostics import watchdog as _wd
+        _wd.resume()  # re-arm across an elastic shutdown->init cycle
+        from horovod_tpu.diagnostics.flight_recorder import (
+            install_crash_hooks, record_event)
+        install_crash_hooks()
+        record_event("init", rank=_state.rank, size=_state.size,
+                     backend=type(_state.backend).__name__)
 
         _state.initialized = True
 
@@ -178,6 +211,17 @@ def shutdown(force: bool = False) -> None:
     with _state.lock:
         if not _state.initialized:
             return
+        from horovod_tpu.diagnostics.flight_recorder import record_event
+        record_event("shutdown", rank=_state.rank, force=force)
+        try:
+            # a watchdog must not run against a torn-down world — but an
+            # elastic shutdown→init cycle must not silently disarm it
+            # either, so suspend (remember armed) rather than drop;
+            # init() resumes it for the new world
+            from horovod_tpu.diagnostics import watchdog as _wd
+            _wd.suspend()
+        except Exception:
+            pass
         try:
             if _state.backend is not None:
                 import inspect
@@ -258,6 +302,19 @@ def stragglers() -> dict:
     and non-core backends return an empty report."""
     st = _require_init()
     fn = getattr(st.backend, "stragglers", None)
+    return fn() if fn is not None else {}
+
+
+def engine_state() -> dict:
+    """Pending-tensor autopsy snapshot from the engine
+    (``hvd_engine_state_json``): per coordination domain, the tensors
+    still waiting for announcements with ready/missing ranks, queue
+    depth and join state.  The data behind the hang watchdog's "which
+    rank is stuck in what" summary (docs/OBSERVABILITY.md "Flight
+    recorder & hang autopsy").  Meaningful on the coordinator; empty for
+    backends without a negotiating control plane."""
+    st = _require_init()
+    fn = getattr(st.backend, "engine_state", None)
     return fn() if fn is not None else {}
 
 

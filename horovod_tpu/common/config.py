@@ -76,6 +76,15 @@ class Config:
     # Timeline (reference: timeline.h:48-183)
     timeline: str = ""
     timeline_mark_cycles: bool = False
+    # Diagnostics (docs/OBSERVABILITY.md "Flight recorder & hang
+    # autopsy"): every rank writes a timeline shard
+    # (<timeline>.rank<r>.json) with span ids + wall-clock anchors;
+    # merge with `python -m horovod_tpu.diagnostics merge`.  The other
+    # diagnostics knobs (WATCHDOG_SECONDS, FLIGHT_RECORDER_SIZE,
+    # AUTOPSY_DIR) are read live from env by horovod_tpu/diagnostics —
+    # they must track env changes across elastic re-init and tests, so
+    # they deliberately bypass this cached snapshot.
+    timeline_all_ranks: bool = False
     # Stall inspection (reference: stall_inspector.h:30-99)
     stall_check_disable: bool = False
     stall_warning_time_seconds: float = 60.0
@@ -133,6 +142,7 @@ class Config:
                 d.autotune_gaussian_process_noise),
             timeline=env_str("TIMELINE"),
             timeline_mark_cycles=env_bool("TIMELINE_MARK_CYCLES"),
+            timeline_all_ranks=env_bool("TIMELINE_ALL_RANKS"),
             stall_check_disable=env_bool("STALL_CHECK_DISABLE"),
             stall_warning_time_seconds=env_float(
                 "STALL_CHECK_TIME_SECONDS", d.stall_warning_time_seconds),

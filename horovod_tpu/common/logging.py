@@ -11,17 +11,31 @@ _LOGGER = None
 
 
 class _RankFilter(logging.Filter):
-    """Resolve the rank lazily, per record.
+    """Resolve the rank (and active collective span) lazily, per record.
 
     The logger is frequently touched before the launcher's env setup (any
     import-time ``get_logger()`` call), and the old read-once-at-creation
     scheme then stamped ``[rank ?]`` on every later line. Per-record
     resolution follows the config precedence (``HVD_TPU_`` beats
-    ``HOROVOD_``) and picks up the identity whenever it appears."""
+    ``HOROVOD_``) and picks up the identity whenever it appears.
+
+    ``record.span`` carries the thread's active per-collective span id
+    (``horovod_tpu.diagnostics.spans``) so a log line emitted inside a
+    traced collective can be joined against the merged cross-rank trace
+    (the trace events carry the same id in ``args.span``); empty
+    otherwise, keeping untraced lines byte-identical to before."""
 
     def filter(self, record: logging.LogRecord) -> bool:
         record.rank = os.environ.get(
             "HVD_TPU_RANK", os.environ.get("HOROVOD_RANK", "?"))
+        record.span = ""
+        try:
+            from horovod_tpu.diagnostics.spans import current_span
+            span = current_span()
+            if span:
+                record.span = f" [span {span}]"
+        except Exception:
+            pass
         return True
 
 
@@ -36,7 +50,8 @@ def get_logger() -> logging.Logger:
             # HOROVOD_LOG_HIDE_TIME drops the timestamp (reference knob)
             ts = "" if get_config().log_hide_timestamp else "[%(asctime)s] "
             h.setFormatter(logging.Formatter(
-                f"{ts}[hvd-tpu] [rank %(rank)s] %(levelname)s: %(message)s"))
+                f"{ts}[hvd-tpu] [rank %(rank)s]%(span)s "
+                "%(levelname)s: %(message)s"))
             logger.addHandler(h)
         name = get_config().log_level
         if name == "TRACE":  # python logging has no TRACE tier

@@ -15,7 +15,7 @@ import os
 import queue
 import threading
 import time
-from typing import Optional
+from typing import List, Optional
 
 # Reference activity names (common.h:73-105 subset relevant on TPU).
 NEGOTIATE_ALLREDUCE = "NEGOTIATE_ALLREDUCE"
@@ -29,6 +29,26 @@ MEMCPY_IN_FUSION_BUFFER = "MEMCPY_IN_FUSION_BUFFER"
 COMPUTE = "COMPUTE"
 XLA_COLLECTIVE = "XLA_COLLECTIVE"
 MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER"
+
+
+def shard_path(base: str, rank: int) -> str:
+    """Per-rank shard path for timeline base ``base``
+    (``HVD_TPU_TIMELINE``): ``<dir>/timeline.rank<r>.json`` when base is
+    a directory, else ``<base>.rank<r>.json`` next to the rank-0 file —
+    distinct from the path the C++ core owns on rank 0, so the two
+    writers never interleave."""
+    if base.endswith(os.sep) or os.path.isdir(base):
+        return os.path.join(base, f"timeline.rank{rank}.json")
+    return f"{base}.rank{rank}.json"
+
+
+def shard_paths_for(base: str) -> List[str]:
+    """Existing shard files for ``base`` (merger/autopsy discovery)."""
+    if base.endswith(os.sep) or os.path.isdir(base):
+        from horovod_tpu.diagnostics.merge import find_shards
+        return find_shards(base)
+    import glob
+    return sorted(glob.glob(f"{base}.rank*.json"))
 
 
 class Timeline:
@@ -53,12 +73,17 @@ class Timeline:
             self.start(file_path)
 
     # -- lifecycle ---------------------------------------------------------
-    def start(self, file_path: str, mark_cycles: bool = False) -> None:
+    def start(self, file_path: str, mark_cycles: bool = False,
+              force: bool = False, meta: Optional[dict] = None) -> None:
+        """``force=True`` opens a file on ANY rank (per-rank shard mode,
+        ``HVD_TPU_TIMELINE_ALL_RANKS``); ``meta`` args are embedded as
+        the shard's leading ``SHARD_META`` event with a wall-clock
+        anchor so the merger can align shards across hosts."""
         with self._lock:
             if self._started:
                 return
             self._mark_cycles = mark_cycles
-            if self._rank != 0:
+            if self._rank != 0 and not force:
                 # Workers keep timeline state but only rank 0 writes a file
                 # (reference: coordinator-only file, operations.cc:459-475).
                 self._started = True
@@ -72,6 +97,16 @@ class Timeline:
             # steal (or corrupt) this generation's events
             self._q = queue.Queue()
             self._file.write("[\n")
+            if meta is not None:
+                # wall + monotonic sampled back-to-back: the merger maps
+                # event ts onto the wall clock via this anchor pair
+                wall, mono = time.time(), time.monotonic()
+                self._file.write(json.dumps({
+                    "ph": "i", "name": "SHARD_META", "pid": self._rank,
+                    "tid": "meta", "ts": (mono - self._t0) * 1e6,
+                    "s": "g",
+                    "args": {"epoch_us": wall * 1e6, **meta},
+                }) + ",\n")
             self._thread = threading.Thread(
                 target=self._writer_loop, args=(self._q, self._file),
                 name="hvd-tpu-timeline", daemon=True)
@@ -114,6 +149,23 @@ class Timeline:
                     pass
                 self._file = None
 
+    def start_shard(self, path: str, wall_offset_s: float = 0.0,
+                    mark_cycles: bool = False) -> None:
+        """Open a per-rank shard at ``path`` (any rank) with merge
+        metadata: this rank, ``source=host`` and the estimated wall
+        offset to the coordinator (:mod:`horovod_tpu.diagnostics.clock`)."""
+        self.start(path, mark_cycles=mark_cycles, force=True,
+                   meta={"rank": self._rank, "source": "host",
+                         "wall_offset_us": wall_offset_s * 1e6})
+
+    def flush(self, timeout: float = 1.0) -> None:
+        """Best-effort: let the writer drain so an autopsy reading the
+        shard file mid-run sees the recent events (the writer flushes
+        per event; truncated tails are repaired by the merger)."""
+        deadline = time.monotonic() + timeout
+        while not self._q.empty() and time.monotonic() < deadline:
+            time.sleep(0.01)
+
     def close(self) -> None:
         self.stop()
 
@@ -153,6 +205,22 @@ class Timeline:
     def negotiate_end(self, tensor_name: str) -> None:
         self._emit("E", "", "negotiate", tensor_name)
 
+    # Per-collective spans (diagnostics cross-rank trace): B/E on the
+    # tensor-name track, carrying the span id every rank computes
+    # identically (horovod_tpu.diagnostics.spans) so the merger can
+    # correlate the same collective across rank tracks.
+    def collective_begin(self, tensor_name: str, kind: str,
+                         span: str) -> None:
+        self._emit("B", kind.upper(), "collective", tensor_name,
+                   {"span": span})
+
+    def collective_end(self, tensor_name: str, span: str,
+                       ok: bool = True) -> None:
+        args = {"span": span}
+        if not ok:
+            args["error"] = True
+        self._emit("E", "", "collective", tensor_name, args)
+
     def mark_cycle(self) -> None:
         """Cycle tick marker (reference: HOROVOD_TIMELINE_MARK_CYCLES)."""
         if self._mark_cycles:
@@ -171,5 +239,11 @@ class Timeline:
                 return
             try:
                 file.write(json.dumps(ev) + ",\n")
+                # flush on drain, not per event (same policy as the C++
+                # writer): batches syscalls when a high-rate trace backs
+                # the queue up, while an idle — or hung — shard still
+                # has a fresh tail on disk for the autopsy
+                if q.empty():
+                    file.flush()
             except (OSError, ValueError):
                 return

@@ -47,11 +47,19 @@ def _codec_instruments(codec: str):
 
 def record_compression(codec: str, pre_bytes: int, wire_bytes: int) -> None:
     """Account one compressed transfer; updates the cumulative ratio."""
+    first = codec not in _INSTRUMENTS
     pre, wire, ratio = _codec_instruments(codec)
     pre.inc(pre_bytes)
     wire.inc(wire_bytes)
     if wire.value > 0:
         ratio.set(pre.value / wire.value)
+    if first:
+        # codec choice is a control-plane decision worth remembering in
+        # a post-mortem; once per codec keeps the flight ring for the
+        # per-collective evidence
+        from horovod_tpu.diagnostics.flight_recorder import record_event
+        record_event("codec_choice", codec=codec, pre_bytes=pre_bytes,
+                     wire_bytes=wire_bytes)
 
 
 def compression_ratio(codec: str) -> float:

@@ -87,6 +87,15 @@ def _load_lib():
         # override) that predates the straggler API
         if hasattr(lib, "hvd_stragglers_json"):
             lib.hvd_stragglers_json.restype = ctypes.c_char_p
+        # ... or the diagnostics APIs (engine state + span marks)
+        if hasattr(lib, "hvd_engine_state_json"):
+            lib.hvd_engine_state_json.restype = ctypes.c_char_p
+        if hasattr(lib, "hvd_timeline_enabled"):
+            lib.hvd_timeline_enabled.restype = ctypes.c_int
+        if hasattr(lib, "hvd_timeline_mark"):
+            lib.hvd_timeline_mark.restype = None
+            lib.hvd_timeline_mark.argtypes = [ctypes.c_char_p,
+                                              ctypes.c_char_p]
         lib.hvd_start_timeline.restype = ctypes.c_int
         lib.hvd_start_timeline.argtypes = [ctypes.c_char_p, ctypes.c_int]
         lib.hvd_stop_timeline.restype = ctypes.c_int
@@ -385,6 +394,28 @@ class CoreBackend(Backend):
         if not hasattr(self._lib, "hvd_stragglers_json"):
             return {}
         return json.loads(self._lib.hvd_stragglers_json().decode())
+
+    def engine_state(self) -> dict:
+        """Pending-tensor autopsy snapshot (cpp hvd_engine_state_json):
+        per coordination domain, the tensors waiting for announcements
+        with ready/missing ranks, queue depth and join state.  Published
+        by the engine loop at <=2 Hz; empty away from the coordinator
+        (only rank 0 tracks readiness)."""
+        import json
+        if not hasattr(self._lib, "hvd_engine_state_json"):
+            return {}
+        return json.loads(self._lib.hvd_engine_state_json().decode())
+
+    def core_timeline_enabled(self) -> bool:
+        if not hasattr(self._lib, "hvd_timeline_enabled"):
+            return False
+        return bool(self._lib.hvd_timeline_enabled())
+
+    def timeline_mark(self, name: str, span: str) -> None:
+        """Stamp an eager-enqueue marker with its span id into the
+        engine's timeline (diagnostics cross-rank trace)."""
+        if hasattr(self._lib, "hvd_timeline_mark"):
+            self._lib.hvd_timeline_mark(name.encode(), span.encode())
 
     def start_core_timeline(self, file_path: str,
                             mark_cycles: bool = False) -> bool:

@@ -1,0 +1,78 @@
+"""CLI for the diagnostics subsystem.
+
+``python -m horovod_tpu.diagnostics merge [-o OUT] SHARD... | --dir DIR``
+    Fold per-rank timeline shards into one Perfetto/chrome trace.
+
+``python -m horovod_tpu.diagnostics flight DUMP.json``
+    Summarize a flight-recorder dump (event counts per kind, tail).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _cmd_merge(args: argparse.Namespace) -> int:
+    from horovod_tpu.diagnostics.merge import (find_shards, merge_shards)
+    paths = list(args.shards)
+    if args.dir:
+        paths.extend(find_shards(args.dir))
+    if not paths:
+        print("no shards given (pass shard files or --dir)",
+              file=sys.stderr)
+        return 2
+    out = args.output
+    if not out:
+        import os
+        base = args.dir or os.path.dirname(paths[0]) or "."
+        out = os.path.join(base, "merged_trace.json")
+    doc = merge_shards(paths, out)
+    pids = {ev.get("pid") for ev in doc["traceEvents"]
+            if ev.get("ph") != "M"}
+    print(f"merged {len(paths)} shard(s), "
+          f"{len(doc['traceEvents'])} events, {len(pids)} track(s) "
+          f"-> {out}")
+    return 0
+
+
+def _cmd_flight(args: argparse.Namespace) -> int:
+    with open(args.dump) as f:
+        doc = json.load(f)
+    events = doc.get("events", [])
+    kinds: dict = {}
+    for ev in events:
+        kinds[ev.get("kind", "?")] = kinds.get(ev.get("kind", "?"), 0) + 1
+    print(f"rank {doc.get('rank')}: {len(events)} events "
+          f"({doc.get('dropped', 0)} dropped, capacity "
+          f"{doc.get('capacity')})")
+    for kind, n in sorted(kinds.items(), key=lambda kv: -kv[1]):
+        print(f"  {kind}: {n}")
+    for ev in events[-args.tail:]:
+        print(" ", json.dumps(ev, default=str))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m horovod_tpu.diagnostics")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    mp = sub.add_parser("merge", help="merge per-rank timeline shards")
+    mp.add_argument("shards", nargs="*", help="shard files")
+    mp.add_argument("--dir", help="directory to glob shards from")
+    mp.add_argument("-o", "--output", help="merged trace path")
+    mp.set_defaults(fn=_cmd_merge)
+
+    fp = sub.add_parser("flight", help="summarize a flight dump")
+    fp.add_argument("dump")
+    fp.add_argument("--tail", type=int, default=10,
+                    help="print the last N events")
+    fp.set_defaults(fn=_cmd_flight)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,268 @@
+"""Autopsy bundles: one directory that answers "which rank is stuck in
+what".
+
+Written by the hang watchdog (:mod:`horovod_tpu.diagnostics.watchdog`)
+or on demand (:func:`write_autopsy`).  Every rank contributes its own
+evidence with rank-suffixed filenames (so a shared filesystem
+accumulates the whole picture even if cross-rank fetching fails):
+
+* ``stacks_rank<r>.txt`` — ``faulthandler`` dump of every thread;
+* ``flight_rank<r>.json`` — the flight-recorder ring;
+* ``engine_rank<r>.json`` — engine counters + straggler report +
+  pending-tensor state (``hvd_engine_state_json``: which tensors are
+  waiting on which ranks — coordinator-only detail, like the reference's
+  stall inspector);
+* ``metrics_rank<r>.json`` — the full metrics snapshot;
+* ``merged_trace.json`` — the per-rank timeline shards merged into one
+  Perfetto trace (when shard tracing is on, docs/OBSERVABILITY.md).
+
+Rank 0 additionally scrapes every peer's ``/debug/stacks``,
+``/debug/flight`` and ``/debug/engine`` endpoints (served by the
+metrics exporter, ``HVD_TPU_METRICS_PORT``) into ``peer_rank<r>_*``
+files. Each rank writes ``summary_rank<r>.json``; rank 0's names the
+suspect ranks/tensors (the coordinator sees every announcement).
+
+All of it is best-effort: a hung process must never hang HARDER because
+its autopsy failed.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+from urllib.request import urlopen
+
+from horovod_tpu.common.logging import get_logger
+from horovod_tpu.diagnostics.flight_recorder import recorder
+
+_FETCH_TIMEOUT_S = 5.0
+
+
+def default_autopsy_dir() -> str:
+    from horovod_tpu.common.config import env_str
+    return env_str("AUTOPSY_DIR") or os.path.join(os.getcwd(),
+                                                  "hvd_autopsy")
+
+
+def _state():
+    try:
+        from horovod_tpu.common.basics import _state as st
+        return st if st.initialized else None
+    except Exception:
+        return None
+
+
+def _my_rank() -> int:
+    st = _state()
+    if st is not None:
+        return st.rank
+    from horovod_tpu.diagnostics.flight_recorder import _best_effort_rank
+    return _best_effort_rank()
+
+
+def _write(path: str, text: str) -> None:
+    with open(path, "w") as f:
+        f.write(text)
+
+
+def _write_json(path: str, doc: Any) -> None:
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, default=str)
+
+
+def stacks_text() -> str:
+    """All-thread stacks via faulthandler (works mid-hang: it walks
+    frames without taking the GIL hostage beyond the dump)."""
+    import tempfile
+    # faulthandler needs a real fd, not a StringIO
+    with tempfile.TemporaryFile(mode="w+") as f:
+        faulthandler.dump_traceback(file=f, all_threads=True)
+        f.seek(0)
+        return f.read()
+
+
+def engine_doc() -> Dict[str, Any]:
+    """Counters + stragglers + pending-tensor state from the live
+    backend (empty sections when not initialized / not the core)."""
+    doc: Dict[str, Any] = {"rank": _my_rank(), "ts": time.time()}
+    st = _state()
+    be = st.backend if st is not None else None
+    for key, attr in (("counters", "counters"),
+                      ("stragglers", "stragglers"),
+                      ("engine_state", "engine_state")):
+        fn = getattr(be, attr, None)
+        if fn is None:
+            continue
+        try:
+            doc[key] = fn()
+        except Exception as e:
+            doc[key] = {"error": repr(e)}
+    return doc
+
+
+def metrics_doc() -> Dict[str, Any]:
+    try:
+        from horovod_tpu.common.basics import metrics_snapshot
+        return metrics_snapshot()
+    except Exception:
+        from horovod_tpu.metrics.registry import default_registry
+        return {"registry": default_registry().snapshot()}
+
+
+def suspects_from_engine(engine: Dict[str, Any]) -> List[dict]:
+    """Pending tensors → who is being waited on (the autopsy headline)."""
+    out = []
+    for dom in (engine.get("engine_state") or {}).get("domains", []):
+        for p in dom.get("pending", []):
+            out.append({"tensor": p.get("name"),
+                        "waited_s": p.get("waited_s"),
+                        "missing_ranks": p.get("missing_ranks", []),
+                        "ready_ranks": p.get("ready_ranks", []),
+                        "domain": dom.get("id")})
+    out.sort(key=lambda p: -(p.get("waited_s") or 0.0))
+    return out
+
+
+def peer_debug_ports() -> Dict[int, tuple]:
+    """rank → (host, port) for every OTHER rank's exporter.
+
+    Port is ``HVD_TPU_METRICS_PORT + local_rank`` (exporter contract).
+    Hosts: same-host ranks are ``127.0.0.1``; multi-host layouts need
+    ``HVD_TPU_PEER_HOSTS`` (comma-separated host per rank) since worker
+    processes don't learn peer hostnames from the launcher.
+    """
+    st = _state()
+    if st is None or st.config is None:
+        return {}
+    base = getattr(st.config, "metrics_port", 0)
+    if not base or base <= 0:
+        return {}
+    hosts_env = os.environ.get("HVD_TPU_PEER_HOSTS", "")
+    hosts = [h.strip() for h in hosts_env.split(",")] if hosts_env else []
+    out = {}
+    for r in range(st.size):
+        if r == st.rank:
+            continue
+        if hosts:
+            host = hosts[r] if r < len(hosts) and hosts[r] else "127.0.0.1"
+            # the exporter binds base + local_rank; with the full
+            # rank→host map, rank r's local rank is its index among the
+            # ranks sharing its host (launchers fill hosts in order)
+            local = sum(1 for q in range(r) if q < len(hosts)
+                        and hosts[q] == hosts[r])
+            out[r] = (host, base + local)
+        else:
+            # single-host launches: local_rank == global rank;
+            # multi-host without PEER_HOSTS is skipped, not guessed
+            if st.cross_size > 1:
+                continue
+            out[r] = ("127.0.0.1", base + r)
+    return out
+
+
+def _fetch(url: str) -> Optional[bytes]:
+    try:
+        return urlopen(url, timeout=_FETCH_TIMEOUT_S).read()
+    except Exception as e:
+        get_logger().warning("autopsy: fetch %s failed: %r", url, e)
+        return None
+
+
+def _collect_peers(bundle: str) -> List[int]:
+    fetched = []
+    for r, (host, port) in sorted(peer_debug_ports().items()):
+        base = f"http://{host}:{port}/debug"
+        got_any = False
+        for kind, suffix in (("stacks", "txt"), ("flight", "json"),
+                             ("engine", "json")):
+            body = _fetch(f"{base}/{kind}")
+            if body is None:
+                continue
+            got_any = True
+            with open(os.path.join(
+                    bundle, f"peer_rank{r}_{kind}.{suffix}"), "wb") as f:
+                f.write(body)
+        if got_any:
+            fetched.append(r)
+    return fetched
+
+
+def _merge_shards_into(bundle: str) -> Optional[str]:
+    """Merge whatever timeline shards this host can see (shared-FS best
+    case: all of them) into the bundle."""
+    from horovod_tpu.common.config import get_config
+    from horovod_tpu.common.timeline import shard_paths_for
+    from horovod_tpu.diagnostics.merge import merge_shards
+    cfg = get_config()
+    if not cfg.timeline:
+        return None
+    st = _state()
+    if st is not None and st.timeline is not None:
+        st.timeline.flush()  # a live shard is mid-array on disk
+    paths = [p for p in shard_paths_for(cfg.timeline)
+             if os.path.exists(p)]
+    # the core's rank-0 trace, if any (a FILE base only — a directory
+    # base holds shards already picked up above)
+    if os.path.isfile(cfg.timeline):
+        paths.append(cfg.timeline)
+    if not paths:
+        return None
+    out = os.path.join(bundle, "merged_trace.json")
+    merge_shards(paths, out)
+    return out
+
+
+def write_autopsy(out_dir: Optional[str] = None, reason: str = "",
+                  fetch_peers: Optional[bool] = None) -> str:
+    """Write this rank's autopsy evidence into ``out_dir`` (default
+    ``HVD_TPU_AUTOPSY_DIR`` / ``./hvd_autopsy``); returns the bundle
+    directory.  Every step is individually best-effort."""
+    rank = _my_rank()
+    bundle = out_dir or default_autopsy_dir()
+    os.makedirs(bundle, exist_ok=True)
+    get_logger().error("writing autopsy bundle to %s (%s)", bundle,
+                       reason or "on demand")
+
+    def step(fn):
+        try:
+            return fn()
+        except Exception as e:
+            get_logger().warning("autopsy step failed: %r", e)
+            return None
+
+    step(lambda: _write(os.path.join(bundle, f"stacks_rank{rank}.txt"),
+                        stacks_text()))
+    step(lambda: recorder().dump_to(
+        os.path.join(bundle, f"flight_rank{rank}.json")))
+    engine = step(engine_doc) or {}
+    step(lambda: _write_json(
+        os.path.join(bundle, f"engine_rank{rank}.json"), engine))
+    step(lambda: _write_json(
+        os.path.join(bundle, f"metrics_rank{rank}.json"), metrics_doc()))
+    step(lambda: _merge_shards_into(bundle))
+
+    if fetch_peers is None:
+        fetch_peers = rank == 0
+    fetched: List[int] = []
+    if fetch_peers:
+        fetched = step(lambda: _collect_peers(bundle)) or []
+
+    suspects = suspects_from_engine(engine)
+    step(lambda: _write_json(
+        os.path.join(bundle, f"summary_rank{rank}.json"), {
+        "reason": reason,
+        "rank": rank,
+        "written_at": time.time(),
+        "suspects": suspects,
+        "peers_fetched": fetched,
+    }))
+    if suspects:
+        top = suspects[0]
+        get_logger().error(
+            "autopsy: tensor %r has waited %.1fs on ranks %s",
+            top["tensor"], top.get("waited_s") or 0.0,
+            top.get("missing_ranks"))
+    return bundle

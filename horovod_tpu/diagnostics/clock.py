@@ -1,0 +1,64 @@
+"""Wall-clock offset estimation for cross-rank trace alignment.
+
+Timeline shards are stamped with each host's own clocks; on a pod the
+hosts' wall clocks can disagree by far more than a collective takes, so
+merging shards raw would show rank 3 "responding" before rank 0 asked.
+Each shard therefore records an estimated offset to the coordinator's
+wall clock, measured by piggybacking on the collective plane that init
+just brought up: after a barrier releases every rank ~simultaneously,
+all ranks sample ``time.time()`` and allgather the samples; my offset is
+the median over a few rounds of ``my_sample - rank0_sample``.  The
+barrier bounds the sampling skew to one negotiation round-trip (ms),
+while real clock skew on unsynchronized hosts is seconds — good enough
+to line tracks up, and free of any extra service.
+
+``HVD_TPU_CLOCK_OFFSET_S`` overrides the estimate (tests inject known
+skew; operators can pin a value on NTP-disciplined fleets).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional, Tuple
+
+
+def wall_monotonic_pair() -> Tuple[float, float]:
+    """(wall seconds, monotonic seconds) sampled back-to-back — the
+    anchor pair shard metadata embeds so monotonic event timestamps can
+    be mapped onto the wall clock."""
+    return time.time(), time.monotonic()
+
+
+def estimate_wall_offset(backend=None, rounds: int = 5) -> float:
+    """Estimated ``my_wall - coordinator_wall`` in seconds (0.0 when it
+    cannot be measured: single process, no backend, or any failure —
+    alignment degrades gracefully to raw clocks)."""
+    forced = os.environ.get("HVD_TPU_CLOCK_OFFSET_S")
+    if forced not in (None, ""):
+        try:
+            return float(forced)
+        except ValueError:
+            pass
+    if backend is None or getattr(backend, "size", 1) <= 1:
+        return 0.0
+    try:
+        return _measure(backend, rounds)
+    except Exception:
+        return 0.0
+
+
+def _measure(backend, rounds: int) -> float:
+    import numpy as np
+    offsets = []
+    for i in range(max(rounds, 1)):
+        backend.barrier()  # release is ~simultaneous on every rank
+        sample = np.asarray([time.time()], np.float64)
+        gathered = backend.allgather_async(
+            f"_hvd.clocksync.{i}", sample).wait(30)
+        gathered = np.asarray(gathered).reshape(-1)
+        if gathered.size < 2:
+            return 0.0
+        offsets.append(float(sample[0] - gathered[0]))
+    offsets.sort()
+    return offsets[len(offsets) // 2]

@@ -1,0 +1,69 @@
+"""Per-collective span ids: the cross-rank correlation key.
+
+A span id is ``"<tensor_name>#<occurrence>"`` where the occurrence is a
+per-name enqueue counter.  Because negotiation already requires every
+rank to submit the same tensor names in a compatible order (the
+coordinator matches announcements BY NAME — reference
+``controller.cc ComputeResponseList``), each rank computing the counter
+independently yields the SAME span id for the same logical collective —
+no extra wire traffic.  The C++ core's timeline derives spans the same
+way (``cpp/timeline.cc Timeline::NoteEnqueue``), so the merged
+cross-rank trace correlates host shards and the engine trace without a
+handshake.
+
+The active span is tracked per-thread so log lines emitted inside a
+traced collective can carry it (``common/logging.py`` appends it to the
+record format) and be joined against the merged trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Iterator, Optional
+
+_counts: Dict[str, int] = {}
+_lock = threading.Lock()
+_active = threading.local()
+
+
+def next_span(name: str) -> str:
+    """Allocate the span id for this enqueue of ``name`` (per-name
+    occurrence counter; deterministic across SPMD ranks)."""
+    with _lock:
+        # auto-named tensors mint fresh names forever: bound the map.
+        # Every rank sees the same name sequence (negotiation requires
+        # it), so the reset lands on the same enqueue on every rank and
+        # ids stay aligned (cpp/timeline.cc applies the same bound).
+        if len(_counts) >= 65536:
+            _counts.clear()
+        seq = _counts.get(name, 0) + 1
+        _counts[name] = seq
+    return f"{name}#{seq}"
+
+
+def current_span() -> Optional[str]:
+    """Span id of the collective being traced on THIS thread, if any."""
+    return getattr(_active, "span", None)
+
+
+def set_active(span: Optional[str]) -> None:
+    _active.span = span
+
+
+@contextlib.contextmanager
+def active_span(span: str) -> Iterator[str]:
+    """Scope ``span`` as the thread's active span (for log joining)."""
+    prev = current_span()
+    _active.span = span
+    try:
+        yield span
+    finally:
+        _active.span = prev
+
+
+def reset() -> None:
+    """Drop all per-name counters (tests and elastic re-init: a new
+    world negotiates from a clean slate, so spans must too)."""
+    with _lock:
+        _counts.clear()

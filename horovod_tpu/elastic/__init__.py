@@ -159,8 +159,11 @@ def _apply_world_update(update: dict, force_shutdown: bool = False) -> None:
     ``common/elastic.py:151-175``."""
     global _current_generation
     import horovod_tpu as hvd
+    from horovod_tpu.diagnostics.flight_recorder import record_event
     my_rank = str(rank())
     old_size = size()
+    record_event("elastic_remesh", generation=update.get("generation"),
+                 old_size=old_size, new_size=update.get("size"))
     slot_env = update["slots"].get(my_rank)
     if slot_env is None:  # we are not part of the new world
         hvd.shutdown(force=True)  # close our sockets for the survivors
@@ -212,6 +215,8 @@ class State:
             cb()
 
     def commit(self) -> None:
+        from horovod_tpu.diagnostics.flight_recorder import record_event
+        record_event("elastic_commit")
         self.save()
         self.check_host_updates()
 

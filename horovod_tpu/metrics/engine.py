@@ -70,6 +70,36 @@ class EngineCollector:
                     f"hvd_engine_{key}",
                     help=f"engine counter {key} (cumulative)",
                     agg="sum").set(float(val))
+            # stall inspector surfaced as first-class metrics (beyond
+            # the generic hvd_engine_* mirror): a true Prometheus
+            # counter for warnings plus the live stalled-tensor gauge
+            # (docs/OBSERVABILITY.md "Stall metrics")
+            if "stall_warnings" in c:
+                counter = self._reg.counter(
+                    "hvd_stall_warnings_total",
+                    help="stall-inspector warnings issued (tensors that "
+                         "crossed STALL_CHECK_TIME_SECONDS)")
+                cur = float(c["stall_warnings"])
+                prev_sw = (self._prev or {}).get("stall_warnings")
+                if prev_sw is None:
+                    # first sample from this collector: sync against the
+                    # registry total (another collector generation may
+                    # already have recorded part of it)
+                    delta = cur - counter.value
+                else:
+                    delta = cur - float(prev_sw)
+                if delta < 0:
+                    # engine restarted (elastic re-mesh resets the C++
+                    # counters): the whole new total is new warnings
+                    delta = cur
+                if delta > 0:
+                    counter.inc(delta)
+            if "stalled_tensors" in c:
+                self._reg.gauge(
+                    "hvd_stalled_tensors",
+                    help="tensors currently past the stall warning "
+                         "threshold", agg="sum").set(
+                    float(c["stalled_tensors"]))
             for key, val in derived_ratios(c).items():
                 self._reg.gauge(
                     f"hvd_engine_{key}",

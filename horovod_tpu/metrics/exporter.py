@@ -49,8 +49,37 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             doc = exporter.health()
             code = 200 if doc.get("status") == "ok" else 503
             self._send(code, json.dumps(doc).encode(), "application/json")
+        elif path.startswith("/debug/"):
+            self._debug(path[len("/debug/"):])
         else:
             self._send(404, b"not found\n", "text/plain")
+
+    def _debug(self, kind: str) -> None:
+        """Hang-autopsy evidence endpoints (docs/OBSERVABILITY.md
+        "Flight recorder & hang autopsy"): rank 0's watchdog scrapes
+        every peer's ``/debug/stacks`` / ``/debug/flight`` /
+        ``/debug/engine`` so one directory answers "which rank is stuck
+        in what".  Served from the exporter's own thread pool, so they
+        answer even while the training thread is wedged."""
+        try:
+            if kind == "stacks":
+                from horovod_tpu.diagnostics.autopsy import stacks_text
+                self._send(200, stacks_text().encode(), "text/plain")
+            elif kind == "flight":
+                from horovod_tpu.diagnostics.flight_recorder import recorder
+                self._send(200,
+                           json.dumps(recorder().dump(),
+                                      default=str).encode(),
+                           "application/json")
+            elif kind == "engine":
+                from horovod_tpu.diagnostics.autopsy import engine_doc
+                self._send(200,
+                           json.dumps(engine_doc(), default=str).encode(),
+                           "application/json")
+            else:
+                self._send(404, b"unknown debug endpoint\n", "text/plain")
+        except Exception as e:  # evidence collection must never crash
+            self._send(500, repr(e).encode() + b"\n", "text/plain")
 
 
 class MetricsExporter:

@@ -40,10 +40,31 @@ class HvdHandle:
     def _set_result(self, value: Any) -> None:
         self._result = value
         self._event.set()
+        self._fire_done(True)
 
     def _set_error(self, err: BaseException) -> None:
         self._error = err
         self._event.set()
+        self._fire_done(False)
+
+    def add_done_callback(self, cb) -> None:
+        """Invoke ``cb(ok: bool)`` once when the handle completes (fires
+        immediately if it already has). Used by the diagnostics layer to
+        flight-record collective completion; callbacks must not raise —
+        errors are swallowed so observability can never fail a wait."""
+        self._done_cb = cb
+        if self._event.is_set():
+            self._fire_done(self._error is None)
+
+    def _fire_done(self, ok: bool) -> None:
+        # dict.pop is atomic under the GIL: when completion and
+        # add_done_callback race, exactly one caller wins the pop
+        cb = self.__dict__.pop("_done_cb", None)
+        if cb is not None:
+            try:
+                cb(ok)
+            except Exception:
+                pass
 
     def poll(self) -> bool:
         """Reference: ``PollHandle`` (``mpi_ops_v2.cc:566-571``)."""

@@ -26,12 +26,59 @@ import jax.numpy as jnp
 
 from horovod_tpu.common.basics import _require_init
 from horovod_tpu.common.process_sets import ProcessSet, global_process_set
+from horovod_tpu.diagnostics import spans as _spans
+from horovod_tpu.diagnostics.flight_recorder import record_event
 from horovod_tpu.metrics.registry import default_registry
 from horovod_tpu.ops.backend import Backend, HvdHandle, check_scale_dtype
 from horovod_tpu.ops.reduce_op import Adasum, Average, ReduceOp, Sum
 
 
 _CALL_COUNTERS: dict = {}
+
+
+def _trace_enqueue(kind: str, names) -> list:
+    """Diagnostics seam for every eager enqueue: allocate the
+    per-collective span id(s) (``diagnostics.spans`` — deterministic
+    across ranks, the cross-rank correlation key), flight-record the
+    enqueue, open the per-rank timeline spans, and stamp the span into
+    the C++ engine trace when it is live (``hvd_timeline_mark``).
+    Returns ``[(name, span), ...]``."""
+    st = _require_init()
+    if isinstance(names, str):
+        names = [names]
+    if not names:
+        return []
+    pairs = [(name, _spans.next_span(name)) for name in names]
+    tl = st.timeline
+    if tl is not None and tl.enabled:
+        for name, span in pairs:
+            tl.collective_begin(name, kind, span)
+    mark = getattr(st.backend, "timeline_mark", None)
+    if mark is not None and st.backend.core_timeline_enabled():
+        for name, span in pairs:
+            mark(f"enqueue_{kind}", span)
+    record_event("enqueue", op=kind, name=pairs[0][0], n=len(pairs),
+                 span=pairs[0][1])
+    return pairs
+
+
+def _trace_done(handle: HvdHandle, kind: str, pairs) -> HvdHandle:
+    """Flight-record completion (and close the timeline spans) when the
+    handle resolves. Observability only — never raises into the wait."""
+    if not pairs:
+        return handle
+    st = _require_init()
+    tl = st.timeline
+
+    def on_done(ok: bool) -> None:
+        record_event("complete", op=kind, name=pairs[0][0],
+                     span=pairs[0][1], ok=ok)
+        if tl is not None and tl.enabled:
+            for name, span in pairs:
+                tl.collective_end(name, span, ok=ok)
+
+    handle.add_done_callback(on_done)
+    return handle
 
 
 def _count_call(kind: str) -> None:
@@ -102,12 +149,12 @@ def allreduce_async(value, average: Optional[bool] = None,
     _check_scales([value], prescale_factor, postscale_factor, op)
     _count_call("allreduce")
     be = _backend_for(process_set)
-    st = _require_init()
     name = _auto_name("allreduce", name)
-    if st.timeline is not None:
-        st.timeline.instant("enqueue_allreduce", {"tensor": name})
-    return be.allreduce_async(name, value, op, prescale_factor,
-                              postscale_factor)
+    pairs = _trace_enqueue("allreduce", name)
+    with _spans.active_span(pairs[0][1]):
+        h = be.allreduce_async(name, value, op, prescale_factor,
+                               postscale_factor)
+    return _trace_done(h, "allreduce", pairs)
 
 
 def allreduce(value, average: Optional[bool] = None,
@@ -134,8 +181,11 @@ def grouped_allreduce_async(values: Sequence, average: Optional[bool] = None,
     be = _backend_for(process_set)
     base = _auto_name("grouped_allreduce", name)
     names = [f"{base}.{i}" for i in range(len(values))]
-    return be.grouped_allreduce_async(names, list(values), op,
-                                      prescale_factor, postscale_factor)
+    pairs = _trace_enqueue("grouped_allreduce", names)
+    with _spans.active_span(pairs[0][1] if pairs else None):
+        h = be.grouped_allreduce_async(names, list(values), op,
+                                       prescale_factor, postscale_factor)
+    return _trace_done(h, "grouped_allreduce", pairs)
 
 
 def grouped_allreduce(values: Sequence, average: Optional[bool] = None,
@@ -293,7 +343,11 @@ def allgather_async(value, name: Optional[str] = None,
     first-dim sizes in the Response)."""
     _count_call("allgather")
     be = _backend_for(process_set)
-    return be.allgather_async(_auto_name("allgather", name), value)
+    name = _auto_name("allgather", name)
+    pairs = _trace_enqueue("allgather", name)
+    with _spans.active_span(pairs[0][1]):
+        h = be.allgather_async(name, value)
+    return _trace_done(h, "allgather", pairs)
 
 
 def allgather(value, name: Optional[str] = None,
@@ -309,7 +363,11 @@ def broadcast_async(value, root_rank: int, name: Optional[str] = None,
     ``operations.cc:1560-1592`` converts global → set-relative internally)."""
     _count_call("broadcast")
     be = _backend_for(process_set)
-    return be.broadcast_async(_auto_name("broadcast", name), value, root_rank)
+    name = _auto_name("broadcast", name)
+    pairs = _trace_enqueue("broadcast", name)
+    with _spans.active_span(pairs[0][1]):
+        h = be.broadcast_async(name, value, root_rank)
+    return _trace_done(h, "broadcast", pairs)
 
 
 def broadcast(value, root_rank: int, name: Optional[str] = None,
@@ -327,7 +385,11 @@ def alltoall_async(value, splits: Optional[Sequence[int]] = None,
     result is (received tensor, received splits)."""
     _count_call("alltoall")
     be = _backend_for(process_set)
-    return be.alltoall_async(_auto_name("alltoall", name), value, splits)
+    name = _auto_name("alltoall", name)
+    pairs = _trace_enqueue("alltoall", name)
+    with _spans.active_span(pairs[0][1]):
+        h = be.alltoall_async(name, value, splits)
+    return _trace_done(h, "alltoall", pairs)
 
 
 def alltoall(value, splits: Optional[Sequence[int]] = None,
@@ -349,9 +411,13 @@ def reducescatter_async(value, op: Optional[ReduceOp] = None,
     _count_call("reducescatter")
     be = _backend_for(process_set)
     name = _auto_name("reducescatter", name)
-    if be.size == 1:
-        return be.allreduce_async(name, value, op)
-    return be.reducescatter_async(name, value, op)
+    pairs = _trace_enqueue("reducescatter", name)
+    with _spans.active_span(pairs[0][1]):
+        if be.size == 1:
+            h = be.allreduce_async(name, value, op)
+        else:
+            h = be.reducescatter_async(name, value, op)
+    return _trace_done(h, "reducescatter", pairs)
 
 
 def reducescatter(value, op: Optional[ReduceOp] = None,

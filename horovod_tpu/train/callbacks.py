@@ -117,6 +117,10 @@ class StepTimer:
 
     def start_step(self) -> None:
         self._t0 = time.perf_counter()
+        from horovod_tpu.diagnostics.flight_recorder import record_event
+        # +1: number the step being ENTERED, matching the post-increment
+        # number its step_end will carry (begin/end pairs must agree)
+        record_event("step_begin", step=int(self.steps.value) + 1)
 
     def end_step(self, units: float = 0.0) -> Optional[float]:
         """Close the step opened by :meth:`start_step`; returns the step
@@ -128,6 +132,13 @@ class StepTimer:
         self.last_step_seconds = dt
         self.step_time.observe(dt)
         self.steps.inc()
+        # a completed step IS forward progress: feed the hang watchdog
+        # and the flight recorder (docs/OBSERVABILITY.md)
+        step_no = int(self.steps.value)
+        from horovod_tpu.diagnostics.flight_recorder import record_event
+        from horovod_tpu.diagnostics.watchdog import notify_progress
+        record_event("step_end", step=step_no, seconds=round(dt, 6))
+        notify_progress(step_no)
         if units:
             self.units.inc(units)
             if dt > 0:
@@ -186,6 +197,11 @@ class TelemetryCallback:
 
     ``log_every_n_steps`` > 0 logs a one-line telemetry summary (step
     time, units/s, MFU) through the rank-tagged logger.
+
+    Creating the callback also arms the process-wide hang watchdog
+    (``HVD_TPU_WATCHDOG_SECONDS``, default 600; 0 disarms): if no step
+    completes for that long, an autopsy bundle is written —
+    docs/OBSERVABILITY.md "Flight recorder & hang autopsy".
     """
 
     def __init__(self, units_per_step: float = 0.0,
@@ -202,6 +218,16 @@ class TelemetryCallback:
         self._hlo_factor = hlo_flops_factor
         self._log_every = log_every_n_steps
         self._steps = 0
+        # armed-by-default: a training loop with telemetry gets hang
+        # autopsies for free (None when WATCHDOG_SECONDS=0).  Only for
+        # an INITIALIZED process: a callback constructed without
+        # hvd.init (unit tests, dry imports) has no world to autopsy,
+        # and a leaked 600s daemon in a long pytest process would
+        # eventually fire mid-suite — the false positive the acceptance
+        # criteria forbid.
+        from horovod_tpu.common.basics import is_initialized
+        from horovod_tpu.diagnostics.watchdog import ensure_watchdog
+        self.watchdog = ensure_watchdog() if is_initialized() else None
 
     def on_train_begin(self, *args, **kwargs):
         return args[0] if len(args) == 1 else (args or None)
@@ -236,6 +262,14 @@ class TelemetryCallback:
         """Pass-through hook so the callback can ride the same list as
         :class:`MetricAverageCallback`."""
         return logs
+
+    def on_train_end(self, *args, **kwargs) -> None:
+        """Stand down the hang watchdog: after the last step, a long
+        eval/export phase with no step completions is legitimate, not a
+        hang (the watchdog is suspended, not dropped — a later
+        ``hvd.init`` or ``ensure_watchdog`` re-arms it)."""
+        from horovod_tpu.diagnostics import watchdog as _wd
+        _wd.suspend()
 
 
 class CheckpointCallback:

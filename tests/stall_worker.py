@@ -8,7 +8,16 @@ Straggler mode (HVD_TEST_STRAGGLER_SECS set): instead of the stall
 scenario, rank 1 deliberately sleeps before each submission and the
 coordinator's rank-attributed negotiation-wait report
 (``CoreBackend.stragglers`` → ``hvd_stragglers_json``) must name rank 1
-as the rank everyone waited on (docs/OBSERVABILITY.md).
+as the rank everyone waited up on (docs/OBSERVABILITY.md).
+
+Autopsy mode (HVD_TEST_AUTOPSY=1): the end-to-end hang-autopsy demo
+(docs/OBSERVABILITY.md "Flight recorder & hang autopsy") — both ranks
+run a telemetry-instrumented loop (arming the watchdog), rank 1 then
+silently stops submitting; with NO operator action rank 0's watchdog
+must write an autopsy bundle containing per-rank stacks, engine state
+naming the missing rank/tensor, a flight-recorder dump, peer evidence
+fetched over /debug/*, and a merged multi-rank Perfetto trace with
+correlated collective spans.
 """
 import os
 import sys
@@ -56,7 +65,99 @@ def straggle(be, rank):
     print(f"straggler worker {rank}: OK", flush=True)
 
 
+def autopsy():
+    """One stalled rank → rank 0 produces a self-contained autopsy."""
+    import json
+
+    import horovod_tpu as hvd
+    from horovod_tpu.train.callbacks import TelemetryCallback
+
+    hvd.init()
+    rank = hvd.rank()
+    tele = TelemetryCallback()  # arms the watchdog (env: 3s)
+    assert tele.watchdog is not None and tele.watchdog.armed
+
+    for i in range(3):  # healthy steps on every rank
+        tele.on_step_begin()
+        hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                      name=f"step.{i}")
+        tele.on_step_end()
+
+    bundle = os.environ["HVD_TPU_AUTOPSY_DIR"]
+    if rank == 1:
+        # silently stop submitting; stay alive so /debug/* answers and
+        # close the timeline shard so the merger sees a complete file
+        from horovod_tpu.common.basics import _state
+        _state.timeline.stop()
+        time.sleep(25)
+        print("autopsy worker 1: OK", flush=True)
+        os._exit(0)
+
+    # rank 0 enqueues a collective rank 1 never joins -> silent hang
+    tele.on_step_begin()
+    h = hvd.allreduce_async(np.ones(4, np.float32), op=hvd.Sum,
+                            name="step.hang")
+    try:
+        h.wait(20)
+        raise AssertionError("expected the collective to hang")
+    except TimeoutError:
+        pass
+
+    # the watchdog (3s) must have fired DURING the hang, no operator
+    # action — verify the bundle answers "which rank is stuck in what"
+    assert tele.watchdog.trigger_count >= 1, "watchdog never fired"
+    summary = json.load(open(os.path.join(bundle, "summary_rank0.json")))
+    suspects = summary["suspects"]
+    assert suspects, summary
+    assert suspects[0]["tensor"] == "step.hang", suspects
+    assert suspects[0]["missing_ranks"] == [1], suspects
+
+    stacks = open(os.path.join(bundle, "stacks_rank0.txt")).read()
+    assert "Thread" in stacks or "File" in stacks, stacks[:200]
+
+    flight = json.load(open(os.path.join(bundle, "flight_rank0.json")))
+    kinds = {(e["kind"], e.get("name")) for e in flight["events"]}
+    assert ("enqueue", "step.hang") in kinds, sorted(kinds)
+    assert ("watchdog_trigger", None) in kinds, sorted(kinds)
+
+    engine = json.load(open(os.path.join(bundle, "engine_rank0.json")))
+    pend = [p for d in engine["engine_state"]["domains"]
+            for p in d["pending"]]
+    assert any(p["name"] == "step.hang" and p["missing_ranks"] == [1]
+               for p in pend), pend
+    # satellite: the stall inspector surfaced as counters (warn time 1s)
+    assert engine["counters"]["stall_warnings"] >= 1, engine["counters"]
+    assert engine["counters"]["stalled_tensors"] >= 1, engine["counters"]
+
+    # peer evidence fetched from rank 1's /debug endpoints
+    peer = open(os.path.join(bundle, "peer_rank1_stacks.txt")).read()
+    assert "Thread" in peer or "File" in peer, peer[:200]
+    assert os.path.exists(os.path.join(bundle, "peer_rank1_flight.json"))
+    assert os.path.exists(os.path.join(bundle, "peer_rank1_engine.json"))
+
+    # merged multi-rank trace: valid chrome JSON, >=2 process tracks,
+    # the same collective span correlated across rank tracks
+    trace = json.load(open(os.path.join(bundle, "merged_trace.json")))
+    events = trace["traceEvents"]
+    span_pids = {}
+    for ev in events:
+        span = (ev.get("args") or {}).get("span")
+        if ev.get("ph") == "B" and span:
+            span_pids.setdefault(span, set()).add(ev["pid"])
+    pids = {ev["pid"] for ev in events if ev.get("ph") != "M"}
+    assert len(pids) >= 2, pids
+    correlated = [s for s, p in span_pids.items() if len(p) >= 2]
+    assert any(s.startswith("step.") for s in correlated), \
+        (sorted(span_pids), pids)
+
+    print("autopsy worker 0: OK", flush=True)
+    os._exit(0)  # skip atexit shutdown: rank 1 is gone, consensus can't
+
+
 def main():
+    if os.environ.get("HVD_TEST_AUTOPSY"):
+        autopsy()
+        return
     be = CoreBackend()
     rank = be.rank
     if os.environ.get("HVD_TEST_STRAGGLER_SECS"):

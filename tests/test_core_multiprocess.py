@@ -184,6 +184,28 @@ def _free_port_pair():
 
 
 @needs_core
+@pytest.mark.slow
+def test_hang_autopsy_names_stuck_rank(tmp_path):
+    """End-to-end hang autopsy (docs/OBSERVABILITY.md "Flight recorder &
+    hang autopsy"): a 2-process run where rank 1 silently stops
+    submitting must — without operator action — leave an autopsy
+    directory with per-rank stacks, engine state naming the missing
+    rank/tensor, a flight-recorder dump, peer evidence fetched over
+    /debug/*, and a merged multi-rank Perfetto trace whose collective
+    spans correlate across rank tracks.  Assertions live in
+    stall_worker.py autopsy mode."""
+    bundle = tmp_path / "autopsy"
+    _launch(2, {"HVD_TEST_AUTOPSY": "1",
+                "HVD_TPU_AUTOPSY_DIR": str(bundle),
+                "HVD_TPU_WATCHDOG_SECONDS": "3",
+                "HVD_TPU_METRICS_PORT": str(_free_port_pair()),
+                "HVD_TPU_TIMELINE": str(tmp_path / "tl.json"),
+                "HVD_TPU_TIMELINE_ALL_RANKS": "1",
+                "HOROVOD_STALL_CHECK_TIME_SECONDS": "1"},
+            timeout=120, worker=STALL_WORKER)
+
+
+@needs_core
 def test_metrics_exporter_live_scrape():
     """2-process live run with HVD_TPU_METRICS_PORT: each worker's
     ``/metrics`` serves Prometheus text with the engine cache-hit rate,
