@@ -85,6 +85,62 @@ def _git_commit() -> str:
         return ""
 
 
+def _clean_exit(code: int = 0) -> None:
+    """Finish the child with grace-then-escalate semantics (the self-exit
+    analog of TERM→wait→KILL, under an explicit deadline instead of a
+    load-sensitive fixed wait).  Everything that matters — the result
+    JSON on stdout, the phase file — is flushed HERE, so whatever
+    happens afterwards is teardown politeness, not data.
+
+    Two teardown failure modes under load used to flip a finished run
+    into a dirty one (the child_exits_cleanly flake): XLA:CPU teardown
+    CRASHES (glibc "double free" aborts — synchronous C aborts that no
+    Python-level signal handler can intercept) or WEDGES.  Off-TPU there
+    is no chip claim to release, so teardown buys nothing: hard-exit
+    immediately after the flush.  On TPU a dirty exit wedges the relay
+    lease for the NEXT run, so tear down politely — but under
+    ``HVD_BENCH_EXIT_GRACE_S`` (default 30s; 0 = no escalation), after
+    which a daemon timer hard-exits with the SAME status rather than
+    letting the parent's kill path classify a clean run as dirty.
+    (Limitation: a daemon Timer can fire during the atexit phase — where
+    the observed PJRT/relay wedges live — but not once interpreter
+    finalization has frozen daemon threads; a wedge that deep still
+    falls to the parent's TERM→wait→KILL.)"""
+    _flush_phase_file()
+    try:
+        sys.stdout.flush()
+        sys.stderr.flush()
+    except Exception:
+        pass
+    platform = ""
+    try:
+        import jax
+        platform = jax.default_backend()
+    except Exception:
+        pass
+    if platform != "tpu":
+        os._exit(code)
+    try:
+        grace = float(os.environ.get("HVD_BENCH_EXIT_GRACE_S", "30"))
+    except ValueError:
+        grace = 30.0
+    if grace > 0:
+        def _escalate():
+            _log(f"clean exit did not complete within {grace:.0f}s grace "
+                 "(wedged teardown); hard-exiting with the same status")
+            try:
+                sys.stdout.flush()
+                sys.stderr.flush()
+            except Exception:
+                pass
+            os._exit(code)
+
+        t = threading.Timer(grace, _escalate)
+        t.daemon = True
+        t.start()
+    sys.exit(code)
+
+
 def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
@@ -157,8 +213,17 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
     window, so a run killed by an external deadline still carries a real
     measured number (round-3 failure mode: cold compile through the relay
     out-waited the driver and the round shipped value=null).
+
+    ``HVD_BENCH_ITERS`` overrides the final timing window's step count —
+    contract tests on CPU shrink it (they assert the artifact schema, not
+    timing precision); leave it unset for real measurements.
     """
     import jax
+
+    try:
+        iters = int(os.environ.get("HVD_BENCH_ITERS", "") or iters)
+    except ValueError:
+        pass
 
     def emit(value, dt_window, n_iters, provisional, flops_per_device,
              flops_src, compile_s):
@@ -201,11 +266,23 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
     compile_s = _end_phase("compile", t_c0)
     _log(f"first step (compile+run) took {compile_s:.1f}s; warmup window...")
 
+    # XLA:CPU on a starved host (the 8-virtual-device test mesh on one
+    # core) crashes/deadlocks when multi-device executions pile up
+    # un-synced — with a WARM compile cache the dispatch is fast enough
+    # to pile them reliably (the child_exits_cleanly "under load" flake:
+    # heap corruption surfacing as mid-run SIGSEGV or a teardown
+    # "double free" abort).  A per-step host sync serializes the queue;
+    # CPU numbers are smoke, not perf, so the sync costs nothing real.
+    # TPU keeps the async chain (queue depth IS the perf being measured).
+    sync_every_step = jax.default_backend() == "cpu"
+
     # measured warmup window -> provisional result (analytic FLOPs: cheap)
     warmup_iters = 2
     t_w0 = _begin_phase("warmup")
     for _ in range(warmup_iters):
         state, loss = step_fn(state)
+        if sync_every_step:
+            readback(loss)
     readback(loss)
     dt_w = _end_phase("warmup", t_w0)
     emit(per_step_units * warmup_iters / dt_w / n_chips, dt_w, warmup_iters,
@@ -227,7 +304,7 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
         _log(f"skipping final window (predicted {est_final_s:.0f}s would "
              "cross the attempt deadline); provisional already emitted, "
              "exiting cleanly")
-        sys.exit(0)
+        _clean_exit(0)
 
     # --trace-dir / HVD_BENCH_TRACE_DIR: per-rank timeline shard over
     # the measured phase, merged into the artifact dir afterwards so a
@@ -238,6 +315,8 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
         if tracer is not None:
             tracer.collective_begin("measure_step", "step", f"step#{i+1}")
         state, loss = step_fn(state)
+        if sync_every_step:
+            readback(loss)
         if tracer is not None:
             tracer.collective_end("measure_step", f"step#{i+1}")
     readback(loss)  # forces completion of the whole chain
@@ -699,8 +778,23 @@ def _enable_compile_cache() -> None:
     retries and successive driver rounds compile warm. A cold ResNet-50
     compile through the relay can exceed the driver's deadline; with the
     cache populated it is seconds. Harmless no-op if the backend doesn't
-    support the cache."""
+    support the cache.
+
+    CPU children skip it: executing a warm-cache (deserialized) program
+    on the 8-virtual-device XLA:CPU test mesh intermittently corrupts
+    the heap (mid-run SIGSEGV or a teardown "double free" abort — the
+    child_exits_cleanly flake; conftest.py records the same
+    cache-on-only crash signature for the test suite), and a CPU
+    child's compile is seconds anyway."""
     import jax
+    # platform read from config, NOT default_backend(): backend init
+    # must stay inside the attributable device_init phase (and on TPU
+    # it claims the chips — minutes through a busy relay)
+    platforms = str(getattr(jax.config, "jax_platforms", "") or "")
+    if platforms.split(",")[0].strip() == "cpu":
+        _log("persistent compile cache skipped on CPU (warm-cache "
+             "XLA:CPU executions are unstable on the virtual test mesh)")
+        return
     cache_dir = os.environ.get(
         "HVD_BENCH_CACHE_DIR",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -747,11 +841,19 @@ def _child() -> None:
     elif which in ("resnet50", "resnet101", "vgg16", "inception3"):
         _child_cnn(which)
     else:
-        # rc 2 = deterministic config error; the parent fails fast
-        # instead of retrying
-        _log(f"unknown HVD_BENCH_MODEL={which!r}; expected "
-             "resnet50|resnet50_bare|resnet101|vgg16|inception3|bert|gpt")
-        sys.exit(2)
+        _no_such_model(which)
+    # result line is on stdout; don't let a wedged or crashing
+    # interpreter teardown turn this clean run into a parent TERM->KILL
+    # (and a wedged relay lease for the NEXT run)
+    _clean_exit(0)
+
+
+def _no_such_model(which: str) -> None:
+    # rc 2 = deterministic config error; the parent fails fast
+    # instead of retrying
+    _log(f"unknown HVD_BENCH_MODEL={which!r}; expected "
+         "resnet50|resnet50_bare|resnet101|vgg16|inception3|bert|gpt")
+    sys.exit(2)
 
 
 # Latest per-phase timing record recovered from a child (via its

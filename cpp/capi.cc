@@ -100,6 +100,12 @@ CoreConfig ParseEnvConfig() {
   cfg.straggler_report_secs =
       atof(EnvOr("HVD_TPU_STRAGGLER_REPORT_SECONDS",
                  "HOROVOD_STRAGGLER_REPORT_SECONDS", "0"));
+  // inactivity deadline on transport receives (0 = wait forever); a
+  // wedged peer then fails collectives -> HorovodInternalError -> the
+  // elastic reset path, instead of hanging the job (docs/CHAOS.md)
+  cfg.transport_timeout_secs =
+      atof(EnvOr("HVD_TPU_TRANSPORT_TIMEOUT_S",
+                 "HOROVOD_TRANSPORT_TIMEOUT_S", "0"));
   return cfg;
 }
 
@@ -129,6 +135,7 @@ const char* hvd_cfg_dump() {
      << "\nautotune_max_samples=" << c.autotune_max_samples
      << "\nautotune_gp_noise=" << c.autotune_gp_noise
      << "\nrendezvous_timeout_secs=" << c.rendezvous_timeout_secs
+     << "\ntransport_timeout_s=" << c.transport_timeout_secs
      << "\nthread_affinity=" << c.thread_affinity
      << "\ntimeline=" << c.timeline_path
      << "\ntimeline_mark_cycles=" << (c.timeline_mark_cycles ? 1 : 0)
@@ -287,7 +294,9 @@ const char* hvd_counters_json() {
      << ",\"hier_allreduces\":" << c.hier_allreduces.load()
      << ",\"hier_allgathers\":" << c.hier_allgathers.load()
      << ",\"stall_warnings\":" << c.stall_warnings.load()
-     << ",\"stalled_tensors\":" << c.stalled_tensors.load() << "}";
+     << ",\"stalled_tensors\":" << c.stalled_tensors.load()
+     << ",\"transport_chaos_injected\":"
+     << c.transport_chaos_injected.load() << "}";
   g_counters_json = os.str();
   return g_counters_json.c_str();
 }

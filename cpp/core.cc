@@ -335,10 +335,24 @@ void Core::PushToDomain(int domain, TensorTableEntry e, Request r) {
     timeline_->NoteEnqueue(e.name);
   if (loop_done_.load()) {
     if (e.callback)
-      e.callback(Status::Aborted("hvdcore background loop is not running"));
+      e.callback(Status::Aborted(
+          loop_error_.empty()
+              ? "hvdcore background loop is not running"
+              : "hvdcore background loop is not running: " + loop_error_));
     return;
   }
   std::lock_guard<std::mutex> lk(domains_mu_);
+  // re-check under the same lock the dying loop's finalize pass takes:
+  // an entry pushed after that pass would otherwise never resolve (its
+  // waiter would hang — exactly the failure mode this PR hunts)
+  if (loop_done_.load()) {
+    if (e.callback)
+      e.callback(Status::Aborted(
+          loop_error_.empty()
+              ? "hvdcore background loop is not running"
+              : "hvdcore background loop is not running: " + loop_error_));
+    return;
+  }
   auto it = domains_.find(domain);
   if (it == domains_.end()) {
     if (e.callback)
@@ -374,6 +388,7 @@ void Core::KickCycle() {
 Status Core::Init(const CoreConfig& cfg) {
   if (initialized_) return Status::OK();
   cfg_ = cfg;
+  loop_error_.clear();  // a prior generation's exit cause is not ours
   LogRank() = cfg.rank;  // stamp every later log line with our rank
   HVD_LOG(Info) << "core init: size=" << cfg.size << " coordinator="
                 << cfg.coord_addr << ":" << cfg.coord_port
@@ -381,7 +396,8 @@ Status Core::Init(const CoreConfig& cfg) {
                 << "B cycle=" << cfg.cycle_time_ms << "ms";
   transport_.reset(
       new Transport(cfg.rank, cfg.size, cfg.coord_addr, cfg.coord_port,
-                    cfg.rendezvous_timeout_secs));
+                    cfg.rendezvous_timeout_secs,
+                    cfg.transport_timeout_secs));
   auto st = transport_->Init();
   if (!st.ok()) return st;
   timeline_.reset(new Timeline(cfg.rank, cfg.timeline_path,
@@ -778,15 +794,22 @@ void Core::Loop() {
         [this] { return cycle_kick_; });
     cycle_kick_ = false;
   }
+  if (transport_)
+    counters_.transport_chaos_injected.store(
+        transport_->chaos_injected(), std::memory_order_relaxed);
   loop_done_ = true;
   // Abnormal exits (peer death mid-collective) leave waiters pending —
   // finalize them with the real error instead of letting them time out
   // (reference: operations.cc finalizes the tensor queue at shutdown).
+  std::string why = loop_error_.empty()
+      ? "hvdcore background loop terminated (peer failure or shutdown)"
+      : "hvdcore background loop terminated: " + loop_error_;
+  if (!loop_error_.empty()) {
+    HVD_LOG(Error) << "background loop exiting: " << loop_error_;
+  }
   std::lock_guard<std::mutex> lk(domains_mu_);
   for (auto& kv : domains_)
-    kv.second->queue.FinalizeAllWithError(
-        Status::Aborted("hvdcore background loop terminated "
-                        "(peer failure or shutdown)"));
+    kv.second->queue.FinalizeAllWithError(Status::Aborted(why));
 }
 
 namespace {
@@ -1137,6 +1160,12 @@ void Core::ApplyDomainLifecycle(const std::vector<int32_t>& activate,
 }
 
 bool Core::RunOnce() {
+  // mirror the transport's chaos-injection count into the long-lived
+  // Counters struct: only the loop thread may touch transport_ (the
+  // metrics scraper reads counters_ concurrently with elastic re-init)
+  if (transport_)
+    counters_.transport_chaos_injected.store(
+        transport_->chaos_injected(), std::memory_order_relaxed);
   bool want_shutdown = shutdown_requested_.load();
   counters_.cycles++;
   if (timeline_ && timeline_->enabled() && timeline_->mark_cycles())
@@ -1249,7 +1278,7 @@ bool Core::RunOnce() {
         std::vector<uint8_t> buf;
         auto st = transport_->Recv(d->group.global(i),
                                    DomTag(id, kTagNegotiate), &buf);
-        if (!st.ok()) return false;
+        if (!st.ok()) { loop_error_ = st.reason; return false; }
         bool sd;
         std::vector<int32_t> bits;
         std::vector<wire::DomainAnnounce> ann;
@@ -1358,7 +1387,7 @@ bool Core::RunOnce() {
         auto st = transport_->Send(d->group.global(i),
                                    DomTag(id, kTagResponse), payload.data(),
                                    payload.size());
-        if (!st.ok()) return false;
+        if (!st.ok()) { loop_error_ = st.reason; return false; }
       }
       if (id == 0) ApplyDomainLifecycle(activate, retired);
       if (id == 0 && has_pending_knobs_) {
@@ -1386,10 +1415,10 @@ bool Core::RunOnce() {
           id == 0 ? my_retire : std::vector<int32_t>{});
       auto st = transport_->Send(coord, DomTag(id, kTagNegotiate),
                                  payload.data(), payload.size());
-      if (!st.ok()) return false;
+      if (!st.ok()) { loop_error_ = st.reason; return false; }
       std::vector<uint8_t> buf;
       st = transport_->Recv(coord, DomTag(id, kTagResponse), &buf);
-      if (!st.ok()) return false;
+      if (!st.ok()) { loop_error_ = st.reason; return false; }
       int64_t coord_threshold = cfg_.fusion_threshold;
       std::vector<int32_t> activate, retired;
       uint8_t knobs = KnobFlags();

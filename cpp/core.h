@@ -188,6 +188,11 @@ struct CoreConfig {
   double autotune_window_secs = 2.0;   // scoring window per sample
   std::string autotune_log;            // AUTOTUNE_LOG sample trace file
   double rendezvous_timeout_secs = 30.0;  // GLOO_TIMEOUT_SECONDS analog
+  // > 0: inactivity deadline on transport receives — a dead-but-connected
+  // peer surfaces as a collective error (-> HorovodInternalError, feeding
+  // elastic recovery) instead of an infinite recv
+  // (HVD_TPU_TRANSPORT_TIMEOUT_S; docs/CHAOS.md)
+  double transport_timeout_secs = 0.0;
   // > 0: the coordinator logs a rank-attributed negotiation-wait summary
   // every this many seconds (HVD_TPU_STRAGGLER_REPORT_SECONDS); the
   // snapshot is queryable via hvd_stragglers_json either way
@@ -308,6 +313,11 @@ class Core {
     // of tensors past the warning threshold (a gauge, not a counter)
     std::atomic<uint64_t> stall_warnings{0};
     std::atomic<int64_t> stalled_tensors{0};
+    // chaos-harness transport injections (docs/CHAOS.md), MIRRORED here
+    // by the loop thread from the Transport's own counter: the metrics
+    // scrape thread must never dereference transport_ (an elastic
+    // re-init resets that pointer under it)
+    std::atomic<uint64_t> transport_chaos_injected{0};
   };
   const Counters& counters() const { return counters_; }
 
@@ -386,6 +396,11 @@ class Core {
   std::atomic<bool> initialized_{false};
   std::atomic<bool> shutdown_requested_{false};
   std::atomic<bool> loop_done_{false};
+  // the transport error that killed the background loop (loop-thread
+  // writes before exiting, loop thread reads in its own epilogue) —
+  // finalized waiters then carry the REAL cause ("transport timeout: no
+  // data from peer 1 ...") instead of a generic abort
+  std::string loop_error_;
   // wake-on-enqueue: the loop sleeps cycle_time_ms between lockstep
   // rounds, but a freshly enqueued collective (or shutdown vote) kicks it
   // awake so single eager ops don't pay the idle-poll latency. SPMD ranks

@@ -1,4 +1,5 @@
 #include "transport.h"
+#include "logging.h"
 #include "wire.h"
 
 #include <arpa/inet.h>
@@ -9,12 +10,77 @@
 #include <unistd.h>
 
 #include <cerrno>
+#include <chrono>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 
 namespace hvd {
 
 namespace {
+
+// Parse the HVD_TPU_CHAOS_TRANSPORT spec (see TransportChaos in
+// transport.h).  Malformed entries are skipped with a log line — a typo
+// in a chaos spec must degrade to "fault not armed", never crash the job
+// it was meant to test.
+std::unique_ptr<TransportChaos> ParseChaosEnv(int size) {
+  const char* env = getenv("HVD_TPU_CHAOS_TRANSPORT");
+  if (env == nullptr || env[0] == '\0') return nullptr;
+  auto chaos = std::unique_ptr<TransportChaos>(new TransportChaos(size));
+  std::string spec(env);
+  size_t pos = 0;
+  while (pos <= spec.size()) {
+    size_t end = spec.find(';', pos);
+    if (end == std::string::npos) end = spec.size();
+    std::string entry = spec.substr(pos, end - pos);
+    pos = end + 1;
+    if (entry.empty()) continue;
+    TransportChaosRule rule;
+    bool ok = !entry.empty(), have_dir = false, have_kind = false;
+    size_t fpos = 0;
+    while (fpos <= entry.size()) {
+      size_t fend = entry.find(':', fpos);
+      if (fend == std::string::npos) fend = entry.size();
+      std::string field = entry.substr(fpos, fend - fpos);
+      fpos = fend + 1;
+      size_t eq = field.find('=');
+      if (eq == std::string::npos) { ok = false; break; }
+      std::string k = field.substr(0, eq), v = field.substr(eq + 1);
+      if (k == "dir") {
+        have_dir = true;
+        if (v == "recv") rule.recv = true;
+        else if (v == "send") rule.recv = false;
+        else ok = false;
+      } else if (k == "kind") {
+        have_kind = true;
+        if (v == "delay") rule.kind = 0;
+        else if (v == "drop") rule.kind = 1;
+        else if (v == "close") rule.kind = 2;
+        else ok = false;
+      } else if (k == "peer") {
+        rule.peer = (v == "*") ? -1 : atoi(v.c_str());
+      } else if (k == "after") {
+        rule.after = strtoull(v.c_str(), nullptr, 10);
+      } else if (k == "count") {
+        rule.count = strtoull(v.c_str(), nullptr, 10);
+      } else if (k == "ms") {
+        rule.ms = atof(v.c_str());
+      } else {
+        ok = false;
+      }
+    }
+    if (ok && have_dir && have_kind) {
+      chaos->rules.push_back(rule);
+    } else {
+      HVD_LOG(Warning) << "chaos: ignoring malformed transport rule '"
+                       << entry << "'";
+    }
+  }
+  if (chaos->rules.empty()) return nullptr;
+  HVD_LOG(Warning) << "chaos: transport faults armed ("
+                   << chaos->rules.size() << " rule(s): " << spec << ")";
+  return chaos;
+}
 
 Status WriteAll(int fd, const void* data, size_t len) {
   const uint8_t* p = (const uint8_t*)data;
@@ -31,7 +97,17 @@ Status WriteAll(int fd, const void* data, size_t len) {
   return Status::OK();
 }
 
-Status ReadAll(int fd, void* data, size_t len) {
+int64_t NowNs() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// `activity` (optional) is stamped after every successful chunk, so a
+// peer slowly streaming one large frame keeps registering as alive for
+// the recv inactivity deadline.
+Status ReadAll(int fd, void* data, size_t len,
+               std::atomic<int64_t>* activity = nullptr) {
   uint8_t* p = (uint8_t*)data;
   while (len > 0) {
     ssize_t n = ::recv(fd, p, len, 0);
@@ -39,6 +115,7 @@ Status ReadAll(int fd, void* data, size_t len) {
       if (n < 0 && errno == EINTR) continue;
       return Status::Error("socket recv failed/closed");
     }
+    if (activity) activity->store(NowNs());
     p += n;
     len -= n;
   }
@@ -76,15 +153,51 @@ void SetNoDelay(int fd) {
 }  // namespace
 
 Transport::Transport(int rank, int size, const std::string& coord_addr,
-                     int coord_port, double connect_timeout_secs)
+                     int coord_port, double connect_timeout_secs,
+                     double recv_timeout_secs)
     : rank_(rank), size_(size), coord_addr_(coord_addr),
       coord_port_(coord_port),
-      connect_timeout_secs_(connect_timeout_secs) {
+      connect_timeout_secs_(connect_timeout_secs),
+      recv_timeout_secs_(recv_timeout_secs),
+      chaos_(ParseChaosEnv(size)), last_rx_ns_(size) {
+  for (int i = 0; i < size; ++i) last_rx_ns_[i] = 0;
   peer_fds_.assign(size, -1);
   inbox_.resize(size);
   dead_.assign(size, false);
   for (int i = 0; i < size; ++i)
     send_mu_.emplace_back(new std::mutex());
+}
+
+bool Transport::ChaosOnFrame(bool recv, int peer) {
+  // chaos_ checked by the caller; frame indices count per peer per
+  // direction so `after` means "the Nth frame exchanged with THAT peer"
+  uint64_t seq = recv ? chaos_->recv_seen[peer].fetch_add(1)
+                      : chaos_->send_seen[peer].fetch_add(1);
+  bool drop = false;
+  for (const auto& r : chaos_->rules) {
+    if (r.recv != recv) continue;
+    if (r.peer != -1 && r.peer != peer) continue;
+    if (seq < r.after) continue;
+    if (r.count != 0 && seq >= r.after + r.count) continue;
+    chaos_->injected.fetch_add(1);
+    if (r.kind == 0) {  // delay
+      HVD_LOG(Warning) << "chaos: delaying " << (recv ? "recv" : "send")
+                       << " frame " << seq << " from/to peer " << peer
+                       << " by " << r.ms << "ms";
+      usleep((useconds_t)(r.ms * 1000.0));
+    } else if (r.kind == 1) {  // drop
+      HVD_LOG(Warning) << "chaos: dropping " << (recv ? "recv" : "send")
+                       << " frame " << seq << " (peer " << peer << ")";
+      drop = true;
+    } else {  // close: reset the peer's socket mid-stream
+      HVD_LOG(Warning) << "chaos: closing socket to peer " << peer
+                       << " at frame " << seq;
+      int fd = peer_fds_[peer];
+      if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+      drop = true;
+    }
+  }
+  return drop;
 }
 
 Transport::~Transport() { Shutdown(); }
@@ -246,9 +359,19 @@ void Transport::ReaderLoop(int peer) {
   int fd = peer_fds_[peer];
   for (;;) {
     int32_t hdr[2];  // tag, len
-    if (!ReadAll(fd, hdr, sizeof(hdr)).ok()) break;
+    int64_t before = last_rx_ns_[peer].load();
+    if (!ReadAll(fd, hdr, sizeof(hdr), &last_rx_ns_[peer]).ok()) break;
     std::vector<uint8_t> payload(hdr[1]);
-    if (hdr[1] > 0 && !ReadAll(fd, payload.data(), hdr[1]).ok()) break;
+    if (hdr[1] > 0 &&
+        !ReadAll(fd, payload.data(), hdr[1], &last_rx_ns_[peer]).ok())
+      break;
+    // chaos seam: zero-cost when off (one null test per frame)
+    if (chaos_ && ChaosOnFrame(/*recv=*/true, peer)) {
+      // an injected drop/close must look like SILENCE to the recv
+      // deadline — that is the wedged-peer scenario it simulates
+      last_rx_ns_[peer].store(before);
+      continue;
+    }
     auto q = GetQueue(peer, hdr[0]);
     {
       std::lock_guard<std::mutex> lk(q->mu);
@@ -280,6 +403,10 @@ Status Transport::Send(int peer, int32_t tag, const void* data, size_t len) {
     return Status::OK();
   }
   std::lock_guard<std::mutex> lk(*send_mu_[peer]);
+  // chaos seam: a dropped send is written NOWHERE — the peer starves,
+  // which is exactly the wedged-peer scenario the recv deadline catches
+  if (chaos_ && ChaosOnFrame(/*recv=*/false, peer))
+    return Status::OK();
   int fd = peer_fds_[peer];
   if (fd < 0) return Status::Error("no connection to peer");
   int32_t hdr[2] = {tag, (int32_t)len};
@@ -291,7 +418,33 @@ Status Transport::Send(int peer, int32_t tag, const void* data, size_t len) {
 Status Transport::Recv(int peer, int32_t tag, std::vector<uint8_t>* out) {
   auto q = GetQueue(peer, tag);
   std::unique_lock<std::mutex> lk(q->mu);
-  q->cv.wait(lk, [&] { return !q->q.empty() || q->closed; });
+  if (recv_timeout_secs_ > 0) {
+    // inactivity deadline: the engine's lockstep cycle keeps frames
+    // flowing every few ms while peers are healthy, so a silent gap of
+    // this length means a dead-but-connected peer (SIGSTOP, wedged
+    // host, half-open TCP) — surface it instead of blocking forever.
+    // The clock is per-peer DELIVERED-byte activity (stamped chunk-wise
+    // by ReaderLoop), not this tag queue's emptiness: a healthy peer
+    // slowly streaming one large fused frame keeps resetting it.
+    const int64_t timeout_ns = (int64_t)(recv_timeout_secs_ * 1e9);
+    const int64_t waited_from = NowNs();
+    while (q->q.empty() && !q->closed) {
+      q->cv.wait_for(lk, std::chrono::milliseconds(200));
+      if (!q->q.empty() || q->closed) break;
+      int64_t base = waited_from;
+      if (peer != rank_) base = std::max(base, last_rx_ns_[peer].load());
+      if (NowNs() - base > timeout_ns) {
+        return Status::Error(
+            "transport timeout: no data from peer " +
+            std::to_string(peer) + " for " +
+            std::to_string(recv_timeout_secs_) +
+            "s (HVD_TPU_TRANSPORT_TIMEOUT_S); peer is wedged or "
+            "unreachable");
+      }
+    }
+  } else {
+    q->cv.wait(lk, [&] { return !q->q.empty() || q->closed; });
+  }
   if (q->q.empty())
     return Status::Aborted("connection closed");
   *out = std::move(q->q.front());

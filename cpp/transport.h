@@ -7,9 +7,11 @@
 // demultiplexed by (peer, tag) with per-tag blocking queues.
 #pragma once
 
+#include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <queue>
 #include <string>
@@ -25,13 +27,49 @@ struct Frame {
   std::vector<uint8_t> payload;
 };
 
+// Fault injection for the chaos harness (docs/CHAOS.md): parsed from
+// HVD_TPU_CHAOS_TRANSPORT ("dir=recv:kind=delay:peer=1:after=10:count=5:
+// ms=25;..." — compiled per rank by horovod_tpu/chaos from the JSON
+// fault plan).  Rules key on the per-peer per-direction frame index:
+// `delay` sleeps ms before handling the frame, `drop` discards it
+// (recv: never delivered; send: never written — the peer starves),
+// `close` shuts the peer socket down mid-stream.  When the env var is
+// absent the transport holds no chaos object and the hot path pays one
+// null-pointer test per frame.
+struct TransportChaosRule {
+  bool recv = true;       // direction this rule applies to
+  int kind = 0;           // 0 delay, 1 drop, 2 close
+  int peer = -1;          // -1 = any peer
+  uint64_t after = 0;     // first affected frame index (0-based)
+  uint64_t count = 0;     // frames affected; 0 = unlimited
+  double ms = 0.0;        // delay milliseconds
+};
+
+struct TransportChaos {
+  std::vector<TransportChaosRule> rules;
+  std::vector<std::atomic<uint64_t>> recv_seen, send_seen;  // per peer
+  std::atomic<uint64_t> injected{0};
+  explicit TransportChaos(int size)
+      : recv_seen(size), send_seen(size) {
+    for (int i = 0; i < size; ++i) {
+      recv_seen[i] = 0;
+      send_seen[i] = 0;
+    }
+  }
+};
+
 class Transport {
  public:
   // rank/size/coordinator address resolved from env by the caller.
   // connect_timeout_secs: how long rendezvous/mesh connects retry before
   // giving up (reference knob: HOROVOD_GLOO_TIMEOUT_SECONDS, default 30).
+  // recv_timeout_secs: inactivity deadline on Recv (0 = wait forever,
+  // the pre-hardening behavior) — a dead-but-connected peer (SIGSTOP,
+  // wedged host, chaos `drop`) then surfaces as a Status error instead
+  // of an infinite block (knob: HVD_TPU_TRANSPORT_TIMEOUT_S).
   Transport(int rank, int size, const std::string& coord_addr,
-            int coord_port, double connect_timeout_secs = 30.0);
+            int coord_port, double connect_timeout_secs = 30.0,
+            double recv_timeout_secs = 0.0);
   ~Transport();
 
   Status Init();            // rendezvous + full mesh
@@ -45,14 +83,30 @@ class Transport {
   Status Send(int peer, int32_t tag, const void* data, size_t len);
   Status Recv(int peer, int32_t tag, std::vector<uint8_t>* out);
 
+  // total chaos faults injected by this transport (0 when no spec armed)
+  uint64_t chaos_injected() const {
+    return chaos_ ? chaos_->injected.load() : 0;
+  }
+
  private:
   void ReaderLoop(int peer);
   Status ConnectTo(const std::string& host, int port, int* fd_out);
+  // returns true when the frame must be dropped; may sleep or shut the
+  // peer's socket down per the armed rules
+  bool ChaosOnFrame(bool recv, int peer);
 
   int rank_, size_;
   std::string coord_addr_;
   int coord_port_;
   double connect_timeout_secs_;
+  double recv_timeout_secs_;
+  std::unique_ptr<TransportChaos> chaos_;  // null = chaos off
+  // per-peer last-DELIVERED-byte stamp (steady ns), fed by ReaderLoop as
+  // payload bytes stream in: the recv deadline measures true peer
+  // inactivity, so a healthy peer slowly streaming one large fused frame
+  // can never trip it (a chaos drop/close rewinds the stamp — a dropped
+  // frame must look like silence, that is the scenario it simulates)
+  std::vector<std::atomic<int64_t>> last_rx_ns_;
   int listen_fd_ = -1;
   std::vector<int> peer_fds_;                 // index = peer rank
   std::vector<std::unique_ptr<std::mutex>> send_mu_;

@@ -105,6 +105,12 @@ class AsyncWriter:
                 job = self._jobs.popleft()
                 self._busy = True
             try:
+                # chaos seam (docs/CHAOS.md): an injected io_error raises
+                # here and surfaces through the normal async-error path
+                # (next submit/wait), a slow_fsync sleeps the writer —
+                # exactly where a real flaky/slow disk would bite
+                from horovod_tpu import chaos
+                chaos.fire("checkpoint.write")
                 job()
             except BaseException as e:  # held for the next submit/wait
                 with self._cond:

@@ -117,6 +117,14 @@ def init(ranks: Optional[Sequence[int]] = None,
         _state.cross_size = ident["cross_size"]
         _state.hostname = ident["hostname"] or os.uname().nodename
 
+        # Chaos harness (docs/CHAOS.md): arm the fault plan BEFORE the
+        # backend boots — transport.* rules compile into the env spec the
+        # C++ core reads at Transport::Init, and rank-scoped rules must
+        # track the rank an elastic re-mesh just handed us.  No plan set
+        # = everything stays disarmed (zero-cost seams).
+        from horovod_tpu import chaos as _chaos
+        _chaos.install(rank=ident["rank"])
+
         if ranks is not None and len(ranks) > 0:
             ranks = sorted(set(ranks))
             # Restrict the world to the given launched ranks (reference

@@ -208,6 +208,11 @@ class CoreBackend(Backend):
                  size: int = None, lib=None, owns_core: bool = None):
         self._lib = lib or _load_lib()
         if domain == 0:
+            # chaos harness: raw-core workers (no hvd.init) still honor
+            # HVD_TPU_FAULT_PLAN — the transport env spec must be
+            # compiled before the C++ core reads it at Transport::Init
+            from horovod_tpu import chaos
+            chaos.install()
             rc = self._lib.hvd_init()
             if rc != 0:
                 raise RuntimeError("hvdcore init failed: " +

@@ -164,15 +164,35 @@ def peer_debug_ports() -> Dict[int, tuple]:
 
 
 def _fetch(url: str) -> Optional[bytes]:
+    """Peer evidence fetch with backoff: a single transient connection
+    reset must not silently lose a rank's stacks/flight dump from the
+    bundle (the peer's exporter is a tiny threaded server that resets
+    connections under accept bursts — exactly what a multi-rank autopsy
+    causes)."""
+    from urllib.error import HTTPError
+
+    from horovod_tpu.common.retry import retry_call
     try:
-        return urlopen(url, timeout=_FETCH_TIMEOUT_S).read()
+        return retry_call(
+            lambda: urlopen(url, timeout=_FETCH_TIMEOUT_S).read(),
+            site="autopsy.peer_fetch",
+            retry_on=(OSError, TimeoutError),
+            # an HTTP status (404/500: version skew, endpoint disabled)
+            # will not heal with patience — and autopsy time is precious
+            give_up_on=(HTTPError,),
+            attempts=3, base_delay_s=0.2, max_delay_s=1.0,
+            deadline_s=2.0 * _FETCH_TIMEOUT_S)
     except Exception as e:
         get_logger().warning("autopsy: fetch %s failed: %r", url, e)
         return None
 
 
-def _collect_peers(bundle: str) -> List[int]:
-    fetched = []
+def _collect_peers(bundle: str) -> tuple:
+    """Returns ``(fetched, unreachable)`` rank lists; a peer is
+    unreachable when none of its /debug endpoints answered even with
+    retries — recorded in the summary so a bundle missing a rank's
+    evidence says so explicitly instead of looking complete."""
+    fetched, unreachable = [], []
     for r, (host, port) in sorted(peer_debug_ports().items()):
         base = f"http://{host}:{port}/debug"
         got_any = False
@@ -185,9 +205,8 @@ def _collect_peers(bundle: str) -> List[int]:
             with open(os.path.join(
                     bundle, f"peer_rank{r}_{kind}.{suffix}"), "wb") as f:
                 f.write(body)
-        if got_any:
-            fetched.append(r)
-    return fetched
+        (fetched if got_any else unreachable).append(r)
+    return fetched, unreachable
 
 
 def _merge_shards_into(bundle: str) -> Optional[str]:
@@ -247,8 +266,14 @@ def write_autopsy(out_dir: Optional[str] = None, reason: str = "",
     if fetch_peers is None:
         fetch_peers = rank == 0
     fetched: List[int] = []
+    unreachable: List[int] = []
     if fetch_peers:
-        fetched = step(lambda: _collect_peers(bundle)) or []
+        fetched, unreachable = step(
+            lambda: _collect_peers(bundle)) or ([], [])
+        if unreachable:
+            get_logger().warning(
+                "autopsy: peers %s unreachable after retries; their "
+                "evidence is missing from this bundle", unreachable)
 
     suspects = suspects_from_engine(engine)
     step(lambda: _write_json(
@@ -258,6 +283,7 @@ def write_autopsy(out_dir: Optional[str] = None, reason: str = "",
         "written_at": time.time(),
         "suspects": suspects,
         "peers_fetched": fetched,
+        "peers_unreachable": unreachable,
     }))
     if suspects:
         top = suspects[0]

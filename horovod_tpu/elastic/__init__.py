@@ -133,7 +133,8 @@ def _world_update(poll: bool = True) -> Optional[dict]:
         from horovod_tpu.runner.http_kv import kv_get
         # short timeout: commit() must stay cheap even if the driver's
         # port silently drops packets
-        raw = kv_get(addr, int(port), "world", "current", timeout=3.0)
+        raw = kv_get(addr, int(port), "world", "current", timeout=3.0,
+                     site="elastic.world_poll")
     except OSError:
         return None  # driver KV transiently unreachable: not our problem
     return _validate_doc(raw)
@@ -191,6 +192,11 @@ def _await_world_update(timeout_s: Optional[float] = None) -> Optional[dict]:
     exit and publishes the shrunken world within moments — the survivors
     wait here for it instead of dying for a generation restart."""
     import time
+    if not os.environ.get("HVD_ELASTIC_KV"):
+        # no driver manages this job: a recovery world can never arrive,
+        # and waiting out the full window would stall EVERY
+        # HorovodInternalError retry by 15s for nothing
+        return None
     if timeout_s is None:
         timeout_s = float(os.environ.get("HVD_ELASTIC_SHRINK_WAIT_S", "15"))
     deadline = time.time() + timeout_s
@@ -419,6 +425,23 @@ class ObjectState(State):
                                              store.latest_step() or 0)
                 except Exception:
                     pass
+
+    def flush(self) -> None:
+        """Drain pending DURABLE commits (they are async — the train loop
+        never blocks on disk), so a worker about to exit knows its last
+        commit actually landed.  A failed trailing commit is logged, not
+        raised: the pickle tier and host memory still hold the state, and
+        an exit path must not crash over a flaky shared filesystem."""
+        store = self._durable_store
+        if store is None:
+            return
+        try:
+            store.wait()
+        except Exception:
+            from horovod_tpu.common.logging import get_logger
+            get_logger().warning(
+                "flush: a trailing durable commit of state %r failed",
+                self._name, exc_info=True)
 
     def restore(self) -> None:
         for k, v in self._saved.items():

@@ -60,8 +60,13 @@ class WorkerNotificationListener:
         my_host = os.environ.get("HOROVOD_HOSTNAME") or socket.getfqdn()
         rank = os.environ.get("HOROVOD_RANK",
                               os.environ.get("HVD_TPU_RANK", "0"))
+        # site label: registration failures show up on /metrics as their
+        # own retry series, not blended into generic KV traffic — a
+        # worker whose registrations keep exhausting is a worker the
+        # driver will deem unrecoverable (docs/ELASTIC.md)
         kv_put(driver_addr, driver_port, "notify", rank,
-               f"{my_host}:{self.port}".encode(), timeout=5.0)
+               f"{my_host}:{self.port}".encode(), timeout=5.0,
+               site="elastic.notify.register")
 
     def stop(self) -> None:
         self._kv.stop()

@@ -177,7 +177,8 @@ class ElasticDriver:
 
         def push(host: str, port: int) -> None:
             try:
-                kv_put(host, port, "world", "current", body, timeout=5.0)
+                kv_put(host, port, "world", "current", body, timeout=5.0,
+                       site="elastic.world_push")
             except OSError as e:
                 get_logger().debug("world push to %s:%d failed: %s",
                                    host, port, e)

@@ -19,6 +19,8 @@ from typing import Dict, Optional, Tuple
 from urllib.request import Request, urlopen
 from urllib.error import HTTPError
 
+from horovod_tpu.common.retry import retry_call
+
 
 class _KVHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # silence
@@ -99,6 +101,15 @@ class KVStoreServer:
         self._httpd.shutdown()
         if self._thread:
             self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                # a wedged handler (slow client, injected fault) is
+                # outliving shutdown — the daemon thread won't block exit,
+                # but leaking it silently hides the wedge from operators
+                from horovod_tpu.common.logging import get_logger
+                get_logger().warning(
+                    "KVStoreServer.stop(): server thread still alive "
+                    "after 5s join; leaking a daemon thread (port %s)",
+                    self.port)
 
     # direct access for in-process use
     def put(self, scope: str, key: str, value: bytes) -> None:
@@ -118,37 +129,50 @@ class KVStoreServer:
             self._httpd.kv.pop(scope, None)
 
 
-def _with_retries(do, attempts: int = 4):
+def _with_retries(do, attempts: int = 4,
+                  deadline_s: Optional[float] = None,
+                  site: str = "http_kv"):
     """Transient-error shield: a busy single-core box can overflow the
     server's listen backlog under polling bursts, resetting connections
-    mid-handshake; retry with short backoff instead of failing a worker."""
+    mid-handshake; retry with jittered backoff instead of failing a
+    worker.  ``deadline_s`` caps TOTAL wall time (attempts + sleeps) so
+    the call's cost stays tied to the caller's intent instead of
+    ``attempts × per-attempt timeout``; ``site`` labels the per-call-site
+    retry metrics (``hvd_retry_*_total{site=...}``)."""
     import http.client
-    delay = 0.05
-    for i in range(attempts):
-        try:
-            return do()
-        except (ConnectionError, http.client.RemoteDisconnected,
-                TimeoutError, OSError) as e:
-            if isinstance(e, HTTPError) or i == attempts - 1:
-                raise
-            import time
-            time.sleep(delay)
-            delay *= 2
+    return retry_call(
+        do, site=site,
+        retry_on=(ConnectionError, http.client.RemoteDisconnected,
+                  TimeoutError, OSError),
+        give_up_on=(HTTPError,),
+        attempts=attempts, base_delay_s=0.05, backoff=2.0,
+        max_delay_s=2.0, jitter=0.25, deadline_s=deadline_s)
 
 
 def kv_put(addr: str, port: int, scope: str, key: str, value: bytes,
-           timeout: float = 30.0) -> None:
+           timeout: float = 30.0, site: str = "http_kv.put") -> None:
     req = Request(f"http://{addr}:{port}/{scope}/{key}", data=value,
                   method="PUT")
-    _with_retries(lambda: urlopen(req, timeout=timeout).read())
+
+    def do():
+        from horovod_tpu import chaos
+        chaos.fire("kv.request")
+        return urlopen(req, timeout=timeout).read()
+
+    _with_retries(do, deadline_s=2.0 * timeout, site=site)
 
 
 def kv_get(addr: str, port: int, scope: str, key: str,
-           timeout: float = 30.0) -> Optional[bytes]:
+           timeout: float = 30.0, site: str = "http_kv.get"
+           ) -> Optional[bytes]:
+    def do():
+        from horovod_tpu import chaos
+        chaos.fire("kv.request")
+        return urlopen(f"http://{addr}:{port}/{scope}/{key}",
+                       timeout=timeout).read()
+
     try:
-        return _with_retries(
-            lambda: urlopen(f"http://{addr}:{port}/{scope}/{key}",
-                            timeout=timeout).read())
+        return _with_retries(do, deadline_s=2.0 * timeout, site=site)
     except HTTPError as e:
         if e.code == 404:
             return None

@@ -40,21 +40,41 @@ def _endpoint(endpoint: Optional[str]) -> str:
 
 
 def metadata_get(attribute: str, endpoint: Optional[str] = None,
-                 timeout: float = 5.0) -> str:
+                 timeout: float = 5.0, attempts: int = 3) -> str:
     """Fetch one instance attribute; raises ``OSError`` when not on a TPU
-    VM (no metadata server) or the attribute is absent."""
+    VM (no metadata server) or the attribute is absent.
+
+    Transient failures (connection resets from a briefly-restarting
+    metadata server) are retried up to ``attempts`` times under a total
+    deadline of ``attempts * timeout`` (:mod:`horovod_tpu.common.retry`);
+    an HTTP error (absent attribute) or a non-HTTP answerer (captive
+    portal) gives up immediately — patience will not change those."""
     import http.client
+
+    from horovod_tpu.common.retry import retry_call
+
     req = urllib.request.Request(
         _endpoint(endpoint) + _ATTR_BASE + attribute,
         headers={"Metadata-Flavor": "Google"})
-    try:
+
+    def do():
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.read().decode().strip()
+
+    try:
+        return retry_call(
+            do, site="tpu_discovery",
+            retry_on=(urllib.error.URLError, TimeoutError, OSError),
+            # absent attribute (HTTPError) and non-HTTP answerers —
+            # captive portals raising BadStatusLine/UnicodeDecodeError —
+            # are permanent for this probe: fail immediately, as before
+            give_up_on=(urllib.error.HTTPError,
+                        http.client.HTTPException, UnicodeDecodeError),
+            attempts=attempts, base_delay_s=0.1, max_delay_s=1.0,
+            deadline_s=attempts * timeout)
     except (urllib.error.URLError, urllib.error.HTTPError,
             http.client.HTTPException, UnicodeDecodeError, OSError) as e:
-        # non-HTTP services answering the probe (captive portals, proxies)
-        # raise BadStatusLine/UnicodeDecodeError — the contract stays
-        # "OSError when not on a TPU VM"
+        # the contract stays "OSError when not on a TPU VM"
         raise OSError(f"metadata attribute {attribute!r} unavailable: {e}") \
             from e
 
@@ -90,7 +110,10 @@ def running_on_tpu_vm(endpoint: Optional[str] = None,
                       timeout: float = 1.0) -> bool:
     """Cheap probe: is the TPU metadata surface reachable from here?"""
     try:
-        metadata_get("worker-network-endpoints", endpoint, timeout=timeout)
+        # attempts=1: the probe's point is to be cheap off-TPU, where
+        # every attempt burns the full connect timeout
+        metadata_get("worker-network-endpoints", endpoint, timeout=timeout,
+                     attempts=1)
         return True
     except OSError:
         return False

@@ -233,6 +233,10 @@ class TelemetryCallback:
         return args[0] if len(args) == 1 else (args or None)
 
     def on_step_begin(self) -> None:
+        # chaos `step` seam (docs/CHAOS.md): rank kill/stall schedules
+        # key on the step counter; dead when no fault plan is armed
+        from horovod_tpu import chaos
+        chaos.step_tick(self._steps)
         self.timer.start_step()
 
     def on_step_end(self, units: Optional[float] = None) -> None:

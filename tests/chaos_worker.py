@@ -1,0 +1,65 @@
+"""Chaos multiprocess worker: the transport-stall hardening demo.
+
+Scenario (docs/CHAOS.md "Reproducing a CI chaos failure"): the fault plan
+arms a ``transport.recv`` DROP on rank 0 for everything rank 1 sends
+after frame N — the wire-level equivalent of a peer that is alive and
+connected but wedged (SIGSTOP, dead NIC queue, half-open TCP).  Before
+this PR's transport inactivity deadline, rank 0's coordinator Recv would
+block forever and the job hung silently; with
+``HVD_TPU_TRANSPORT_TIMEOUT_S`` set, the blocked Recv errors out, the
+engine finalizes every waiter, and BOTH ranks surface
+``HorovodInternalError`` (the elastic reset trigger) within the deadline.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from horovod_tpu.core.core_backend import CoreBackend  # noqa: E402
+from horovod_tpu.ops.reduce_op import ReduceOp  # noqa: E402
+
+
+def main():
+    timeout_s = float(os.environ["HVD_TPU_TRANSPORT_TIMEOUT_S"])
+    be = CoreBackend()
+    rank = be.rank
+
+    # healthy phase: the drop window starts at frame 200, far past this
+    out = be.allreduce_async("warm", np.ones(4, np.float32),
+                             ReduceOp.SUM).wait(60)
+    np.testing.assert_allclose(out, 2.0)
+
+    # idle cycles stream frames at ~1ms cadence; ride past the window
+    # start so the next collective hits a fully-armed drop
+    time.sleep(1.0)
+
+    t0 = time.monotonic()
+    h = be.allreduce_async("stalled", np.ones(4, np.float32), ReduceOp.SUM)
+    from horovod_tpu.elastic import HorovodInternalError
+    try:
+        h.wait(10 * timeout_s)
+        raise AssertionError("expected the stalled collective to error")
+    except HorovodInternalError as e:
+        elapsed = time.monotonic() - t0
+        # the deadline, not the 10x wait budget, must have fired; slack
+        # covers a loaded box, not another timeout
+        assert elapsed < 4 * timeout_s, (elapsed, timeout_s)
+        msg = str(e)
+        if rank == 0:
+            # rank 0's Recv hit the deadline directly: the error must
+            # name the real cause, not a generic abort
+            assert "transport timeout" in msg, msg
+            c = be.counters()
+            assert c.get("transport_chaos_injected", 0) > 0, c
+
+    print(f"chaos worker {rank}: OK", flush=True)
+    # rank 1's engine died from the coordinator vanishing; negotiated
+    # shutdown consensus can't complete — exit hard like the autopsy demo
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,7 @@ QUANTIZED_WORKER = os.path.join(os.path.dirname(__file__),
                                 "quantized_worker.py")
 CHECKPOINT_WORKER = os.path.join(os.path.dirname(__file__),
                                  "checkpoint_worker.py")
+CHAOS_WORKER = os.path.join(os.path.dirname(__file__), "chaos_worker.py")
 
 
 def _free_port():
@@ -203,6 +204,25 @@ def test_hang_autopsy_names_stuck_rank(tmp_path):
                 "HVD_TPU_TIMELINE_ALL_RANKS": "1",
                 "HOROVOD_STALL_CHECK_TIME_SECONDS": "1"},
             timeout=120, worker=STALL_WORKER)
+
+
+@needs_core
+@pytest.mark.slow
+def test_transport_stall_surfaces_timeout():
+    """Chaos transport fault + inactivity deadline (docs/CHAOS.md): a
+    fault plan makes rank 0 DROP every frame it receives from rank 1
+    after frame 200 — the alive-but-wedged peer — and with
+    HVD_TPU_TRANSPORT_TIMEOUT_S set both ranks must surface
+    HorovodInternalError (naming the transport timeout on the rank whose
+    Recv starved) within the deadline instead of hanging forever.
+    Assertions live in chaos_worker.py."""
+    import json
+    plan = json.dumps({"faults": [
+        {"seam": "transport.recv", "kind": "drop", "rank": 0, "peer": 1,
+         "start": 200}]})
+    _launch(2, {"HVD_TPU_FAULT_PLAN": plan,
+                "HVD_TPU_TRANSPORT_TIMEOUT_S": "3"},
+            timeout=180, worker=CHAOS_WORKER)
 
 
 @needs_core

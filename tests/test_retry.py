@@ -1,0 +1,193 @@
+"""Unit battery for the shared retry policy engine
+(:mod:`horovod_tpu.common.retry`): backoff/jitter bounds, total-deadline
+budget, exception filtering, and per-call-site metrics emission."""
+
+import random
+
+import pytest
+
+from horovod_tpu.common.retry import retry_call
+from horovod_tpu.metrics.registry import default_registry
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+def test_success_first_try_no_sleep():
+    sleeps = []
+    assert retry_call(lambda: 42, site="t.first", sleep=sleeps.append) == 42
+    assert sleeps == []
+
+
+def test_retries_then_succeeds():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionResetError("boom")
+        return "ok"
+
+    sleeps = []
+    out = retry_call(flaky, site="t.flaky", attempts=4,
+                     sleep=sleeps.append, jitter=0.0)
+    assert out == "ok"
+    assert calls["n"] == 3
+    assert len(sleeps) == 2  # two failures -> two backoffs
+
+
+def test_exhaustion_raises_last_error():
+    def always():
+        raise TimeoutError("still down")
+
+    with pytest.raises(TimeoutError):
+        retry_call(always, site="t.exhaust", attempts=3,
+                   retry_on=(TimeoutError,), sleep=lambda s: None)
+
+
+def test_backoff_is_exponential_and_capped():
+    sleeps = []
+
+    def always():
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        retry_call(always, site="t.backoff", attempts=5, base_delay_s=0.1,
+                   backoff=2.0, max_delay_s=0.35, jitter=0.0,
+                   sleep=sleeps.append)
+    # retries 0..3 sleep; the 5th (last) attempt raises without sleeping
+    assert sleeps == pytest.approx([0.1, 0.2, 0.35, 0.35])
+
+
+def test_jitter_bounds():
+    """Every jittered sleep stays within [delay*(1-j), delay*(1+j)]."""
+    sleeps = []
+
+    def always():
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        retry_call(always, site="t.jitter", attempts=50, base_delay_s=0.1,
+                   backoff=1.0, max_delay_s=0.1, jitter=0.5,
+                   rng=random.Random(7), sleep=sleeps.append)
+    assert len(sleeps) == 49
+    assert all(0.05 - 1e-9 <= s <= 0.15 + 1e-9 for s in sleeps)
+    # jitter actually varies (not a fixed multiplier)
+    assert max(sleeps) - min(sleeps) > 0.01
+
+
+def test_deadline_budget_stops_early():
+    """A total-deadline budget stops retrying long before the attempt
+    count would: 100 attempts with ~0.5s sleeps under a 1.2s budget."""
+    clk = FakeClock()
+    tries = {"n": 0}
+
+    def always():
+        tries["n"] += 1
+        clk.t += 0.1  # each attempt itself costs wall time
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_call(always, site="t.deadline", attempts=100,
+                   base_delay_s=0.5, backoff=1.0, max_delay_s=0.5,
+                   jitter=0.0, deadline_s=1.2, sleep=clk.sleep, clock=clk)
+    # attempt(0.1) + sleep(0.5) fits twice; the third attempt's sleep
+    # would cross the 1.2s budget -> give up
+    assert tries["n"] == 3
+    assert clk.t <= 1.5
+
+
+def test_deadline_never_starves_first_attempt():
+    # even with a 0 deadline the first call runs (and its error counts
+    # as exhaustion, not a crash in the budget math)
+    with pytest.raises(OSError):
+        retry_call(lambda: (_ for _ in ()).throw(OSError("x")),
+                   site="t.zero", deadline_s=0.0, sleep=lambda s: None)
+
+
+def test_non_retryable_propagates_immediately():
+    calls = {"n": 0}
+
+    def bad():
+        calls["n"] += 1
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        retry_call(bad, site="t.filter", retry_on=(OSError,),
+                   sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_give_up_on_beats_retry_on_subclassing():
+    """urllib's HTTPError subclasses OSError; give_up_on must win so a
+    404 is not retried four times."""
+    from urllib.error import HTTPError
+    calls = {"n": 0}
+
+    def not_found():
+        calls["n"] += 1
+        raise HTTPError("http://x", 404, "nf", {}, None)
+
+    with pytest.raises(HTTPError):
+        retry_call(not_found, site="t.giveup", retry_on=(OSError,),
+                   give_up_on=(HTTPError,), sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_metrics_emitted_per_site():
+    reg = default_registry()
+    site = "t.metrics.unique"
+    key_a = 'hvd_retry_attempts_total{site="%s"}' % site
+    key_e = 'hvd_retry_exhausted_total{site="%s"}' % site
+    before_a = reg.snapshot().get(key_a, {}).get("value", 0)
+    before_e = reg.snapshot().get(key_e, {}).get("value", 0)
+
+    def always():
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        retry_call(always, site=site, attempts=3, sleep=lambda s: None)
+    snap = reg.snapshot()
+    assert snap[key_a]["value"] == before_a + 3
+    assert snap[key_e]["value"] == before_e + 1
+
+    # a successful retry emits attempts but no exhaustion
+    calls = {"n": 0}
+
+    def once():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("x")
+        return 1
+
+    retry_call(once, site=site, attempts=3, sleep=lambda s: None)
+    snap = reg.snapshot()
+    assert snap[key_a]["value"] == before_a + 4
+    assert snap[key_e]["value"] == before_e + 1
+
+
+def test_attempts_must_be_positive():
+    with pytest.raises(ValueError):
+        retry_call(lambda: 1, site="t.bad", attempts=0)
+
+
+def test_single_attempt_is_a_plain_call_no_metrics():
+    """attempts=1 means no retry policy — a failing probe must not raise
+    false 'retry exhausted' alarms on /metrics (running_on_tpu_vm runs
+    off-TPU on every CI box)."""
+    reg = default_registry()
+    site = "t.single.unique"
+    with pytest.raises(OSError):
+        retry_call(lambda: (_ for _ in ()).throw(OSError("off-tpu")),
+                   site=site, attempts=1, sleep=lambda s: None)
+    snap = reg.snapshot()
+    assert ('hvd_retry_attempts_total{site="%s"}' % site) not in snap
+    assert ('hvd_retry_exhausted_total{site="%s"}' % site) not in snap
