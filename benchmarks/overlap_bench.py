@@ -1,0 +1,176 @@
+"""Overlap efficiency microbench: exposed-communication seconds per step.
+
+Measures the same data-parallel train step in three schedules on a
+multi-device mesh (8 virtual CPU devices by default — the test mesh; a
+real TPU slice when run there):
+
+* ``compute``    — collectives replaced by identity (``sync=False``):
+                   the pure-compute floor.
+* ``serialized`` — bucket count 1 and every reduction pinned onto the
+                   critical path before the next microbatch's backward
+                   (``overlap=False`` — the reduce-after-backward
+                   behavior the ISSUE calls the MFU blocker).
+* ``overlap``    — bucketed, software-pipelined reductions issued one
+                   iteration behind production (``overlap=True``).
+
+``exposed_comm = step_time(config) − step_time(compute)`` attributes the
+collective seconds that did NOT hide behind backward compute. The
+overlap schedule must keep exposed_comm strictly below the serialized
+schedule — that delta is the whole point of the engine
+(docs/PERF.md "Overlap & bucketing").
+
+Results land on the PR-1 metrics registry
+(``hvd_overlap_exposed_comm_seconds{config=...}``) and stdout carries
+one JSON doc. Run standalone::
+
+    python benchmarks/overlap_bench.py        # 8 virtual CPU devices
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+N_DEVICES = int(os.environ.get("HVD_OVERLAP_BENCH_DEVICES", "8"))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if __name__ == "__main__":  # force the virtual mesh before jax imports
+    sys.path.insert(0, REPO)
+    os.environ.setdefault(
+        "XLA_FLAGS", f"--xla_force_host_platform_device_count={N_DEVICES}")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def _build(mesh, axis_name, d_model, n_layers, n_micro, batch,
+           bucket_bytes, config, ring):
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.train.overlap import make_overlap_train_step
+
+    rng = np.random.RandomState(0)
+    params = {
+        f"w{i}": jnp.asarray(
+            rng.randn(d_model, d_model).astype(np.float32)
+            / np.sqrt(d_model))
+        for i in range(n_layers)
+    }
+
+    def loss_fn(p, xy):
+        x, y = xy
+        h = x
+        for i in range(n_layers):
+            h = jnp.tanh(h @ p[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    tx = optax.sgd(1e-3)
+    step = make_overlap_train_step(
+        loss_fn, tx, mesh, axis_name, n_micro=n_micro,
+        bucket_bytes=bucket_bytes, ring=ring,
+        overlap=(config == "overlap"), sync=(config != "compute"),
+        donate=False)
+    x = jnp.asarray(rng.randn(batch, d_model).astype(np.float32))
+    y = jnp.asarray(rng.randn(batch, d_model).astype(np.float32))
+    opt_state = tx.init(params)
+    return step, params, opt_state, (x, y)
+
+
+def _time_config(mesh, axis_name, config, *, d_model, n_layers, n_micro,
+                 batch, bucket_bytes, iters, ring) -> float:
+    import jax
+
+    step, params, opt_state, batch_xy = _build(
+        mesh, axis_name, d_model, n_layers, n_micro, batch,
+        # serialized = the bucketing-off baseline: ONE bucket
+        (1 << 62) if config == "serialized" else bucket_bytes,
+        config, ring and config == "overlap")
+    params, opt_state, loss = step(params, opt_state, batch_xy)  # compile
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, batch_xy)
+    jax.block_until_ready(loss)
+    jax.block_until_ready(params)
+    return (time.perf_counter() - t0) / iters
+
+
+def run_overlap_bench(mesh=None, axis_name: str = "dp", *,
+                      d_model: int = 256, n_layers: int = 12,
+                      n_micro: int = 4, batch_per_device: int = 4,
+                      bucket_bytes: int = 128 * 1024, iters: int = 10,
+                      ring: bool = False, repeats: int = 3) -> dict:
+    """Run all three schedules; returns the result doc (see module
+    docstring) and records the exposed-comm gauges. Best-of-``repeats``
+    per config so one scheduler hiccup on a loaded box doesn't invert
+    the comparison."""
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.metrics.registry import default_registry
+
+    if mesh is None:
+        mesh = hvd.build_mesh(dp=-1)
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    batch = batch_per_device * n_dev * n_micro
+
+    kw = dict(d_model=d_model, n_layers=n_layers, n_micro=n_micro,
+              batch=batch, bucket_bytes=bucket_bytes, iters=iters,
+              ring=ring)
+    times = {}
+    for config in ("compute", "serialized", "overlap"):
+        times[config] = min(
+            _time_config(mesh, axis_name, config, **kw)
+            for _ in range(max(1, repeats)))
+
+    reg = default_registry()
+    exposed = {}
+    for config in ("serialized", "overlap"):
+        exposed[config] = max(0.0, times[config] - times["compute"])
+        reg.gauge("hvd_overlap_exposed_comm_seconds",
+                  help="exposed collective seconds per step by schedule",
+                  labels={"config": config}).set(exposed[config])
+
+    # the ACTUAL plan (oversized leaves ride alone — ceil(bytes/budget)
+    # would overstate the bucket count for layer-sized leaves)
+    import jax
+    from horovod_tpu.train.buckets import plan_buckets
+    plan = plan_buckets(
+        [jax.ShapeDtypeStruct((d_model, d_model), "float32")
+         for _ in range(n_layers)], bucket_bytes)
+    grad_bytes = plan.total_bytes
+    n_buckets = plan.num_buckets
+    doc = {
+        "metric": "overlap_exposed_comm_seconds_per_step",
+        "n_devices": n_dev,
+        "n_micro": n_micro,
+        "bucket_bytes": bucket_bytes,
+        "bucket_count": n_buckets,
+        "grad_bytes": grad_bytes,
+        "step_s": {k: round(v, 5) for k, v in times.items()},
+        "exposed_comm_s": {k: round(v, 5) for k, v in exposed.items()},
+        "overlap_beats_serialized":
+            exposed["overlap"] < exposed["serialized"],
+        "exposed_comm_reduction":
+            round(1.0 - exposed["overlap"] / exposed["serialized"], 3)
+            if exposed["serialized"] > 0 else None,
+    }
+    return doc
+
+
+def main() -> int:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+    hvd.init()
+    try:
+        doc = run_overlap_bench()
+        print(json.dumps(doc), flush=True)
+        return 0
+    finally:
+        hvd.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

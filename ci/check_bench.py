@@ -1,14 +1,137 @@
 #!/usr/bin/env python
 """Bench artifact contract check: bench.py must print exactly one line of
 parseable JSON with the headline metric keys, succeeding (value numeric)
-on TPU and degrading to a diagnostic (value null, error set) elsewhere."""
+on TPU and degrading to a diagnostic (value null, error set) elsewhere.
 
+``--scaling NEW [--baseline OLD] [--tolerance T]`` is the scaling-curve
+regression gate (ISSUE 6): NEW/OLD are MULTICHIP_* artifacts (or raw
+dryrun output) whose ``[scaling] {json}`` line carries samples/s vs
+world size with and without int8 compression; the gate fails when any
+world's throughput (either series) regresses more than T (default 0.25
+— CPU-mesh numbers are noisy; the band catches collapses, not jitter)
+below the baseline. A baseline without a curve (older rounds) passes
+with a note; a NEW artifact without a curve fails — the standing
+artifact is the point."""
+
+import glob
 import json
 import os
 import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def extract_scaling_curve(text: str):
+    """Last ``[scaling] {json}`` line of a dryrun's output, or None.
+    Accepts either raw text or a MULTICHIP artifact's ``tail`` field."""
+    doc = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("[scaling] "):
+            continue
+        try:
+            parsed = json.loads(line[len("[scaling] "):])
+        except ValueError:
+            continue  # progress lines ([scaling] world=...) are not JSON
+        if isinstance(parsed, dict) and "scaling_curve" in parsed:
+            doc = parsed
+    return doc
+
+
+def _load_curve(path: str):
+    with open(path) as f:
+        text = f.read()
+    try:  # MULTICHIP artifact: the dryrun output lives in "tail"
+        artifact = json.loads(text)
+        if isinstance(artifact, dict) and "tail" in artifact:
+            text = artifact["tail"]
+    except ValueError:
+        pass  # raw dryrun output
+    return extract_scaling_curve(text)
+
+
+def check_scaling_regression(new: dict, baseline: dict,
+                             tolerance: float) -> list:
+    """Regressions beyond the band: [(world, series, new, base), ...].
+    A baseline world the new curve failed to measure (but could have —
+    it fits the new run's device count) is itself a regression: a
+    slowdown that eats the measurement budget must not erase the
+    evidence and pass (``None`` marks the missing measurement)."""
+    base_by_world = {row["world"]: row
+                     for row in baseline.get("scaling_curve", [])}
+    new_worlds = {row["world"] for row in new.get("scaling_curve", [])}
+    bad = []
+    for row in new.get("scaling_curve", []):
+        base = base_by_world.get(row["world"])
+        if base is None:
+            continue
+        for series in ("samples_per_sec", "samples_per_sec_int8"):
+            n, b = row.get(series), base.get(series)
+            if n is not None and b and n < b * (1.0 - tolerance):
+                bad.append((row["world"], series, n, b))
+    new_capacity = new.get("n_devices") or max(new_worlds, default=0)
+    for world, base in sorted(base_by_world.items()):
+        if world <= new_capacity and world not in new_worlds:
+            bad.append((world, "missing", None,
+                        base.get("samples_per_sec")))
+    return bad
+
+
+def _default_baseline(exclude: str):
+    """Newest committed MULTICHIP_r*.json that carries a curve."""
+    for path in sorted(glob.glob(os.path.join(REPO, "MULTICHIP_r*.json")),
+                       reverse=True):
+        if os.path.abspath(path) == os.path.abspath(exclude):
+            continue
+        curve = _load_curve(path)
+        if curve:
+            return path, curve
+    return None, None
+
+
+def scaling_main(argv) -> int:
+    new_path = argv[argv.index("--scaling") + 1]
+    tolerance = float(argv[argv.index("--tolerance") + 1]) \
+        if "--tolerance" in argv else 0.25
+    new = _load_curve(new_path)
+    if not new or not new.get("scaling_curve"):
+        print(f"no scaling curve in {new_path}: the dryrun must emit the "
+              "[scaling] line (HVD_DRYRUN_SCALING=0 set, or the child "
+              "died before the scaling phase?)")
+        return 1
+    if "--baseline" in argv:
+        base_path = argv[argv.index("--baseline") + 1]
+        base = _load_curve(base_path)
+    else:
+        base_path, base = _default_baseline(new_path)
+    if not base:
+        print(f"scaling gate: no baseline curve available ({base_path}); "
+              f"accepting {len(new['scaling_curve'])}-point curve as the "
+              "new baseline")
+        return 0
+    bad = check_scaling_regression(new, base, tolerance)
+    if new.get("truncated"):
+        # a budget-truncated curve means the measurement itself slowed
+        # down — exactly the condition a perf gate must not wave through
+        print("scaling gate: NEW curve is truncated (the dryrun's "
+              "scaling budget ran out) — investigate the slowdown")
+        return 1
+    if bad:
+        for world, series, n, b in bad:
+            if n is None:
+                print(f"scaling REGRESSION world={world}: present in "
+                      f"baseline ({b:.2f}/s) but NOT measured this run")
+            else:
+                print(f"scaling REGRESSION world={world} {series}: "
+                      f"{n:.2f}/s vs baseline {b:.2f}/s "
+                      f"(> {tolerance:.0%} below)")
+        return 1
+    print(f"scaling gate OK vs {base_path} "
+          f"(tolerance {tolerance:.0%}): "
+          + "; ".join(f"w{r['world']}={r['samples_per_sec']}/s"
+                      for r in new["scaling_curve"]))
+    return 0
 
 
 def main() -> int:
@@ -63,4 +186,6 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--scaling" in sys.argv:
+        sys.exit(scaling_main(sys.argv))
     sys.exit(main())

@@ -117,6 +117,22 @@ from horovod_tpu.train.optimizer import (  # noqa: F401
     broadcast_object,
     allgather_object,
 )
+# Backprop/collective overlap engine (docs/PERF.md "Overlap &
+# bucketing"): byte-budgeted gradient buckets, software-pipelined
+# microbatch accumulation, fused dequantize+apply optimizers.
+from horovod_tpu.train.buckets import (  # noqa: F401
+    BucketPlan,
+    plan_buckets,
+)
+from horovod_tpu.train.overlap import (  # noqa: F401
+    bucketed_grad_sync,
+    make_overlap_train_step,
+    pipelined_accumulate,
+)
+from horovod_tpu.train.fused_apply import (  # noqa: F401
+    fused_adam,
+    fused_sgd,
+)
 # Gradient compression subsystem (quantizers + error feedback +
 # quantized wire paths; reference analog: horovod/torch/compression.py,
 # grown per EQuARX — see docs/PERF.md "Gradient compression")

@@ -54,15 +54,24 @@ def env_str(name: str, default: str = "") -> str:
 class Config:
     """Snapshot of all runtime knobs.
 
-    Defaults follow the reference: fusion threshold 64 MB is the reference's
-    compile-time default but 128 MB is set at startup
-    (``operations.cc:488``); cycle time 1 ms; cache capacity 1024.
+    Defaults follow the reference: fusion threshold 64 MiB — the
+    reference's own default (``operations.cc:487``) and what our C++
+    core's env parser falls back to (``capi.cc``); the two layers must
+    agree because the bucket planner (``train/buckets.py``) reuses this
+    number as the overlap bucket budget. Cycle time 1 ms; cache
+    capacity 1024.
     """
 
     # Fusion / cycle (reference: operations.cc:487-538)
-    fusion_threshold_bytes: int = 128 * 1024 * 1024
+    fusion_threshold_bytes: int = 64 * 1024 * 1024
     cycle_time_ms: float = 1.0
     cache_capacity: int = 1024
+    # Gradient bucketing / overlap (docs/PERF.md "Overlap & bucketing"):
+    # bucket_bytes 0 = follow fusion_threshold_bytes; overlap_buckets
+    # gates the eager per-bucket async issue path (off = one grouped
+    # call for the whole tree, the pre-bucketing behavior).
+    bucket_bytes: int = 0
+    overlap_buckets: bool = True
     # Hierarchical ops (reference: operations.cc:514-538)
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
@@ -125,6 +134,8 @@ class Config:
             fusion_threshold_bytes=env_int(
                 "FUSION_THRESHOLD", d.fusion_threshold_bytes),
             cycle_time_ms=env_float("CYCLE_TIME", d.cycle_time_ms),
+            bucket_bytes=env_int("BUCKET_BYTES", d.bucket_bytes),
+            overlap_buckets=env_bool("OVERLAP_BUCKETS", d.overlap_buckets),
             cache_capacity=env_int("CACHE_CAPACITY", d.cache_capacity),
             hierarchical_allreduce=env_bool("HIERARCHICAL_ALLREDUCE"),
             hierarchical_allgather=env_bool("HIERARCHICAL_ALLGATHER"),

@@ -145,6 +145,70 @@ def pring_shift(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
     return lax.ppermute(x, axis_name, perm)
 
 
+def pring_allreduce(x: jax.Array, axis_name: str,
+                    op: ReduceOp = ReduceOp.SUM) -> jax.Array:
+    """Chunked ring allreduce built from ``ppermute`` (2(n−1) steps of
+    1/n-sized sends) instead of one monolithic ``psum``.
+
+    This is the large-bucket path of the overlap engine
+    (``train/overlap.py``): a single big ``psum`` is one indivisible
+    collective on XLA's schedule, while the ring decomposes it into
+    2(n−1) fine-grained permute steps the latency-hiding scheduler can
+    interleave with the next microbatch's backward — the explicit-SPMD
+    analog of NCCL's internal ring that the reference leans on
+    (``docs/benchmarks.rst`` scaling story; MLPerf TPU-pod paper's
+    latency-optimized decompositions, arxiv 1909.09756).
+
+    SUM and AVERAGE only (the ring folds with ``+``). Works on any
+    per-shard shape; internally flattens, pads to an ``n`` multiple and
+    restores the shape. Numerics: each element is still a sum of the
+    same ``n`` contributions, folded in ring order instead of psum's
+    tree order — equal to ``psum`` up to fp reassociation. The ring
+    moves and folds in the INPUT dtype (a bf16 bucket sends bf16 on
+    every hop — same in-wire dtype a psum would use; cast to fp32
+    first if you want fp32 accumulation).
+    """
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(f"ring allreduce supports Sum/Average, got {op}")
+    n = axis_size(axis_name)
+    if n == 1:
+        return x
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    size = flat.size
+    pad = (-size) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    chunks = flat.reshape(n, -1)  # chunk c = slice c of the vector
+    r = lax.axis_index(axis_name)
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    # reduce-scatter: at step s every rank sends chunk (r−s) mod n to its
+    # right neighbor, which folds it into the same chunk index — after
+    # n−1 steps rank r owns the fully reduced chunk (r+1) mod n.
+    for s in range(n - 1):
+        send_idx = (r - s) % n
+        recv_idx = (r - s - 1) % n
+        moved = lax.ppermute(jnp.take(chunks, send_idx, axis=0),
+                             axis_name, fwd)
+        chunks = chunks.at[recv_idx].add(moved)
+
+    # allgather: pass each completed chunk once around the ring.
+    for s in range(n - 1):
+        send_idx = (r + 1 - s) % n
+        recv_idx = (r - s) % n
+        moved = lax.ppermute(jnp.take(chunks, send_idx, axis=0),
+                             axis_name, fwd)
+        chunks = chunks.at[recv_idx].set(moved)
+
+    out = chunks.reshape(-1)
+    if pad:
+        out = out[:size]
+    if op == ReduceOp.AVERAGE:
+        out = out / n
+    return out.reshape(orig_shape).astype(orig_dtype)
+
+
 # ---------------------------------------------------------------------------
 # Array-level collectives with jit caching
 # ---------------------------------------------------------------------------

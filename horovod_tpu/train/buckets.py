@@ -1,0 +1,176 @@
+"""Gradient bucket planner: byte-budgeted partitions of a gradient pytree.
+
+The reference overlaps gradient reduction with backward compute by
+fusing ready tensors into a bounded buffer and dispatching it while
+autograd is still producing later gradients (``fusion_buffer_manager.h``,
+PAPER.md background thread). The JAX analog needs the partition decided
+AHEAD of time — traced programs can't grow buffers dynamically — so this
+module plans it once per (tree structure, budget): leaves are walked in
+REVERSE registration order (output-side layers produce their gradients
+first under reverse-mode AD, exactly the order the reference's hooks see
+them) and greedily packed into buckets of at most ``bucket_bytes``.
+
+The byte budget intentionally reuses the engine's fusion-threshold
+semantics (``HVD_TPU_FUSION_THRESHOLD`` → ``Config.fusion_threshold_bytes``,
+64 MiB like the C++ core) unless overridden by ``HVD_TPU_BUCKET_BYTES``
+or an explicit argument — so the eager TCP path (which fuses per cycle in
+C++) and the traced mesh path (which packs per bucket here) agree on what
+"one unit of communication" means.
+
+Consumers:
+
+* :mod:`horovod_tpu.train.overlap` — per-bucket reduce_scatter→allgather
+  pipelined against the next microbatch's backward (traced regimes);
+* :mod:`horovod_tpu.train.optimizer` — per-bucket
+  ``grouped_allreduce_async`` on the eager wire, so bucket ``b+1``'s
+  codec/enqueue overlaps bucket ``b``'s wire time.
+
+Planning is pure metadata (shapes/dtypes only — works on
+``jax.ShapeDtypeStruct`` trees and tracers alike) and cached per
+(structure, budget); ``pack``/``unpack`` are the matching runtime
+helpers that concatenate a bucket's leaves into one flat fp32 vector and
+split it back.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class Bucket(NamedTuple):
+    """One communication unit: ``indices`` are positions into the
+    tree_flatten leaf list (ascending within the bucket), ``nbytes`` the
+    payload size at the leaves' own dtypes."""
+
+    indices: Tuple[int, ...]
+    nbytes: int
+
+
+class BucketPlan(NamedTuple):
+    """Buckets in ISSUE order (reverse registration: bucket 0 holds the
+    LAST-registered leaves — the first gradients backprop produces)."""
+
+    buckets: Tuple[Bucket, ...]
+    total_bytes: int
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.buckets)
+
+
+def resolve_bucket_bytes(bucket_bytes: Optional[int] = None) -> int:
+    """Effective byte budget: explicit argument > ``HVD_TPU_BUCKET_BYTES``
+    (``Config.bucket_bytes``) > the engine's fusion threshold."""
+    if bucket_bytes is not None:
+        return max(1, int(bucket_bytes))
+    from horovod_tpu.common.config import get_config
+    cfg = get_config()
+    if cfg.bucket_bytes > 0:
+        return cfg.bucket_bytes
+    return max(1, cfg.fusion_threshold_bytes)
+
+
+def _leaf_nbytes(leaf) -> int:
+    shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+    dtype = np.dtype(getattr(leaf, "dtype", None) or np.asarray(leaf).dtype)
+    return int(np.prod(shape, dtype=np.int64)) * dtype.itemsize if shape \
+        else dtype.itemsize
+
+
+@functools.lru_cache(maxsize=256)
+def _plan_cached(sizes: Tuple[int, ...], budget: int,
+                 reverse: bool) -> BucketPlan:
+    order = range(len(sizes) - 1, -1, -1) if reverse else range(len(sizes))
+    buckets = []
+    cur: list = []
+    cur_bytes = 0
+    for i in order:
+        nb = sizes[i]
+        # a leaf larger than the whole budget still gets exactly one
+        # bucket (the engine's fusion buffer has the same overflow rule:
+        # an oversized tensor is its own execution unit)
+        if cur and cur_bytes + nb > budget:
+            buckets.append(Bucket(tuple(sorted(cur)), cur_bytes))
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nb
+    if cur:
+        buckets.append(Bucket(tuple(sorted(cur)), cur_bytes))
+    return BucketPlan(tuple(buckets), sum(sizes))
+
+
+def plan_buckets(tree: Any, bucket_bytes: Optional[int] = None,
+                 reverse: bool = True) -> BucketPlan:
+    """Partition ``tree``'s leaves into byte-budgeted buckets.
+
+    ``tree`` may hold arrays, tracers, or ``jax.ShapeDtypeStruct``s —
+    only shapes/dtypes are read. Leaves are taken in reverse
+    registration order by default (tiny tensors coalesce with their
+    neighbors until the running total would exceed the budget); a leaf
+    bigger than the budget forms its own bucket. Records the plan on
+    the overlap metrics gauges (``docs/OBSERVABILITY.md``).
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    budget = resolve_bucket_bytes(bucket_bytes)
+    plan = _plan_cached(tuple(_leaf_nbytes(l) for l in leaves), budget,
+                        bool(reverse))
+    record_plan(plan)
+    return plan
+
+
+def record_plan(plan: BucketPlan) -> None:
+    """Surface the active plan on /metrics (PR-1 registry): bucket count
+    and total payload bytes."""
+    from horovod_tpu.metrics.registry import default_registry
+    reg = default_registry()
+    reg.gauge("hvd_overlap_bucket_count",
+              help="gradient buckets in the active overlap plan"
+              ).set(plan.num_buckets)
+    reg.gauge("hvd_overlap_bucket_bytes",
+              help="total gradient payload bytes in the active plan"
+              ).set(plan.total_bytes)
+
+
+# ---------------------------------------------------------------------------
+# Runtime pack/unpack (traced-safe)
+# ---------------------------------------------------------------------------
+
+def pack(leaves: Sequence, bucket: Bucket, pad_to: int = 1) -> jax.Array:
+    """Concatenate ``bucket``'s leaves into one flat vector, zero-padded
+    to a ``pad_to`` multiple (collective divisibility: pass the
+    mesh-axis size — or axis*block for the quantized path).
+
+    The vector's dtype is the bucket's widest member dtype
+    (``jnp.result_type``), NOT a forced fp32: an all-bf16 gradient
+    bucket moves bf16 over the interconnect — the same in-wire dtype
+    XLA's sharding-derived reduction would use — instead of paying 2x
+    the bytes this subsystem exists to save. Mixed buckets promote to
+    the widest member (bf16+fp32 → fp32)."""
+    dtype = jnp.result_type(*(leaves[i].dtype for i in bucket.indices))
+    vec = jnp.concatenate(
+        [jnp.ravel(leaves[i]).astype(dtype) for i in bucket.indices])
+    pad = (-vec.size) % max(1, pad_to)
+    if pad:
+        vec = jnp.concatenate([vec, jnp.zeros((pad,), dtype)])
+    return vec
+
+
+def unpack(vec: jax.Array, bucket: Bucket, like: Sequence) -> list:
+    """Split a packed (possibly padded) vector back into ``bucket``'s
+    leaves with their original shapes/dtypes. Returns leaves in
+    ``bucket.indices`` order."""
+    out = []
+    offset = 0
+    for i in bucket.indices:
+        ref = like[i]
+        n = int(np.prod(ref.shape, dtype=np.int64)) if ref.shape else 1
+        out.append(jax.lax.dynamic_slice_in_dim(vec, offset, n)
+                   .reshape(ref.shape).astype(ref.dtype))
+        offset += n
+    return out

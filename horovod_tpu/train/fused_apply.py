@@ -1,0 +1,292 @@
+"""Fused dequantize + optimizer apply: the quantized-gradient tail in
+one kernel pass.
+
+With quantized gradient sync (PR 2) the step's tail used to serialize
+three full-tensor HBM sweeps after the collective: dequantize the int8
+codes, update the momentum/Adam moments, form the delta. The Pallas
+kernels in :mod:`horovod_tpu.ops.pallas_quantize` (``fused_sgd_apply``,
+``fused_adam_apply``) collapse that into one VMEM round trip, and
+``block_quantize_ef`` produces the error-feedback residual in the same
+pass that makes the codes — so the whole compress→carry→apply chain
+reads each gradient byte once.
+
+Use via :func:`horovod_tpu.DistributedOptimizer`::
+
+    tx = hvd.DistributedOptimizer(hvd.fused_sgd(0.1, momentum=0.9),
+                                  compression=hvd.ErrorFeedback(
+                                      hvd.Compression.int8))
+
+``fused_sgd``/``fused_adam`` return a :class:`FusedOptSpec` — a
+descriptor, not an optax transform — which ``DistributedOptimizer``
+lowers into a single gradient transformation that fuses sync and apply.
+Regimes (same routing logic as ``DistributedGradTransform``):
+
+* **global-SPMD jit / single process** — the flagship bench regime: the
+  sync is an identity (XLA reduces from shardings), so the kernel
+  consumes the local codes directly: fully fused.
+* **shard_map with a live axis** — codes are dequantized into the
+  in-graph ``preduce`` (quantized payloads aren't sum-reducible), then
+  the same update math runs on the reduced blocks (XLA path).
+* **eager multi-process** — the qdq'd gradients ride the existing
+  quantized wire (block-int8 requantization is exact — ``quantize ∘
+  dequantize ∘ quantize = quantize`` — so re-entering the wire path
+  costs one redundant codec pass, not accuracy), then blocked apply.
+
+Hyperparameters are scalars (traced values are fine — they ride in
+SMEM); optax-style schedules are not supported here. All optimizer
+state (moments, EF residual) lives in the codec's blocked ``[n_blocks,
+block]`` fp32 layout so it feeds the kernels without reshuffling.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from horovod_tpu.common.basics import size
+from horovod_tpu.common.process_sets import ProcessSet, global_process_set
+from horovod_tpu.common.util import is_traced as _is_traced
+from horovod_tpu.compression.error_feedback import ErrorFeedback
+from horovod_tpu.compression.quantizers import BlockInt8Quantizer
+from horovod_tpu.ops.reduce_op import Average, ReduceOp, Sum
+
+_tree = jax.tree_util
+
+
+class FusedOptSpec(NamedTuple):
+    """Descriptor for a fusable optimizer; build with :func:`fused_sgd`
+    or :func:`fused_adam` and hand to ``DistributedOptimizer``."""
+
+    kind: str  # "sgd" | "adam"
+    lr: Any
+    momentum: Any = 0.0
+    b1: Any = 0.9
+    b2: Any = 0.999
+    eps: Any = 1e-8
+
+    def to_optax(self) -> optax.GradientTransformation:
+        """Reference (unfused) optax equivalent — parity tests and
+        fallbacks."""
+        if self.kind == "sgd":
+            return optax.sgd(self.lr,
+                             momentum=self.momentum or None)
+        return optax.adam(self.lr, b1=self.b1, b2=self.b2, eps=self.eps)
+
+
+def fused_sgd(learning_rate, momentum=0.0) -> FusedOptSpec:
+    """SGD(+momentum) with the fused dequantize+apply kernel
+    (optax.sgd numerics)."""
+    return FusedOptSpec("sgd", learning_rate, momentum=momentum)
+
+
+def fused_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8) -> FusedOptSpec:
+    """Adam with the fused dequantize+apply kernel (optax.adam
+    numerics, bias correction included)."""
+    return FusedOptSpec("adam", learning_rate, b1=b1, b2=b2, eps=eps)
+
+
+class FusedOptState(NamedTuple):
+    count: jax.Array
+    mom: Any        # sgd momentum / adam first moment (blocked fp32)
+    vel: Any        # adam second moment (blocked fp32) or None leaves
+    residual: Any   # EF residual (blocked fp32) or None leaves
+
+
+def _leaf_meta(leaf, block: int) -> Tuple[int, int]:
+    n = int(np.prod(leaf.shape, dtype=np.int64)) if leaf.shape else 1
+    return n, -(-n // block)  # (elements, n_blocks)
+
+
+def _to_blocks(x, block: int) -> jax.Array:
+    flat = jnp.ravel(x).astype(jnp.float32)
+    pad = (-flat.size) % block
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    return flat.reshape(-1, block)
+
+
+def _from_blocks(blocks, leaf) -> jax.Array:
+    n = int(np.prod(leaf.shape, dtype=np.int64)) if leaf.shape else 1
+    return blocks.reshape(-1)[:n].reshape(leaf.shape).astype(leaf.dtype)
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def make_fused_transform(spec: FusedOptSpec,
+                         op: ReduceOp = Average,
+                         process_set: ProcessSet = global_process_set,
+                         compression=None,
+                         axis_name: Optional[str] = None
+                         ) -> optax.GradientTransformation:
+    """Lower a :class:`FusedOptSpec` + int8 codec into one optax
+    transform fusing EF-quantized gradient sync with the optimizer
+    apply (see module docstring for regime routing). Called by
+    ``DistributedOptimizer``; usable directly in an optax chain."""
+    if spec.kind not in ("sgd", "adam"):
+        raise ValueError(f"unknown fused optimizer kind {spec.kind!r}")
+    ef = isinstance(compression, ErrorFeedback)
+    codec = compression.inner if ef else compression
+    if not isinstance(codec, BlockInt8Quantizer):
+        raise ValueError(
+            "fused_sgd/fused_adam need the block-int8 codec whose layout "
+            "the kernels consume: pass compression=Compression.int8 (or "
+            "ErrorFeedback(Compression.int8)); for other codecs use "
+            f"spec.to_optax() with DistributedOptimizer (got {codec!r})")
+    if op not in (Sum, ReduceOp.AVERAGE):
+        raise ValueError(f"fused apply supports Sum/Average, got {op}")
+    block = codec.block_size
+    interp = codec.interpret
+    use_mom = spec.kind == "adam" or spec.momentum != 0.0
+
+    def init_fn(params):
+        def zeros(p):
+            if not _is_float(p):
+                return None
+            _, nb = _leaf_meta(p, block)
+            return jnp.zeros((nb, block), jnp.float32)
+
+        mom = _tree.tree_map(zeros, params) if use_mom else \
+            _tree.tree_map(lambda p: None, params)
+        vel = _tree.tree_map(zeros, params) if spec.kind == "adam" else \
+            _tree.tree_map(lambda p: None, params)
+        res = _tree.tree_map(zeros, params) if ef else \
+            _tree.tree_map(lambda p: None, params)
+        return FusedOptState(count=jnp.zeros((), jnp.int32), mom=mom,
+                             vel=vel, residual=res)
+
+    def update_fn(updates, state, params=None):
+        del params
+        from horovod_tpu.ops.pallas_quantize import (
+            block_dequantize, block_quantize_ef, fused_adam_apply,
+            fused_sgd_apply)
+
+        t = state.count + 1
+        tf = t.astype(jnp.float32)
+        if spec.kind == "adam":
+            bc1 = 1.0 - jnp.float32(spec.b1) ** tf
+            bc2 = 1.0 - jnp.float32(spec.b2) ** tf
+
+        leaves, treedef = _tree.tree_flatten(updates)
+        flat_mom = treedef.flatten_up_to(state.mom)
+        flat_vel = treedef.flatten_up_to(state.vel)
+        flat_res = treedef.flatten_up_to(state.residual)
+
+        traced = _is_traced(updates)
+        # a live named axis spans DEVICES within one process, so it wins
+        # over the process count; eager needs multiple processes; the
+        # rest (global-SPMD jit, single process) is identity sync
+        axis_regime = traced and axis_name is not None
+        eager = (not traced) and size() > 1
+        identity_sync = not axis_regime and not eager
+
+        # pass 1: quantize (+EF residual) every float leaf
+        quantized = []  # (vals, scales) or None per leaf
+        new_res = list(flat_res)
+        for i, g in enumerate(leaves):
+            if not _is_float(g):
+                quantized.append(None)
+                continue
+            blocks = _to_blocks(g, block)
+            if ef and flat_res[i] is not None:
+                blocks = blocks + flat_res[i]
+            vals, scales, res = block_quantize_ef(blocks, interpret=interp)
+            quantized.append((vals, scales))
+            if ef:
+                new_res[i] = res
+
+        # pass 2 (non-identity regimes): materialize the synced, still
+        # blocked fp32 gradients
+        synced_blocks = [None] * len(leaves)
+        if not identity_sync:
+            if eager:
+                from horovod_tpu.train.optimizer import \
+                    _eager_allreduce_tree
+                qdq = [leaves[i] if q is None else
+                       _from_blocks(block_dequantize(q[0], q[1],
+                                                     interpret=interp),
+                                    leaves[i])
+                       for i, q in enumerate(quantized)]
+                synced = _eager_allreduce_tree(
+                    _tree.tree_unflatten(treedef, qdq), op, process_set,
+                    codec, 1.0, 1.0)
+                synced_blocks = [
+                    None if q is None else _to_blocks(s, block)
+                    for q, s in zip(quantized,
+                                    _tree.tree_leaves(synced))]
+            else:  # traced with a live named axis
+                from horovod_tpu.ops.mesh_collectives import preduce
+                synced_blocks = [
+                    None if q is None else
+                    preduce(block_dequantize(q[0], q[1], interpret=interp),
+                            axis_name, op)
+                    for q in quantized]
+
+        # pass 3: fused (or blocked-XLA) optimizer apply
+        out = []
+        new_mom = list(flat_mom)
+        new_vel = list(flat_vel)
+        for i, g in enumerate(leaves):
+            q = quantized[i]
+            if q is None:
+                out.append(jnp.zeros_like(g))
+                continue
+            if spec.kind == "sgd":
+                mom_i = flat_mom[i] if use_mom else None
+                if identity_sync:
+                    delta, nm = fused_sgd_apply(
+                        q[0], q[1], mom_i, spec.lr, spec.momentum,
+                        interpret=interp)
+                else:
+                    h = jnp.stack([jnp.float32(spec.lr),
+                                   jnp.float32(spec.momentum)])
+                    delta, nm = _apply_sgd_blocks(h, synced_blocks[i],
+                                                  mom_i)
+                if use_mom:
+                    new_mom[i] = nm
+            else:
+                if identity_sync:
+                    delta, nm, nv = fused_adam_apply(
+                        q[0], q[1], flat_mom[i], flat_vel[i], spec.lr,
+                        spec.b1, spec.b2, spec.eps, bc1, bc2,
+                        interpret=interp)
+                else:
+                    h = jnp.stack([jnp.float32(spec.lr),
+                                   jnp.float32(spec.b1),
+                                   jnp.float32(spec.b2),
+                                   jnp.float32(spec.eps), bc1, bc2])
+                    delta, nm, nv = _apply_adam_blocks(
+                        h, synced_blocks[i], flat_mom[i], flat_vel[i])
+                new_mom[i], new_vel[i] = nm, nv
+            out.append(_from_blocks(delta, g))
+
+        new_state = FusedOptState(
+            count=t,
+            mom=_tree.tree_unflatten(treedef, new_mom),
+            vel=_tree.tree_unflatten(treedef, new_vel),
+            residual=_tree.tree_unflatten(treedef, new_res))
+        return _tree.tree_unflatten(treedef, out), new_state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def _apply_sgd_blocks(h, g_blocks, mom):
+    """optax.sgd update on already-dequantized fp32 blocks (the
+    non-identity-sync regimes, where the reduction had to densify)."""
+    if mom is None:
+        return -h[0] * g_blocks, None
+    m = g_blocks + h[1] * mom
+    return -h[0] * m, m
+
+
+def _apply_adam_blocks(h, g_blocks, m, v):
+    m = h[1] * m + (1.0 - h[1]) * g_blocks
+    v = h[2] * v + (1.0 - h[2]) * g_blocks * g_blocks
+    delta = -h[0] * (m / h[4]) / (jnp.sqrt(v / h[5]) + h[3])
+    return delta, m, v

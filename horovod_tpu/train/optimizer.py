@@ -52,36 +52,103 @@ from horovod_tpu.ops import collectives as C
 from horovod_tpu.ops.reduce_op import Average, ReduceOp, Sum
 
 
+def _record_sync_timing(exposed_s: float, total_s: float,
+                        n_buckets: int) -> None:
+    """Overlap efficiency on /metrics (docs/OBSERVABILITY.md): how much
+    of the eager gradient sync was spent BLOCKED on the wire (exposed)
+    vs overlapped with local codec/enqueue work."""
+    from horovod_tpu.metrics.registry import default_registry
+    reg = default_registry()
+    reg.gauge("hvd_overlap_exposed_comm_seconds",
+              help="seconds blocked on collective completion in the last "
+              "gradient sync").set(exposed_s)
+    reg.gauge("hvd_overlap_sync_seconds",
+              help="wall seconds of the last eager gradient sync"
+              ).set(total_s)
+    reg.counter("hvd_overlap_exposed_comm_seconds_total",
+                help="cumulative exposed-communication seconds"
+                ).inc(exposed_s)
+    reg.gauge("hvd_overlap_bucket_count",
+              help="gradient buckets in the active overlap plan"
+              ).set(n_buckets)
+
+
 def _eager_allreduce_tree(grads, op: ReduceOp, process_set: ProcessSet,
                           compression: Compressor,
                           prescale: float, postscale: float):
-    """Grouped (fused) eager allreduce of a gradient pytree.
+    """Bucketed (fused) eager allreduce of a gradient pytree.
+
+    The tree is partitioned into byte-budgeted buckets in reverse
+    registration order (``train/buckets.py``, the engine's
+    fusion-threshold budget) and each bucket is issued as ONE async
+    group: bucket ``b``'s payload is on the wire while bucket ``b+1``
+    is still being compressed/enqueued — the eager-path analog of the
+    reference's background thread reducing early gradients mid-backward.
+    ``HVD_TPU_OVERLAP_BUCKETS=0`` restores the single grouped call.
 
     Cast compressors ride the plain grouped allreduce in their wire
     dtype (sum in fp16/bf16 is well-defined); quantizers take the
     quantized allgather path (``C.quantized_grouped_allreduce``) — their
     per-block-scaled payloads are not sum-reducible, and the C++ wire
-    moves ~4x fewer bytes for the int8 codec."""
+    moves ~4x fewer bytes for the int8 codec. Exposed-communication
+    seconds (time blocked in ``wait`` after all local work) land on the
+    overlap metrics either way."""
+    import time as _time
+
+    from horovod_tpu.common.config import get_config
+    from horovod_tpu.train.buckets import Bucket, BucketPlan, plan_buckets
+
     leaves, treedef = jax.tree_util.tree_flatten(grads)
-    if isinstance(compression, Quantizer):
-        if prescale != 1.0:
-            leaves = [leaf * prescale for leaf in leaves]
-        reduced = C.quantized_grouped_allreduce(
-            leaves, compression, op=op, name="grad",
-            process_set=process_set)
-        if postscale != 1.0:
-            reduced = [r * postscale for r in reduced]
-        return jax.tree_util.tree_unflatten(treedef, reduced)
-    compressed, ctxs = [], []
-    for leaf in leaves:
-        c, ctx = compression.compress(leaf)
-        compressed.append(c)
-        ctxs.append(ctx)
-    reduced = C.grouped_allreduce(compressed, op=op,
-                                  name="grad", prescale_factor=prescale,
-                                  postscale_factor=postscale,
-                                  process_set=process_set)
-    out = [compression.decompress(r, ctx) for r, ctx in zip(reduced, ctxs)]
+    if not leaves:
+        return grads
+    if get_config().overlap_buckets and len(leaves) > 1:
+        plan = plan_buckets(leaves)
+    else:
+        from horovod_tpu.train.buckets import _leaf_nbytes
+        nbytes = sum(_leaf_nbytes(l) for l in leaves)
+        plan = BucketPlan((Bucket(tuple(range(len(leaves))), nbytes),),
+                          nbytes)
+
+    quantized = isinstance(compression, Quantizer)
+    t0 = _time.perf_counter()
+    pending = []  # (bucket, handle, ctxs or None)
+    for bi, bucket in enumerate(plan.buckets):
+        vals = [leaves[i] for i in bucket.indices]
+        if quantized:
+            if prescale != 1.0:
+                vals = [v * prescale for v in vals]
+            h = C.quantized_grouped_allreduce_async(
+                vals, compression, op=op, name=f"grad.b{bi}",
+                process_set=process_set)
+            pending.append((bucket, h, None))
+        else:
+            compressed, ctxs = [], []
+            for leaf in vals:
+                c, ctx = compression.compress(leaf)
+                compressed.append(c)
+                ctxs.append(ctx)
+            h = C.grouped_allreduce_async(
+                compressed, op=op, name=f"grad.b{bi}",
+                prescale_factor=prescale, postscale_factor=postscale,
+                process_set=process_set)
+            pending.append((bucket, h, ctxs))
+
+    out: list = [None] * len(leaves)
+    exposed = 0.0
+    for bucket, h, ctxs in pending:
+        tw = _time.perf_counter()
+        reduced = h.wait()
+        exposed += _time.perf_counter() - tw
+        if ctxs is None:
+            if postscale != 1.0:
+                reduced = [r * postscale for r in reduced]
+        else:
+            reduced = [compression.decompress(r, ctx)
+                       for r, ctx in zip(reduced, ctxs)]
+        for i, r in zip(bucket.indices, reduced):
+            out[i] = r
+    _record_sync_timing(exposed, _time.perf_counter() - t0,
+                        plan.num_buckets)
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
@@ -270,6 +337,30 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     (see :func:`DistributedGradTransform`); the Adasum path has no
     compression seam — combining them raises.
     """
+    from horovod_tpu.train.fused_apply import (FusedOptSpec,
+                                               make_fused_transform)
+    if isinstance(optimizer, FusedOptSpec):
+        # fused dequantize+apply path (train/fused_apply.py): sync and
+        # optimizer lower into ONE transform so the int8 codes feed the
+        # Pallas kernel directly — no separate dequantize sweep.
+        if op == ReduceOp.ADASUM:
+            raise ValueError("fused_sgd/fused_adam have no Adasum path")
+        if prescale_factor != 1.0 or postscale_factor != 1.0:
+            raise ValueError(
+                "fused apply does not take pre/postscale factors; fold "
+                "them into the learning rate")
+        if host_sync_in_jit:
+            raise ValueError(
+                "fused apply and host_sync_in_jit are mutually "
+                "exclusive (the fused path keeps codes on device)")
+        fused = make_fused_transform(optimizer, op=op,
+                                     process_set=process_set,
+                                     compression=compression,
+                                     axis_name=axis_name)
+        if backward_passes_per_step > 1:
+            return optax.MultiSteps(
+                fused, every_k_schedule=backward_passes_per_step)
+        return fused
     if op == ReduceOp.ADASUM:
         if compression is not Compression.none:
             raise ValueError(

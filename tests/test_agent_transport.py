@@ -7,6 +7,8 @@ import json
 import threading
 import time
 
+import pytest
+
 from horovod_tpu.runner.elastic.agent import (AgentRegistryDiscovery,
                                               make_agent_exec,
                                               resolve_kv_addr)
@@ -74,6 +76,8 @@ def test_exec_gives_up_and_retires_cmd_when_agent_dies():
     assert kv.get("cmd", "h1@0") == b""  # retired, not replayable
 
 
+@pytest.mark.slow  # ~30s: deliberately waits out the kill deadline;
+#                    tier-1 budget (integration tier runs it unfiltered)
 def test_exec_kill_deadline_bounds_teardown_wait():
     """After a teardown kill, an agent that never acks is abandoned at
     the kill deadline instead of blocking the generation restart."""

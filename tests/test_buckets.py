@@ -1,0 +1,111 @@
+"""Bucket planner unit battery (ISSUE 6): byte budgets, reverse
+registration order, tiny-tensor coalescing, oversized leaves,
+pack/unpack roundtrips, budget resolution against the fusion
+threshold."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.common.config import reset_config
+from horovod_tpu.train.buckets import (pack, plan_buckets,
+                                       resolve_bucket_bytes, unpack)
+
+
+def _tree(*shapes, dtype=jnp.float32):
+    return [jnp.zeros(s, dtype) for s in shapes]
+
+
+def test_single_bucket_when_budget_covers_all():
+    plan = plan_buckets(_tree((4,), (8,), (2,)), bucket_bytes=1 << 20)
+    assert plan.num_buckets == 1
+    assert plan.buckets[0].indices == (0, 1, 2)
+    assert plan.total_bytes == (4 + 8 + 2) * 4
+
+
+def test_budget_splits_and_reverse_order():
+    # leaves: 400B, 48B, 8B; reverse walk packs (c, b) then a
+    plan = plan_buckets(_tree((100,), (3, 4), (2,)), bucket_bytes=400)
+    assert plan.num_buckets == 2
+    # bucket 0 holds the LAST-registered leaves (first grads produced)
+    assert plan.buckets[0].indices == (1, 2)
+    assert plan.buckets[0].nbytes == 56
+    assert plan.buckets[1].indices == (0,)
+
+
+def test_forward_order_flag():
+    plan = plan_buckets(_tree((100,), (3, 4), (2,)), bucket_bytes=400,
+                        reverse=False)
+    assert plan.buckets[0].indices == (0,)
+
+
+def test_tiny_tensors_coalesce():
+    # 64 tiny leaves coalesce into few buckets, never one-per-leaf
+    plan = plan_buckets(_tree(*[(4,)] * 64), bucket_bytes=128)
+    assert plan.num_buckets == 8
+    assert all(len(b.indices) == 8 for b in plan.buckets)
+
+
+def test_oversized_leaf_gets_own_bucket():
+    plan = plan_buckets(_tree((1000,), (2,), (1000,)), bucket_bytes=512)
+    sizes = [b.nbytes for b in plan.buckets]
+    assert plan.num_buckets == 3
+    assert sorted(sizes)[-1] == 4000  # oversized leaves ride alone
+    # and the tiny leaf shares no bucket with either giant
+    tiny = [b for b in plan.buckets if 1 in b.indices]
+    assert tiny[0].indices == (1,)
+
+
+def test_plan_on_shape_dtype_structs():
+    tree = {"w": jax.ShapeDtypeStruct((16, 16), jnp.bfloat16),
+            "b": jax.ShapeDtypeStruct((16,), jnp.float32)}
+    plan = plan_buckets(tree, bucket_bytes=1 << 20)
+    assert plan.total_bytes == 16 * 16 * 2 + 16 * 4
+
+
+def test_budget_resolution_prefers_env_then_fusion(monkeypatch):
+    monkeypatch.delenv("HVD_TPU_BUCKET_BYTES", raising=False)
+    monkeypatch.delenv("HOROVOD_BUCKET_BYTES", raising=False)
+    monkeypatch.delenv("HVD_TPU_FUSION_THRESHOLD", raising=False)
+    monkeypatch.delenv("HOROVOD_FUSION_THRESHOLD", raising=False)
+    reset_config()
+    # reconciled default: 64 MiB, the reference's own fusion default
+    assert resolve_bucket_bytes() == 64 * 1024 * 1024
+    monkeypatch.setenv("HVD_TPU_BUCKET_BYTES", "4096")
+    reset_config()
+    assert resolve_bucket_bytes() == 4096
+    assert resolve_bucket_bytes(128) == 128  # explicit argument wins
+    reset_config()
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.RandomState(0)
+    leaves = [jnp.asarray(rng.randn(3, 5).astype(np.float32)),
+              jnp.asarray(rng.randn(7).astype(np.float32)),
+              jnp.asarray(rng.randn(2, 2).astype(np.float32))]
+    plan = plan_buckets(leaves, bucket_bytes=1 << 20)
+    vec = pack(leaves, plan.buckets[0], pad_to=8)
+    assert vec.size % 8 == 0
+    out = unpack(vec, plan.buckets[0], leaves)
+    for got, want in zip(out, [leaves[i]
+                               for i in plan.buckets[0].indices]):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_pack_keeps_native_bf16_wire_dtype():
+    """An all-bf16 bucket must move bf16 (the bandwidth the subsystem
+    exists to save), promoting only for mixed buckets."""
+    leaves = [jnp.zeros((8,), jnp.bfloat16), jnp.zeros((4,), jnp.bfloat16)]
+    plan = plan_buckets(leaves, bucket_bytes=1 << 20)
+    assert pack(leaves, plan.buckets[0]).dtype == jnp.bfloat16
+    mixed = [jnp.zeros((8,), jnp.bfloat16), jnp.zeros((4,), jnp.float32)]
+    plan = plan_buckets(mixed, bucket_bytes=1 << 20)
+    assert pack(mixed, plan.buckets[0]).dtype == jnp.float32
+
+
+def test_plan_records_metrics():
+    from horovod_tpu.metrics.registry import default_registry
+    plan_buckets(_tree((64,), (64,)), bucket_bytes=256)
+    snap = default_registry().snapshot()
+    assert snap["hvd_overlap_bucket_count"]["value"] == 2
+    assert snap["hvd_overlap_bucket_bytes"]["value"] == 512

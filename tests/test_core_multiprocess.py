@@ -244,6 +244,8 @@ def test_torch_adapter_multiprocess():
 
 
 @needs_core
+@pytest.mark.slow  # ~15s tf.function compile; tier-1 budget (parallel
+#                    tier runs it unfiltered)
 def test_tf_tape_in_tf_function():
     """DistributedGradientTape traced by tf.function at size 2: averaged
     gradients match the locally-computed cross-rank mean, None gradients

@@ -69,6 +69,8 @@ def test_example_torch_synthetic_benchmark_two_procs():
 
 
 @needs_core
+@pytest.mark.slow  # ~17s elastic launch; tier-1 budget (examples tier
+#                    runs it unfiltered)
 def test_example_keras_elastic_two_procs():
     """examples/keras/keras_elastic_mnist.py under an ELASTIC hvdrun
     (fixed 2-host world): model.fit with the elastic callback trio runs

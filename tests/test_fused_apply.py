@@ -1,0 +1,225 @@
+"""Pallas fused dequantize+optimizer kernels (ISSUE 6): interpret-mode
+kernel parity against the reference optax math, the quantize-with-
+residual kernel, the fused DistributedOptimizer transform across
+regimes, and its argument validation."""
+
+import functools
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd_mod
+from horovod_tpu._compat import shard_map
+from horovod_tpu.compression.error_feedback import (ErrorFeedback,
+                                                    error_feedback_transform)
+from horovod_tpu.compression.quantizers import BlockInt8Quantizer
+from horovod_tpu.ops.pallas_quantize import (block_dequantize,
+                                             block_quantize,
+                                             block_quantize_ef,
+                                             fused_adam_apply,
+                                             fused_sgd_apply)
+
+
+def _blocks(rng, n=5, block=256):
+    return jnp.asarray(rng.randn(n, block).astype(np.float32))
+
+
+def test_quantize_ef_kernel_matches_plain_quantize_plus_residual():
+    rng = np.random.RandomState(0)
+    x = _blocks(rng)
+    v1, s1 = block_quantize(x, interpret=True)
+    v2, s2, res = block_quantize_ef(x, interpret=True)
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    dq = block_dequantize(v1, s1, interpret=True)
+    np.testing.assert_allclose(np.asarray(res), np.asarray(x - dq),
+                               atol=1e-6)
+
+
+def test_quantize_ef_xla_fallback_same_semantics():
+    rng = np.random.RandomState(1)
+    x = _blocks(rng, block=100)  # non-128-multiple -> XLA path
+    v, s, res = block_quantize_ef(x)
+    dq = block_dequantize(v, s)
+    np.testing.assert_allclose(np.asarray(res), np.asarray(x - dq),
+                               atol=1e-6)
+
+
+@pytest.mark.parametrize("momentum", [0.0, 0.9])
+def test_fused_sgd_kernel_matches_optax(momentum):
+    rng = np.random.RandomState(2)
+    x = _blocks(rng)
+    vals, scales = block_quantize(x, interpret=True)
+    g = block_dequantize(vals, scales, interpret=True)
+    mom = _blocks(rng) if momentum else None
+    delta, nm = fused_sgd_apply(vals, scales, mom, 0.1, momentum,
+                                interpret=True)
+    ref_m = g if not momentum else g + momentum * mom
+    np.testing.assert_allclose(np.asarray(delta),
+                               np.asarray(-0.1 * ref_m), atol=1e-6)
+    if momentum:
+        np.testing.assert_allclose(np.asarray(nm), np.asarray(ref_m),
+                                   atol=1e-6)
+    else:
+        assert nm is None
+
+
+def test_fused_adam_kernel_matches_optax_step():
+    rng = np.random.RandomState(3)
+    x = _blocks(rng)
+    vals, scales = block_quantize(x, interpret=True)
+    g = block_dequantize(vals, scales, interpret=True)
+    tx = optax.adam(1e-3)
+    st = tx.init(x)
+    ref_updates, _ = tx.update(g, st, x)
+    delta, nm, nv = fused_adam_apply(
+        vals, scales, jnp.zeros_like(x), jnp.zeros_like(x),
+        1e-3, 0.9, 0.999, 1e-8, 1 - 0.9, 1 - 0.999, interpret=True)
+    np.testing.assert_allclose(np.asarray(delta),
+                               np.asarray(ref_updates), atol=1e-6)
+
+
+def test_fused_kernels_pad_ragged_rows():
+    # 33 rows crosses the 32-row int8 tile: padding must round-trip
+    rng = np.random.RandomState(4)
+    x = _blocks(rng, n=33, block=128)
+    vals, scales, res = block_quantize_ef(x, interpret=True)
+    assert vals.shape == (33, 128) and res.shape == (33, 128)
+    delta, nm, nv = fused_adam_apply(
+        vals, scales, jnp.zeros_like(x), jnp.zeros_like(x),
+        1e-3, 0.9, 0.999, 1e-8, 0.1, 0.001, interpret=True)
+    assert delta.shape == (33, 128)
+    assert np.all(np.isfinite(np.asarray(delta)))
+
+
+# -- the fused transform through DistributedOptimizer ----------------------
+
+def _param_tree(rng):
+    return {"w": jnp.asarray(rng.randn(10, 30).astype(np.float32)),
+            "b": jnp.asarray(rng.randn(7).astype(np.float32))}
+
+
+@pytest.mark.parametrize("spec_ref", [
+    ("sgd", lambda h: (h.fused_sgd(0.1), optax.sgd(0.1))),
+    ("sgd_mom", lambda h: (h.fused_sgd(0.1, momentum=0.9),
+                           optax.sgd(0.1, momentum=0.9))),
+    ("adam", lambda h: (h.fused_adam(1e-3), optax.adam(1e-3))),
+], ids=lambda p: p[0] if isinstance(p, tuple) else None)
+def test_fused_transform_matches_ef_reference_chain(hvd, spec_ref):
+    """fused path == error_feedback_transform(int8) ∘ optax reference
+    over multiple steps (single-process regime: identity sync)."""
+    spec, ref_tx = spec_ref[1](hvd)
+    codec = BlockInt8Quantizer(256, interpret=True)
+    tx = hvd.DistributedOptimizer(spec,
+                                  compression=ErrorFeedback(codec))
+    ref = optax.chain(error_feedback_transform(codec), ref_tx)
+    rng = np.random.RandomState(5)
+    params = _param_tree(rng)
+    st, rst = tx.init(params), ref.init(params)
+    p1, p2 = dict(params), dict(params)
+    for _ in range(5):
+        g = _param_tree(rng)
+        u1, st = tx.update(g, st, p1)
+        u2, rst = ref.update(g, rst, p2)
+        p1 = optax.apply_updates(p1, u1)
+        p2 = optax.apply_updates(p2, u2)
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5)
+
+
+def test_fused_transform_under_jit_and_multisteps(hvd):
+    tx = hvd.DistributedOptimizer(
+        hvd.fused_adam(1e-3), compression=hvd.Compression.int8,
+        backward_passes_per_step=2)
+    rng = np.random.RandomState(6)
+    params = _param_tree(rng)
+    st = tx.init(params)
+
+    @jax.jit
+    def step(p, st, g):
+        u, st = tx.update(g, st, p)
+        return optax.apply_updates(p, u), st
+
+    p = params
+    for _ in range(3):
+        p, st = step(p, st, _param_tree(rng))
+    assert all(bool(jnp.all(jnp.isfinite(x)))
+               for x in jax.tree_util.tree_leaves(p))
+
+
+def test_fused_transform_axis_regime_reduces(hvd):
+    """shard_map regime: codes dequantize into an in-graph pmean, every
+    shard lands on the identical update."""
+    mesh = hvd_mod.build_mesh(dp=-1)
+    codec = BlockInt8Quantizer(256, interpret=True)
+    tx = hvd_mod.DistributedOptimizer(
+        hvd_mod.fused_sgd(1.0), compression=codec, axis_name="dp")
+    rng = np.random.RandomState(7)
+    params = {"w": jnp.zeros((64,), jnp.float32)}
+    g = jnp.asarray(rng.randn(8, 64).astype(np.float32))
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(P(), P("dp")),
+                       out_specs=P("dp"), check_vma=False)
+    def body(p, gs):
+        st = tx.init(p)
+        u, _ = tx.update({"w": gs[0]}, st, p)
+        return u["w"][None]
+
+    out = np.asarray(jax.jit(body)(params, g))
+    expect = -np.mean([np.asarray(codec.qdq(g[r])) for r in range(8)],
+                      axis=0)
+    for r in range(8):
+        np.testing.assert_allclose(out[r], expect, atol=2e-5)
+
+
+def test_fused_requires_int8_codec(hvd):
+    with pytest.raises(ValueError, match="block-int8"):
+        hvd.DistributedOptimizer(hvd.fused_sgd(0.1))
+    with pytest.raises(ValueError, match="block-int8"):
+        hvd.DistributedOptimizer(hvd.fused_sgd(0.1),
+                                 compression=hvd.Compression.fp16)
+
+
+def test_fused_rejects_unsupported_combinations(hvd):
+    ok = hvd.Compression.int8
+    with pytest.raises(ValueError, match="Adasum"):
+        hvd.DistributedOptimizer(hvd.fused_sgd(0.1), op=hvd_mod.Adasum,
+                                 compression=ok)
+    with pytest.raises(ValueError, match="scale"):
+        hvd.DistributedOptimizer(hvd.fused_sgd(0.1), compression=ok,
+                                 prescale_factor=2.0)
+    with pytest.raises(ValueError, match="host_sync_in_jit"):
+        hvd.DistributedOptimizer(hvd.fused_sgd(0.1), compression=ok,
+                                 host_sync_in_jit=True)
+
+
+def test_fused_trains_a_model(hvd):
+    """End-to-end: the fused optimizer reduces the loss on a small
+    regression problem (EF carries the int8 error, so convergence must
+    track plain SGD closely)."""
+    rng = np.random.RandomState(8)
+    X = jnp.asarray(rng.randn(128, 10).astype(np.float32))
+    true_w = jnp.asarray(rng.randn(10).astype(np.float32))
+    Y = X @ true_w
+    codec = BlockInt8Quantizer(256, interpret=True)
+    tx = hvd.DistributedOptimizer(hvd.fused_sgd(0.05),
+                                  compression=ErrorFeedback(codec))
+    params = {"w": jnp.zeros((10,), jnp.float32)}
+    st = tx.init(params)
+
+    def loss_fn(p):
+        return jnp.mean((X @ p["w"] - Y) ** 2)
+
+    losses = []
+    for _ in range(40):
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        u, st = tx.update(g, st, params)
+        params = optax.apply_updates(params, u)
+        losses.append(float(loss))
+    assert losses[-1] < 0.05 * losses[0], losses[::8]

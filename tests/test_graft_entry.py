@@ -25,24 +25,39 @@ sys.path.insert(0, {REPO!r})
 """ + tail
 
 
-def test_driver_call_path(capsys):
+def test_driver_call_path(capsys, monkeypatch):
     """EXACTLY what the driver does: import the module and call
     dryrun_multichip(8) — no env bootstrap, no subprocess wrapper. The
     function must self-bootstrap a forced-CPU child regardless of this
-    process's JAX state."""
+    process's JAX state. The scaling-curve phase must emit its
+    ``[scaling] {json}`` artifact line (one world here keeps the test
+    inside the tier-1 budget; the driver's real run measures 1,2,4,8)."""
+    monkeypatch.setenv("HVD_DRYRUN_SCALING_WORLDS", "2")
     sys.path.insert(0, REPO)
     try:
         import __graft_entry__
         __graft_entry__.dryrun_multichip(8)
     finally:
         sys.path.remove(REPO)
-    assert "[dryrun] OK" in capsys.readouterr().out
+    out = capsys.readouterr().out
+    assert "[dryrun] OK" in out
+    sys.path.insert(0, REPO)
+    try:
+        from ci.check_bench import extract_scaling_curve
+    finally:
+        sys.path.remove(REPO)
+    curve = extract_scaling_curve(out)
+    assert curve and curve["scaling_curve"][0]["world"] == 2
+    assert curve["scaling_curve"][0]["samples_per_sec"] > 0
+    assert curve["scaling_curve"][0]["samples_per_sec_int8"] > 0
 
 
 @pytest.mark.parametrize("n", [2, 4, 16])
-def test_dryrun_device_counts(n):
+def test_dryrun_device_counts(n, monkeypatch):
     # the function self-bootstraps; call it directly at every
-    # driver-plausible device count
+    # driver-plausible device count (scaling is the driver-artifact
+    # phase, covered by test_driver_call_path — skip it here)
+    monkeypatch.setenv("HVD_DRYRUN_SCALING", "0")
     sys.path.insert(0, REPO)
     try:
         import __graft_entry__

@@ -2,6 +2,7 @@
 docs/benchmarks.rst:13-14 — Inception V3 / ResNet-101 / VGG-16)."""
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 import optax
@@ -12,6 +13,8 @@ from horovod_tpu.models.inception import (InceptionV3,
 from horovod_tpu.models.resnet import batch_sharding
 
 
+@pytest.mark.slow  # ~30s XLA:CPU compile; tier-1 budget (models tier
+#                    runs it unfiltered)
 def test_inception_v3_trains(hvd):
     """Geometry + one GSPMD-auto train step (small input keeps the CPU
     test fast; 95 is the smallest size the VALID-padded stem and the two

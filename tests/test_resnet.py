@@ -74,6 +74,8 @@ def test_vgg16_trains(hvd):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # ~12s double compile; tier-1 budget (models tier
+#                    runs it unfiltered)
 def test_vgg_scan_steps_matches_sequential_dropout_indices(hvd):
     """The INDEXED scan variant (dropout models): scanned step i must use
     dropout index step_idx * scan_steps + i, so a scan_steps=2 dispatch
@@ -115,6 +117,8 @@ def test_vgg_scan_steps_matches_sequential_dropout_indices(hvd):
                                    rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow  # ~19s double compile; tier-1 budget (models tier
+#                    runs it unfiltered)
 def test_scan_steps_matches_sequential(hvd):
     """scan_steps=2 (one dispatch, two in-graph optimizer steps) must
     produce the same params/loss as two sequential scan_steps=1 calls —
@@ -153,6 +157,8 @@ def test_scan_steps_matches_sequential(hvd):
                                    rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow  # ~12s double compile; tier-1 budget (models tier
+#                    runs it unfiltered)
 def test_resnet_remat_matches_plain(hvd):
     """remat=True (jax.checkpoint per block) changes memory, not math:
     one train step produces the same loss and params as the plain model."""

@@ -181,6 +181,8 @@ def test_keras_estimator_transformation_fn(tmp_path):
     assert err < 1.0, err
 
 
+@pytest.mark.slow  # ~30s: two full fits; tier-1 budget (integration
+#                    tier runs it unfiltered)
 @needs_core
 def test_torch_estimator_train_steps_cap(tmp_path):
     """train_steps_per_epoch bounds each epoch's optimizer steps
@@ -329,6 +331,8 @@ def test_torch_estimator_over_nonlocal_store(tmp_path):
 
 
 @needs_core
+@pytest.mark.slow  # ~19s distributed fit; tier-1 budget (integration
+#                    tier runs it unfiltered)
 def test_estimator_distributed_materialization(fake_pyspark, tmp_path):
     """A partitioned (fake-)Spark DataFrame is materialized by the
     EXECUTORS — one parquet shard per partition written through the
@@ -367,6 +371,8 @@ def test_estimator_distributed_materialization(fake_pyspark, tmp_path):
     assert "y__output" in out.columns
 
 
+@pytest.mark.slow  # ~38s: two distributed fits; tier-1 budget
+#                    (integration tier runs it unfiltered)
 @needs_core
 def test_run_id_reuse_clears_stale_shards(fake_pyspark, tmp_path):
     """Refitting with the SAME run_id must not mix the previous fit's
