@@ -231,7 +231,7 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
         pass
 
     def emit(value, dt_window, n_iters, provisional, flops_per_device,
-             flops_src, compile_s):
+             flops_src, compile_s, series=None):
         peak = _peak_flops(jax.devices()[0].device_kind)
         mfu = (round(flops_per_device * n_iters / dt_window / peak, 4)
                if peak and flops_per_device else None)
@@ -255,6 +255,14 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
             "phases": dict(_PHASES),
             **ex,
         }
+        if series is not None:
+            # per-iteration wall-clock gaps across the timing window
+            # (on CPU each is a synced real step; on TPU they are
+            # dispatch gaps, which still track device throughput once
+            # the async queue saturates) — the TRAJECTORY, so
+            # ci/check_bench.py can gate on drift inside the window,
+            # not just the window mean (docs/OBSERVABILITY.md)
+            doc["step_time_series"] = series
         if provisional:
             doc["provisional"] = True
             # side-channel mirror: the streamed stdout line survives a
@@ -333,7 +341,9 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
     # the measured phase, merged into the artifact dir afterwards so a
     # perf regression ships with its trace (docs/OBSERVABILITY.md)
     tracer = _start_measure_trace()
+    step_series = []
     t0 = _begin_phase("measure")
+    t_prev = time.perf_counter()
     for i in range(iters):
         if tracer is not None:
             tracer.collective_begin("measure_step", "step", f"step#{i+1}")
@@ -342,8 +352,12 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
             readback(loss)
         if tracer is not None:
             tracer.collective_end("measure_step", f"step#{i+1}")
+        t_now = time.perf_counter()
+        step_series.append(round(t_now - t_prev, 6))
+        t_prev = t_now
     readback(loss)  # forces completion of the whole chain
     dt = _end_phase("measure", t0)
+    _record_bench_series(step_series)
     _finish_measure_trace(tracer)
     _log(f"timing window {dt:.2f}s for {iters} iters")
 
@@ -369,12 +383,28 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
 
     emit(per_chip, dt, iters, provisional=False,
          flops_per_device=flops_per_device, flops_src=flops_src,
-         compile_s=compile_s)
+         compile_s=compile_s, series=step_series)
 
 
 # wall-clock start of model/data setup, stamped by _child() after device
 # init; consumed (into the "setup" phase) by _measure_and_report
 _T_SETUP0 = None
+
+
+def _record_bench_series(step_series) -> None:
+    """Persist the measured window's per-step trajectory into the
+    observability history (HVD_TPU_OBS_DIR JSONL) — the same store the
+    train-loop telemetry writes, so ``python -m horovod_tpu.metrics
+    history`` reads bench runs too.  Best-effort: history must never
+    fail the measurement."""
+    try:
+        from horovod_tpu.metrics import timeseries
+        if not timeseries.obs_dir():
+            return
+        for i, dt in enumerate(step_series):
+            timeseries.record_step(i + 1, dt, source="bench")
+    except Exception as e:
+        _log(f"bench series persistence failed ({e!r}); continuing")
 
 
 def _start_measure_trace():

@@ -11,7 +11,18 @@ world's throughput (either series) regresses more than T (default 0.25
 — CPU-mesh numbers are noisy; the band catches collapses, not jitter)
 below the baseline. A baseline without a curve (older rounds) passes
 with a note; a NEW artifact without a curve fails — the standing
-artifact is the point."""
+artifact is the point.
+
+``--trajectory ARTIFACT [--tolerance T]`` is the within-window drift
+gate (ISSUE 7): the bench doc now records ``step_time_series`` — every
+iteration of the timing window — so a run whose *mean* looks fine but
+whose steps were degrading (thermal creep, a neighbor ramping up, a
+leak) fails instead of shipping a number that was only true at the
+start of the window.  The gate compares the mean of the window's last
+third against its first third; drift beyond T (default 0.5 — window
+noise on shared CPUs is large) fails.  The main contract check applies
+the same gate automatically when the doc carries a real (non-null)
+measured value and enough points."""
 
 import glob
 import json
@@ -76,6 +87,55 @@ def check_scaling_regression(new: dict, baseline: dict,
             bad.append((world, "missing", None,
                         base.get("samples_per_sec")))
     return bad
+
+
+TRAJECTORY_MIN_POINTS = 6
+
+
+def check_trajectory(series, tolerance: float = 0.5):
+    """Within-window drift check over a ``step_time_series`` list.
+
+    Returns None when healthy, else a human-readable failure string.
+    Fewer than TRAJECTORY_MIN_POINTS points (contract tests shrink
+    HVD_BENCH_ITERS) or non-numeric content is not gated — but a
+    *malformed* series (non-list) is always an error: the recording
+    contract broke."""
+    if not isinstance(series, list):
+        return f"step_time_series is not a list: {series!r}"
+    vals = [v for v in series if isinstance(v, (int, float)) and v >= 0]
+    if len(vals) != len(series):
+        return f"step_time_series carries non-numeric entries: {series!r}"
+    if len(vals) < TRAJECTORY_MIN_POINTS:
+        return None  # too short to judge drift (smoke/contract runs)
+    third = max(1, len(vals) // 3)
+    head = sum(vals[:third]) / third
+    tail = sum(vals[-third:]) / third
+    if head > 0 and tail > head * (1.0 + tolerance):
+        return (f"trajectory drift: last third of the window averaged "
+                f"{tail:.6f}s/step vs {head:.6f}s at the start "
+                f"(> {tolerance:.0%} slower over {len(vals)} steps)")
+    return None
+
+
+def trajectory_main(argv) -> int:
+    path = argv[argv.index("--trajectory") + 1]
+    tolerance = float(argv[argv.index("--tolerance") + 1]) \
+        if "--tolerance" in argv else 0.5
+    with open(path) as f:
+        doc = json.load(f)
+    series = doc.get("step_time_series")
+    if series is None:
+        print(f"no step_time_series in {path}: the artifact predates the "
+              "trajectory contract (or the child died before the timing "
+              "window)")
+        return 1
+    problem = check_trajectory(series, tolerance)
+    if problem:
+        print(f"trajectory gate FAILED for {path}: {problem}")
+        return 1
+    print(f"trajectory gate OK for {path} ({len(series)} steps, "
+          f"tolerance {tolerance:.0%})")
+    return 0
 
 
 def _default_baseline(exclude: str):
@@ -181,6 +241,21 @@ def main() -> int:
     if bogus:
         print(f"unknown phase names {sorted(bogus)} in {doc}")
         return 1
+    # trajectory contract: a doc with a REAL measured value must carry
+    # a healthy within-window series (provisional/salvaged docs — the
+    # deadline-kill path — legitimately have none).  The automatic gate
+    # uses a wide band (default 1.0 = only 2x+ in-window collapses;
+    # HVD_BENCH_TRAJECTORY_TOL overrides) — shared-CPU smoke windows
+    # are noisy; the strict default lives in the explicit --trajectory
+    # mode used for regression analysis
+    if doc["value"] is not None and not doc.get("provisional"):
+        series = doc.get("step_time_series")
+        if series is not None:
+            tol = float(os.environ.get("HVD_BENCH_TRAJECTORY_TOL", "1.0"))
+            problem = check_trajectory(series, tolerance=tol)
+            if problem:
+                print(f"bench {problem}")
+                return 1
     print(f"bench contract OK: {doc}")
     return 0
 
@@ -188,4 +263,6 @@ def main() -> int:
 if __name__ == "__main__":
     if "--scaling" in sys.argv:
         sys.exit(scaling_main(sys.argv))
+    if "--trajectory" in sys.argv:
+        sys.exit(trajectory_main(sys.argv))
     sys.exit(main())

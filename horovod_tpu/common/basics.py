@@ -190,6 +190,16 @@ def init(ranks: Optional[Sequence[int]] = None,
         # keep agreeing with it.
         from horovod_tpu.diagnostics import spans as _spans
         _spans.reset()
+        # observability history follows the world: the step-series
+        # recorder re-reads rank + HVD_TPU_OBS_DIR (a re-mesh can
+        # renumber us) and the anomaly detectors drop their baselines
+        # (a different world size legitimately changes step time —
+        # re-learn instead of flagging the re-mesh itself; findings
+        # already flagged are kept for the autopsy)
+        from horovod_tpu.metrics import timeseries as _timeseries
+        _timeseries.reset()
+        from horovod_tpu.metrics import anomaly as _anomaly
+        _anomaly.reset_baselines()
         from horovod_tpu.diagnostics import watchdog as _wd
         _wd.resume()  # re-arm across an elastic shutdown->init cycle
         from horovod_tpu.diagnostics.flight_recorder import (

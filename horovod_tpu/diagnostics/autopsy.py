@@ -140,26 +140,18 @@ def peer_debug_ports() -> Dict[int, tuple]:
     base = getattr(st.config, "metrics_port", 0)
     if not base or base <= 0:
         return {}
+    from horovod_tpu.metrics.exporter import peer_endpoint
     hosts_env = os.environ.get("HVD_TPU_PEER_HOSTS", "")
     hosts = [h.strip() for h in hosts_env.split(",")] if hosts_env else []
     out = {}
     for r in range(st.size):
         if r == st.rank:
             continue
-        if hosts:
-            host = hosts[r] if r < len(hosts) and hosts[r] else "127.0.0.1"
-            # the exporter binds base + local_rank; with the full
-            # rank→host map, rank r's local rank is its index among the
-            # ranks sharing its host (launchers fill hosts in order)
-            local = sum(1 for q in range(r) if q < len(hosts)
-                        and hosts[q] == hosts[r])
-            out[r] = (host, base + local)
-        else:
-            # single-host launches: local_rank == global rank;
-            # multi-host without PEER_HOSTS is skipped, not guessed
-            if st.cross_size > 1:
-                continue
-            out[r] = ("127.0.0.1", base + r)
+        # single-host launches need no map (local_rank == global rank);
+        # multi-host without PEER_HOSTS is skipped, not guessed
+        if not hosts and st.cross_size > 1:
+            continue
+        out[r] = peer_endpoint(r, base, hosts)
     return out
 
 
@@ -276,15 +268,33 @@ def write_autopsy(out_dir: Optional[str] = None, reason: str = "",
                 "evidence is missing from this bundle", unreachable)
 
     suspects = suspects_from_engine(engine)
+
+    def _anomalies():
+        # "was it degrading before it died?" — the anomaly engine's
+        # findings (step-time drift, throughput regression, persistent
+        # straggler, exposed-comm growth; docs/OBSERVABILITY.md
+        # "Anomaly engine") land in the summary so a hang autopsy also
+        # reports the degradation history that preceded the stall
+        from horovod_tpu.metrics.anomaly import recent_findings
+        return recent_findings()
+
+    anomalies = step(_anomalies) or []
     step(lambda: _write_json(
         os.path.join(bundle, f"summary_rank{rank}.json"), {
         "reason": reason,
         "rank": rank,
         "written_at": time.time(),
         "suspects": suspects,
+        "anomalies": anomalies,
         "peers_fetched": fetched,
         "peers_unreachable": unreachable,
     }))
+    if anomalies:
+        last = anomalies[-1]
+        get_logger().error(
+            "autopsy: %d anomaly finding(s) preceded this bundle; last: "
+            "%s at step %s", len(anomalies), last.get("kind"),
+            last.get("step"))
     if suspects:
         top = suspects[0]
         get_logger().error(

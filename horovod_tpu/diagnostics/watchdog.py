@@ -133,6 +133,10 @@ class Watchdog:
 _WATCHDOG: Optional[Watchdog] = None
 _SUSPENDED = False
 _LOCK = threading.Lock()
+# module-level progress stamp, kept even when no watchdog is armed —
+# /healthz reports last-step age regardless of autopsy configuration
+_LAST_PROGRESS_TS: Optional[float] = None
+_LAST_STEP: Optional[int] = None
 
 
 def ensure_watchdog() -> Optional[Watchdog]:
@@ -151,10 +155,29 @@ def ensure_watchdog() -> Optional[Watchdog]:
 
 
 def notify_progress(step: Optional[int] = None) -> None:
-    """Feed the process-wide watchdog (no-op when none is armed)."""
+    """Feed the process-wide watchdog (no-op when none is armed) and
+    stamp the module-level liveness clock either way."""
+    global _LAST_PROGRESS_TS, _LAST_STEP
+    _LAST_PROGRESS_TS = time.monotonic()
+    if step is not None:
+        _LAST_STEP = step
     wd = _WATCHDOG
     if wd is not None:
         wd.notify_progress(step)
+
+
+def liveness() -> dict:
+    """What ``/healthz`` reports beyond process-up: watchdog armed
+    state + configured threshold, the last completed step and how long
+    ago progress was last stamped (None before the first stamp — a
+    process still compiling is not 'stalled')."""
+    wd = _WATCHDOG
+    age = None if _LAST_PROGRESS_TS is None \
+        else time.monotonic() - _LAST_PROGRESS_TS
+    return {"armed": bool(wd is not None and wd.armed),
+            "timeout_s": _env_timeout(),
+            "last_step": _LAST_STEP,
+            "last_step_age_s": round(age, 3) if age is not None else None}
 
 
 def suspend() -> None:
@@ -179,9 +202,11 @@ def resume() -> None:
 
 def reset() -> None:
     """Stop and drop the process-wide watchdog (tests)."""
-    global _WATCHDOG, _SUSPENDED
+    global _WATCHDOG, _SUSPENDED, _LAST_PROGRESS_TS, _LAST_STEP
     with _LOCK:
         if _WATCHDOG is not None:
             _WATCHDOG.stop()
             _WATCHDOG = None
         _SUSPENDED = False
+        _LAST_PROGRESS_TS = None
+        _LAST_STEP = None

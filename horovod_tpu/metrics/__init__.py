@@ -1,6 +1,6 @@
 """Unified metrics & telemetry subsystem.
 
-Four layers (see ``docs/OBSERVABILITY.md``):
+Seven layers (see ``docs/OBSERVABILITY.md``):
 
 * :mod:`~horovod_tpu.metrics.registry` — dependency-free Counter / Gauge /
   Histogram with mergeable snapshots and Prometheus text rendering.
@@ -9,6 +9,15 @@ Four layers (see ``docs/OBSERVABILITY.md``):
   the coordinator's straggler attribution.
 * :mod:`~horovod_tpu.metrics.exporter` — per-worker HTTP ``/metrics`` +
   ``/healthz`` endpoints, enabled by ``HVD_TPU_METRICS_PORT``.
+* :mod:`~horovod_tpu.metrics.fleet` — tree-aggregated whole-job view:
+  ranks push mergeable snapshots up a fan-in tree; rank 0 serves one
+  ``/metrics/fleet`` scrape with per-rank breakdown gauges.
+* :mod:`~horovod_tpu.metrics.timeseries` — step-aligned history: bounded
+  ring + ``HVD_TPU_OBS_DIR`` JSONL, queryable by
+  ``python -m horovod_tpu.metrics history``.
+* :mod:`~horovod_tpu.metrics.anomaly` — online EWMA+MAD detectors over
+  the series: step-time drift, throughput regression, persistent
+  straggler, exposed-comm growth.
 * :mod:`~horovod_tpu.metrics.mfu` — chip peak FLOPs + compiled-HLO FLOPs
   counting shared by ``bench.py`` and the train-loop telemetry.
 """
@@ -30,3 +39,10 @@ from horovod_tpu.metrics.exporter import (  # noqa: F401
     MetricsExporter,
     start_worker_exporter,
 )
+from horovod_tpu.metrics.fleet import FleetAggregator  # noqa: F401
+from horovod_tpu.metrics.timeseries import (  # noqa: F401
+    StepSeriesRecorder,
+    TimeSeriesRing,
+    read_series,
+)
+from horovod_tpu.metrics.anomaly import AnomalyEngine  # noqa: F401

@@ -1,0 +1,197 @@
+"""Fleet observability CLI: ``python -m horovod_tpu.metrics <cmd>``.
+
+* ``top`` — live, curses-free fleet dashboard: polls one endpoint
+  (rank 0's ``/metrics/fleet`` by default, falling back to plain
+  ``/metrics``) and renders the headline numbers plus the per-rank
+  step-time breakdown as plain text, redrawn in place with ANSI
+  escapes (``--once`` / ``--iterations`` for scripting).
+* ``history`` — tabular dump of the persisted step time-series
+  (``HVD_TPU_OBS_DIR`` JSONL, docs/OBSERVABILITY.md "Step time-series
+  history"); plot-free by design — pipe into your tool of choice.
+
+Both are stdlib-only, like everything else in the metrics plane.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import Dict, Optional
+
+from horovod_tpu.metrics.timeseries import read_series
+
+
+def _fetch(url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Minimal text-format v0.0.4 parser: {series_key: value}."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            key, val = line.rsplit(" ", 1)
+            out[key] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def _labeled(series: Dict[str, float], name: str) -> Dict[str, float]:
+    """{label-suffix: value} for every series of ``name{...}``."""
+    out = {}
+    for key, v in series.items():
+        if key.startswith(name + "{") and key.endswith("}"):
+            out[key[len(name) + 1:-1]] = v
+    return out
+
+
+def _fmt_seconds(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    return f"{v * 1e3:.1f}ms" if v < 1.0 else f"{v:.2f}s"
+
+
+def render_top(series: Dict[str, float], source: str) -> str:
+    """One dashboard frame from a parsed scrape (pure: unit-testable)."""
+    lines = [f"hvd-tpu fleet view  [{source}]  "
+             f"{time.strftime('%H:%M:%S')}"]
+    size = series.get("hvd_fleet_size")
+    reporting = series.get("hvd_fleet_ranks_reporting")
+    if size is not None:
+        gap = "" if reporting == size else "  << RANKS MISSING"
+        lines.append(f"ranks reporting : {int(reporting or 0)}/{int(size)}"
+                     f" (tree depth {int(series.get('hvd_fleet_tree_depth', 0))},"
+                     f" generation {int(series.get('hvd_fleet_generation', 0))})"
+                     + gap)
+    steps = series.get("hvd_steps_total")
+    if steps is not None:
+        lines.append(f"steps total     : {int(steps)}")
+    tsum = series.get("hvd_step_time_seconds_sum")
+    tcnt = series.get("hvd_step_time_seconds_count")
+    if tcnt:
+        lines.append(f"step time mean  : {_fmt_seconds(tsum / tcnt)} "
+                     f"(over {int(tcnt)} samples)")
+    mn, mx = series.get("hvd_fleet_step_time_min"), \
+        series.get("hvd_fleet_step_time_max")
+    if mn is not None and mx is not None:
+        lines.append(
+            f"step time window: min {_fmt_seconds(mn)}  "
+            f"mean {_fmt_seconds(series.get('hvd_fleet_step_time_mean'))}  "
+            f"max {_fmt_seconds(mx)}")
+    straggler = series.get("hvd_fleet_straggler_rank")
+    if straggler is not None:
+        lines.append(f"straggler rank  : {int(straggler)}")
+    for key, value in sorted(series.items()):
+        if key.endswith("_per_second") and "{" not in key:
+            lines.append(f"{key[4:]:<16}: {value:,.1f}")
+    anomalies = _labeled(series, "hvd_anomaly_total")
+    if anomalies:
+        kinds = ", ".join(f"{k.split('=')[1].strip(chr(34))}×{int(v)}"
+                          for k, v in sorted(anomalies.items()))
+        lines.append(f"ANOMALIES       : {kinds}")
+    per_rank = _labeled(series, "hvd_fleet_rank_step_time_seconds")
+    if per_rank:
+        lines.append("per-rank windowed step time:")
+        entries = sorted(per_rank.items(),
+                         key=lambda kv: int(kv[0].split('"')[1]))
+        worst = max(v for _, v in entries)
+        for label, v in entries:
+            r = label.split('"')[1]
+            bar = "#" * max(1, int(30 * v / worst)) if worst > 0 else ""
+            lines.append(f"  rank {r:>4}  {_fmt_seconds(v):>9}  {bar}")
+    return "\n".join(lines)
+
+
+def cmd_top(args: argparse.Namespace) -> int:
+    url = args.url.rstrip("/")
+    endpoints = [url] if url.endswith(("/metrics", "/metrics/fleet")) \
+        else [url + "/metrics/fleet", url + "/metrics"]
+    iterations = 1 if args.once else args.iterations
+    n = 0
+    while iterations <= 0 or n < iterations:
+        n += 1
+        body = source = None
+        for ep in endpoints:
+            try:
+                body, source = _fetch(ep), ep
+                break
+            except Exception as e:
+                err = e
+        if body is None:
+            print(f"scrape failed: {err!r}", file=sys.stderr)
+            return 1
+        frame = render_top(parse_prometheus(body), source)
+        if n > 1:
+            # redraw in place: cursor home + clear-to-end (curses-free)
+            sys.stdout.write("\x1b[H\x1b[J")
+        elif iterations != 1:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(frame, flush=True)
+        if iterations <= 0 or n < iterations:
+            time.sleep(args.interval)
+    return 0
+
+
+def cmd_history(args: argparse.Namespace) -> int:
+    points = read_series(args.dir, rank=args.rank)
+    if args.last:
+        points = points[-args.last:]
+    if not points:
+        print(f"no series under {args.dir}", file=sys.stderr)
+        return 1
+    if args.json:
+        for p in points:
+            print(json.dumps(p))
+        return 0
+    cols = ["rank", "step", "step_time_s", "units_per_s"]
+    print(f"{'ts':<19} " + " ".join(f"{c:>12}" for c in cols))
+    for p in points:
+        ts = time.strftime("%Y-%m-%d %H:%M:%S",
+                           time.localtime(p.get("ts", 0)))
+        row = " ".join(
+            f"{p[c]:>12}" if c in p else f"{'-':>12}" for c in cols)
+        print(f"{ts:<19} {row}")
+    print(f"-- {len(points)} point(s)")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m horovod_tpu.metrics",
+                                description=__doc__.split("\n\n")[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    t = sub.add_parser("top", help="live fleet dashboard")
+    t.add_argument("--url", default="http://127.0.0.1:9090",
+                   help="exporter base URL (rank 0); /metrics/fleet is "
+                        "tried first, /metrics as fallback")
+    t.add_argument("--interval", type=float, default=2.0)
+    t.add_argument("--iterations", type=int, default=0,
+                   help="frames to render (0 = until interrupted)")
+    t.add_argument("--once", action="store_true",
+                   help="render one frame and exit")
+    t.set_defaults(fn=cmd_top)
+    h = sub.add_parser("history", help="dump the persisted time-series")
+    h.add_argument("--dir", required=True, help="HVD_TPU_OBS_DIR")
+    h.add_argument("--rank", type=int, default=None,
+                   help="one rank's series (default: all, time-sorted)")
+    h.add_argument("--last", type=int, default=0,
+                   help="only the last N points")
+    h.add_argument("--json", action="store_true",
+                   help="raw JSONL instead of the table")
+    h.set_defaults(fn=cmd_history)
+    args = p.parse_args(argv)
+    try:
+        return args.fn(args)
+    except KeyboardInterrupt:
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())

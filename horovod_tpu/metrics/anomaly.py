@@ -1,0 +1,321 @@
+"""Online anomaly engine: EWMA+MAD detectors over the step time-series.
+
+The live metrics answer *is the job healthy now*; the autopsy answers
+*what happened when it died*; this layer answers the question between
+them: **was it degrading before anyone noticed?**  Four detectors run
+over the points the time-series layer records
+(:mod:`horovod_tpu.metrics.timeseries`):
+
+* ``step_time_drift`` — step wall time drifts above its rolling
+  baseline (an EWMA with a MAD-style robust deviation estimate);
+* ``throughput_regression`` — units/s falls below the rolling baseline;
+* ``exposed_comm_growth`` — the exposed-communication fraction of the
+  step (``hvd_overlap_exposed_comm_seconds`` / step time) grows — the
+  overlap schedule is losing (docs/PERF.md "Overlap & bucketing");
+* ``persistent_straggler`` — the fleet view charges the SAME rank as
+  slowest for N consecutive aggregation windows (fed by the fleet
+  aggregator on rank 0, :mod:`horovod_tpu.metrics.fleet`).
+
+Every finding lands three ways: a ``hvd_anomaly_total{kind=...}``
+counter on ``/metrics``, an ``anomaly`` flight-recorder event, and the
+engine's bounded findings list, which the autopsy bundle's summary
+embeds — a hang autopsy now says whether the job was already sick.
+
+Detection is deliberately conservative (the acceptance bar is ZERO
+false positives on a clean run): a point is anomalous only when it is
+``k`` robust deviations AND a minimum ratio away from the baseline, it
+takes ``consecutive`` anomalous points in a row to flag, the baseline
+refuses to learn from anomalous points (a stall must not become the new
+normal), and a flagged detector stays quiet until the signal recovers
+(hysteresis — one finding per episode, not one per step).
+
+Thresholds are env-tunable (docs/KNOBS.md): ``HVD_TPU_ANOMALY_ALPHA``,
+``_K``, ``_MIN_RATIO``, ``_CONSECUTIVE``, ``_WARMUP``,
+``_STRAGGLER_WINDOWS``, ``_STRAGGLER_RATIO``; ``HVD_TPU_ANOMALY=0``
+disables the engine entirely.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from horovod_tpu.metrics.registry import Registry, default_registry
+
+MAX_FINDINGS = 64
+
+
+def _envf(name: str, default: float) -> float:
+    from horovod_tpu.common.config import env_float
+    return env_float(name, default)
+
+
+def _envi(name: str, default: int) -> int:
+    from horovod_tpu.common.config import env_int
+    return env_int(name, default)
+
+
+def enabled() -> bool:
+    from horovod_tpu.common.config import env_bool
+    return env_bool("ANOMALY", True)
+
+
+class EwmaMad:
+    """Robust online baseline: an EWMA of the value plus an EWMA of the
+    absolute residual (a MAD-flavored scale estimate — resistant to the
+    occasional spike a variance estimate would chase).  The deviation is
+    floored at ``rel_floor`` of the mean plus ``abs_floor`` so a
+    near-constant series (CPU smoke steps jitter by microseconds) does
+    not become hypersensitive."""
+
+    def __init__(self, alpha: float, rel_floor: float = 0.05,
+                 abs_floor: float = 1e-6) -> None:
+        self.alpha = alpha
+        self.rel_floor = rel_floor
+        self.abs_floor = abs_floor
+        self.mean: Optional[float] = None
+        self.mad = 0.0
+        self.n = 0
+
+    def update(self, v: float) -> None:
+        self.n += 1
+        if self.mean is None:
+            self.mean = v
+            return
+        resid = abs(v - self.mean)
+        self.mean += self.alpha * (v - self.mean)
+        self.mad += self.alpha * (resid - self.mad)
+
+    def deviation(self) -> float:
+        m = abs(self.mean or 0.0)
+        return max(self.mad, self.rel_floor * m, self.abs_floor)
+
+
+class _DriftDetector:
+    """Shared one-sided drift rule: warmup, then flag after
+    ``consecutive`` points beyond ``k`` deviations AND ``min_ratio``
+    from the baseline, with hysteresis and baseline freezing while
+    anomalous.  ``direction=+1`` flags increases (step time),
+    ``-1`` decreases (throughput)."""
+
+    def __init__(self, kind: str, direction: int, alpha: float, k: float,
+                 min_ratio: float, consecutive: int, warmup: int) -> None:
+        self.kind = kind
+        self.direction = direction
+        self.baseline = EwmaMad(alpha)
+        self.k = k
+        self.min_ratio = min_ratio
+        self.consecutive = max(1, consecutive)
+        self.warmup = max(2, warmup)
+        self._streak = 0
+        self._active = False  # inside a flagged episode
+
+    def observe(self, v: float) -> Optional[dict]:
+        b = self.baseline
+        if b.n < self.warmup:
+            b.update(v)
+            return None
+        mean, dev = b.mean, b.deviation()
+        delta = (v - mean) * self.direction
+        ratio_bad = (v > mean * self.min_ratio) if self.direction > 0 \
+            else (v < mean / self.min_ratio)
+        anomalous = delta > self.k * dev and ratio_bad
+        if not anomalous:
+            b.update(v)  # only healthy points teach the baseline
+            self._streak = 0
+            self._active = False  # recovered: a new episode may flag
+            return None
+        self._streak += 1
+        if self._active or self._streak < self.consecutive:
+            return None
+        self._active = True
+        return {"kind": self.kind, "value": round(v, 6),
+                "baseline": round(mean, 6),
+                "deviation": round(dev, 6),
+                "ratio": round(v / mean, 3) if mean else None,
+                "consecutive": self._streak}
+
+
+class AnomalyEngine:
+    """Per-process detector bank; feed it from the train loop
+    (``observe_step``) and, on rank 0, from the fleet aggregator
+    (``observe_fleet``).  Thread-safe; every call is O(1)."""
+
+    def __init__(self, registry: Optional[Registry] = None) -> None:
+        self._reg = registry or default_registry()
+        self._lock = threading.Lock()
+        alpha = _envf("ANOMALY_ALPHA", 0.1)
+        k = _envf("ANOMALY_K", 6.0)
+        min_ratio = _envf("ANOMALY_MIN_RATIO", 1.5)
+        consecutive = _envi("ANOMALY_CONSECUTIVE", 3)
+        warmup = _envi("ANOMALY_WARMUP", 10)
+        self._step = _DriftDetector(
+            "step_time_drift", +1, alpha, k, min_ratio, consecutive,
+            warmup)
+        self._thr = _DriftDetector(
+            "throughput_regression", -1, alpha, k, min_ratio, consecutive,
+            warmup)
+        self._exposed = _DriftDetector(
+            "exposed_comm_growth", +1, alpha, k, min_ratio, consecutive,
+            warmup)
+        self._straggler_windows = max(
+            2, _envi("ANOMALY_STRAGGLER_WINDOWS", 3))
+        self._straggler_ratio = _envf("ANOMALY_STRAGGLER_RATIO", 1.3)
+        self._straggler_rank: Optional[int] = None
+        self._straggler_run = 0
+        self._straggler_active = False
+        self.findings: List[dict] = []
+
+    # -- feeds ---------------------------------------------------------------
+    def observe_step(self, step: int, seconds: float,
+                     units_per_s: Optional[float] = None,
+                     exposed_comm_s: Optional[float] = None) -> List[dict]:
+        """One completed step; returns any NEW findings (usually [])."""
+        out = []
+        with self._lock:
+            f = self._step.observe(float(seconds))
+            if f:
+                out.append(self._flag(f, step=step))
+            if units_per_s is not None and units_per_s > 0:
+                f = self._thr.observe(float(units_per_s))
+                if f:
+                    out.append(self._flag(f, step=step))
+            if exposed_comm_s is not None and seconds > 0:
+                frac = max(0.0, min(1.0, exposed_comm_s / seconds))
+                f = self._exposed.observe(frac)
+                if f:
+                    out.append(self._flag(f, step=step))
+        return out
+
+    def observe_fleet(self, per_rank: Dict[Any, dict]) -> List[dict]:
+        """One fleet aggregation window: ``per_rank`` maps rank to a
+        breakdown entry carrying ``win_step_time`` (the fleet
+        aggregator's per-push windowed mean step time).  Flags when the
+        same rank stays the slowest — and meaningfully slower than the
+        fleet mean — for N consecutive windows."""
+        times = {int(r): e["win_step_time"] for r, e in per_rank.items()
+                 if isinstance(e, dict)
+                 and isinstance(e.get("win_step_time"), (int, float))}
+        with self._lock:
+            if len(times) < 2:
+                self._straggler_run = 0
+                self._straggler_rank = None
+                return []
+            worst = max(times, key=lambda r: times[r])
+            mean = sum(times.values()) / len(times)
+            others = [t for r, t in times.items() if r != worst]
+            peer_mean = sum(others) / len(others)
+            charged = peer_mean > 0 and \
+                times[worst] > peer_mean * self._straggler_ratio
+            if not charged:
+                self._straggler_run = 0
+                self._straggler_rank = None
+                self._straggler_active = False
+                return []
+            if worst == self._straggler_rank:
+                self._straggler_run += 1
+            else:
+                self._straggler_rank = worst
+                self._straggler_run = 1
+                self._straggler_active = False
+            if self._straggler_active or \
+                    self._straggler_run < self._straggler_windows:
+                return []
+            self._straggler_active = True
+            return [self._flag({
+                "kind": "persistent_straggler", "rank": worst,
+                "win_step_time": round(times[worst], 6),
+                "fleet_mean": round(mean, 6),
+                "windows": self._straggler_run})]
+
+    # -- reporting -----------------------------------------------------------
+    def _flag(self, finding: dict, **extra: Any) -> dict:
+        finding.update(extra)
+        finding["ts"] = round(time.time(), 3)
+        self.findings.append(finding)
+        del self.findings[:-MAX_FINDINGS]
+        kind = finding["kind"]
+        try:
+            self._reg.counter(
+                "hvd_anomaly_total",
+                help="anomaly-engine findings, per detector kind",
+                labels={"kind": kind}).inc()
+        except Exception:
+            pass
+        try:
+            from horovod_tpu.diagnostics.flight_recorder import record_event
+            # "detector", not "kind": the ring's own event-kind key wins
+            # (same convention as the chaos seam's "fault" field)
+            record_event("anomaly",
+                         **{("detector" if k == "kind" else k): v
+                            for k, v in finding.items() if k != "ts"})
+        except Exception:
+            pass
+        try:
+            from horovod_tpu.common.logging import get_logger
+            get_logger().warning("anomaly: %s %s", kind,
+                                 {k: v for k, v in finding.items()
+                                  if k not in ("kind", "ts")})
+        except Exception:
+            pass
+        return finding
+
+    def recent_findings(self, last_n: int = MAX_FINDINGS) -> List[dict]:
+        with self._lock:
+            return list(self.findings[-last_n:])
+
+    def reset_baselines(self) -> None:
+        """Forget the learned baselines but KEEP the findings: an
+        elastic re-mesh legitimately changes step time (different world
+        size) and must re-learn, while already-flagged degradation
+        stays available to the autopsy."""
+        alpha = self._step.baseline.alpha
+        with self._lock:
+            for det in (self._step, self._thr, self._exposed):
+                det.baseline = EwmaMad(alpha)
+                det._streak = 0
+                det._active = False
+            self._straggler_rank = None
+            self._straggler_run = 0
+            self._straggler_active = False
+
+
+_ENGINE: Optional[AnomalyEngine] = None
+_ENGINE_LOCK = threading.Lock()
+
+
+def default_engine() -> Optional[AnomalyEngine]:
+    """The process-wide engine (None when ``HVD_TPU_ANOMALY=0``);
+    created on first use, rebuilt by :func:`reset`."""
+    global _ENGINE
+    if not enabled():
+        return None
+    if _ENGINE is None:
+        with _ENGINE_LOCK:
+            if _ENGINE is None:
+                _ENGINE = AnomalyEngine()
+    return _ENGINE
+
+
+def recent_findings() -> List[dict]:
+    """Findings so far (empty when the engine never ran) — what the
+    autopsy summary embeds under ``anomalies``."""
+    eng = _ENGINE
+    return eng.recent_findings() if eng is not None else []
+
+
+def reset() -> None:
+    """Drop the process-wide engine so thresholds re-read env (tests,
+    elastic re-init)."""
+    global _ENGINE
+    with _ENGINE_LOCK:
+        _ENGINE = None
+
+
+def reset_baselines() -> None:
+    """Re-learn baselines in place (``hvd.init`` across an elastic
+    re-mesh); no-op when the engine never ran."""
+    eng = _ENGINE
+    if eng is not None:
+        eng.reset_baselines()

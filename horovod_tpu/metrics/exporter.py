@@ -28,6 +28,25 @@ from horovod_tpu.runner.http_kv import ThreadedHTTPServer
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
+def peer_endpoint(rank: int, base_port: int,
+                  hosts: Optional[list] = None) -> tuple:
+    """(host, exporter port) for ``rank`` under the exporter contract:
+    port is ``base + local rank`` (the rank's index among the ranks
+    sharing its host), host from a rank-indexed ``HVD_TPU_PEER_HOSTS``
+    list.  THE one implementation of the peer-address derivation — the
+    autopsy's cross-rank evidence fetch and the fleet tree's upstream
+    push both route through it, so the addressing contract cannot
+    silently fork.  A rank beyond (or blank in) the host map falls back
+    to the no-map convention (loopback, base + global rank) instead of
+    raising — a short map must degrade, not kill the caller's loop."""
+    if hosts and rank < len(hosts) and hosts[rank]:
+        host = hosts[rank]
+        local = sum(1 for q in range(rank)
+                    if q < len(hosts) and hosts[q] == host)
+        return host, base_port + local
+    return "127.0.0.1", base_port + rank
+
+
 class _MetricsHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # silence per-scrape access lines
         pass
@@ -45,6 +64,21 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         if path in ("/metrics", "/"):
             body = exporter.render().encode()
             self._send(200, body, CONTENT_TYPE)
+        elif path == "/metrics/fleet":
+            # tree-aggregated whole-job view (docs/OBSERVABILITY.md
+            # "Fleet view"): rank 0 serves the full fleet; any other
+            # rank serves its subtree (useful for debugging a branch)
+            fleet = exporter.fleet
+            if fleet is None:
+                self._send(404, b"fleet aggregation not enabled\n",
+                           "text/plain")
+                return
+            try:
+                body = fleet.render_fleet().encode()
+            except Exception as e:
+                self._send(500, repr(e).encode() + b"\n", "text/plain")
+                return
+            self._send(200, body, CONTENT_TYPE)
         elif path == "/healthz":
             doc = exporter.health()
             code = 200 if doc.get("status") == "ok" else 503
@@ -53,6 +87,32 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             self._debug(path[len("/debug/"):])
         else:
             self._send(404, b"not found\n", "text/plain")
+
+    def do_POST(self):
+        path = self.path.split("?", 1)[0].rstrip("/")
+        exporter: "MetricsExporter" = self.server.exporter
+        if path != "/metrics/push":
+            self._send(404, b"not found\n", "text/plain")
+            return
+        fleet = exporter.fleet
+        if fleet is None:
+            self._send(404, b"fleet aggregation not enabled\n",
+                       "text/plain")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(length))
+            accepted = fleet.ingest(doc)
+        except Exception as e:  # a malformed push must not kill serving
+            self._send(400, repr(e).encode() + b"\n", "text/plain")
+            return
+        if accepted:
+            self._send(200, b"ok\n", "text/plain")
+        else:
+            # 409: sender is from another world/generation — tells an
+            # elastic straggler to stop pushing here
+            self._send(409, b"rejected (world/generation mismatch)\n",
+                       "text/plain")
 
     def _debug(self, kind: str) -> None:
         """Hang-autopsy evidence endpoints (docs/OBSERVABILITY.md
@@ -103,6 +163,9 @@ class MetricsExporter:
         self._httpd = ThreadedHTTPServer(("0.0.0.0", port), _MetricsHandler)
         self._httpd.exporter = self
         self._thread: Optional[threading.Thread] = None
+        # fleet fan-in node served/fed through this exporter's HTTP
+        # plane (/metrics/fleet, /metrics/push); owned: stop() stops it
+        self.fleet = None  # metrics.fleet.FleetAggregator
 
     @property
     def port(self) -> int:
@@ -135,6 +198,14 @@ class MetricsExporter:
         return self.port
 
     def stop(self) -> None:
+        # the tree node first: its push loop targets peers that are
+        # also shutting down, and it must not outlive its own registry
+        if self.fleet is not None:
+            try:
+                self.fleet.stop()
+            except Exception:
+                pass
+            self.fleet = None
         # shutdown() handshakes with serve_forever() and blocks forever if
         # the serving thread was never started — only call it after start()
         if self._thread is not None:
@@ -165,11 +236,46 @@ def start_worker_exporter(state) -> Optional[MetricsExporter]:
         return fn() if fn is not None else {}
 
     def health():
-        return {"status": "ok" if state.initialized else "shutdown",
-                "rank": state.rank, "size": state.size,
-                "hostname": state.hostname}
+        """Liveness, not just process-up (docs/OBSERVABILITY.md): last
+        step age + watchdog state + engine reachability, going 503
+        (``status != ok``) once the step age crosses the watchdog
+        threshold — an external orchestrator can act on the stall
+        BEFORE the in-process autopsy fires."""
+        doc = {"status": "ok" if state.initialized else "shutdown",
+               "rank": state.rank, "size": state.size,
+               "hostname": state.hostname}
+        from horovod_tpu.diagnostics import watchdog as _wd
+        live = _wd.liveness()
+        age = live.get("last_step_age_s")
+        doc["watchdog"] = {"armed": live["armed"],
+                           "timeout_s": live["timeout_s"],
+                           "last_fed_age_s": age}
+        doc["last_step"] = live.get("last_step")
+        doc["last_step_age_s"] = age
+        be = state.backend
+        engine_alive = None
+        if be is not None:
+            try:
+                be.counters()
+                engine_alive = True
+            except Exception:
+                engine_alive = False
+        doc["engine_alive"] = engine_alive
+        threshold = live["timeout_s"]
+        if doc["status"] == "ok" and threshold and threshold > 0 \
+                and age is not None and age > threshold:
+            # steps HAVE been flowing (age is only set after the first
+            # progress stamp) and then stopped past the hang threshold
+            doc["status"] = "stalled"
+        return doc
 
     registry = default_registry()
+    # a re-meshed world must not serve the dead engine's last values as
+    # live state: the mirror gauges are re-populated by the NEW
+    # collector on first scrape (cumulative counters like
+    # hvd_stall_warnings_total are a different prefix and survive)
+    for prefix in ("hvd_engine_", "hvd_straggler_"):
+        registry.drop_prefix(prefix)
     collector = EngineCollector(counters_fn, registry=registry,
                                 stragglers_fn=stragglers_fn)
     try:
@@ -182,5 +288,21 @@ def start_worker_exporter(state) -> Optional[MetricsExporter]:
             "metrics exporter could not bind port %d (%s); metrics "
             "disabled for this worker", port, e)
         return None
+    # fleet fan-in tree node (docs/OBSERVABILITY.md "Fleet view"):
+    # child pushes ride this exporter plane, rank 0 serves
+    # /metrics/fleet; rebuilt per init so an elastic re-mesh re-wires
+    # the tree from the NEW (rank, size)
+    from horovod_tpu.metrics.fleet import FleetAggregator, fleet_enabled
+    if fleet_enabled() and state.rank >= 0:
+        import os as _os
+        gen = 0
+        try:
+            gen = int(_os.environ.get("HVD_ELASTIC_GENERATION", "0"))
+        except ValueError:
+            pass
+        exp.fleet = FleetAggregator(
+            rank=state.rank, size=state.size, base_port=base,
+            registry=registry, collectors=[collector.collect],
+            generation=gen, cross_size=state.cross_size).start()
     get_logger().info("metrics exporter serving on :%d/metrics", exp.port)
     return exp

@@ -213,10 +213,30 @@ class Registry:
         return self._get_or_create(Histogram, name, help, labels,
                                    buckets=buckets)
 
+    def get(self, name: str,
+            labels: Optional[Dict[str, str]] = None) -> Optional[_Instrument]:
+        """Fetch an existing instrument, or None — never creates (the
+        get-or-create constructors would register a zero-valued
+        instrument as a side effect of merely *asking*)."""
+        with self._lock:
+            return self._instruments.get(name + _label_key(labels))
+
     def unregister(self, name: str,
                    labels: Optional[Dict[str, str]] = None) -> None:
         with self._lock:
             self._instruments.pop(name + _label_key(labels), None)
+
+    def drop_prefix(self, prefix: str) -> int:
+        """Unregister every instrument whose key starts with ``prefix``;
+        returns the count.  Used on (re-)init to drop gauges mirroring a
+        DEAD engine's state (``hvd_engine_*``, ``hvd_straggler_*``) so a
+        re-meshed world's scrape never serves the previous generation's
+        last values as if they were live."""
+        with self._lock:
+            keys = [k for k in self._instruments if k.startswith(prefix)]
+            for k in keys:
+                del self._instruments[k]
+            return len(keys)
 
     def clear(self) -> None:
         with self._lock:

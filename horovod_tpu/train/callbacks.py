@@ -139,6 +139,11 @@ class StepTimer:
         from horovod_tpu.diagnostics.watchdog import notify_progress
         record_event("step_end", step=step_no, seconds=round(dt, 6))
         notify_progress(step_no)
+        # step-aligned history: the bounded ring (always) + the
+        # HVD_TPU_OBS_DIR JSONL (when set) — docs/OBSERVABILITY.md
+        # "Step time-series history"
+        from horovod_tpu.metrics import timeseries
+        timeseries.record_step(step_no, dt, units)
         if units:
             self.units.inc(units)
             if dt > 0:
@@ -228,21 +233,46 @@ class TelemetryCallback:
         from horovod_tpu.common.basics import is_initialized
         from horovod_tpu.diagnostics.watchdog import ensure_watchdog
         self.watchdog = ensure_watchdog() if is_initialized() else None
+        # online anomaly engine (docs/OBSERVABILITY.md "Anomaly
+        # engine"; HVD_TPU_ANOMALY=0 disables): every completed step
+        # feeds the drift detectors — a degradation is flagged as an
+        # hvd_anomaly_total{kind} counter + flight event while the job
+        # still runs, and lands in any later autopsy bundle's summary
+        from horovod_tpu.metrics.anomaly import default_engine
+        self.anomaly_engine = default_engine()
 
     def on_train_begin(self, *args, **kwargs):
         return args[0] if len(args) == 1 else (args or None)
 
     def on_step_begin(self) -> None:
+        self.timer.start_step()
         # chaos `step` seam (docs/CHAOS.md): rank kill/stall schedules
-        # key on the step counter; dead when no fault plan is armed
+        # key on the step counter; dead when no fault plan is armed.
+        # AFTER start_step: an injected stall must land INSIDE the
+        # timed window — it models a slow step, and the observability
+        # plane (step-time histogram, time-series, anomaly engine) has
+        # to see it exactly like a real one (a kill/exit does not care,
+        # and this way the step_begin flight event precedes it)
         from horovod_tpu import chaos
         chaos.step_tick(self._steps)
-        self.timer.start_step()
 
     def on_step_end(self, units: Optional[float] = None) -> None:
         dt = self.timer.end_step(
             self.units_per_step if units is None else units)
         self._steps += 1
+        if self.anomaly_engine is not None and dt is not None:
+            # exposed-comm gauge is optional (eager overlap path only);
+            # Registry.get never creates — absent stays absent
+            exposed = self.timer._reg.get(
+                "hvd_overlap_exposed_comm_seconds")
+            thr = self.timer.throughput.value or None
+            try:
+                self.anomaly_engine.observe_step(
+                    int(self.timer.steps.value), dt, units_per_s=thr,
+                    exposed_comm_s=exposed.value
+                    if exposed is not None else None)
+            except Exception:
+                pass  # detection must never break the loop
         if self.timer.flops_per_step is None and self._lowerable is not None:
             from horovod_tpu.metrics.mfu import hlo_flops_per_device
             try:

@@ -1,0 +1,237 @@
+"""Unit tests for the online anomaly engine (docs/OBSERVABILITY.md
+"Anomaly engine"): EWMA+MAD baseline behavior, the four detector kinds,
+hysteresis (one finding per episode), baseline freezing under anomaly,
+the zero-false-positive bar on clean/noisy series, and — the ISSUE 7
+acceptance — an injected slow-step window (PR-5 chaos ``step`` stall
+seam) flagged as ``step_time_drift`` with a flight event and an autopsy
+summary naming the degradation, while an identical clean run flags
+nothing."""
+
+import json
+import os
+import random
+
+import pytest
+
+from horovod_tpu.metrics.anomaly import AnomalyEngine, EwmaMad
+from horovod_tpu.metrics.registry import Registry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_singletons():
+    from horovod_tpu.metrics import anomaly, timeseries
+    anomaly.reset()
+    timeseries.reset()
+    yield
+    anomaly.reset()
+    timeseries.reset()
+
+
+def _engine():
+    return AnomalyEngine(registry=Registry())
+
+
+def _counter(eng, kind):
+    c = eng._reg.get("hvd_anomaly_total", labels={"kind": kind})
+    return c.value if c is not None else 0.0
+
+
+# -- baseline ---------------------------------------------------------------
+
+def test_ewma_mad_tracks_and_floors():
+    b = EwmaMad(alpha=0.2)
+    for _ in range(50):
+        b.update(1.0)
+    assert b.mean == pytest.approx(1.0)
+    # deviation floored relative to the mean: a perfectly flat series
+    # must not become infinitely sensitive
+    assert b.deviation() >= 0.05 * 1.0
+    for _ in range(200):
+        b.update(2.0)
+    assert b.mean == pytest.approx(2.0, rel=0.01)
+
+
+# -- step-time drift --------------------------------------------------------
+
+def test_clean_run_flags_nothing():
+    eng = _engine()
+    rng = random.Random(7)
+    for i in range(500):  # jittery but healthy: +-20% around 10ms
+        dt = 0.010 * (1.0 + 0.2 * (rng.random() - 0.5))
+        assert eng.observe_step(i, dt, units_per_s=32 / dt) == []
+    assert eng.recent_findings() == []
+
+
+def test_step_time_drift_flagged_once_per_episode():
+    eng = _engine()
+    for i in range(30):
+        eng.observe_step(i, 0.010)
+    findings = []
+    for i in range(30, 40):  # 10 stalled steps, one episode
+        findings += eng.observe_step(i, 0.200)
+    assert len(findings) == 1, findings
+    f = findings[0]
+    assert f["kind"] == "step_time_drift"
+    assert f["value"] == pytest.approx(0.2)
+    assert f["baseline"] == pytest.approx(0.010, rel=0.05)
+    assert _counter(eng, "step_time_drift") == 1
+    # recovery, then a second degradation: a NEW episode flags again
+    for i in range(40, 60):
+        assert eng.observe_step(i, 0.010) == []
+    findings = []
+    for i in range(60, 70):
+        findings += eng.observe_step(i, 0.200)
+    assert len(findings) == 1
+    assert _counter(eng, "step_time_drift") == 2
+
+
+def test_baseline_refuses_to_learn_from_the_stall():
+    eng = _engine()
+    for i in range(20):
+        eng.observe_step(i, 0.010)
+    for i in range(20, 120):  # a LONG stall: 5x baseline for 100 steps
+        eng.observe_step(i, 0.050)
+    # the stall never becomes the new normal
+    assert eng._step.baseline.mean == pytest.approx(0.010, rel=0.05)
+
+
+def test_single_spike_not_flagged():
+    eng = _engine()
+    for i in range(30):
+        eng.observe_step(i, 0.010)
+    assert eng.observe_step(30, 0.5) == []   # one GC pause
+    assert eng.observe_step(31, 0.010) == []
+    assert eng.recent_findings() == []
+
+
+def test_throughput_regression_and_exposed_growth():
+    eng = _engine()
+    for i in range(30):
+        eng.observe_step(i, 0.010, units_per_s=3200.0,
+                         exposed_comm_s=0.001)
+    out = []
+    for i in range(30, 40):  # throughput halves, exposed comm triples
+        out += eng.observe_step(i, 0.010, units_per_s=1500.0,
+                                exposed_comm_s=0.006)
+    kinds = {f["kind"] for f in out}
+    assert kinds == {"throughput_regression", "exposed_comm_growth"}
+
+
+# -- persistent straggler ---------------------------------------------------
+
+def _window(times):
+    return {str(r): {"win_step_time": t} for r, t in times.items()}
+
+
+def test_persistent_straggler_needs_same_rank_n_windows():
+    eng = _engine()
+    healthy = _window({0: 0.01, 1: 0.011, 2: 0.0105})
+    for _ in range(10):
+        assert eng.observe_fleet(healthy) == []
+    # rank 2 turns slow; windows 1 and 2 accumulate, window 3 flags
+    slow = _window({0: 0.01, 1: 0.011, 2: 0.05})
+    assert eng.observe_fleet(slow) == []
+    assert eng.observe_fleet(slow) == []
+    out = eng.observe_fleet(slow)
+    assert len(out) == 1 and out[0]["kind"] == "persistent_straggler"
+    assert out[0]["rank"] == 2
+    assert eng.observe_fleet(slow) == []  # hysteresis: same episode
+    assert _counter(eng, "persistent_straggler") == 1
+
+
+def test_rotating_straggler_not_flagged():
+    """A different rank slowest each window is load noise, not a sick
+    host — the trend detector must not fire."""
+    eng = _engine()
+    for i in range(12):
+        slow_rank = i % 3
+        times = {r: (0.05 if r == slow_rank else 0.01) for r in range(3)}
+        assert eng.observe_fleet(_window(times)) == []
+    assert eng.recent_findings() == []
+
+
+def test_remesh_resets_baselines_keeps_findings():
+    eng = _engine()
+    for i in range(30):
+        eng.observe_step(i, 0.010)
+    for i in range(30, 40):
+        eng.observe_step(i, 0.2)
+    assert len(eng.recent_findings()) == 1
+    eng.reset_baselines()
+    assert len(eng.recent_findings()) == 1  # history survives
+    # the new world runs 4x slower — legitimately; no flag
+    for i in range(60):
+        assert eng.observe_step(i, 0.040) == []
+
+
+# -- ISSUE 7 acceptance: chaos stall window -> flagged, clean run -> not ----
+
+def _run_telemetry_loop(steps):
+    from horovod_tpu.train.callbacks import TelemetryCallback
+    cb = TelemetryCallback(units_per_step=32, registry=Registry())
+    for _ in range(steps):
+        cb.on_step_begin()
+        cb.on_step_end()
+    return cb
+
+
+def test_injected_slow_step_window_is_flagged_end_to_end(
+        tmp_path, monkeypatch):
+    """The acceptance path: a chaos `step` stall window makes
+    hvd_anomaly_total{kind="step_time_drift"} increment on the DEFAULT
+    registry, lands an `anomaly` flight event, and the autopsy bundle's
+    summary names the degradation — with zero findings on a clean run
+    of the same length."""
+    from horovod_tpu import chaos
+    from horovod_tpu.diagnostics.flight_recorder import recorder
+    from horovod_tpu.metrics import anomaly
+    from horovod_tpu.metrics.registry import default_registry
+
+    recorder().clear()
+    plan = {"faults": [{"seam": "step", "kind": "stall",
+                        "start": 30, "stop": 36, "stall_s": 0.15}]}
+    monkeypatch.setenv("HVD_TPU_FAULT_PLAN", json.dumps(plan))
+    chaos.install(rank=0)
+    try:
+        _run_telemetry_loop(45)
+    finally:
+        monkeypatch.delenv("HVD_TPU_FAULT_PLAN")
+        chaos.uninstall()
+    findings = anomaly.recent_findings()
+    kinds = [f["kind"] for f in findings]
+    assert "step_time_drift" in kinds, findings
+    counter = default_registry().get("hvd_anomaly_total",
+                                     labels={"kind": "step_time_drift"})
+    assert counter is not None and counter.value >= 1
+    events = [e for e in recorder().events() if e["kind"] == "anomaly"]
+    assert events, recorder().events()
+    assert events[0]["detector"] == "step_time_drift"
+    assert any(e.get("value", 0) > 0.1 for e in events)
+
+    # the autopsy summary names the degradation
+    from horovod_tpu.diagnostics.autopsy import write_autopsy
+    bundle = write_autopsy(str(tmp_path / "bundle"), reason="test",
+                           fetch_peers=False)
+    summaries = [f for f in os.listdir(bundle)
+                 if f.startswith("summary_rank")]
+    assert summaries
+    with open(os.path.join(bundle, summaries[0])) as f:
+        summary = json.load(f)
+    assert any(a["kind"] == "step_time_drift"
+               for a in summary["anomalies"]), summary
+
+
+def test_clean_run_of_same_length_flags_nothing():
+    from horovod_tpu.metrics import anomaly
+    _run_telemetry_loop(45)
+    assert anomaly.recent_findings() == []
+
+
+def test_anomaly_disabled_by_env(monkeypatch):
+    from horovod_tpu.metrics import anomaly
+    monkeypatch.setenv("HVD_TPU_ANOMALY", "0")
+    anomaly.reset()
+    assert anomaly.default_engine() is None
+    assert anomaly.recent_findings() == []
+    cb = _run_telemetry_loop(3)  # telemetry runs fine without the engine
+    assert cb.anomaly_engine is None

@@ -1,0 +1,349 @@
+"""Unit tests for the fleet observability plane (docs/OBSERVABILITY.md
+"Fleet view"): tree topology math, subtree merge semantics, push/ingest
+over the exporter HTTP plane, staleness and world-mismatch rejection,
+rank-0 breakdown gauges, and — the ISSUE 7 satellite — exporter +
+EngineCollector behavior across an elastic ``shutdown -> init`` re-mesh
+(no stale collector serving the dead engine's counters, sane port
+rebinding, fleet tree re-registered).  The live 2-process scrape is
+covered by test_core_multiprocess.py::test_fleet_scrape_survives_remesh.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from horovod_tpu.metrics.engine import EngineCollector
+from horovod_tpu.metrics.exporter import MetricsExporter
+from horovod_tpu.metrics.fleet import (FleetAggregator, children_of,
+                                       parent_of, rank_endpoint,
+                                       tree_depth)
+from horovod_tpu.metrics.registry import Registry
+
+
+# -- topology ---------------------------------------------------------------
+
+def test_tree_topology_complete_and_consistent():
+    """Every rank except 0 has exactly one parent, every rank is its
+    parent's child, and the tree covers the whole world."""
+    for size in (1, 2, 3, 5, 16, 100):
+        for arity in (1, 2, 4, 8):
+            seen = {0}
+            for r in range(1, size):
+                p = parent_of(r, arity)
+                assert 0 <= p < r  # parents precede children: no cycles
+                assert r in children_of(p, size, arity)
+                seen.add(r)
+            assert seen == set(range(size))
+            for r in range(size):
+                assert len(children_of(r, size, arity)) <= arity
+
+
+def test_tree_depth_logarithmic():
+    assert tree_depth(1, 4) == 0
+    assert tree_depth(5, 4) == 1
+    assert tree_depth(21, 4) == 2
+    assert tree_depth(1000, 4) <= 5  # O(log_4 W), not O(W)
+    assert tree_depth(8, 1) == 7     # degenerate chain still terminates
+
+
+def test_rank_endpoint_peer_hosts(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_PEER_HOSTS", "hostA,hostA,hostB,hostB")
+    assert rank_endpoint(0, 9090) == ("hostA", 9090)
+    assert rank_endpoint(1, 9090) == ("hostA", 9091)  # 2nd worker on A
+    assert rank_endpoint(2, 9090) == ("hostB", 9090)  # 1st worker on B
+    monkeypatch.delenv("HVD_TPU_PEER_HOSTS")
+    assert rank_endpoint(3, 9090) == ("127.0.0.1", 9093)
+
+
+def test_rank_endpoint_short_host_map_degrades(monkeypatch):
+    """A PEER_HOSTS list shorter than the world must fall back to the
+    no-map convention for the uncovered ranks, not raise and silently
+    kill the push loop."""
+    monkeypatch.setenv("HVD_TPU_PEER_HOSTS", "h0,h0")
+    assert rank_endpoint(3, 9090) == ("127.0.0.1", 9093)
+    monkeypatch.setenv("HVD_TPU_PEER_HOSTS", "h0,,h1")  # blank entry
+    assert rank_endpoint(1, 9090) == ("127.0.0.1", 9091)
+    # and the autopsy uses the SAME implementation
+    from horovod_tpu.metrics.exporter import peer_endpoint
+    assert peer_endpoint(7, 9090, ["h0", "h0"]) == ("127.0.0.1", 9097)
+
+
+def test_cross_host_without_peer_hosts_disables_push(monkeypatch):
+    """Multi-host without a rank->host map: upstream addresses cannot
+    be derived — refuse to guess loopback (pushes off, subtree serving
+    stays up); PEER_HOSTS re-enables the tree."""
+    monkeypatch.delenv("HVD_TPU_PEER_HOSTS", raising=False)
+    blind = FleetAggregator(rank=1, size=4, base_port=1,
+                            registry=Registry(), push_interval=60.0,
+                            cross_size=2)
+    assert not blind.routable
+    blind.flush()  # no connection attempt: failures stay 0
+    assert blind._push_failures == 0 and blind.pushes_sent == 0
+    assert blind.subtree_doc()["covers"] == [1]  # local view still works
+    monkeypatch.setenv("HVD_TPU_PEER_HOSTS", "h0,h0,h1,h1")
+    routed = FleetAggregator(rank=1, size=4, base_port=1,
+                             registry=Registry(), push_interval=60.0,
+                             cross_size=2)
+    assert routed.routable
+    assert FleetAggregator(rank=0, size=4, base_port=1,
+                           registry=Registry(), push_interval=60.0,
+                           cross_size=2).routable  # root never pushes
+
+
+# -- merge / ingest ---------------------------------------------------------
+
+def _agg(rank, size, reg=None, **kw):
+    kw.setdefault("push_interval", 60.0)  # no background push in tests
+    return FleetAggregator(rank=rank, size=size, base_port=9090,
+                           registry=reg or Registry(), **kw)
+
+
+def _child_doc(agg_child):
+    return agg_child.subtree_doc()
+
+
+def test_subtree_merges_counters_and_covers():
+    regs = [Registry() for _ in range(3)]
+    for i, reg in enumerate(regs):
+        reg.counter("hvd_steps_total").inc(10 * (i + 1))
+    root = _agg(0, 3, regs[0], arity=4)
+    for r in (1, 2):
+        assert _agg(r, 3, regs[r], arity=4).parent == 0
+        assert root.ingest(_child_doc(_agg(r, 3, regs[r], arity=4)))
+    doc = root.subtree_doc()
+    assert doc["covers"] == [0, 1, 2]
+    assert doc["snapshot"]["hvd_steps_total"]["value"] == 60
+    assert set(doc["per_rank"]) == {"0", "1", "2"}
+
+
+def test_ingest_rejects_other_world_and_generation():
+    root = _agg(0, 2, generation=1)
+    child = _agg(1, 2, generation=1)
+    ok_doc = _child_doc(child)
+    assert root.ingest(ok_doc)
+    wrong_size = dict(ok_doc, size=3)
+    wrong_gen = dict(ok_doc, generation=0)
+    not_my_child = dict(ok_doc, from_rank=5)
+    garbage = {"hello": "world"}
+    for doc in (wrong_size, wrong_gen, not_my_child, garbage):
+        assert not root.ingest(doc)
+    assert root.rejected == 4
+
+
+def test_stale_children_drop_out_of_the_merge():
+    root = _agg(0, 2, push_interval=0.05)  # stale_after = 0.15s
+    child = _agg(1, 2)
+    assert root.ingest(_child_doc(child))
+    assert 1 in root.subtree_doc()["covers"]
+    import time
+    time.sleep(0.2)
+    doc = root.subtree_doc()
+    assert doc["covers"] == [0]  # silence != stale data served as live
+    assert doc["stale"] == [1]
+
+
+def test_mismatched_histogram_bounds_degrade_to_local_view():
+    """A mid-rollout worker with different bucket bounds must not take
+    the whole fleet view down."""
+    ra, rb = Registry(), Registry()
+    ra.histogram("h", buckets=[1.0]).observe(0.5)
+    rb.histogram("h", buckets=[2.0]).observe(0.5)
+    root = _agg(0, 2, ra)
+    assert root.ingest(_child_doc(_agg(1, 2, rb)))
+    doc = root.subtree_doc()  # must not raise
+    assert doc["covers"] == [0]
+
+
+def test_fleet_breakdown_gauges_and_straggler():
+    regs = {r: Registry() for r in range(3)}
+    # per-rank windowed step time = the step-time histogram's delta
+    # since the previous push (first push: everything so far)
+    aggs = {r: _agg(r, 3, regs[r]) for r in range(3)}
+    for r, mean in ((0, 0.01), (1, 0.01), (2, 0.05)):
+        for _ in range(4):
+            regs[r].histogram("hvd_step_time_seconds").observe(mean)
+    root = aggs[0]
+    for r in (1, 2):
+        assert root.ingest(aggs[r].subtree_doc())
+    snap = root.fleet_snapshot()["snapshot"]
+    assert snap["hvd_fleet_size"]["value"] == 3
+    assert snap["hvd_fleet_ranks_reporting"]["value"] == 3
+    assert snap["hvd_fleet_straggler_rank"]["value"] == 2
+    assert snap["hvd_fleet_step_time_max"]["value"] == pytest.approx(
+        0.05, rel=0.01)
+    assert snap["hvd_fleet_step_time_min"]["value"] == pytest.approx(
+        0.01, rel=0.01)
+    assert snap['hvd_fleet_rank_step_time_seconds{rank="2"}'][
+        "value"] == pytest.approx(0.05, rel=0.01)
+    # the synthesized gauges are view-only: they must NOT leak back
+    # into the local registry (they would ride the next upstream push)
+    assert "hvd_fleet_size" not in regs[0].snapshot()
+
+
+def test_scrape_does_not_consume_the_push_window():
+    """A dashboard polling /metrics/fleet faster than the push cadence
+    must not starve the window the next push reports; and a rank with
+    no new steps since its last push keeps its last window mean instead
+    of vanishing from the breakdown."""
+    reg = Registry()
+    agg = _agg(0, 1, reg)
+    for _ in range(4):
+        reg.histogram("hvd_step_time_seconds").observe(0.02)
+    for _ in range(5):  # scrape storm between pushes
+        snap = agg.fleet_snapshot()["snapshot"]
+        assert snap["hvd_fleet_step_time_mean"]["value"] == \
+            pytest.approx(0.02, rel=0.01)
+    # the push still sees the whole 4-step window
+    doc = agg.subtree_doc(consume_window=True)
+    assert doc["per_rank"]["0"]["win_step_time"] == pytest.approx(
+        0.02, rel=0.01)
+    # idle since that push: the breakdown carries the last closed window
+    doc = agg.subtree_doc(consume_window=True)
+    assert doc["per_rank"]["0"]["win_step_time"] == pytest.approx(
+        0.02, rel=0.01)
+
+
+# -- push over the exporter HTTP plane --------------------------------------
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def test_push_and_fleet_scrape_over_http():
+    reg0, reg1 = Registry(), Registry()
+    reg0.counter("hvd_steps_total").inc(3)
+    reg1.counter("hvd_steps_total").inc(4)
+    exp = MetricsExporter(registry=reg0, port=0)
+    exp.fleet = _agg(0, 2, reg0)
+    exp.start()
+    try:
+        child_doc = _child_doc(_agg(1, 2, reg1))
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{exp.port}/metrics/push",
+            data=json.dumps(child_doc).encode(), method="POST")
+        assert urllib.request.urlopen(req, timeout=10).status == 200
+        status, body = _get(exp.port, "/metrics/fleet")
+        assert status == 200
+        assert "hvd_steps_total 7" in body  # 3 + 4: merged, not local
+        assert "hvd_fleet_ranks_reporting 2" in body
+    finally:
+        exp.stop()
+
+
+def test_push_rejected_with_409_and_no_fleet_404():
+    exp = MetricsExporter(registry=Registry(), port=0)
+    exp.fleet = _agg(0, 2, generation=7)
+    exp.start()
+    try:
+        stale = dict(_child_doc(_agg(1, 2, generation=6)))
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{exp.port}/metrics/push",
+            data=json.dumps(stale).encode(), method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 409
+    finally:
+        exp.stop()
+    exp2 = MetricsExporter(registry=Registry(), port=0)
+    exp2.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(exp2.port, "/metrics/fleet")
+        assert e.value.code == 404  # fleet disabled: explicit, not 500
+    finally:
+        exp2.stop()
+
+
+def test_child_pushes_upstream_for_real():
+    """End-to-end over loopback: a child aggregator's flush() POSTs to
+    the parent exporter's real port and the parent's fleet view then
+    covers both ranks."""
+    reg0, reg1 = Registry(), Registry()
+    reg0.counter("hvd_steps_total").inc(1)
+    reg1.counter("hvd_steps_total").inc(2)
+    exp = MetricsExporter(registry=reg0, port=0)
+    exp.fleet = _agg(0, 2, reg0)
+    exp.start()
+    try:
+        child = FleetAggregator(rank=1, size=2, base_port=exp.port,
+                                registry=reg1, push_interval=60.0)
+        # rank_endpoint(0, base) = base + rank 0 = the exporter's port
+        child.flush()
+        doc = exp.fleet.subtree_doc()
+        assert doc["covers"] == [0, 1]
+        assert doc["snapshot"]["hvd_steps_total"]["value"] == 3
+        assert child.pushes_sent == 1
+    finally:
+        exp.stop()
+
+
+def test_dead_parent_degrades_gracefully():
+    child = FleetAggregator(rank=1, size=2, base_port=1,  # nothing there
+                            registry=Registry(), push_interval=60.0)
+    child.flush()  # must not raise
+    child.flush()
+    assert child.pushes_sent == 0
+    assert child._push_failures == 2
+
+
+# -- elastic re-mesh coverage (ISSUE 7 satellite) ---------------------------
+
+def test_remesh_drops_stale_engine_gauges_and_rebinds():
+    """Generation 1's exporter mirrors engine counters; after a
+    shutdown -> init re-mesh the NEW collector must serve the NEW
+    engine's counters on the SAME port, with no gauge left from the
+    dead engine."""
+    reg = Registry()
+    gen1_counters = {"cache_hits": 8, "cycles": 100, "legacy_only": 5}
+    col1 = EngineCollector(lambda: dict(gen1_counters), registry=reg)
+    exp1 = MetricsExporter(registry=reg, port=0,
+                           collectors=[col1.collect])
+    exp1.start()
+    port = exp1.port
+    _get(port, "/metrics")
+    assert reg.snapshot()["hvd_engine_legacy_only"]["value"] == 5
+    exp1.stop()
+
+    # re-mesh: the new engine has different counters (no legacy_only);
+    # init-time hygiene drops the hvd_engine_*/hvd_straggler_* mirrors
+    # exactly like start_worker_exporter does
+    for prefix in ("hvd_engine_", "hvd_straggler_"):
+        reg.drop_prefix(prefix)
+    gen2_counters = {"cache_hits": 1, "cycles": 2}
+    col2 = EngineCollector(lambda: dict(gen2_counters), registry=reg)
+    exp2 = MetricsExporter(registry=reg, port=port,  # same port: rebind
+                           collectors=[col2.collect])
+    exp2.fleet = _agg(0, 2, reg, generation=1)
+    exp2.start()
+    try:
+        assert exp2.port == port
+        _, body = _get(port, "/metrics")
+        assert "hvd_engine_legacy_only" not in body  # dead engine gone
+        assert "hvd_engine_cache_hits 1" in body     # new engine served
+        # fleet tree re-registered for the new generation: old-world
+        # pushes bounce, new-world pushes land
+        assert not exp2.fleet.ingest(
+            _child_doc(_agg(1, 2, generation=0)))
+        assert exp2.fleet.ingest(_child_doc(_agg(1, 2, generation=1)))
+        _, fleet_body = _get(port, "/metrics/fleet")
+        assert "hvd_fleet_generation 1" in fleet_body
+        assert "hvd_fleet_ranks_reporting 2" in fleet_body
+    finally:
+        exp2.stop()
+    # both generations down: the port serves nothing (no leaked thread)
+    with pytest.raises((OSError, urllib.error.URLError)):
+        _get(port, "/healthz")
+
+
+def test_exporter_stop_stops_fleet_thread():
+    exp = MetricsExporter(registry=Registry(), port=0)
+    agg = _agg(0, 1, push_interval=0.05)
+    exp.fleet = agg.start()
+    exp.start()
+    exp.stop()
+    assert exp.fleet is None
+    assert agg._thread is None  # joined, not leaked

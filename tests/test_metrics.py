@@ -380,3 +380,123 @@ def test_telemetry_callback_hooks():
     assert snap["hvd_steps_total"]["value"] == 3
     assert snap["hvd_tokens_total"]["value"] == 48
     assert cb.on_epoch_end({"loss": 1.0}) == {"loss": 1.0}
+
+
+# -- registry get/drop_prefix (fleet + re-mesh hygiene) ---------------------
+
+def test_registry_get_never_creates():
+    reg = Registry()
+    assert reg.get("absent") is None
+    assert "absent" not in reg.snapshot()
+    c = reg.counter("present", labels={"a": "1"})
+    assert reg.get("present", labels={"a": "1"}) is c
+    assert reg.get("present") is None  # label set is part of identity
+
+
+def test_registry_drop_prefix():
+    reg = Registry()
+    reg.gauge("hvd_engine_cycles").set(1)
+    reg.gauge("hvd_engine_cache_hits").set(2)
+    reg.counter("hvd_stall_warnings_total").inc(3)
+    assert reg.drop_prefix("hvd_engine_") == 2
+    snap = reg.snapshot()
+    assert "hvd_engine_cycles" not in snap
+    # cumulative counters under other prefixes survive the re-mesh
+    assert snap["hvd_stall_warnings_total"]["value"] == 3
+
+
+# -- /healthz liveness (ISSUE 7 satellite) ----------------------------------
+
+def test_watchdog_liveness_doc():
+    from horovod_tpu.diagnostics import watchdog as wd
+    wd.reset()
+    try:
+        live = wd.liveness()
+        assert live["last_step"] is None
+        assert live["last_step_age_s"] is None  # still compiling != stalled
+        wd.notify_progress(7)
+        live = wd.liveness()
+        assert live["last_step"] == 7
+        assert 0 <= live["last_step_age_s"] < 5
+    finally:
+        wd.reset()
+
+
+def _health_doc_like_worker(state_initialized, age_s, timeout_s,
+                            last_step):
+    """The exporter's health rule, distilled: stalled only when steps
+    HAVE flowed and then stopped past the watchdog threshold."""
+    status = "ok" if state_initialized else "shutdown"
+    if status == "ok" and timeout_s and timeout_s > 0 \
+            and age_s is not None and age_s > timeout_s:
+        status = "stalled"
+    return status
+
+
+def test_healthz_statuses():
+    assert _health_doc_like_worker(True, None, 600, None) == "ok"
+    assert _health_doc_like_worker(True, 10, 600, 5) == "ok"
+    assert _health_doc_like_worker(True, 700, 600, 5) == "stalled"
+    assert _health_doc_like_worker(True, 700, 0, 5) == "ok"  # disarmed
+    assert _health_doc_like_worker(False, 1, 600, 5) == "shutdown"
+
+
+def test_healthz_liveness_served_end_to_end(monkeypatch):
+    """A live exporter built the way hvd.init builds it (same health
+    closure semantics): reports last-step age, flips to 503 once the
+    age crosses the threshold."""
+    from horovod_tpu.diagnostics import watchdog as wd
+
+    class _State:
+        initialized = True
+        rank, size, hostname = 0, 1, "test-host"
+        backend = None
+
+    state = _State()
+    wd.reset()
+
+    def health():
+        doc = {"status": "ok" if state.initialized else "shutdown",
+               "rank": state.rank, "size": state.size}
+        live = wd.liveness()
+        doc["last_step"] = live["last_step"]
+        doc["last_step_age_s"] = live["last_step_age_s"]
+        doc["watchdog"] = {"armed": live["armed"],
+                           "timeout_s": live["timeout_s"]}
+        age = live["last_step_age_s"]
+        if doc["status"] == "ok" and live["timeout_s"] > 0 \
+                and age is not None and age > live["timeout_s"]:
+            doc["status"] = "stalled"
+        return doc
+
+    exp = MetricsExporter(registry=Registry(), port=0, health_fn=health)
+    exp.start()
+    try:
+        # no steps yet: ok (compiling is not a stall)
+        status, _, body = _get(exp.port, "/healthz")
+        doc = json.loads(body)
+        assert status == 200 and doc["last_step"] is None
+
+        wd.notify_progress(41)
+        status, _, body = _get(exp.port, "/healthz")
+        doc = json.loads(body)
+        assert status == 200 and doc["last_step"] == 41
+        assert doc["last_step_age_s"] < 5
+        assert doc["watchdog"]["timeout_s"] == 600.0
+
+        # age the last step past the threshold: 503 + "stalled"
+        monkeypatch.setenv("HVD_TPU_WATCHDOG_SECONDS", "0.01")
+        import time
+        time.sleep(0.05)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(exp.port, "/healthz")
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["status"] == "stalled"
+
+        # disarmed watchdog (0) never reports stalled
+        monkeypatch.setenv("HVD_TPU_WATCHDOG_SECONDS", "0")
+        status, _, body = _get(exp.port, "/healthz")
+        assert status == 200
+    finally:
+        exp.stop()
+        wd.reset()

@@ -1,0 +1,64 @@
+"""The metrics <-> docs lint (ci/check_metrics_docs.py, ISSUE 7
+satellite): the real tree must be in sync with docs/OBSERVABILITY.md,
+and the matcher semantics that keep the lint honest are pinned here."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint():
+    sys.path.insert(0, os.path.join(REPO, "ci"))
+    try:
+        import check_metrics_docs
+        return check_metrics_docs
+    finally:
+        sys.path.pop(0)
+
+
+def test_tree_and_docs_in_sync():
+    """THE gate: every registered metric documented, no stale docs."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "ci",
+                                      "check_metrics_docs.py")],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "lint OK" in out.stdout
+
+
+def test_extraction_finds_known_registrations():
+    lint = _lint()
+    code = lint.code_metrics()
+    # plain literal, f-string pattern, multi-line call, fleet g() helper
+    assert "hvd_steps_total" in code
+    assert "hvd_*_total" in code            # f"hvd_{metric_unit}_total"
+    assert "hvd_anomaly_total" in code      # multi-line .counter(
+    assert "hvd_fleet_straggler_rank" in code   # fleet's g(...)
+    assert "hvd_engine_*" in code
+    # registration sites are reported for the failure message
+    assert any("callbacks.py" in s for s in code["hvd_steps_total"])
+
+
+def test_generic_doc_pattern_does_not_blanket_document():
+    lint = _lint()
+    # hvd_engine_* documents any engine mirror...
+    assert lint._doc_covers_code("hvd_engine_cache_hits", "hvd_engine_*")
+    # ...but the fully generic per-unit convention must not swallow
+    # arbitrary counters (the lint would never fire again)
+    assert not lint._doc_covers_code("hvd_anomaly_total", "hvd_*_total")
+    assert lint._doc_covers_code("hvd_*_total", "hvd_*_total")
+
+
+def test_histogram_subseries_not_stale():
+    lint = _lint()
+    undocumented, stale, _code = lint.check()
+    assert undocumented == []
+    assert stale == []
+    # docs show hvd_step_time_seconds_bucket{...} in examples; the
+    # suffix-stripping keeps that from reading as a stale mention —
+    # verified implicitly by stale == [] while the docs contain it
+    docs = lint.doc_metrics()
+    assert any(d.startswith("hvd_step_time_seconds_bucket")
+               for d in docs)

@@ -1,0 +1,207 @@
+"""Unit tests for the step time-series history layer
+(docs/OBSERVABILITY.md "Step time-series history"): ring bounds, JSONL
+persistence + rotation + torn-tail tolerance, the sampling stride, the
+``python -m horovod_tpu.metrics`` CLI (history table + one-shot top
+frame), and the bench trajectory gate in ``ci/check_bench.py``."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.metrics.timeseries import (SeriesWriter,
+                                            StepSeriesRecorder,
+                                            TimeSeriesRing, read_series)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- ring -------------------------------------------------------------------
+
+def test_ring_bounded_drop_oldest():
+    ring = TimeSeriesRing(capacity=3)
+    for i in range(5):
+        ring.append({"step": i})
+    assert [p["step"] for p in ring.points()] == [2, 3, 4]
+    assert [p["step"] for p in ring.points(last_n=2)] == [3, 4]
+    assert len(ring) == 3
+
+
+# -- JSONL writer / reader --------------------------------------------------
+
+def test_writer_roundtrip_and_rank_tagging(tmp_path):
+    d = str(tmp_path)
+    for rank in (0, 1):
+        w = SeriesWriter(d, rank=rank)
+        for i in range(3):
+            assert w.write({"ts": rank * 100 + i, "step": i})
+        w.close()
+    mine = read_series(d, rank=1)
+    assert [p["step"] for p in mine] == [0, 1, 2]
+    assert all(p["rank"] == 1 for p in mine)
+    everyone = read_series(d)
+    assert len(everyone) == 6
+    assert [p["ts"] for p in everyone] == sorted(
+        p["ts"] for p in everyone)  # time-sorted across ranks
+
+
+def test_writer_rotation_keeps_one_generation(tmp_path):
+    w = SeriesWriter(str(tmp_path), rank=0, max_bytes=200)
+    for i in range(50):
+        w.write({"step": i, "pad": "x" * 20})
+    w.close()
+    assert os.path.exists(w.path)
+    assert os.path.exists(w.path + ".1")
+    assert os.path.getsize(w.path) <= 200 + 64  # bounded, not unbounded
+    points = read_series(str(tmp_path), rank=0)
+    # rotated generation read first: order preserved, newest point last
+    assert points[-1]["step"] == 49
+    assert [p["step"] for p in points] == sorted(
+        p["step"] for p in points)
+
+
+def test_reader_skips_torn_tail_line(tmp_path):
+    path = tmp_path / "obs_rank0.jsonl"
+    path.write_text(json.dumps({"step": 1}) + "\n"
+                    + json.dumps({"step": 2}) + "\n"
+                    + '{"step": 3, "trunc')  # crash mid-append
+    points = read_series(str(tmp_path), rank=0)
+    assert [p["step"] for p in points] == [1, 2]
+
+
+def test_recorder_sampling_stride_and_env_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_OBS_DIR", str(tmp_path))
+    monkeypatch.setenv("HVD_TPU_OBS_SAMPLE_EVERY", "2")
+    rec = StepSeriesRecorder(rank=3)
+    for i in range(6):
+        rec.record_step(i + 1, 0.01 * (i + 1), units=32)
+    rec.close()
+    assert len(rec.ring) == 3  # steps 1, 3, 5 sampled
+    points = read_series(str(tmp_path), rank=3)
+    assert [p["step"] for p in points] == [1, 3, 5]
+    assert points[0]["units_per_s"] == pytest.approx(3200, rel=0.01)
+
+
+def test_step_timer_feeds_the_series(monkeypatch, tmp_path):
+    from horovod_tpu.metrics import timeseries
+    from horovod_tpu.metrics.registry import Registry
+    from horovod_tpu.train.callbacks import StepTimer
+    monkeypatch.setenv("HVD_TPU_OBS_DIR", str(tmp_path))
+    timeseries.reset()
+    try:
+        timer = StepTimer(unit="images", registry=Registry())
+        for _ in range(2):
+            with timer.step(units=8):
+                pass
+        points = timeseries.recorder().ring.points()
+        assert [p["step"] for p in points[-2:]] == [1, 2]
+        assert read_series(str(tmp_path))  # persisted too
+    finally:
+        timeseries.reset()
+
+
+# -- CLI --------------------------------------------------------------------
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.metrics", *args],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=120)
+
+
+def test_cli_history_table_and_json(tmp_path):
+    w = SeriesWriter(str(tmp_path), rank=0)
+    for i in range(4):
+        w.write({"ts": 1700000000 + i, "step": i + 1,
+                 "step_time_s": 0.25, "units_per_s": 128.0})
+    w.close()
+    out = _cli("history", "--dir", str(tmp_path), "--last", "3")
+    assert out.returncode == 0, out.stderr
+    assert "step_time_s" in out.stdout and "0.25" in out.stdout
+    assert "3 point(s)" in out.stdout
+    js = _cli("history", "--dir", str(tmp_path), "--json")
+    assert js.returncode == 0
+    assert len(js.stdout.strip().splitlines()) == 4
+    empty = _cli("history", "--dir", str(tmp_path / "nope"))
+    assert empty.returncode == 1
+
+
+def test_cli_top_renders_fleet_frame():
+    """One-shot frame against a live exporter serving a fleet view."""
+    from horovod_tpu.metrics.exporter import MetricsExporter
+    from horovod_tpu.metrics.fleet import FleetAggregator
+    from horovod_tpu.metrics.registry import Registry
+    reg = Registry()
+    reg.counter("hvd_steps_total").inc(12)
+    reg.histogram("hvd_step_time_seconds").observe(0.02)
+    exp = MetricsExporter(registry=reg, port=0)
+    exp.fleet = FleetAggregator(rank=0, size=1, base_port=9090,
+                                registry=reg, push_interval=60.0)
+    exp.start()
+    try:
+        out = _cli("top", "--url", f"http://127.0.0.1:{exp.port}",
+                   "--once")
+        assert out.returncode == 0, out.stderr
+        assert "ranks reporting : 1/1" in out.stdout
+        assert "steps total     : 12" in out.stdout
+    finally:
+        exp.stop()
+
+
+def test_cli_top_render_is_pure():
+    from horovod_tpu.metrics.__main__ import parse_prometheus, render_top
+    series = parse_prometheus(
+        "hvd_fleet_size 4\nhvd_fleet_ranks_reporting 3\n"
+        "hvd_fleet_straggler_rank 2\n"
+        'hvd_fleet_rank_step_time_seconds{rank="2"} 0.5\n'
+        'hvd_anomaly_total{kind="step_time_drift"} 2\n'
+        "# a comment\nbogus line\n")
+    frame = render_top(series, "test")
+    assert "3/4" in frame and "RANKS MISSING" in frame
+    assert "straggler rank  : 2" in frame
+    assert "step_time_drift×2" in frame
+    assert "rank    2" in frame  # per-rank bar chart row
+
+
+# -- bench trajectory gate --------------------------------------------------
+
+def _check_bench():
+    sys.path.insert(0, os.path.join(REPO, "ci"))
+    try:
+        import check_bench
+        return check_bench
+    finally:
+        sys.path.pop(0)
+
+
+def test_trajectory_gate_flags_drift_not_noise():
+    cb = _check_bench()
+    flat = [0.1] * 12
+    noisy = [0.1, 0.12, 0.09, 0.11, 0.1, 0.13, 0.1, 0.09, 0.12, 0.11]
+    drifting = [0.1] * 4 + [0.12] * 4 + [0.2] * 4  # tail 2x the head
+    assert cb.check_trajectory(flat) is None
+    assert cb.check_trajectory(noisy) is None
+    assert cb.check_trajectory(drifting) is not None
+    assert cb.check_trajectory([0.1] * 3) is None  # too short to judge
+    assert cb.check_trajectory("not-a-list") is not None
+    assert cb.check_trajectory([0.1, None, 0.1]) is not None
+
+
+def test_trajectory_cli_gate(tmp_path):
+    good = tmp_path / "good.json"
+    bad = tmp_path / "bad.json"
+    good.write_text(json.dumps(
+        {"value": 1.0, "step_time_series": [0.1] * 12}))
+    bad.write_text(json.dumps(
+        {"value": 1.0, "step_time_series": [0.1] * 6 + [0.3] * 6}))
+    base = [sys.executable, os.path.join(REPO, "ci", "check_bench.py"),
+            "--trajectory"]
+    ok = subprocess.run(base + [str(good)], capture_output=True,
+                        text=True, timeout=60)
+    assert ok.returncode == 0, ok.stdout
+    fail = subprocess.run(base + [str(bad)], capture_output=True,
+                          text=True, timeout=60)
+    assert fail.returncode == 1
+    assert "drift" in fail.stdout
