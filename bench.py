@@ -17,7 +17,10 @@ HEADS,DFF}``). ``HVD_BENCH_BATCH`` / ``HVD_BENCH_SEQ`` / ``HVD_BENCH_STEM``
 tune shapes. ``--compression int8|fp8|onebit|fp16|bf16`` (or
 ``HVD_BENCH_COMPRESSION``) wraps the optimizer in error-feedback
 gradient compression so the codec's in-graph cost lands in the measured
-step (docs/PERF.md "Gradient compression"). See docs/PERF.md for
+step (docs/PERF.md "Gradient compression"). ``--autotune`` (or
+``HVD_BENCH_AUTOTUNE=1``) warm-starts the communication knobs from the
+persistent mesh-autotune plan cache (docs/PERF.md "Autotuning").
+See docs/PERF.md for
 recorded numbers.
 
 Hardened for the driver contract:
@@ -460,16 +463,31 @@ def _wrap_compression(tx):
     through ``hvd.DistributedOptimizer`` (docs/PERF.md "Gradient
     compression"). Returns ``(tx, codec_name_or_None)``; the in-graph
     quantize∘dequantize cost lands in the measured step either way, so
-    the number answers "what does the codec cost on this model"."""
+    the number answers "what does the codec cost on this model".
+
+    ``--autotune`` / HVD_BENCH_AUTOTUNE=1 additionally warm-starts the
+    communication knobs from the persistent mesh-autotune plan cache
+    (``DistributedOptimizer(autotune=True)``, docs/PERF.md
+    "Autotuning") — a prior tuned run's bucket/codec choice lands in
+    the measured step with zero search."""
     name = os.environ.get("HVD_BENCH_COMPRESSION", "").strip().lower()
-    if not name or name == "none":
+    autotune = os.environ.get("HVD_BENCH_AUTOTUNE", "") not in ("", "0")
+    if (not name or name == "none") and not autotune:
         return tx, None
     import horovod_tpu as hvd
-    from horovod_tpu.compression import ErrorFeedback, resolve_compressor
-    codec = resolve_compressor(name)
-    _log(f"gradient compression enabled: {name} (error feedback)")
-    return hvd.DistributedOptimizer(
-        tx, compression=ErrorFeedback(codec)), name
+    kw = {}
+    if name and name != "none":
+        from horovod_tpu.compression import (ErrorFeedback,
+                                             resolve_compressor)
+        kw["compression"] = ErrorFeedback(resolve_compressor(name))
+        _log(f"gradient compression enabled: {name} (error feedback)")
+    else:
+        name = None
+    if autotune:
+        kw["autotune"] = True
+        _log("autotune warm start enabled (plan cache: "
+             f"{os.environ.get('HVD_TPU_AUTOTUNE_CACHE_DIR', '<unset>')})")
+    return hvd.DistributedOptimizer(tx, **kw), name
 
 
 def _child_bert() -> None:
@@ -1145,6 +1163,11 @@ if __name__ == "__main__":
                   "onebit|fp16|bf16|none)", file=sys.stderr)
             sys.exit(2)
         os.environ["HVD_BENCH_COMPRESSION"] = sys.argv[i + 1]
+    # --autotune: warm-start communication knobs from the persistent
+    # mesh-autotune plan cache (HVD_TPU_AUTOTUNE_CACHE_DIR) in every
+    # child (docs/PERF.md "Autotuning")
+    if "--autotune" in sys.argv:
+        os.environ["HVD_BENCH_AUTOTUNE"] = "1"
     # --trace-dir DIR: per-rank timeline shards during the measured
     # phase, merged into DIR/merged_trace.json (env channel:
     # HVD_BENCH_TRACE_DIR — inherited by the measurement child)

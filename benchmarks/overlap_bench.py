@@ -43,13 +43,12 @@ if __name__ == "__main__":  # force the virtual mesh before jax imports
     os.environ["JAX_PLATFORMS"] = "cpu"
 
 
-def _build(mesh, axis_name, d_model, n_layers, n_micro, batch,
-           bucket_bytes, config, ring):
+def _sweep_model(d_model, n_layers):
+    """The shared tanh-stack workload: BOTH the schedule comparison and
+    the autotune plan sweep time this exact model — the acceptance gate
+    compares their numbers, so they must never drift apart."""
     import numpy as np
     import jax.numpy as jnp
-    import optax
-
-    from horovod_tpu.train.overlap import make_overlap_train_step
 
     rng = np.random.RandomState(0)
     params = {
@@ -66,12 +65,28 @@ def _build(mesh, axis_name, d_model, n_layers, n_micro, batch,
             h = jnp.tanh(h @ p[f"w{i}"])
         return jnp.mean((h - y) ** 2)
 
+    return params, loss_fn
+
+
+def _build(mesh, axis_name, d_model, n_layers, n_micro, batch,
+           bucket_bytes, config, ring):
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.train.overlap import make_overlap_train_step
+
+    rng = np.random.RandomState(0)
+    params, loss_fn = _sweep_model(d_model, n_layers)
+
     tx = optax.sgd(1e-3)
+    # autotune=False: this bench COMPARES fixed schedules — a
+    # fleet-wide HVD_TPU_AUTOTUNE_MESH=1 must not swap in the searcher
     step = make_overlap_train_step(
         loss_fn, tx, mesh, axis_name, n_micro=n_micro,
         bucket_bytes=bucket_bytes, ring=ring,
         overlap=(config == "overlap"), sync=(config != "compute"),
-        donate=False)
+        donate=False, autotune=False)
     x = jnp.asarray(rng.randn(batch, d_model).astype(np.float32))
     y = jnp.asarray(rng.randn(batch, d_model).astype(np.float32))
     opt_state = tx.init(params)
@@ -157,6 +172,74 @@ def run_overlap_bench(mesh=None, axis_name: str = "dp", *,
             if exposed["serialized"] > 0 else None,
     }
     return doc
+
+
+def run_plan_sweep(mesh=None, axis_name: str = "dp", *,
+                   plans=None, d_model: int = 128, n_layers: int = 8,
+                   n_micro: int = 2, batch_per_device: int = 4,
+                   iters: int = 6, repeats: int = 2) -> dict:
+    """Hand-set configuration sweep: measure each candidate
+    :class:`~horovod_tpu.train.autotune.Plan` with the SAME step builder
+    the autotuner compiles, best-of-``repeats`` wall time per step.
+
+    This is the autotune acceptance baseline (ISSUE 8): the online
+    search must lock a plan no worse (within tolerance) than the best
+    row of this sweep — it searches the same space with the same
+    measurement, so losing to the sweep means the search logic, not the
+    hardware, regressed. Returns ``{"plans": {key: s}, "best_plan":
+    key, "best_s": s}``.
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.common.topology import detect_topology
+    from horovod_tpu.train.autotune import candidate_plans
+    from horovod_tpu.train.overlap import make_overlap_train_step
+
+    if mesh is None:
+        mesh = hvd.build_mesh(dp=-1)
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    topo = detect_topology(mesh, axis_name)
+    if plans is None:
+        plans = candidate_plans(topo)
+    params, loss_fn = _sweep_model(d_model, n_layers)
+    tx = optax.sgd(1e-3)
+    rng = np.random.RandomState(1)
+    batch = batch_per_device * n_dev * n_micro
+    x = jnp.asarray(rng.randn(batch, d_model).astype(np.float32))
+    y = jnp.asarray(rng.randn(batch, d_model).astype(np.float32))
+
+    state = {}
+    for plan in plans:
+        # autotune=False: each row realizes ONE hand-set plan
+        step = make_overlap_train_step(
+            loss_fn, tx, mesh, axis_name, n_micro=n_micro, donate=False,
+            autotune=False, **plan.step_kwargs(topo))
+        p, s, loss = step(params, tx.init(params), (x, y))  # compile
+        jax.block_until_ready(loss)
+        state[plan.key] = (step, p, s)
+    times = {plan.key: float("inf") for plan in plans}
+    # INTERLEAVE the repeats round-robin across plans: box-load drift
+    # (another process ramping up mid-sweep) then penalizes every plan
+    # equally instead of whichever happened to be measured last — the
+    # best-of over interleaved windows is what makes this sweep a
+    # stable baseline for the autotune acceptance gate
+    for _ in range(max(1, repeats)):
+        for plan in plans:
+            step, p, s = state[plan.key]
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                p, s, loss = step(p, s, (x, y))
+            jax.block_until_ready(loss)
+            times[plan.key] = min(times[plan.key],
+                                  (time.perf_counter() - t0) / iters)
+            state[plan.key] = (step, p, s)
+    best_key = min(times, key=times.get)
+    return {"plans": times, "best_plan": best_key,
+            "best_s": times[best_key], "n_devices": n_dev}
 
 
 def main() -> int:

@@ -13,6 +13,16 @@ below the baseline. A baseline without a curve (older rounds) passes
 with a note; a NEW artifact without a curve fails — the standing
 artifact is the point.
 
+``--tuned TUNED --default DEFAULT [--tolerance T]`` is the
+autotune-never-regresses gate (ISSUE 8): TUNED is a scaling artifact
+measured with the mesh autotuner on, DEFAULT the same sweep with the
+static hand-set config. The gate fails when the tuned plan loses to
+the default beyond T at any world (same missing-world evidence rule as
+the scaling gate: a world the default measured but the tuned run
+didn't is itself a failure) — autotune converging to something WORSE
+than the baseline candidate means the search scored garbage, exactly
+what must not ship silently.
+
 ``--trajectory ARTIFACT [--tolerance T]`` is the within-window drift
 gate (ISSUE 7): the bench doc now records ``step_time_series`` — every
 iteration of the timing window — so a run whose *mean* looks fine but
@@ -135,6 +145,48 @@ def trajectory_main(argv) -> int:
         return 1
     print(f"trajectory gate OK for {path} ({len(series)} steps, "
           f"tolerance {tolerance:.0%})")
+    return 0
+
+
+def tuned_main(argv) -> int:
+    """``--tuned TUNED --default DEFAULT``: the tuned run must not lose
+    to the static default. The comparison IS the scaling-regression
+    check with the default as baseline — a tuned curve below the
+    default's band, or a world the tuned run failed to measure, fails."""
+    tuned_path = argv[argv.index("--tuned") + 1]
+    if "--default" not in argv:
+        print("--tuned requires --default DEFAULT_ARTIFACT (the "
+              "static-config run to hold the tuned run against)")
+        return 2
+    default_path = argv[argv.index("--default") + 1]
+    tolerance = float(argv[argv.index("--tolerance") + 1]) \
+        if "--tolerance" in argv else 0.25
+    tuned = _load_curve(tuned_path)
+    default = _load_curve(default_path)
+    if not tuned or not tuned.get("scaling_curve"):
+        print(f"no scaling curve in tuned artifact {tuned_path}")
+        return 1
+    if not default or not default.get("scaling_curve"):
+        print(f"no scaling curve in default artifact {default_path}; "
+              "cannot judge the tuned run — measure the static config "
+              "first")
+        return 1
+    bad = check_scaling_regression(tuned, default, tolerance)
+    if bad:
+        for world, series, n, b in bad:
+            if n is None:
+                print(f"tuned-vs-default FAILED world={world}: default "
+                      f"measured {b:.2f}/s but the tuned run has no "
+                      "measurement")
+            else:
+                print(f"tuned-vs-default FAILED world={world} {series}: "
+                      f"tuned {n:.2f}/s vs default {b:.2f}/s "
+                      f"(> {tolerance:.0%} below — autotune regressed a "
+                      "previously good config)")
+        return 1
+    print(f"tuned-vs-default OK (tolerance {tolerance:.0%}): "
+          + "; ".join(f"w{r['world']}={r['samples_per_sec']}/s"
+                      for r in tuned["scaling_curve"]))
     return 0
 
 
@@ -261,6 +313,8 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--tuned" in sys.argv:
+        sys.exit(tuned_main(sys.argv))
     if "--scaling" in sys.argv:
         sys.exit(scaling_main(sys.argv))
     if "--trajectory" in sys.argv:

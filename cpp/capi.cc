@@ -296,7 +296,13 @@ const char* hvd_counters_json() {
      << ",\"stall_warnings\":" << c.stall_warnings.load()
      << ",\"stalled_tensors\":" << c.stalled_tensors.load()
      << ",\"transport_chaos_injected\":"
-     << c.transport_chaos_injected.load() << "}";
+     << c.transport_chaos_injected.load()
+     << ",\"autotune_fusion_bytes\":" << c.autotune_fusion_bytes.load()
+     << ",\"autotune_cycle_ms\":"
+     << (c.autotune_cycle_us.load() / 1000.0)
+     << ",\"autotune_hierarchical\":" << c.autotune_hierarchical.load()
+     << ",\"autotune_cache_enabled\":"
+     << c.autotune_cache_enabled.load() << "}";
   g_counters_json = os.str();
   return g_counters_json.c_str();
 }

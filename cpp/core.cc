@@ -1535,6 +1535,14 @@ bool Core::RunOnce() {
     }
   }
   counters_.stalled_tensors.store(cycle_stalled);
+  // mirror the (possibly autotuned) knob values for the metrics scrape
+  // thread — every rank, every cycle: workers adopt tuned values via the
+  // response fusion threshold + knob flags, so their mirrors track too
+  counters_.autotune_fusion_bytes.store(cfg_.fusion_threshold);
+  counters_.autotune_cycle_us.store(
+      (uint64_t)(cfg_.cycle_time_ms * 1000.0));
+  counters_.autotune_hierarchical.store(hier_enabled_ ? 1 : 0);
+  counters_.autotune_cache_enabled.store(cfg_.cache_enabled ? 1 : 0);
   // periodic rank-attributed negotiation-wait summary (coordinator only
   // accumulates attribution; HVD_TPU_STRAGGLER_REPORT_SECONDS)
   if (cfg_.rank == 0) MaybeReportStragglers();

@@ -318,6 +318,14 @@ class Core {
     // scrape thread must never dereference transport_ (an elastic
     // re-init resets that pointer under it)
     std::atomic<uint64_t> transport_chaos_injected{0};
+    // live values of the autotune-managed knobs (docs/OBSERVABILITY.md
+    // "Autotune metrics"): mirrored every negotiation cycle by the loop
+    // thread so /metrics shows WHAT the tuner picked, not just that it
+    // is on. cycle time stored as microseconds to stay integral.
+    std::atomic<int64_t> autotune_fusion_bytes{0};
+    std::atomic<uint64_t> autotune_cycle_us{0};
+    std::atomic<uint64_t> autotune_hierarchical{0};
+    std::atomic<uint64_t> autotune_cache_enabled{0};
   };
   const Counters& counters() const { return counters_; }
 

@@ -129,6 +129,17 @@ from horovod_tpu.train.overlap import (  # noqa: F401
     make_overlap_train_step,
     pipelined_accumulate,
 )
+# Mesh-path communication autotuner (docs/PERF.md "Autotuning"):
+# topology-aware hierarchical collectives + online plan search with a
+# persistent, fingerprint-keyed tuning cache.
+from horovod_tpu.common.topology import (  # noqa: F401
+    MeshTopology,
+    detect_topology,
+)
+from horovod_tpu.train.autotune import (  # noqa: F401
+    AutotuneOptions,
+    Plan as AutotunePlan,
+)
 from horovod_tpu.train.fused_apply import (  # noqa: F401
     fused_adam,
     fused_sgd,

@@ -72,6 +72,19 @@ class Config:
     # call for the whole tree, the pre-bucketing behavior).
     bucket_bytes: int = 0
     overlap_buckets: bool = True
+    # Small-bucket latency floor (docs/PERF.md "Autotuning"): gradient
+    # buckets under this many bytes skip quantization and ring /
+    # hierarchical chunking and take one dense psum (latency-optimized
+    # small-tensor path, arxiv 1909.09756). 0 = off.
+    small_bucket_floor: int = 0
+    # Mesh-path communication autotuner (train/autotune.py): online plan
+    # search over bucket_bytes x algorithm x codec x small-bucket floor
+    # on the traced path, bounded by a step budget, winner persisted to
+    # a fingerprint-keyed JSON cache. Distinct from the C++ core's
+    # eager-path autotune= below.
+    autotune_mesh: bool = False
+    autotune_budget_steps: int = 48
+    autotune_cache_dir: str = ""
     # Hierarchical ops (reference: operations.cc:514-538)
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
@@ -136,6 +149,13 @@ class Config:
             cycle_time_ms=env_float("CYCLE_TIME", d.cycle_time_ms),
             bucket_bytes=env_int("BUCKET_BYTES", d.bucket_bytes),
             overlap_buckets=env_bool("OVERLAP_BUCKETS", d.overlap_buckets),
+            small_bucket_floor=env_int("SMALL_BUCKET_FLOOR",
+                                       d.small_bucket_floor),
+            autotune_mesh=env_bool("AUTOTUNE_MESH"),
+            autotune_budget_steps=env_int("AUTOTUNE_BUDGET_STEPS",
+                                          d.autotune_budget_steps),
+            autotune_cache_dir=env_str("AUTOTUNE_CACHE_DIR",
+                                       d.autotune_cache_dir),
             cache_capacity=env_int("CACHE_CAPACITY", d.cache_capacity),
             hierarchical_allreduce=env_bool("HIERARCHICAL_ALLREDUCE"),
             hierarchical_allgather=env_bool("HIERARCHICAL_ALLGATHER"),

@@ -1,0 +1,137 @@
+"""Mesh topology: (num_hosts × local_devices) structure for hierarchical
+collectives.
+
+TPU pods are two-level networks: chips on one host share fast intra-host
+links (ICI), hosts talk over the slower inter-host fabric (DCN). A flat
+allreduce pushes the full payload across the slow hop; the hierarchical
+decomposition (MLPerf TPU-pod work, arxiv 1909.09756; the reference's
+``HOROVOD_HIERARCHICAL_ALLREDUCE`` path in ``operations.cc:514-538``)
+moves only ``1/local_size`` of the bytes inter-host:
+
+    intra-host reduce_scatter → inter-host allreduce on the shard →
+    intra-host allgather
+
+This module derives that structure from jax device process indices and
+turns it into the ``axis_index_groups`` the SPMD collectives need
+(:func:`horovod_tpu.ops.mesh_collectives.phier_allreduce`).
+
+``HVD_TPU_VIRTUAL_HOSTS`` imposes a virtual host split on a
+single-process mesh — how the 8-device CPU test mesh exercises (2×4),
+(4×2) and (8×1) topologies, and how a benchmark can measure the
+hierarchy's reassociation cost without a pod.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence
+
+
+class MeshTopology(NamedTuple):
+    """Two-level structure of a mesh axis: ``num_hosts`` groups of
+    ``local_size`` devices each, contiguous along the axis (device at
+    axis position ``i`` lives on host ``i // local_size``)."""
+
+    num_hosts: int
+    local_size: int
+
+    @property
+    def world(self) -> int:
+        return self.num_hosts * self.local_size
+
+    @property
+    def is_hierarchical(self) -> bool:
+        """Both levels non-trivial — the only case where the two-hop
+        decomposition beats a flat collective."""
+        return self.num_hosts > 1 and self.local_size > 1
+
+    def intra_groups(self) -> List[List[int]]:
+        """``axis_index_groups`` for the intra-host hops: one group per
+        host, its ``local_size`` consecutive axis positions."""
+        L = self.local_size
+        return [[h * L + i for i in range(L)]
+                for h in range(self.num_hosts)]
+
+    def inter_groups(self) -> List[List[int]]:
+        """``axis_index_groups`` for the inter-host hop: one group per
+        local position, the same local slot on every host. After the
+        intra-host reduce_scatter, every member of group ``l`` holds
+        shard ``l`` of its host's sum — reducing across the group
+        completes the global reduction for that shard."""
+        L = self.local_size
+        return [[h * L + l for h in range(self.num_hosts)]
+                for l in range(L)]
+
+
+def flat_topology(n: int) -> MeshTopology:
+    """The degenerate 1×n topology (no hierarchy)."""
+    return MeshTopology(1, max(1, int(n)))
+
+
+def virtual_hosts() -> int:
+    """``HVD_TPU_VIRTUAL_HOSTS`` — impose this many virtual hosts on the
+    axis (0 = derive from real process indices). Read live, not from the
+    cached Config snapshot, so tests can sweep topologies."""
+    from horovod_tpu.common.config import env_int
+    return env_int("VIRTUAL_HOSTS", 0)
+
+
+def _axis_devices(mesh, axis_name: str) -> Sequence:
+    """Devices along one mesh axis (other axes pinned at coordinate 0),
+    in axis order — the order ``lax.axis_index`` sees."""
+    import numpy as np
+    names = list(mesh.axis_names)
+    ax = names.index(axis_name)
+    devs = np.asarray(mesh.devices)
+    index = [0] * devs.ndim
+    index[ax] = slice(None)
+    return list(devs[tuple(index)])
+
+
+def detect_topology(mesh=None, axis_name: str = "dp",
+                    n: Optional[int] = None) -> MeshTopology:
+    """Derive the (num_hosts × local_devices) structure of a mesh axis.
+
+    Precedence: ``HVD_TPU_VIRTUAL_HOSTS`` (when it evenly divides the
+    axis) > jax device process indices > flat. The process-index path
+    requires each host's devices to be CONTIGUOUS along the axis with
+    equal counts — the layout ``jax.devices()`` and ``build_mesh``
+    produce; any other arrangement degrades to flat rather than
+    producing groups that cross the slow hop twice.
+
+    ``mesh=None`` with ``n`` set derives a topology for a bare axis size
+    (virtual override or flat) — what the autotuner uses when planning
+    before the mesh exists.
+    """
+    if mesh is not None:
+        devices = _axis_devices(mesh, axis_name)
+        size = len(devices)
+    else:
+        devices = None
+        size = int(n or 0)
+    if size <= 1:
+        return flat_topology(size or 1)
+
+    vh = virtual_hosts()
+    if vh > 0:
+        if vh <= size and size % vh == 0:
+            return MeshTopology(vh, size // vh)
+        from horovod_tpu.common.logging import get_logger
+        get_logger().warning(
+            "HVD_TPU_VIRTUAL_HOSTS=%d does not evenly divide axis size "
+            "%d; ignoring the virtual split", vh, size)
+
+    if devices is None:
+        return flat_topology(size)
+
+    procs = [getattr(d, "process_index", 0) for d in devices]
+    hosts = sorted(set(procs))
+    if len(hosts) <= 1:
+        return flat_topology(size)
+    if size % len(hosts) != 0:
+        return flat_topology(size)
+    local = size // len(hosts)
+    # contiguity + equal counts: host h owns axis slots [h*local, (h+1)*local)
+    for i, p in enumerate(procs):
+        if procs[(i // local) * local] != p:
+            return flat_topology(size)
+    return MeshTopology(len(hosts), local)

@@ -66,6 +66,19 @@ class EngineCollector:
         now = time.monotonic()
         if c:
             for key, val in c.items():
+                if key.startswith("autotune_"):
+                    # live tuner decisions (fusion bytes, cycle ms,
+                    # hierarchical/cache flips) are config VALUES, not
+                    # cumulative counters: first-class hvd_autotune_*
+                    # gauges, max-merged (every rank mirrors the same
+                    # coordinator-tuned value) — docs/OBSERVABILITY.md
+                    # "Autotune metrics"
+                    sub = key[len("autotune_"):]
+                    self._reg.gauge(
+                        f"hvd_autotune_{sub}",
+                        help=f"engine autotune decision: {sub}",
+                        agg="max").set(float(val))
+                    continue
                 self._reg.gauge(
                     f"hvd_engine_{key}",
                     help=f"engine counter {key} (cumulative)",

@@ -276,6 +276,15 @@ def start_worker_exporter(state) -> Optional[MetricsExporter]:
     # hvd_stall_warnings_total are a different prefix and survive)
     for prefix in ("hvd_engine_", "hvd_straggler_"):
         registry.drop_prefix(prefix)
+    # the engine's autotune DECISION mirrors (docs/OBSERVABILITY.md
+    # "Autotune metrics") die with the engine too — but only these four
+    # exact names: the mesh tuner's hvd_autotune_plan_*/locked/... share
+    # the namespace and must survive a re-mesh (the plan cache is what
+    # makes the re-meshed world start tuned)
+    for name in ("hvd_autotune_fusion_bytes", "hvd_autotune_cycle_ms",
+                 "hvd_autotune_hierarchical",
+                 "hvd_autotune_cache_enabled"):
+        registry.drop_prefix(name)
     collector = EngineCollector(counters_fn, registry=registry,
                                 stragglers_fn=stragglers_fn)
     try:

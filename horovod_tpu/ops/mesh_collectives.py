@@ -137,6 +137,103 @@ def preduce_quantized(x: jax.Array, axis_name: str, quantizer,
         .astype(x.dtype)
 
 
+def phier_allreduce(x: jax.Array, axis_name: str, topology,
+                    op: ReduceOp = ReduceOp.SUM,
+                    inter_codec=None,
+                    small_floor: Optional[int] = None) -> jax.Array:
+    """Topology-aware hierarchical allreduce along a named mesh axis:
+    intra-host reduce_scatter → inter-host allreduce on the
+    ``1/local_size``-sized shard → intra-host allgather.
+
+    ``topology`` is a :class:`horovod_tpu.common.topology.MeshTopology`
+    whose ``world`` must equal the axis size and whose hosts are
+    contiguous along the axis (``detect_topology`` guarantees both).
+    Only ``1/local_size`` of the payload crosses the slow inter-host
+    fabric — the MLPerf TPU-pod decomposition (arxiv 1909.09756) and
+    the reference's ``HOROVOD_HIERARCHICAL_ALLREDUCE`` path.
+
+    ``inter_codec`` (a :class:`~horovod_tpu.compression.quantizers.Quantizer`)
+    quantizes ONLY the inter-host hop, EQuARX-style (arxiv 2506.17615):
+    that hop becomes reduce_scatter (exact) → quantize → allgather →
+    dequantize within each cross-host group, so the intra-host traffic
+    stays full precision and the end-to-end error is one quantization
+    step on the slow hop's bytes only.
+
+    ``small_floor``: payloads under this many bytes skip the whole
+    decomposition (and quantization) and take one flat ``psum`` — for
+    latency-bound small tensors the two extra hops cost more than the
+    bandwidth they save (the MLPerf paper's latency-optimized
+    small-tensor path). Sizes are static under trace, so this is a
+    compile-time branch.
+
+    Sum/Average only. Numerics: every element is still a sum of the
+    same ``n`` contributions, folded intra-host first — equal to flat
+    ``psum`` up to fp reassociation (plus the documented codec bound on
+    the inter-host hop when ``inter_codec`` is given).
+    """
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"hierarchical allreduce supports Sum/Average, got {op}")
+    n = axis_size(axis_name)
+    if topology.world != n:
+        raise ValueError(
+            f"topology {topology.num_hosts}x{topology.local_size} does "
+            f"not cover axis {axis_name!r} of size {n}")
+    nbytes = x.size * x.dtype.itemsize
+    if not topology.is_hierarchical or \
+            (small_floor and nbytes < small_floor):
+        return preduce(x, axis_name, op)
+
+    H, L = topology.num_hosts, topology.local_size
+    intra = topology.intra_groups()
+    inter = topology.inter_groups()
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    size = flat.size
+    # one pad serves both scatters: intra splits by L, the (quantized)
+    # inter hop splits the L-shard by H
+    pad = (-size) % (L * H)
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+
+    # intra-host reduce_scatter: member l of host h holds shard l of the
+    # host-local sum (group order == axis order, so shard l is slice l)
+    part = lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+                            tiled=True, axis_index_groups=intra)
+
+    if inter_codec is None:
+        part = lax.psum(part, axis_name, axis_index_groups=inter)
+        if op == ReduceOp.AVERAGE:
+            part = part / n
+    else:
+        # EQuARX on the slow hop only: reduce_scatter across hosts is
+        # exact; only the already-reduced 1/(L·H) slices travel
+        # quantized through the cross-host allgather
+        sub = lax.psum_scatter(part, axis_name, scatter_dimension=0,
+                               tiled=True, axis_index_groups=inter)
+        if op == ReduceOp.AVERAGE:
+            sub = sub / n
+        q, spec = inter_codec.quantize(sub)
+        g_values = lax.all_gather(q.values, axis_name,
+                                  axis_index_groups=inter)
+        g_scales = lax.all_gather(q.scales, axis_name,
+                                  axis_index_groups=inter)
+        from horovod_tpu.compression.quantizers import Quantized
+        parts = jax.vmap(
+            lambda v, s: inter_codec.dequantize(Quantized(v, s), spec)
+        )(g_values, g_scales)
+        part = parts.reshape((H * sub.shape[0],) + sub.shape[1:]) \
+            .astype(flat.dtype)
+
+    # intra-host allgather reassembles the full vector on every device
+    out = lax.all_gather(part, axis_name, axis=0, tiled=True,
+                         axis_index_groups=intra)
+    out = out.reshape(-1)
+    if pad:
+        out = out[:size]
+    return out.reshape(orig_shape).astype(orig_dtype)
+
+
 def pring_shift(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
     """Ring permute — the building block for ring attention / ring allreduce
     overlap patterns (no reference analog; NCCL rings are internal to NCCL)."""

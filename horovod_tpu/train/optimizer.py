@@ -75,7 +75,8 @@ def _record_sync_timing(exposed_s: float, total_s: float,
 
 def _eager_allreduce_tree(grads, op: ReduceOp, process_set: ProcessSet,
                           compression: Compressor,
-                          prescale: float, postscale: float):
+                          prescale: float, postscale: float,
+                          bucket_bytes=None):
     """Bucketed (fused) eager allreduce of a gradient pytree.
 
     The tree is partitioned into byte-budgeted buckets in reverse
@@ -102,7 +103,7 @@ def _eager_allreduce_tree(grads, op: ReduceOp, process_set: ProcessSet,
     if not leaves:
         return grads
     if get_config().overlap_buckets and len(leaves) > 1:
-        plan = plan_buckets(leaves)
+        plan = plan_buckets(leaves, bucket_bytes)
     else:
         from horovod_tpu.train.buckets import _leaf_nbytes
         nbytes = sum(_leaf_nbytes(l) for l in leaves)
@@ -254,7 +255,8 @@ def DistributedGradTransform(op: ReduceOp = Average,
                              axis_name: Optional[str] = None,
                              prescale_factor: float = 1.0,
                              postscale_factor: float = 1.0,
-                             host_sync_in_jit: bool = False
+                             host_sync_in_jit: bool = False,
+                             bucket_bytes: Optional[int] = None
                              ) -> optax.GradientTransformation:
     """optax transform that synchronizes gradients across the process set.
 
@@ -310,7 +312,8 @@ def DistributedGradTransform(op: ReduceOp = Average,
                                          prescale_factor, postscale_factor)
         else:
             new = _eager_allreduce_tree(updates, op, process_set, codec,
-                                        prescale_factor, postscale_factor)
+                                        prescale_factor, postscale_factor,
+                                        bucket_bytes)
         return new, (EFState(residual=new_residual) if ef else state)
 
     return optax.GradientTransformation(init_fn, update_fn)
@@ -324,7 +327,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          axis_name: Optional[str] = None,
                          prescale_factor: float = 1.0,
                          postscale_factor: float = 1.0,
-                         host_sync_in_jit: bool = False
+                         host_sync_in_jit: bool = False,
+                         autotune=None
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with distributed gradient synchronization.
 
@@ -336,9 +340,42 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     ``compression`` accepts casts, quantizers, or ``ErrorFeedback(...)``
     (see :func:`DistributedGradTransform`); the Adasum path has no
     compression seam — combining them raises.
+
+    ``autotune=True`` warm-starts the communication knobs from the
+    persistent plan cache (docs/PERF.md "Autotuning"): at ``init`` the
+    gradient tree's fingerprint is looked up in
+    ``HVD_TPU_AUTOTUNE_CACHE_DIR`` and a hit applies the tuned
+    ``bucket_bytes`` (and — when you passed no ``compression`` of your
+    own — the tuned codec, wrapped in error feedback so the lossy wire
+    converges). A miss keeps your settings unchanged: the ONLINE search
+    that fills the cache lives in
+    ``make_overlap_train_step(..., autotune=True)``, because restarting
+    the search per candidate means recompiling the step — something an
+    optax transform cannot do from inside your jit.
     """
     from horovod_tpu.train.fused_apply import (FusedOptSpec,
                                                make_fused_transform)
+    env_autotune = False
+    if autotune is None:
+        from horovod_tpu.common.config import get_config
+        autotune = get_config().autotune_mesh
+        env_autotune = bool(autotune)
+    if autotune:
+        if op == ReduceOp.ADASUM or isinstance(optimizer, FusedOptSpec):
+            if not env_autotune:
+                raise ValueError(
+                    "autotune= applies to the standard sync path only "
+                    "(Adasum has no codec/bucket seam; the fused apply "
+                    "pins its own codec)")
+            autotune = False  # fleet-wide env default: skip, don't raise
+    if autotune:
+        return _warm_start_optimizer(
+            optimizer, op=op, process_set=process_set,
+            compression=compression,
+            backward_passes_per_step=backward_passes_per_step,
+            axis_name=axis_name, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            host_sync_in_jit=host_sync_in_jit)
     if isinstance(optimizer, FusedOptSpec):
         # fused dequantize+apply path (train/fused_apply.py): sync and
         # optimizer lower into ONE transform so the int8 codes feed the
@@ -379,6 +416,83 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         return optax.MultiSteps(chained,
                                 every_k_schedule=backward_passes_per_step)
     return chained
+
+
+def _warm_start_optimizer(optimizer, *, op, process_set, compression,
+                          backward_passes_per_step, axis_name,
+                          prescale_factor, postscale_factor,
+                          host_sync_in_jit) -> optax.GradientTransformation:
+    """``DistributedOptimizer(autotune=True)``: resolve the tuned plan
+    lazily at ``init`` — the first moment the gradient-tree structure
+    (== params structure) is in hand to fingerprint — then build the
+    real sync chain with the cached ``bucket_bytes``/codec applied.
+    A cache miss (or no cache dir) degrades to the caller's settings
+    unchanged; resolution NEVER raises."""
+    cell: dict = {}
+
+    def _build(params):
+        from horovod_tpu.common.topology import detect_topology
+        from horovod_tpu.train.autotune import (PlanCache,
+                                                plan_fingerprint,
+                                                resolve_cache_dir,
+                                                topology_key)
+        comp, bucket = compression, None
+        try:
+            cache_dir = resolve_cache_dir(None)
+            if cache_dir:
+                # canonical topology key (NOT a mesh-axis-name dict):
+                # hits entries the mesh search wrote for the same model
+                # at this world size regardless of what the axis was
+                # called over there. Prefer the launcher's own
+                # hosts×local split (the eager world has no mesh to
+                # inspect); virtual-hosts/flat fallback otherwise.
+                from horovod_tpu.common.basics import local_size
+                from horovod_tpu.common.topology import MeshTopology
+                w, ls = size(), local_size()
+                if ls > 0 and w % ls == 0 and w // ls > 1:
+                    topo = MeshTopology(w // ls, ls)
+                else:
+                    topo = detect_topology(n=w)
+                fp = plan_fingerprint(params, topology_key(topo), w)
+                plan = PlanCache(cache_dir).load(fp)
+                if plan is not None:
+                    bucket = plan.bucket_bytes
+                    codec = plan.resolve_codec()
+                    if codec is not None and \
+                            compression is Compression.none:
+                        # lossy codec on the wire needs the residual
+                        # carry to converge (docs/PERF.md)
+                        comp = ErrorFeedback(codec)
+                    from horovod_tpu.diagnostics.flight_recorder import \
+                        record_event
+                    record_event("autotune_warm_start", plan=plan.key)
+                    from horovod_tpu.metrics.registry import \
+                        default_registry
+                    default_registry().counter(
+                        "hvd_autotune_cache_hits_total",
+                        help="runs that started from a cached tuned "
+                             "plan with zero search trials").inc()
+        except Exception:  # warm start is best-effort, never fatal
+            comp, bucket = compression, None
+        sync = DistributedGradTransform(op, process_set, comp, axis_name,
+                                        prescale_factor, postscale_factor,
+                                        host_sync_in_jit, bucket)
+        inner = optax.chain(sync, optimizer)
+        if backward_passes_per_step > 1:
+            inner = optax.MultiSteps(
+                inner, every_k_schedule=backward_passes_per_step)
+        return inner
+
+    def init_fn(params):
+        cell["inner"] = _build(params)
+        return cell["inner"].init(params)
+
+    def update_fn(updates, state, params=None):
+        if "inner" not in cell:  # init skipped (restored state)
+            cell["inner"] = _build(updates)
+        return cell["inner"].update(updates, state, params)
+
+    return optax.GradientTransformation(init_fn, update_fn)
 
 
 def distributed_grad(fun: Callable, argnums=0, has_aux: bool = False,

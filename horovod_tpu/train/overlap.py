@@ -52,48 +52,101 @@ def _tree_add(a, b):
     return _tree.tree_map(jnp.add, a, b)
 
 
+def resolve_small_floor(small_floor: Optional[int] = None) -> int:
+    """Effective small-bucket latency floor in bytes: explicit argument >
+    ``HVD_TPU_SMALL_BUCKET_FLOOR`` (``Config.small_bucket_floor``).
+    0 disables the latency path."""
+    if small_floor is not None:
+        return max(0, int(small_floor))
+    from horovod_tpu.common.config import get_config
+    return max(0, get_config().small_bucket_floor)
+
+
 def bucketed_grad_sync(grads, axis_name: str,
                        plan: Optional[BucketPlan] = None,
                        bucket_bytes: Optional[int] = None,
                        op: ReduceOp = Average,
                        compression=None,
-                       ring: bool = False):
+                       ring: bool = False,
+                       algorithm: Optional[str] = None,
+                       topology=None,
+                       small_floor: Optional[int] = None):
     """Reduce a gradient pytree along ``axis_name`` bucket by bucket.
 
     Call inside ``shard_map`` (a live named axis). Each bucket's leaves
-    are packed into one flat fp32 vector and reduced with ONE collective:
-    ``psum``/``pmean`` by default, ``reduce_scatter → quantize →
-    all_gather`` when ``compression`` is a
-    :class:`~horovod_tpu.compression.quantizers.Quantizer`, or the
-    chunked ``ppermute`` ring (:func:`ops.mesh_collectives.pring_allreduce`)
-    with ``ring=True``. Emitting one independent collective per bucket —
-    instead of one per leaf or one for the whole tree — is what gives
-    XLA's scheduler units it can overlap with compute.
+    are packed into one flat vector and reduced with ONE collective,
+    selected by ``algorithm``:
 
-    Quantized and ring paths support Sum/Average only.
+    * ``"psum"`` (default) — ``psum``/``pmean``; with ``compression`` (a
+      :class:`~horovod_tpu.compression.quantizers.Quantizer`) the EQuARX
+      ``reduce_scatter → quantize → all_gather`` path.
+    * ``"ring"`` — the chunked ``ppermute`` ring
+      (:func:`ops.mesh_collectives.pring_allreduce`); ``ring=True`` is
+      the back-compat spelling. No compression seam (per-hop
+      requantization would accumulate error).
+    * ``"hier"`` — the topology-aware two-level path
+      (:func:`ops.mesh_collectives.phier_allreduce`): intra-host
+      reduce_scatter → inter-host allreduce → intra-host allgather,
+      with ``compression`` applied to the inter-host hop only.
+      ``topology`` (a :class:`~horovod_tpu.common.topology.MeshTopology`)
+      defaults to :func:`~horovod_tpu.common.topology.detect_topology`
+      over the axis size; a non-hierarchical topology degrades to psum.
+
+    ``small_floor`` (bytes; default ``HVD_TPU_SMALL_BUCKET_FLOOR``):
+    buckets under the floor skip quantization and ring/hierarchical
+    chunking and take one dense ``psum`` — the latency-optimized
+    small-tensor path (arxiv 1909.09756). Emitting one independent
+    collective per bucket — instead of one per leaf or one for the
+    whole tree — is what gives XLA's scheduler units it can overlap
+    with compute.
+
+    Quantized, ring and hierarchical paths support Sum/Average only.
     """
-    from horovod_tpu.ops.mesh_collectives import (preduce, preduce_quantized,
+    from horovod_tpu.ops.mesh_collectives import (phier_allreduce, preduce,
+                                                  preduce_quantized,
                                                   pring_allreduce)
+    algo = algorithm or ("ring" if ring else "psum")
+    if algo not in ("psum", "ring", "hier"):
+        raise ValueError(
+            f"unknown bucket algorithm {algorithm!r}; expected "
+            "psum | ring | hier")
+    if algo == "ring" and compression is not None:
+        raise ValueError(
+            "ring allreduce has no compression seam (per-hop "
+            "requantization accumulates error); use algorithm='psum' or "
+            "'hier' with a quantizer")
     leaves, treedef = _tree.tree_flatten(grads)
     if not leaves:
         return grads
     if plan is None:
         plan = plan_buckets(leaves, bucket_bytes)
     n = axis_size(axis_name)
+    floor = resolve_small_floor(small_floor)
+    if algo == "hier":
+        if topology is None:
+            from horovod_tpu.common.topology import detect_topology
+            topology = detect_topology(n=n)
+        if not topology.is_hierarchical:
+            algo = "psum"  # flat topology: the two-level path IS psum
     out: list = [None] * len(leaves)
     for bucket in plan.buckets:
-        if compression is not None:
+        small = floor > 0 and bucket.nbytes < floor
+        if small or (algo == "psum" and compression is None):
+            vec = pack(leaves, bucket)
+            reduced = preduce(vec, axis_name, op)
+        elif algo == "psum":
             if op not in (Sum, ReduceOp.AVERAGE):
                 raise ValueError(
                     f"quantized bucket sync supports Sum/Average, got {op}")
             vec = pack(leaves, bucket, pad_to=n)
             reduced = preduce_quantized(vec, axis_name, compression, op)
-        elif ring:
+        elif algo == "ring":
             vec = pack(leaves, bucket)
             reduced = pring_allreduce(vec, axis_name, op)
-        else:
+        else:  # hier
             vec = pack(leaves, bucket)
-            reduced = preduce(vec, axis_name, op)
+            reduced = phier_allreduce(vec, axis_name, topology, op,
+                                      inter_codec=compression)
         for i, leaf in zip(bucket.indices,
                            unpack(reduced, bucket, leaves)):
             out[i] = leaf
@@ -108,6 +161,9 @@ def pipelined_accumulate(grad_fn: Callable, params,
                          bucket_bytes: Optional[int] = None,
                          compression=None,
                          ring: bool = False,
+                         algorithm: Optional[str] = None,
+                         topology=None,
+                         small_floor: Optional[int] = None,
                          overlap: bool = True,
                          sync: bool = True,
                          microbatch_mean: bool = True
@@ -150,7 +206,9 @@ def pipelined_accumulate(grad_fn: Callable, params,
             return grads
         return bucketed_grad_sync(grads, axis_name, plan=plan,
                                   bucket_bytes=bucket_bytes, op=op,
-                                  compression=compression, ring=ring)
+                                  compression=compression, ring=ring,
+                                  algorithm=algorithm, topology=topology,
+                                  small_floor=small_floor)
 
     def _take(k):
         return _tree.tree_map(lambda x: x[k], microbatches)
@@ -205,9 +263,13 @@ def make_overlap_train_step(loss_fn: Callable, optimizer, mesh,
                             bucket_bytes: Optional[int] = None,
                             compression=None,
                             ring: bool = False,
+                            algorithm: Optional[str] = None,
+                            topology=None,
+                            small_floor: Optional[int] = None,
                             overlap: bool = True,
                             sync: bool = True,
-                            donate: bool = True) -> Callable:
+                            donate: bool = True,
+                            autotune=None) -> Callable:
     """jit-compiled data-parallel train step with pipelined bucket
     overlap: ``shard_map`` over ``mesh[axis_name]``, ``n_micro``
     microbatches split from the batch's leading axis, gradients reduced
@@ -219,11 +281,33 @@ def make_overlap_train_step(loss_fn: Callable, optimizer, mesh,
     divisible by ``n_micro`` per shard. Keyword knobs mirror
     :func:`pipelined_accumulate` (see docs/PERF.md "Overlap &
     bucketing").
+
+    ``autotune`` hands the communication knobs (``bucket_bytes``,
+    ``algorithm``, ``compression`` codec, ``small_floor``) to the online
+    plan search (docs/PERF.md "Autotuning"): pass ``True`` for the
+    default search, or a :class:`horovod_tpu.train.autotune.AutotuneOptions`.
+    The returned step then measures candidate plans during early steps,
+    locks the winner, and persists it to the plan cache; explicit values
+    for the tuned knobs become the search's baseline candidate.
     """
     import optax
     from jax.sharding import PartitionSpec as P
 
     from horovod_tpu._compat import shard_map
+
+    if autotune is None:
+        # HVD_TPU_AUTOTUNE_MESH turns the search on fleet-wide without
+        # touching call sites; an explicit autotune=False still wins
+        from horovod_tpu.common.config import get_config
+        autotune = get_config().autotune_mesh or None
+    if autotune:
+        from horovod_tpu.train.autotune import make_autotuned_train_step
+        return make_autotuned_train_step(
+            loss_fn, optimizer, mesh, axis_name, autotune=autotune,
+            n_micro=n_micro, op=op, bucket_bytes=bucket_bytes,
+            compression=compression, ring=ring, algorithm=algorithm,
+            topology=topology, small_floor=small_floor, overlap=overlap,
+            sync=sync, donate=donate)
 
     grad_fn = jax.value_and_grad(loss_fn)
 
@@ -237,6 +321,7 @@ def make_overlap_train_step(loss_fn: Callable, optimizer, mesh,
         loss, grads = pipelined_accumulate(
             micro_grad, params, micro, axis_name=axis_name, op=op,
             bucket_bytes=bucket_bytes, compression=compression, ring=ring,
+            algorithm=algorithm, topology=topology, small_floor=small_floor,
             overlap=overlap, sync=sync)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

@@ -280,6 +280,69 @@ def test_scaling_gate_extract_and_regression(tmp_path):
                          str(base_path), "--tolerance", "0.9"]) == 1
 
 
+def test_tuned_vs_default_gate(tmp_path):
+    """ci/check_bench.py --tuned TUNED --default DEFAULT (ISSUE 8):
+    the autotuned run must not lose to the static default beyond the
+    band — including the missing-world evidence rule — and degraded
+    inputs (no curve on either side) fail rather than pass silently."""
+    sys.path.insert(0, REPO)
+    try:
+        from ci.check_bench import tuned_main
+    finally:
+        sys.path.remove(REPO)
+
+    def artifact(path, curve, n_devices=8):
+        doc = {"n_devices": n_devices,
+               "tail": "[scaling] " + json.dumps(curve)}
+        path.write_text(json.dumps(doc))
+        return str(path)
+
+    default = {"scaling_curve": [
+        {"world": 1, "samples_per_sec": 10.0,
+         "samples_per_sec_int8": 8.0},
+        {"world": 8, "samples_per_sec": 60.0,
+         "samples_per_sec_int8": 45.0}]}
+    default_path = artifact(tmp_path / "default.json", default)
+
+    # tuned at least as good everywhere: passes
+    good = {"scaling_curve": [
+        {"world": 1, "samples_per_sec": 11.0,
+         "samples_per_sec_int8": 8.5},
+        {"world": 8, "samples_per_sec": 66.0,
+         "samples_per_sec_int8": 50.0}]}
+    good_path = artifact(tmp_path / "tuned_good.json", good)
+    assert tuned_main(["--tuned", good_path,
+                       "--default", default_path]) == 0
+
+    # tuned loses a world beyond the band: fails
+    bad = {"scaling_curve": [
+        {"world": 1, "samples_per_sec": 11.0,
+         "samples_per_sec_int8": 8.5},
+        {"world": 8, "samples_per_sec": 30.0,
+         "samples_per_sec_int8": 50.0}]}
+    bad_path = artifact(tmp_path / "tuned_bad.json", bad)
+    assert tuned_main(["--tuned", bad_path,
+                       "--default", default_path]) == 1
+    # ... but a wide-enough band accepts it
+    assert tuned_main(["--tuned", bad_path, "--default", default_path,
+                       "--tolerance", "0.6"]) == 0
+
+    # a world the default measured but the tuned run erased: fails
+    short = {"n_devices": 8, "scaling_curve": good["scaling_curve"][:1]}
+    short_path = artifact(tmp_path / "tuned_short.json", short)
+    assert tuned_main(["--tuned", short_path,
+                       "--default", default_path]) == 1
+
+    # degraded inputs fail loudly instead of passing by default
+    empty_path = tmp_path / "empty.json"
+    empty_path.write_text(json.dumps({"tail": "[dryrun] OK\n"}))
+    assert tuned_main(["--tuned", str(empty_path),
+                       "--default", default_path]) == 1
+    assert tuned_main(["--tuned", good_path,
+                       "--default", str(empty_path)]) == 1
+    assert tuned_main(["--tuned", good_path]) == 2  # --default missing
+
+
 def test_failure_identity_names():
     for model, metric, unit in [
             ("resnet50", "resnet50_images_per_sec_per_chip", "img/s/chip"),

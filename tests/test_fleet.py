@@ -298,7 +298,8 @@ def test_remesh_drops_stale_engine_gauges_and_rebinds():
     engine's counters on the SAME port, with no gauge left from the
     dead engine."""
     reg = Registry()
-    gen1_counters = {"cache_hits": 8, "cycles": 100, "legacy_only": 5}
+    gen1_counters = {"cache_hits": 8, "cycles": 100, "legacy_only": 5,
+                     "autotune_fusion_bytes": 1 << 25}
     col1 = EngineCollector(lambda: dict(gen1_counters), registry=reg)
     exp1 = MetricsExporter(registry=reg, port=0,
                            collectors=[col1.collect])
@@ -313,6 +314,10 @@ def test_remesh_drops_stale_engine_gauges_and_rebinds():
     # exactly like start_worker_exporter does
     for prefix in ("hvd_engine_", "hvd_straggler_"):
         reg.drop_prefix(prefix)
+    for name in ("hvd_autotune_fusion_bytes", "hvd_autotune_cycle_ms",
+                 "hvd_autotune_hierarchical",
+                 "hvd_autotune_cache_enabled"):
+        reg.drop_prefix(name)
     gen2_counters = {"cache_hits": 1, "cycles": 2}
     col2 = EngineCollector(lambda: dict(gen2_counters), registry=reg)
     exp2 = MetricsExporter(registry=reg, port=port,  # same port: rebind
@@ -324,6 +329,9 @@ def test_remesh_drops_stale_engine_gauges_and_rebinds():
         _, body = _get(port, "/metrics")
         assert "hvd_engine_legacy_only" not in body  # dead engine gone
         assert "hvd_engine_cache_hits 1" in body     # new engine served
+        # the dead engine's autotune DECISION mirrors die with it too
+        # (the new engine hasn't published them yet)
+        assert "hvd_autotune_fusion_bytes" not in body
         # fleet tree re-registered for the new generation: old-world
         # pushes bounce, new-world pushes land
         assert not exp2.fleet.ingest(

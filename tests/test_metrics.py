@@ -234,6 +234,27 @@ def test_engine_collector_mirrors_counters_and_rates():
     assert 0 < rate <= (1 << 20)
 
 
+def test_engine_collector_autotune_decision_gauges():
+    """The C++ autotuner's live decisions (ISSUE 8 satellite): counter
+    keys with the autotune_ prefix surface as first-class
+    hvd_autotune_* gauges — what the tuner PICKED — instead of being
+    mirrored as cumulative hvd_engine_* counters."""
+    reg = Registry()
+    counters = {"cycles": 5,
+                "autotune_fusion_bytes": 32 * 1024 * 1024,
+                "autotune_cycle_ms": 2.5,
+                "autotune_hierarchical": 1,
+                "autotune_cache_enabled": 0}
+    EngineCollector(lambda: dict(counters), registry=reg).collect()
+    snap = reg.snapshot()
+    assert snap["hvd_autotune_fusion_bytes"]["value"] == 32 * 1024 * 1024
+    assert snap["hvd_autotune_cycle_ms"]["value"] == pytest.approx(2.5)
+    assert snap["hvd_autotune_hierarchical"]["value"] == 1
+    assert snap["hvd_autotune_cache_enabled"]["value"] == 0
+    assert "hvd_engine_autotune_fusion_bytes" not in snap
+    assert snap["hvd_engine_cycles"]["value"] == 5
+
+
 def test_engine_collector_straggler_gauges():
     reg = Registry()
     report = {"tensors_timed": 2, "total_wait_seconds": 3.5,

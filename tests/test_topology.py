@@ -1,0 +1,87 @@
+"""Topology derivation battery (ISSUE 8): (num_hosts × local_devices)
+structure from jax device process indices, the HVD_TPU_VIRTUAL_HOSTS
+override the CPU-mesh parity tests lean on, and the axis_index_groups
+the hierarchical collective consumes."""
+
+import types
+
+import pytest
+
+from horovod_tpu.common.topology import (MeshTopology, detect_topology,
+                                         flat_topology)
+from horovod_tpu.parallel import build_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh(dp=-1)  # all 8 virtual devices
+
+
+def test_flat_topology_is_not_hierarchical():
+    t = flat_topology(8)
+    assert (t.num_hosts, t.local_size) == (1, 8)
+    assert not t.is_hierarchical
+    assert t.world == 8
+
+
+def test_hierarchical_groups_cover_axis_disjointly():
+    t = MeshTopology(2, 4)
+    assert t.is_hierarchical
+    assert t.intra_groups() == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert t.inter_groups() == [[0, 4], [1, 5], [2, 6], [3, 7]]
+    # every axis index appears exactly once per grouping
+    for groups in (t.intra_groups(), t.inter_groups()):
+        flat = sorted(i for g in groups for i in g)
+        assert flat == list(range(8))
+
+
+def test_single_process_mesh_derives_flat(mesh):
+    t = detect_topology(mesh, "dp")
+    assert t == flat_topology(8)
+
+
+@pytest.mark.parametrize("hosts,local", [(2, 4), (4, 2), (8, 1)])
+def test_virtual_hosts_override(mesh, monkeypatch, hosts, local):
+    monkeypatch.setenv("HVD_TPU_VIRTUAL_HOSTS", str(hosts))
+    t = detect_topology(mesh, "dp")
+    assert (t.num_hosts, t.local_size) == (hosts, local)
+
+
+def test_virtual_hosts_not_dividing_is_ignored(mesh, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_VIRTUAL_HOSTS", "3")
+    assert detect_topology(mesh, "dp") == flat_topology(8)
+
+
+def test_detect_without_mesh_uses_axis_size(monkeypatch):
+    assert detect_topology(n=8) == flat_topology(8)
+    monkeypatch.setenv("HVD_TPU_VIRTUAL_HOSTS", "2")
+    assert detect_topology(n=8) == MeshTopology(2, 4)
+    assert detect_topology(n=1) == flat_topology(1)
+
+
+def _fake_mesh(procs):
+    """A mesh-shaped stub whose 'dp' axis devices carry the given
+    process indices (detect_topology reads only axis_names/devices)."""
+    import numpy as np
+    devs = np.array([types.SimpleNamespace(process_index=p)
+                     for p in procs], dtype=object)
+    return types.SimpleNamespace(axis_names=("dp",), devices=devs)
+
+
+def test_process_indices_contiguous_derive_hierarchy():
+    t = detect_topology(_fake_mesh([0, 0, 0, 0, 1, 1, 1, 1]), "dp")
+    assert t == MeshTopology(2, 4)
+    t = detect_topology(_fake_mesh([0, 0, 1, 1, 2, 2, 3, 3]), "dp")
+    assert t == MeshTopology(4, 2)
+
+
+def test_process_indices_interleaved_degrade_to_flat():
+    # a host's devices split across the axis would make the 'intra'
+    # hop cross the slow fabric twice — refuse the hierarchy
+    assert detect_topology(
+        _fake_mesh([0, 1, 0, 1, 0, 1, 0, 1]), "dp") == flat_topology(8)
+
+
+def test_process_indices_uneven_degrade_to_flat():
+    assert detect_topology(
+        _fake_mesh([0, 0, 0, 1, 1, 2, 2, 2]), "dp") == flat_topology(8)
