@@ -233,6 +233,29 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
     except ValueError:
         pass
 
+    # compile hooks (docs/OBSERVABILITY.md "Compile & memory
+    # observability"): measured backend-compile seconds replace the old
+    # wall-clock guess (compile_s also timed the first step's RUN)
+    try:
+        from horovod_tpu.profiling import compile_watch as _cw
+        _cw.ensure_installed()
+    except Exception as e:
+        _cw = None
+        _log(f"compile hooks unavailable ({e!r})")
+
+    def _compile_seconds():
+        if _cw is None:
+            return None
+        tot = _cw.totals()
+        return round(tot["seconds_total"], 3) if tot["compiles"] else None
+
+    def _hbm_peak():
+        try:
+            from horovod_tpu.profiling.memory import peak_bytes
+            return peak_bytes()  # None on backends without memory_stats
+        except Exception:
+            return None
+
     def emit(value, dt_window, n_iters, provisional, flops_per_device,
              flops_src, compile_s, series=None):
         peak = _peak_flops(jax.devices()[0].device_kind)
@@ -253,6 +276,8 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
             "n_chips": n_chips,
             "device_kind": jax.devices()[0].device_kind,
             "compile_s": round(compile_s, 1),
+            "compile_seconds": _compile_seconds(),
+            "hbm_peak_bytes": _hbm_peak(),
             "timing_iters": n_iters,
             "commit": _git_commit(),
             "phases": dict(_PHASES),

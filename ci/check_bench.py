@@ -23,6 +23,18 @@ didn't is itself a failure) — autotune converging to something WORSE
 than the baseline candidate means the search scored garbage, exactly
 what must not ship silently.
 
+``--compile-budget NEW [--baseline OLD] [--tolerance T]`` is the
+compile-time regression gate (ISSUE 9): the bench doc records
+``compile_seconds`` — MEASURED backend-compile time from the compile
+hooks (docs/OBSERVABILITY.md "Compile & memory observability"), not
+the old wall-clock phase that also timed the first step's run — and
+the gate fails when NEW's compile time exceeds the baseline's by more
+than T (default 0.5: compile time on shared hosts is noisy; the band
+catches a graph-growth or cache-bust regression, not jitter).  A
+baseline artifact predating the contract passes with a note (NEW
+becomes the baseline); a NEW artifact with a real measured value but
+no compile time fails — the recording contract broke.
+
 ``--trajectory ARTIFACT [--tolerance T]`` is the within-window drift
 gate (ISSUE 7): the bench doc now records ``step_time_series`` — every
 iteration of the timing window — so a run whose *mean* looks fine but
@@ -125,6 +137,99 @@ def check_trajectory(series, tolerance: float = 0.5):
                 f"{tail:.6f}s/step vs {head:.6f}s at the start "
                 f"(> {tolerance:.0%} slower over {len(vals)} steps)")
     return None
+
+
+def _load_bench_doc(path: str):
+    """The bench result doc from a raw doc JSON, a BENCH_r* artifact
+    (doc under ``parsed``), or a BENCH_MEASURED run entry (under
+    ``result``)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        for key in ("parsed", "result"):
+            if isinstance(doc.get(key), dict):
+                return doc[key]
+    return doc if isinstance(doc, dict) else None
+
+
+def doc_compile_seconds(doc):
+    """Measured compile seconds with wall-clock fallback for artifacts
+    predating the compile-hook contract."""
+    if not isinstance(doc, dict):
+        return None, None
+    v = doc.get("compile_seconds")
+    if isinstance(v, (int, float)):
+        return float(v), "hooks"
+    v = doc.get("compile_s")
+    if isinstance(v, (int, float)):
+        return float(v), "wall"
+    return None, None
+
+
+def check_compile_budget(new: dict, baseline, tolerance: float):
+    """None when within budget, else a failure string."""
+    n, n_src = doc_compile_seconds(new)
+    if n is None:
+        if new.get("value") is None:
+            return None  # a failure doc has no compile to judge
+        return ("new artifact carries a measured value but no "
+                "compile_seconds/compile_s — the recording contract "
+                "broke")
+    b, b_src = doc_compile_seconds(baseline) if baseline else (None, None)
+    if b is None:
+        return None  # no baseline: NEW becomes it
+    if b > 0 and n > b * (1.0 + tolerance):
+        return (f"compile-time regression: {n:.1f}s ({n_src}) vs "
+                f"baseline {b:.1f}s ({b_src}) — more than "
+                f"{tolerance:.0%} over budget")
+    return None
+
+
+def compile_budget_main(argv) -> int:
+    new_path = argv[argv.index("--compile-budget") + 1]
+    tolerance = float(argv[argv.index("--tolerance") + 1]) \
+        if "--tolerance" in argv else 0.5
+    new = _load_bench_doc(new_path)
+    if not new:
+        print(f"no bench doc in {new_path}")
+        return 1
+    baseline = None
+    base_path = None
+    if "--baseline" in argv:
+        base_path = argv[argv.index("--baseline") + 1]
+        baseline = _load_bench_doc(base_path)
+    else:
+        # newest committed BENCH_r*.json carrying a compile time
+        for path in sorted(glob.glob(os.path.join(REPO,
+                                                  "BENCH_r*.json")),
+                           reverse=True):
+            if os.path.abspath(path) == os.path.abspath(new_path):
+                continue
+            doc = _load_bench_doc(path)
+            if doc and doc_compile_seconds(doc)[0] is not None:
+                base_path, baseline = path, doc
+                break
+    problem = check_compile_budget(new, baseline, tolerance)
+    if problem:
+        print(f"compile-budget gate FAILED for {new_path}: {problem}")
+        return 1
+    n, src = doc_compile_seconds(new)
+    if n is None:
+        # a failure doc (value null) passes the gate with nothing to
+        # format — don't let the success print crash on None
+        print(f"compile-budget gate: {new_path} is a failure artifact "
+              "with no compile time; nothing to judge")
+    elif baseline is None or doc_compile_seconds(baseline)[0] is None:
+        print(f"compile-budget gate: no baseline compile time "
+              f"({base_path}); accepting "
+              f"{'%.1fs' % n if n is not None else 'n/a'} as the new "
+              "baseline")
+    else:
+        b, bsrc = doc_compile_seconds(baseline)
+        print(f"compile-budget gate OK vs {base_path} "
+              f"(tolerance {tolerance:.0%}): {n:.1f}s ({src}) vs "
+              f"{b:.1f}s ({bsrc})")
+    return 0
 
 
 def trajectory_main(argv) -> int:
@@ -313,6 +418,8 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--compile-budget" in sys.argv:
+        sys.exit(compile_budget_main(sys.argv))
     if "--tuned" in sys.argv:
         sys.exit(tuned_main(sys.argv))
     if "--scaling" in sys.argv:

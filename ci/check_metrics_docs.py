@@ -47,6 +47,7 @@ _NOT_METRICS = {"hvd_engine_state_json", "hvd_stragglers_json",
                 "hvd_counters_json", "hvd_shutdown_force",
                 "hvd_mfu_registered",
                 "hvd_autopsy",        # the autopsy bundle directory
+                "hvd_profile",        # the trace-capture retention dir
                 "hvd_flight_rank*"}   # crash flight-dump filenames
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
 

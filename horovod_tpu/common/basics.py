@@ -150,7 +150,16 @@ def init(ranks: Optional[Sequence[int]] = None,
                 _state.rank = ranks.index(_state.rank)
                 _state.size = len(ranks)
 
-        _state.backend = _create_backend(_state)
+        # re-mesh timeline (docs/OBSERVABILITY.md "Re-mesh timeline"):
+        # when an elastic recovery episode is active, backend creation
+        # is its "rendezvous" phase and the remainder of init its
+        # "rebuild" phase; both are pass-throughs on a first init
+        import time as _time
+
+        from horovod_tpu.elastic import remesh as _remesh
+        with _remesh.phase("rendezvous"):
+            _state.backend = _create_backend(_state)
+        _t_rebuild = _time.perf_counter()
 
         from horovod_tpu.common.process_sets import _init_process_set_table
         _state.process_set_table = _init_process_set_table(
@@ -200,6 +209,16 @@ def init(ranks: Optional[Sequence[int]] = None,
         _timeseries.reset()
         from horovod_tpu.metrics import anomaly as _anomaly
         _anomaly.reset_baselines()
+        # the profiling detectors follow the same rule: a re-meshed
+        # world legitimately recompiles its jitted steps and re-learns
+        # its HBM baseline — per-function storm counts and the growth
+        # detector must not accumulate across generations into false
+        # recompile_storm/hbm_growth findings (the capture manager and
+        # its records DO survive: cooldown + autopsy history)
+        from horovod_tpu.profiling import compile_watch as _cw
+        from horovod_tpu.profiling import memory as _hbm
+        _cw.reset_counts()
+        _hbm.reset()
         from horovod_tpu.diagnostics import watchdog as _wd
         _wd.resume()  # re-arm across an elastic shutdown->init cycle
         from horovod_tpu.diagnostics.flight_recorder import (
@@ -215,6 +234,17 @@ def init(ranks: Optional[Sequence[int]] = None,
         # reports live state, and a bind failure only warns.
         from horovod_tpu.metrics.exporter import start_worker_exporter
         _state.metrics_exporter = start_worker_exporter(_state)
+        # compile observability (docs/OBSERVABILITY.md "Compile & memory
+        # observability"): compile-time metrics + the recompile_storm
+        # detector; idempotent, gated on HVD_TPU_COMPILE_METRICS
+        try:
+            from horovod_tpu.profiling import compile_watch
+            compile_watch.ensure_installed()
+        except Exception:
+            pass
+        _ep = _remesh.current()
+        if _ep is not None and not _ep.finished:
+            _ep.add_phase("rebuild", _time.perf_counter() - _t_rebuild)
         get_logger().info(
             "initialized: rank=%d size=%d local=%d/%d cross=%d/%d backend=%s",
             _state.rank, _state.size, _state.local_rank, _state.local_size,

@@ -269,6 +269,18 @@ def write_autopsy(out_dir: Optional[str] = None, reason: str = "",
 
     suspects = suspects_from_engine(engine)
 
+    def _profiles():
+        # a capture window that never completed (the job degraded,
+        # started its trace, then hung/died) is closed NOW so the trace
+        # bytes are on disk, then every capture record — path, trigger,
+        # size — is embedded: the bundle ships its own "why" evidence
+        # (docs/OBSERVABILITY.md "Deep profiling")
+        from horovod_tpu import profiling
+        profiling.finalize_open_capture(reason=f"autopsy: {reason}")
+        return profiling.recent_captures()
+
+    profiles = step(_profiles) or []
+
     def _anomalies():
         # "was it degrading before it died?" — the anomaly engine's
         # findings (step-time drift, throughput regression, persistent
@@ -286,9 +298,14 @@ def write_autopsy(out_dir: Optional[str] = None, reason: str = "",
         "written_at": time.time(),
         "suspects": suspects,
         "anomalies": anomalies,
+        "profiles": profiles,
         "peers_fetched": fetched,
         "peers_unreachable": unreachable,
     }))
+    if profiles:
+        get_logger().error(
+            "autopsy: %d device-trace capture(s) available; last: %s",
+            len(profiles), profiles[-1].get("path"))
     if anomalies:
         last = anomalies[-1]
         get_logger().error(

@@ -161,6 +161,7 @@ def _apply_world_update(update: dict, force_shutdown: bool = False) -> None:
     global _current_generation
     import horovod_tpu as hvd
     from horovod_tpu.diagnostics.flight_recorder import record_event
+    from horovod_tpu.elastic import remesh
     my_rank = str(rank())
     old_size = size()
     record_event("elastic_remesh", generation=update.get("generation"),
@@ -173,8 +174,9 @@ def _apply_world_update(update: dict, force_shutdown: bool = False) -> None:
             f"{update['generation']}); exiting")
     # a SHRUNKEN world means departed peers: shutdown consensus cannot
     # complete, so skip its grace instead of stalling every survivor
-    hvd.shutdown(force=force_shutdown
-                 or int(update.get("size", 0)) < old_size)
+    with remesh.phase("drain"):
+        hvd.shutdown(force=force_shutdown
+                     or int(update.get("size", 0)) < old_size)
     os.environ.update({k: str(v) for k, v in slot_env.items()})
     os.environ["HVD_TPU_COORD_ADDR"] = update["coord_addr"]
     os.environ["HVD_TPU_COORD_PORT"] = str(update["coord_port"])
@@ -182,6 +184,9 @@ def _apply_world_update(update: dict, force_shutdown: bool = False) -> None:
     _current_generation = int(update["generation"])
     from horovod_tpu.common.config import reset_config
     reset_config()
+    # hvd.init() itself splits into the "rendezvous" (backend
+    # negotiation) and "rebuild" (process sets / timeline / exporter)
+    # phases of the re-mesh timeline — see common/basics.py
     hvd.init()
 
 
@@ -222,8 +227,13 @@ class State:
 
     def commit(self) -> None:
         from horovod_tpu.diagnostics.flight_recorder import record_event
+        from horovod_tpu.elastic import remesh
         record_event("elastic_commit")
         self.save()
+        # a committed unit of work after a recovery closes the re-mesh
+        # timeline's first_step phase (loops without a StepTimer —
+        # the raw elastic loop — still get a measured episode)
+        remesh.note_step_end()
         self.check_host_updates()
 
     def check_host_updates(self) -> None:
@@ -495,26 +505,52 @@ def run(func: Callable) -> Callable:
     ``state.restore()``; resync on HostsUpdatedInterrupt."""
 
     def wrapper(state: State, *args: Any, **kwargs: Any):
+        from horovod_tpu.elastic import remesh
         state.sync()
         while True:
             try:
                 return func(state, *args, **kwargs)
             except HorovodInternalError:
-                state.restore()
+                # re-mesh timeline (docs/OBSERVABILITY.md "Re-mesh
+                # timeline"): the episode opens at the failure and
+                # closes at the first completed step/commit of the new
+                # world; each phase lands as a flight span and an
+                # hvd_remesh_seconds{phase} observation
+                remesh.begin("internal_error", old_size=size())
+                with remesh.phase("drain"):
+                    state.restore()
                 # peer death? the driver publishes the shrunken world as
                 # soon as it reaps the dead process — re-rendezvous into
                 # it IN PLACE (params stay in host memory, PID unchanged).
                 # No doc inside the window -> transient op error: retry
                 # in the same world like the reference.
-                update = _await_world_update()
+                with remesh.phase("failure_detect"):
+                    update = _await_world_update()
                 if update is not None:
                     _apply_world_update(update, force_shutdown=True)
-                    state.on_reset()
-                state.sync()
+                    with remesh.phase("restore"):
+                        state.on_reset()
+                        state.sync()
+                    remesh.mark_recovered(
+                        new_size=size(),
+                        generation=int(update["generation"]))
+                else:
+                    # same-world retry: the mesh did not change, so
+                    # this is NOT a re-mesh episode — close it with a
+                    # retry marker (hvd_remesh_* must mean what it
+                    # says, and already-emitted spans must not dangle)
+                    remesh.note_same_world_retry()
+                    state.sync()
             except HostsUpdatedInterrupt as e:
+                remesh.begin("hosts_updated", old_size=size())
                 if e.update is not None:
                     _apply_world_update(e.update)  # in-place re-mesh
-                state.on_reset()
-                state.sync()
+                with remesh.phase("restore"):
+                    state.on_reset()
+                    state.sync()
+                remesh.mark_recovered(
+                    new_size=size(),
+                    generation=int(e.update["generation"])
+                    if e.update is not None else None)
 
     return wrapper

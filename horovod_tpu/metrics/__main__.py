@@ -59,6 +59,16 @@ def _fmt_seconds(v: Optional[float]) -> str:
     return f"{v * 1e3:.1f}ms" if v < 1.0 else f"{v:.2f}s"
 
 
+def _fmt_bytes(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024:
+            return f"{v:.1f}{unit}" if unit != "B" else f"{int(v)}B"
+        v /= 1024
+    return f"{v:.1f}TiB"
+
+
 def render_top(series: Dict[str, float], source: str) -> str:
     """One dashboard frame from a parsed scrape (pure: unit-testable)."""
     lines = [f"hvd-tpu fleet view  [{source}]  "
@@ -89,6 +99,36 @@ def render_top(series: Dict[str, float], source: str) -> str:
     straggler = series.get("hvd_fleet_straggler_rank")
     if straggler is not None:
         lines.append(f"straggler rank  : {int(straggler)}")
+    # HBM view (docs/OBSERVABILITY.md "Compile & memory observability"):
+    # in-use/peak merge max over ranks, the OOM margin merges MIN — the
+    # tightest rank is the number that matters
+    in_use = series.get("hvd_hbm_bytes_in_use")
+    if in_use is not None:
+        margin = series.get("hvd_hbm_oom_margin_bytes")
+        lines.append(
+            f"hbm             : {_fmt_bytes(in_use)} in use, "
+            f"peak {_fmt_bytes(series.get('hvd_hbm_peak_bytes'))} / "
+            f"limit {_fmt_bytes(series.get('hvd_hbm_limit_bytes'))}"
+            + (f"  (OOM margin {_fmt_bytes(margin)})"
+               if margin is not None else ""))
+    # compile view: total backend compiles + tracing-cache misses +
+    # compile seconds (histogram _sum summed across function labels)
+    compiles = series.get("hvd_compile_total")
+    if compiles is not None:
+        misses = series.get("hvd_compile_cache_miss_total")
+        secs = sum(v for k, v in series.items()
+                   if k.startswith("hvd_compile_seconds_sum"))
+        detail = [f"{_fmt_seconds(secs)} total"]
+        if misses is not None:
+            detail.insert(0, f"{int(misses)} cache misses")
+        lines.append(f"compiles        : {int(compiles)} "
+                     f"({', '.join(detail)})")
+    remeshes = series.get("hvd_remesh_total")
+    if remeshes:
+        rsecs = sum(v for k, v in series.items()
+                    if k.startswith("hvd_remesh_seconds_sum"))
+        lines.append(f"re-meshes       : {int(remeshes)} "
+                     f"({_fmt_seconds(rsecs)} total recovery)")
     for key, value in sorted(series.items()):
         if key.endswith("_per_second") and "{" not in key:
             lines.append(f"{key[4:]:<16}: {value:,.1f}")
@@ -140,8 +180,53 @@ def cmd_top(args: argparse.Namespace) -> int:
     return 0
 
 
+REMESH_PHASES = ("failure_detect", "drain", "rendezvous", "rebuild",
+                 "restore", "first_step")
+
+
+def render_remesh_table(points) -> str:
+    """The re-mesh phase table (docs/OBSERVABILITY.md "Re-mesh
+    timeline"): one row per recovery episode found in the persisted
+    series, phase seconds in pipeline order."""
+    rows = [p for p in points if isinstance(p.get("remesh"), dict)]
+    if not rows:
+        return ""
+    head = (f"{'ts':<19} {'rank':>4} {'trigger':<16} "
+            + " ".join(f"{c:>14}" for c in REMESH_PHASES)
+            + f" {'total':>10}")
+    lines = [head]
+    for p in rows:
+        phases = p["remesh"]
+        ts = time.strftime("%Y-%m-%d %H:%M:%S",
+                           time.localtime(p.get("ts", 0)))
+        cells = " ".join(
+            f"{_fmt_seconds(phases.get(c)):>14}" for c in REMESH_PHASES)
+        lines.append(
+            f"{ts:<19} {p.get('rank', '-'):>4} "
+            f"{str(p.get('trigger', '-')):<16} {cells} "
+            f"{_fmt_seconds(p.get('remesh_total_s')):>10}")
+    lines.append(f"-- {len(rows)} re-mesh episode(s)")
+    return "\n".join(lines)
+
+
 def cmd_history(args: argparse.Namespace) -> int:
     points = read_series(args.dir, rank=args.rank)
+    if getattr(args, "remesh", False):
+        episodes = [p for p in points if isinstance(p.get("remesh"), dict)]
+        if args.last:
+            episodes = episodes[-args.last:]
+        if not episodes:
+            print(f"no re-mesh episodes recorded under {args.dir}",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            for p in episodes:
+                print(json.dumps(p))
+            return 0
+        print(render_remesh_table(episodes))
+        return 0
+    # step points only: free-form episode points have their own view
+    points = [p for p in points if "remesh" not in p]
     if args.last:
         points = points[-args.last:]
     if not points:
@@ -185,6 +270,9 @@ def main(argv=None) -> int:
                    help="only the last N points")
     h.add_argument("--json", action="store_true",
                    help="raw JSONL instead of the table")
+    h.add_argument("--remesh", action="store_true",
+                   help="render the re-mesh phase table instead of the "
+                        "step series (one row per recovery episode)")
     h.set_defaults(fn=cmd_history)
     args = p.parse_args(argv)
     try:

@@ -230,6 +230,17 @@ class AnomalyEngine:
                 "windows": self._straggler_run})]
 
     # -- reporting -----------------------------------------------------------
+    def report(self, kind: str, **fields: Any) -> dict:
+        """Public finding seam for detectors that live OUTSIDE this
+        engine's own step/fleet feeds — the ``recompile_storm`` watcher
+        (:mod:`horovod_tpu.profiling.compile_watch`) and the
+        ``hbm_growth`` sampler (:mod:`horovod_tpu.profiling.memory`).
+        The finding takes the exact same path as a native one: counter,
+        flight event, bounded findings list, and (via the profiling
+        hook) a possible triggered device-trace capture."""
+        with self._lock:
+            return self._flag({"kind": kind, **fields})
+
     def _flag(self, finding: dict, **extra: Any) -> dict:
         finding.update(extra)
         finding["ts"] = round(time.time(), 3)
@@ -241,6 +252,16 @@ class AnomalyEngine:
                 "hvd_anomaly_total",
                 help="anomaly-engine findings, per detector kind",
                 labels={"kind": kind}).inc()
+        except Exception:
+            pass
+        try:
+            # deep-profiling hook (docs/OBSERVABILITY.md "Deep
+            # profiling"): a finding may arm a bounded device-trace
+            # capture of the next steps; the planned path is stamped
+            # into THIS finding dict before the flight event records
+            # it, so every channel points at the same trace
+            from horovod_tpu.profiling import on_anomaly
+            on_anomaly(finding)
         except Exception:
             pass
         try:
@@ -303,6 +324,13 @@ def recent_findings() -> List[dict]:
     autopsy summary embeds under ``anomalies``."""
     eng = _ENGINE
     return eng.recent_findings() if eng is not None else []
+
+
+def report_finding(kind: str, **fields: Any) -> Optional[dict]:
+    """Route an external detector's finding through the process-wide
+    engine (None — silently dropped — when ``HVD_TPU_ANOMALY=0``)."""
+    eng = default_engine()
+    return eng.report(kind, **fields) if eng is not None else None
 
 
 def reset() -> None:

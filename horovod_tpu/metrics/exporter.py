@@ -59,7 +59,8 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        raw_path, _, query = self.path.partition("?")
+        path = raw_path.rstrip("/") or "/"
         exporter: "MetricsExporter" = self.server.exporter
         if path in ("/metrics", "/"):
             body = exporter.render().encode()
@@ -84,7 +85,7 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             code = 200 if doc.get("status") == "ok" else 503
             self._send(code, json.dumps(doc).encode(), "application/json")
         elif path.startswith("/debug/"):
-            self._debug(path[len("/debug/"):])
+            self._debug(path[len("/debug/"):], query)
         else:
             self._send(404, b"not found\n", "text/plain")
 
@@ -114,15 +115,24 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             self._send(409, b"rejected (world/generation mismatch)\n",
                        "text/plain")
 
-    def _debug(self, kind: str) -> None:
-        """Hang-autopsy evidence endpoints (docs/OBSERVABILITY.md
-        "Flight recorder & hang autopsy"): rank 0's watchdog scrapes
-        every peer's ``/debug/stacks`` / ``/debug/flight`` /
-        ``/debug/engine`` so one directory answers "which rank is stuck
-        in what".  Served from the exporter's own thread pool, so they
-        answer even while the training thread is wedged."""
+    def _debug(self, kind: str, query: str = "") -> None:
+        """Hang-autopsy evidence + deep-profiling endpoints
+        (docs/OBSERVABILITY.md "Flight recorder & hang autopsy" /
+        "Deep profiling"): rank 0's watchdog scrapes every peer's
+        ``/debug/stacks`` / ``/debug/flight`` / ``/debug/engine`` so
+        one directory answers "which rank is stuck in what", and
+        ``/debug/profile?steps=N`` arms a bounded device-trace capture
+        of the next N steps (``&peers=1`` fans the request out to every
+        peer exporter via the ``HVD_TPU_PEER_HOSTS`` map).  Served from
+        the exporter's own thread pool, so they answer even while the
+        training thread is wedged."""
         try:
-            if kind == "stacks":
+            if kind == "profile":
+                self._send(200,
+                           json.dumps(_arm_profile(query),
+                                      default=str).encode(),
+                           "application/json")
+            elif kind == "stacks":
                 from horovod_tpu.diagnostics.autopsy import stacks_text
                 self._send(200, stacks_text().encode(), "text/plain")
             elif kind == "flight":
@@ -140,6 +150,54 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                 self._send(404, b"unknown debug endpoint\n", "text/plain")
         except Exception as e:  # evidence collection must never crash
             self._send(500, repr(e).encode() + b"\n", "text/plain")
+
+
+def _arm_profile(query: str) -> dict:
+    """``/debug/profile`` body: arm a capture on THIS rank (and, with
+    ``peers=1``, on every peer reachable through the autopsy's
+    ``HVD_TPU_PEER_HOSTS`` addressing).  The capture starts at the next
+    step boundary; the response carries the planned trace path (or
+    ``started: false`` when a capture is already pending/active)."""
+    from urllib.parse import parse_qs
+    from urllib.request import urlopen
+
+    from horovod_tpu.profiling import default_manager
+    params = parse_qs(query)
+
+    def _int(name, default):
+        try:
+            return int(params[name][0])
+        except (KeyError, IndexError, ValueError):
+            return default
+
+    steps = _int("steps", 0) or None
+    info = default_manager().request_capture(steps=steps,
+                                             reason="debug_endpoint")
+    doc = {"rank": _best_effort_rank(), "started": info is not None}
+    if info is not None:
+        doc["path"] = info["path"]
+        doc["steps"] = info["steps"]
+    else:
+        doc["status"] = default_manager().status()
+    if _int("peers", 0):
+        from horovod_tpu.diagnostics.autopsy import peer_debug_ports
+        peers = {}
+        steps_q = f"?steps={steps}" if steps else ""
+        for r, (host, port) in sorted(peer_debug_ports().items()):
+            url = f"http://{host}:{port}/debug/profile{steps_q}"
+            try:
+                body = urlopen(url, timeout=5.0).read()
+                peers[str(r)] = json.loads(body)
+            except Exception as e:  # best-effort fan-out
+                peers[str(r)] = {"error": repr(e)}
+        doc["peers"] = peers
+    return doc
+
+
+def _best_effort_rank() -> int:
+    from horovod_tpu.diagnostics.flight_recorder import (
+        _best_effort_rank as _rank)
+    return _rank()
 
 
 class MetricsExporter:

@@ -82,7 +82,8 @@ class Counter(_Instrument):
 class Gauge(_Instrument):
     """Point-in-time value. ``agg`` declares how cross-worker merges
     combine samples: ``last`` (default), ``sum`` (e.g. throughput),
-    ``max``, or ``mean``."""
+    ``max``, ``min`` (e.g. OOM margin: the tightest rank is THE
+    number), or ``mean``."""
 
     kind = "gauge"
 
@@ -90,7 +91,7 @@ class Gauge(_Instrument):
                  agg: Optional[str] = None):
         super().__init__(name, help, labels)
         agg = agg or "last"
-        if agg not in ("last", "sum", "max", "mean"):
+        if agg not in ("last", "sum", "max", "min", "mean"):
             raise ValueError(f"unknown gauge agg {agg!r}")
         self.agg = agg
         self._value = 0.0
@@ -282,6 +283,8 @@ class Registry:
                         t["value"] += s["value"]
                     elif agg == "max":
                         t["value"] = max(t["value"], s["value"])
+                    elif agg == "min":
+                        t["value"] = min(t["value"], s["value"])
                     elif agg == "mean":
                         means.setdefault(key, [t["value"]]).append(
                             s["value"])

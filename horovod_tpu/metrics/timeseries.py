@@ -247,6 +247,23 @@ def record_step(step: int, seconds: float, units: float = 0.0,
         pass
 
 
+def record_point(point: Dict[str, Any]) -> None:
+    """Free-form observability point riding the same ring + JSONL store
+    as step points — used by the re-mesh timeline
+    (:mod:`horovod_tpu.elastic.remesh`) to persist each recovery
+    episode's phase breakdown (``python -m horovod_tpu.metrics history
+    --remesh`` renders them).  Never raises."""
+    try:
+        r = recorder()
+        doc = dict(point)
+        doc.setdefault("ts", round(time.time(), 3))
+        r.ring.append(doc)
+        if r.writer is not None:
+            r.writer.write(doc)
+    except Exception:
+        pass
+
+
 def reset() -> None:
     """Drop the process-wide recorder so the next use re-reads rank and
     env (elastic re-init, tests)."""

@@ -1,0 +1,159 @@
+"""Deep-profiling subsystem: evidence of *why*, captured exactly when
+the cheap always-on layer says something is wrong.
+
+Layers (docs/OBSERVABILITY.md "Deep profiling" / "Compile & memory
+observability"):
+
+* :mod:`horovod_tpu.profiling.manager` — bounded, step-windowed
+  ``jax.profiler`` device traces (on demand, scheduled, or fired by the
+  anomaly engine);
+* :mod:`horovod_tpu.profiling.compile_watch` — compile-time metrics,
+  tracing-cache misses, and the ``recompile_storm`` detector;
+* :mod:`horovod_tpu.profiling.memory` — per-device HBM gauges + the
+  ``hbm_growth`` slow-leak detector.
+
+This package owns the two cross-cutting seams:
+
+* the **step seam** — :func:`on_step_begin` / :func:`on_step_end`,
+  called by :class:`horovod_tpu.train.callbacks.StepTimer` on every
+  step (cheap no-ops unless a capture is pending/active or the HBM
+  sampler has a backend that reports stats);
+* the **anomaly seam** — :func:`on_anomaly`, called by the anomaly
+  engine for every finding: when ``HVD_TPU_PROFILE_ON_ANOMALY`` is on
+  (default), a finding arms a capture of the next
+  ``HVD_TPU_PROFILE_STEPS`` steps and stamps the planned trace path
+  into the finding itself, so the flight event, ``/metrics`` and the
+  autopsy all point at the same evidence.
+
+Also re-exported here: the device-annotation helpers the old
+``horovod_tpu.utils.profiler`` stub used to hold (that module is now a
+shim over this package).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Iterator, Optional
+
+from horovod_tpu.profiling.manager import (ProfileManager, default_manager,
+                                           profile_dir)
+from horovod_tpu.profiling import compile_watch, memory
+
+__all__ = [
+    "ProfileManager", "default_manager", "profile_dir",
+    "compile_watch", "memory",
+    "on_step_begin", "on_step_end", "on_anomaly",
+    "recent_captures", "finalize_open_capture", "reset",
+    "start_trace", "stop_trace", "trace", "annotate", "annotate_fn",
+]
+
+
+# -- step seam (called from StepTimer; must never raise) ---------------------
+def on_step_begin(step: int) -> None:
+    try:
+        default_manager().on_step_begin(step)
+    except Exception:
+        pass
+
+
+def on_step_end(step: int) -> None:
+    try:
+        default_manager().on_step_end(step)
+    except Exception:
+        pass
+    try:
+        finding = memory.default_sampler().on_step(step)
+        if finding is not None:
+            from horovod_tpu.metrics.anomaly import report_finding
+            report_finding(**finding)
+    except Exception:
+        pass
+
+
+# -- anomaly seam (called from AnomalyEngine._flag) --------------------------
+def on_anomaly(finding: dict) -> Optional[dict]:
+    """A fresh anomaly finding: arm a rate-limited capture of the next
+    K steps and stamp the planned path into the finding (the engine
+    stores the same dict, so the path shows up in
+    ``recent_findings()`` / the autopsy summary / the flight event)."""
+    from horovod_tpu.profiling.manager import on_anomaly_enabled
+    if not on_anomaly_enabled():
+        return None
+    try:
+        info = default_manager().request_capture(
+            reason=f"anomaly:{finding.get('kind', 'unknown')}",
+            trigger=finding, rate_limited=True)
+    except Exception:
+        return None
+    if info is not None:
+        finding["profile"] = info["path"]
+    return info
+
+
+# -- autopsy integration -----------------------------------------------------
+def recent_captures() -> list:
+    """Completed (and aborted-but-flushed) capture records — what the
+    autopsy summary embeds under ``profiles``."""
+    from horovod_tpu.profiling import manager as _m
+    mgr = _m._MANAGER
+    return mgr.recent_captures() if mgr is not None else []
+
+
+def finalize_open_capture(reason: str = "aborted") -> Optional[dict]:
+    """Close a mid-window capture NOW (autopsy/crash paths): a job that
+    degraded, started its trace, and then hung still ships the trace."""
+    from horovod_tpu.profiling import manager as _m
+    mgr = _m._MANAGER
+    return mgr.finalize_open_capture(reason) if mgr is not None else None
+
+
+def reset() -> None:
+    """Drop process-wide state so env is re-read (tests, elastic)."""
+    from horovod_tpu.profiling import manager as _m
+    _m.reset()
+    memory.reset()
+    compile_watch.reset_counts()
+
+
+# -- device-annotation helpers (the old utils/profiler surface) --------------
+def start_trace(log_dir: str) -> None:
+    """Begin a device trace viewable in TensorBoard/XProf (the device
+    -side counterpart of ``hvd.start_timeline``).  Prefer
+    :class:`ProfileManager` for bounded, managed captures."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace() -> None:
+    import jax
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    start_trace(log_dir)
+    try:
+        yield
+    finally:
+        stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named range on the device timeline (NVTX-range analog)."""
+    import jax
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def annotate_fn(name: Optional[str] = None):
+    """Decorator form: ``@annotate_fn("allreduce.grads")``."""
+    def deco(fn):
+        label = name or fn.__name__
+
+        def wrapped(*args: Any, **kwargs: Any):
+            import jax
+            with jax.profiler.TraceAnnotation(label):
+                return fn(*args, **kwargs)
+        return wrapped
+    return deco

@@ -1,0 +1,284 @@
+"""Compile observability: XLA compile time, tracing-cache misses, and
+the ``recompile_storm`` detector.
+
+The classic silent TPU perf killer is not a slow op — it is a *re*compile
+storm: an input pipeline that drifts shapes (a ragged last batch, a
+padding bug, a python-scalar hyperparameter traced as a constant) makes
+``jit`` miss its tracing cache every step, and the job spends minutes in
+XLA while the step-time metrics only show mush.  This module turns the
+compiler into a first-class metrics source:
+
+* ``hvd_compile_seconds{function=...}`` — per-function backend-compile
+  time histogram (label set bounded; overflow lands on ``other``);
+* ``hvd_compile_total`` — backend compilations;
+* ``hvd_compile_cache_miss_total`` — tracing-cache misses (every
+  "Compiling f" event: jit found no cached trace for the call);
+* ``recompile_storm`` findings through the anomaly engine
+  (:mod:`horovod_tpu.metrics.anomaly`) — the SAME function compiled
+  more than ``HVD_TPU_RECOMPILE_STORM`` times past its
+  ``HVD_TPU_RECOMPILE_WARMUP`` expected compiles, with the offending
+  function named in the finding and the flight event (and, via the
+  anomaly->profile hook, a device trace of the storm itself).
+
+Sources (jax 0.4.x):
+
+* ``jax.monitoring`` duration events
+  (``/jax/core/compile/backend_compile_duration``) time the actual XLA
+  backend compile;
+* the ``jax_log_compiles`` log line ("Compiling <name> with global
+  shapes...") names the function being compiled — jax's monitoring
+  events carry no name, so the log record is the attribution channel.
+  When this module enabled the flag itself it also stops those records
+  propagating to the root logger (they become metrics, not stderr
+  noise); a user who pre-enabled the flag keeps their output.
+
+Everything degrades gracefully: if a jax upgrade renames the logger or
+reshapes the message, compiles are still counted (monitoring events) —
+only the per-function attribution goes to ``unknown``.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+from typing import Dict, Optional
+
+MAX_FUNCTION_LABELS = 32
+DEFAULT_RECOMPILE_WARMUP = 2
+DEFAULT_RECOMPILE_STORM = 3
+
+# jax's lowering log line; the WARNING level is jax's own choice for
+# log_compiles output (jax._src.interpreters.pxla)
+_COMPILING_RE = re.compile(r"^Compiling ([^\s]+) with global shapes")
+_PXLA_LOGGER = "jax._src.interpreters.pxla"
+# also logs at WARNING under log_compiles ("Finished tracing...",
+# "Finished XLA compilation...") — silenced alongside when WE own the
+# flag, or every compile would print three stderr lines
+_DISPATCH_LOGGER = "jax._src.dispatch"
+
+_LOCK = threading.Lock()
+_TLS = threading.local()
+
+_installed = False
+_handler: Optional[logging.Handler] = None
+_null_handler: Optional[logging.Handler] = None
+_we_enabled_flag = False
+_prev_propagate: Dict[str, bool] = {}
+_registry = None
+# jax.monitoring has no listener removal, so the duration listener is
+# registered at most once per process and gated on ``_installed`` —
+# an uninstall/ensure_installed cycle must NOT add a second listener
+# (every compile would count twice)
+_listener_registered = False
+
+# per-function compile counts + storm bookkeeping
+_compiles: Dict[str, int] = {}
+_flagged_at: Dict[str, int] = {}
+_label_set: set = set()
+_totals = {"compiles": 0, "cache_misses": 0, "seconds_total": 0.0}
+
+
+def _envi(name: str, default: int) -> int:
+    from horovod_tpu.common.config import env_int
+    return env_int(name, default)
+
+
+def enabled() -> bool:
+    from horovod_tpu.common.config import env_bool
+    return env_bool("COMPILE_METRICS", True)
+
+
+def _reg():
+    global _registry
+    if _registry is None:
+        from horovod_tpu.metrics.registry import default_registry
+        _registry = default_registry()
+    return _registry
+
+
+def _function_label(name: str) -> str:
+    """Bound the label cardinality: a storm of distinct names (e.g. a
+    lambda per step) must not turn the registry into a leak."""
+    with _LOCK:
+        if name in _label_set:
+            return name
+        if len(_label_set) < MAX_FUNCTION_LABELS:
+            _label_set.add(name)
+            return name
+    return "other"
+
+
+def _note_compiling(name: str) -> None:
+    """A tracing-cache miss for ``name`` (about to trace + compile)."""
+    _TLS.last_name = name
+    with _LOCK:
+        _totals["cache_misses"] += 1
+    try:
+        _reg().counter(
+            "hvd_compile_cache_miss_total",
+            help="jit tracing-cache misses (each one traces and "
+                 "compiles)").inc()
+    except Exception:
+        pass
+    _check_storm(name)
+
+
+def _check_storm(name: str) -> None:
+    warmup = max(0, _envi("RECOMPILE_WARMUP", DEFAULT_RECOMPILE_WARMUP))
+    storm = max(1, _envi("RECOMPILE_STORM", DEFAULT_RECOMPILE_STORM))
+    with _LOCK:
+        n = _compiles.get(name, 0) + 1
+        if len(_compiles) < 4096 or name in _compiles:
+            _compiles[name] = n
+        recompiles = n - warmup
+        last = _flagged_at.get(name, 0)
+        if recompiles <= 0 or recompiles - last < storm:
+            return
+        _flagged_at[name] = recompiles
+    # outside the lock: reporting fans out to counter + flight +
+    # (possibly) a profile capture
+    try:
+        from horovod_tpu.metrics.anomaly import report_finding
+        report_finding("recompile_storm", function=name, compiles=n,
+                       recompiles=recompiles)
+    except Exception:
+        pass
+
+
+def _on_backend_compile(seconds: float) -> None:
+    name = getattr(_TLS, "last_name", None) or "unknown"
+    with _LOCK:
+        _totals["compiles"] += 1
+        _totals["seconds_total"] += float(seconds)
+    try:
+        reg = _reg()
+        reg.counter("hvd_compile_total",
+                    help="XLA backend compilations").inc()
+        reg.histogram(
+            "hvd_compile_seconds",
+            help="XLA backend compile time per compilation",
+            labels={"function": _function_label(name)}).observe(seconds)
+    except Exception:
+        pass
+
+
+class _CompileLogHandler(logging.Handler):
+    def emit(self, record: logging.LogRecord) -> None:
+        if not _installed:
+            return
+        try:
+            m = _COMPILING_RE.match(record.getMessage())
+            if m:
+                _note_compiling(m.group(1))
+        except Exception:
+            pass  # observability must never break compilation
+
+
+def ensure_installed(registry=None) -> bool:
+    """Idempotent; returns True when the hooks are (already) live.
+    Gated on ``HVD_TPU_COMPILE_METRICS`` (default on)."""
+    global _installed, _handler, _null_handler, _we_enabled_flag, \
+        _prev_propagate, _registry, _listener_registered
+    if not enabled():
+        return False
+    with _LOCK:
+        if _installed:
+            return True
+        _installed = True
+    if registry is not None:
+        _registry = registry
+    try:
+        import jax
+        import jax.monitoring
+
+        def _dur_listener(event: str, duration: float, **_kw) -> None:
+            if _installed and \
+                    event == "/jax/core/compile/backend_compile_duration":
+                _on_backend_compile(duration)
+
+        if not _listener_registered:
+            jax.monitoring.register_event_duration_secs_listener(
+                _dur_listener)
+            _listener_registered = True
+        lg = logging.getLogger(_PXLA_LOGGER)
+        _handler = _CompileLogHandler(level=logging.DEBUG)
+        lg.addHandler(_handler)
+        if lg.level > logging.WARNING or lg.level == logging.NOTSET:
+            lg.setLevel(logging.WARNING)
+        if not jax.config.jax_log_compiles:
+            jax.config.update("jax_log_compiles", True)
+            _we_enabled_flag = True
+            # we turned the firehose on; keep it out of stderr.  The
+            # NullHandler matters: with propagate=False and NO handler,
+            # stdlib logging falls back to the bare-format lastResort
+            # stderr handler for WARNING records
+            _null_handler = logging.NullHandler()
+            for name in (_PXLA_LOGGER, _DISPATCH_LOGGER):
+                lgr = logging.getLogger(name)
+                _prev_propagate[name] = lgr.propagate
+                lgr.propagate = False
+                lgr.addHandler(_null_handler)
+    except Exception as e:
+        from horovod_tpu.common.logging import get_logger
+        get_logger().warning("compile observability unavailable: %r", e)
+    return True
+
+
+def uninstall() -> None:
+    """Tests only: disable the hooks and restore jax's flag/propagation.
+    The monitoring listener stays registered (jax has no single-listener
+    removal) but goes inert behind the ``_installed`` flag."""
+    global _installed, _handler, _null_handler, _we_enabled_flag
+    with _LOCK:
+        if not _installed:
+            return
+        _installed = False
+    lg = logging.getLogger(_PXLA_LOGGER)
+    if _handler is not None:
+        lg.removeHandler(_handler)
+        _handler = None
+    if _we_enabled_flag:
+        try:
+            import jax
+            jax.config.update("jax_log_compiles", False)
+        except Exception:
+            pass
+        for name, prop in _prev_propagate.items():
+            lgr = logging.getLogger(name)
+            lgr.propagate = prop
+            if _null_handler is not None:
+                lgr.removeHandler(_null_handler)
+        _we_enabled_flag = False
+        _prev_propagate.clear()
+        _null_handler = None
+
+
+def totals() -> dict:
+    """Process-lifetime compile totals — what ``bench.py`` records as
+    ``compile_seconds`` (measured backend-compile time, not the wall
+    clock of a phase that also ran the first step)."""
+    with _LOCK:
+        return dict(_totals)
+
+
+def per_function_compiles() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_compiles)
+
+
+def reset_counts() -> None:
+    """Forget per-function storm bookkeeping, totals, and the label
+    budget (tests, elastic re-init); the registry instruments are
+    cumulative and stay.  Resetting the label set lets a fresh
+    generation attribute ITS functions by name — without it a
+    long-lived process saturates ``MAX_FUNCTION_LABELS`` once and every
+    later function lands on ``other`` forever.  Re-used names attach to
+    their existing series, so cardinality stays bounded per reset
+    epoch."""
+    with _LOCK:
+        _compiles.clear()
+        _flagged_at.clear()
+        _label_set.clear()
+        _totals.update({"compiles": 0, "cache_misses": 0,
+                        "seconds_total": 0.0})

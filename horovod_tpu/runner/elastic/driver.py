@@ -310,6 +310,15 @@ class ElasticDriver:
             len(survivors), len(replacements))
         self._publish_world(gen, new_slots, coord_addr, coord_port,
                             keyed_slots=keyed)
+        # driver-side half of the re-mesh timeline: the survivors
+        # measure their own phases (hvd_remesh_seconds); the driver
+        # stamps WHEN it published the recovery world, so a merged
+        # flight view can attribute the workers' failure_detect wait
+        from horovod_tpu.diagnostics.flight_recorder import record_event
+        record_event("remesh_driver_published", generation=gen,
+                     np=new_np, survivors=len(survivors),
+                     replacements=len(replacements),
+                     charge_reset=charge_reset)
         # registrations are stale the moment ranks renumber: survivors
         # re-register at their first commit in the new world, and a crash
         # BEFORE that commit conservatively takes the restart path

@@ -120,7 +120,13 @@ class StepTimer:
         from horovod_tpu.diagnostics.flight_recorder import record_event
         # +1: number the step being ENTERED, matching the post-increment
         # number its step_end will carry (begin/end pairs must agree)
-        record_event("step_begin", step=int(self.steps.value) + 1)
+        step_no = int(self.steps.value) + 1
+        record_event("step_begin", step=step_no)
+        # deep-profiling seam (docs/OBSERVABILITY.md "Deep profiling"):
+        # a pending capture request opens its jax.profiler window at
+        # this step boundary; cheap no-op otherwise
+        from horovod_tpu import profiling
+        profiling.on_step_begin(step_no)
 
     def end_step(self, units: float = 0.0) -> Optional[float]:
         """Close the step opened by :meth:`start_step`; returns the step
@@ -144,6 +150,13 @@ class StepTimer:
         # "Step time-series history"
         from horovod_tpu.metrics import timeseries
         timeseries.record_step(step_no, dt, units)
+        # deep-profiling seam: close an active capture window when its
+        # step budget is spent, and sample the HBM gauges; a completed
+        # step also closes the re-mesh timeline's first_step phase
+        from horovod_tpu import profiling
+        profiling.on_step_end(step_no)
+        from horovod_tpu.elastic import remesh
+        remesh.note_step_end(step_no)
         if units:
             self.units.inc(units)
             if dt > 0:
@@ -203,6 +216,12 @@ class TelemetryCallback:
     ``log_every_n_steps`` > 0 logs a one-line telemetry summary (step
     time, units/s, MFU) through the rank-tagged logger.
 
+    ``profile_steps`` > 0 schedules a ProfileManager device-trace
+    capture of the FIRST ``profile_steps`` training steps
+    (docs/OBSERVABILITY.md "Deep profiling"); independent of it, the
+    anomaly engine can fire captures later in the run
+    (``HVD_TPU_PROFILE_ON_ANOMALY``).
+
     Creating the callback also arms the process-wide hang watchdog
     (``HVD_TPU_WATCHDOG_SECONDS``, default 600; 0 disarms): if no step
     completes for that long, an autopsy bundle is written —
@@ -215,6 +234,7 @@ class TelemetryCallback:
                  flops_per_step: Optional[float] = None,
                  hlo_flops_factor: int = 1,
                  log_every_n_steps: int = 0,
+                 profile_steps: int = 0,
                  registry: Optional[Registry] = None) -> None:
         self.timer = StepTimer(unit=unit, flops_per_step=flops_per_step,
                                registry=registry)
@@ -240,6 +260,15 @@ class TelemetryCallback:
         # still runs, and lands in any later autopsy bundle's summary
         from horovod_tpu.metrics.anomaly import default_engine
         self.anomaly_engine = default_engine()
+        # compile observability rides every telemetry loop (idempotent;
+        # HVD_TPU_COMPILE_METRICS=0 disables)
+        from horovod_tpu.profiling import compile_watch
+        compile_watch.ensure_installed()
+        if profile_steps > 0:
+            # armed now, opens at the first step boundary
+            from horovod_tpu.profiling import default_manager
+            default_manager().request_capture(steps=profile_steps,
+                                              reason="telemetry")
 
     def on_train_begin(self, *args, **kwargs):
         return args[0] if len(args) == 1 else (args or None)

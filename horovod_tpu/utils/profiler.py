@@ -1,53 +1,20 @@
-"""Device-side profiling annotations.
+"""Back-compat shim: the device-side profiling helpers moved to the
+deep-profiling subsystem (:mod:`horovod_tpu.profiling` — ISSUE 9).
 
-Reference: NVTX ranges wrapping each user-facing op for Nsight
-(``horovod/common/nvtx_op_range.{h,cc}``, enqueue sites
-``operations.cc:1455-1470``). TPU equivalent: ``jax.profiler`` traces +
-named annotations that show up in XProf/TensorBoard, plus a context manager
-pair mirroring ``hvd.start_timeline``/``stop_timeline`` for the device side.
+This module used to be a dead 50-line stub around
+``jax.profiler.start_trace``; the real machinery now lives in
+``horovod_tpu/profiling/``: :class:`ProfileManager` (bounded,
+step-windowed captures driven on demand, from
+``TelemetryCallback(profile_steps=...)``, or automatically by the
+anomaly engine), compile observability, and HBM sampling.  Import from
+``horovod_tpu.profiling`` in new code.
 """
 
 from __future__ import annotations
 
-import contextlib
-from typing import Iterator, Optional
+from horovod_tpu.profiling import (ProfileManager, annotate,  # noqa: F401
+                                   annotate_fn, default_manager,
+                                   start_trace, stop_trace, trace)
 
-import jax
-
-
-def start_trace(log_dir: str) -> None:
-    """Begin a device trace viewable in TensorBoard/XProf (the device-side
-    counterpart of ``hvd.start_timeline``)."""
-    jax.profiler.start_trace(log_dir)
-
-
-def stop_trace() -> None:
-    jax.profiler.stop_trace()
-
-
-@contextlib.contextmanager
-def trace(log_dir: str) -> Iterator[None]:
-    start_trace(log_dir)
-    try:
-        yield
-    finally:
-        stop_trace()
-
-
-@contextlib.contextmanager
-def annotate(name: str) -> Iterator[None]:
-    """Named range on the device timeline (NVTX-range analog)."""
-    with jax.profiler.TraceAnnotation(name):
-        yield
-
-
-def annotate_fn(name: Optional[str] = None):
-    """Decorator form: ``@annotate_fn("allreduce.grads")``."""
-    def deco(fn):
-        label = name or fn.__name__
-
-        def wrapped(*args, **kwargs):
-            with jax.profiler.TraceAnnotation(label):
-                return fn(*args, **kwargs)
-        return wrapped
-    return deco
+__all__ = ["start_trace", "stop_trace", "trace", "annotate",
+           "annotate_fn", "ProfileManager", "default_manager"]

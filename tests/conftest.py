@@ -15,6 +15,17 @@ os.environ["XLA_FLAGS"] = (
     + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Anomaly findings arm real device-trace captures by default (ISSUE 9,
+# docs/OBSERVABILITY.md "Deep profiling"); any test that provokes one
+# must not drop trace directories into the repo checkout — default the
+# retention dir to a per-run tmp location (tests that assert capture
+# behavior point it at their own tmp_path)
+import tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "HVD_TPU_PROFILE_DIR",
+    os.path.join(tempfile.gettempdir(), f"hvd_profile_test_{os.getpid()}"))
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

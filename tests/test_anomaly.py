@@ -18,13 +18,21 @@ from horovod_tpu.metrics.registry import Registry
 
 
 @pytest.fixture(autouse=True)
-def _fresh_singletons():
+def _fresh_singletons(monkeypatch):
+    import horovod_tpu.profiling as profiling
     from horovod_tpu.metrics import anomaly, timeseries
+    # this file tests the ENGINE; the unit findings it manufactures
+    # must not arm real device-trace captures (the capture path has its
+    # own battery + acceptance in test_profiling.py) — an armed capture
+    # would open during the next telemetry loop and skew its baseline
+    monkeypatch.setenv("HVD_TPU_PROFILE_ON_ANOMALY", "0")
     anomaly.reset()
     timeseries.reset()
+    profiling.reset()
     yield
     anomaly.reset()
     timeseries.reset()
+    profiling.reset()
 
 
 def _engine():

@@ -115,6 +115,11 @@ def test_gpt_child_runs_on_cpu_mesh():
     assert doc["value"] > 0
     assert doc["n_chips"] == 8
     assert doc["compile_s"] > 0
+    # ISSUE 9: hook-measured compile time (counts EVERY backend
+    # compile, not just the first-step wall clock) + HBM peak (None on
+    # CPU: the backend reports no memory_stats)
+    assert doc["compile_seconds"] > 0
+    assert "hbm_peak_bytes" in doc and doc["hbm_peak_bytes"] is None
 
 
 def test_child_exits_cleanly_before_deadline(tmp_path):
@@ -278,6 +283,60 @@ def test_scaling_gate_extract_and_regression(tmp_path):
         {"tail": "[scaling] " + json.dumps(base_full)}))
     assert scaling_main(["--scaling", str(trunc_path), "--baseline",
                          str(base_path), "--tolerance", "0.9"]) == 1
+
+
+def test_compile_budget_gate(tmp_path):
+    """ci/check_bench.py --compile-budget (ISSUE 9): hook-measured
+    compile_seconds gated against the baseline with a tolerance band;
+    wall-clock compile_s is the fallback for pre-contract artifacts."""
+    sys.path.insert(0, REPO)
+    try:
+        from ci.check_bench import (check_compile_budget,
+                                    compile_budget_main,
+                                    doc_compile_seconds)
+    finally:
+        sys.path.remove(REPO)
+    new = {"metric": "resnet50", "value": 100.0, "compile_seconds": 30.0,
+           "compile_s": 99.0}
+    assert doc_compile_seconds(new) == (30.0, "hooks")  # hooks beat wall
+    old = {"metric": "resnet50", "value": 90.0, "compile_s": 25.0}
+    assert doc_compile_seconds(old) == (25.0, "wall")
+    # within band / beyond band / no baseline / broken contract
+    assert check_compile_budget(new, old, tolerance=0.5) is None
+    assert "regression" in check_compile_budget(
+        {"value": 1.0, "compile_seconds": 60.0}, old, tolerance=0.5)
+    assert check_compile_budget(new, None, tolerance=0.5) is None
+    assert "contract" in check_compile_budget(
+        {"value": 1.0}, old, tolerance=0.5)
+    # a failure doc (value null) has no compile to judge
+    assert check_compile_budget(
+        {"value": None, "error": "x"}, old, tolerance=0.5) is None
+
+    # CLI roundtrip incl. the BENCH_r* "parsed" wrapper form
+    new_path = tmp_path / "new.json"
+    new_path.write_text(json.dumps(new))
+    base_path = tmp_path / "BENCH_base.json"
+    base_path.write_text(json.dumps({"n": 1, "parsed": old}))
+    rc = compile_budget_main(["--compile-budget", str(new_path),
+                              "--baseline", str(base_path)])
+    assert rc == 0
+    bad_path = tmp_path / "bad.json"
+    bad_path.write_text(json.dumps(
+        {"value": 1.0, "compile_seconds": 60.0}))
+    rc = compile_budget_main(["--compile-budget", str(bad_path),
+                              "--baseline", str(base_path)])
+    assert rc == 1
+    rc = compile_budget_main(["--compile-budget", str(new_path),
+                              "--baseline", str(base_path),
+                              "--tolerance", "0.1"])
+    assert rc == 1
+    # a failure doc (value null, no compile time) against a real
+    # baseline passes without crashing on the success-path print
+    fail_path = tmp_path / "failed.json"
+    fail_path.write_text(json.dumps({"value": None, "error": "boom"}))
+    rc = compile_budget_main(["--compile-budget", str(fail_path),
+                              "--baseline", str(base_path)])
+    assert rc == 0
 
 
 def test_tuned_vs_default_gate(tmp_path):

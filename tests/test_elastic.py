@@ -383,9 +383,23 @@ def _inplace_worker_prog(log, tmp_path, crash_clause):
 
         out = train(state)
         assert out == float(hvd.size()), (out, hvd.size())
+        # re-mesh timeline evidence (docs/OBSERVABILITY.md "Re-mesh
+        # timeline"): a worker that lived through an in-place re-mesh
+        # carries hvd_remesh_seconds{{phase}} observations + the episode
+        # counter; a freshly booted replacement carries none
+        import re as _re
+        from horovod_tpu.metrics.registry import default_registry
+        snap = default_registry().snapshot()
+        phases = sorted({{
+            _re.search(r'phase="([^"]+)"', k).group(1)
+            for k, s in snap.items()
+            if k.startswith('hvd_remesh_seconds{{') and s["count"] > 0}})
+        total = snap.get("hvd_remesh_total", {{}}).get("value", 0)
         with open({str(log)!r}, "a") as f:
             f.write(f"DONE rank={{hvd.rank()}} pid={{os.getpid()}} "
                     f"size={{hvd.size()}} step={{state.step}}\\n")
+            f.write(f"REMESH rank={{hvd.rank()}} total={{int(total)}} "
+                    f"phases={{','.join(phases)}}\\n")
         hvd.shutdown()
     """)
 
@@ -431,6 +445,23 @@ def test_elastic_crash_recovers_in_place_with_replacement(tmp_path):
         # survivors finish under the PID they booted with
         if parts["rank"] in ("0", "1"):
             assert boot_pids[parts["rank"]] == [parts["pid"]]
+    # the re-mesh phase timeline (ISSUE 9): every survivor measured its
+    # recovery — hvd_remesh_seconds{phase} series exist for the full
+    # pipeline and the episode counter ticked — while the freshly
+    # booted replacement measured none (it never re-meshed)
+    remesh = {}
+    for l in lines:
+        if l.startswith("REMESH"):
+            parts = dict(p.split("=") for p in l.split()[1:])
+            remesh[parts["rank"]] = parts
+    assert set(remesh) == {"0", "1", "2"}, lines
+    full_pipeline = {"failure_detect", "drain", "rendezvous", "rebuild",
+                     "restore", "first_step"}
+    for r in ("0", "1"):  # the survivors
+        assert int(remesh[r]["total"]) >= 1, remesh[r]
+        phases = set(remesh[r]["phases"].split(","))
+        assert phases >= full_pipeline, (r, phases)
+    assert int(remesh["2"]["total"]) == 0, remesh["2"]
 
 
 def test_elastic_capacity_loss_shrinks_in_place(tmp_path):

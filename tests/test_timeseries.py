@@ -128,6 +128,26 @@ def test_cli_history_table_and_json(tmp_path):
     assert empty.returncode == 1
 
 
+def test_cli_history_remesh_honors_json_and_last(tmp_path):
+    """--remesh composes with --json (JSONL out, not the table) and
+    --last (episode slicing) like the step view does."""
+    w = SeriesWriter(str(tmp_path), rank=0)
+    for i in range(3):
+        w.write({"ts": 1700000000 + i, "trigger": f"t{i}",
+                 "remesh": {"drain": 0.1}, "remesh_total_s": 0.5,
+                 "complete": True})
+    w.write({"ts": 1700000009, "step": 1, "step_time_s": 0.2})
+    w.close()
+    js = _cli("history", "--dir", str(tmp_path), "--remesh", "--json")
+    assert js.returncode == 0, js.stderr
+    lines = [json.loads(l) for l in js.stdout.strip().splitlines()]
+    assert len(lines) == 3 and all("remesh" in p for p in lines)
+    last = _cli("history", "--dir", str(tmp_path), "--remesh",
+                "--last", "1")
+    assert last.returncode == 0
+    assert "t2" in last.stdout and "t0" not in last.stdout
+
+
 def test_cli_top_renders_fleet_frame():
     """One-shot frame against a live exporter serving a fleet view."""
     from horovod_tpu.metrics.exporter import MetricsExporter
