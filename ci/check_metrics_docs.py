@@ -5,9 +5,10 @@ docs name must still exist in the tree.
 
 Extraction is static: a registration is a string literal passed as the
 first argument of a ``.counter(`` / ``.gauge(`` / ``.histogram(`` call
-(the registry API) or of the fleet renderer's ``g(`` helper
+(the registry API), of the fleet renderer's ``g(`` helper
 (``metrics/fleet.py`` synthesizes its breakdown gauges directly into the
-snapshot).  F-string placeholders (``f"hvd_{unit}_total"``) become
+snapshot), or of an exception-proofing ``_metric(`` wrapper
+(``runner/kv_relay.py``).  F-string placeholders (``f"hvd_{unit}_total"``) become
 wildcards, matched against the docs' ``hvd_<unit>_total`` convention
 (``<...>`` also becomes a wildcard); histograms implicitly export
 ``_bucket``/``_sum``/``_count`` sub-series, so those suffixes are
@@ -35,7 +36,8 @@ SCAN_ROOTS = ("horovod_tpu", "benchmarks")
 SCAN_FILES = ("bench.py", "__graft_entry__.py")
 
 _REG_CALL = re.compile(
-    r'(?:\.(?:counter|gauge|histogram)|\bg)\(\s*(f?)"(hvd_[^"]+)"', re.S)
+    r'(?:\.(?:counter|gauge|histogram)|\bg|\b_metric)\('
+    r'\s*(f?)"(hvd_[^"]+)"', re.S)
 # docs mention: hvd_name, hvd_<unit>_name, hvd_engine_* ... optionally
 # followed by a {label=...} part (stripped)
 _DOC_NAME = re.compile(r"\bhvd_[A-Za-z0-9_<>*]*[A-Za-z0-9_>*]")

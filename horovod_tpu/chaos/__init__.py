@@ -70,9 +70,13 @@ class ChaosEngine:
             self._fired[rule.index] = fired + 1
         if rule.marker:
             # at-most-once across restarts: O_EXCL create is the gate, so
-            # a replacement process (or a racing thread) cannot re-fire
+            # a replacement process (or a racing thread) cannot re-fire.
+            # {rank} expands per firing rank — a correlated multi-rank
+            # rule kills every group member once each, rather than the
+            # first member's marker disarming the rest of the group.
+            marker = rule.marker.replace("{rank}", str(self.rank))
             try:
-                fd = os.open(rule.marker,
+                fd = os.open(marker,
                              os.O_CREAT | os.O_EXCL | os.O_WRONLY)
                 os.close(fd)
             except FileExistsError:
@@ -93,17 +97,24 @@ class ChaosEngine:
         return True
 
     # -- firing -------------------------------------------------------------
-    def fire(self, seam: str, index: Optional[int] = None
-             ) -> List[Tuple[str, str]]:
+    def fire(self, seam: str, index: Optional[int] = None,
+             peer=None) -> List[Tuple[str, str]]:
         """Evaluate ``seam`` at ``index`` (auto-incrementing per-seam
         counter when None).  Applies every matching rule's fault —
         delays sleep in place, error kinds RAISE, kill/exit terminate
-        the process.  Returns the (seam, kind) pairs applied (delays),
-        for tests."""
+        the process, pure-signal kinds (``preemption``/``notice``) only
+        report.  ``peer`` names the request's TARGET for the
+        ``kv.partition`` seam (a worker rank or ``"driver"``); rules
+        whose cut the (self rank, peer) pair crosses fire
+        bidirectionally.  Returns the (seam, kind) pairs applied, for
+        tests and signal-kind consumers."""
         invocation = self._next_index(seam) if index is None else index
         applied: List[Tuple[str, str]] = []
         raise_after: Optional[BaseException] = None
         for rule in self.plan.rules_for(seam, self.rank):
+            if rule.groups is not None and \
+                    not rule.matches_pair(self.rank, peer):
+                continue
             if not self._should_fire(rule, invocation):
                 continue
             self._note(rule, invocation)
@@ -120,6 +131,12 @@ class ChaosEngine:
                 raise_after = ConnectionRefusedError(
                     f"chaos: injected blackout ({seam} invocation "
                     f"{invocation})")
+            elif rule.kind == "partition":
+                raise_after = ConnectionRefusedError(
+                    f"chaos: injected partition (rank {self.rank} -> "
+                    f"{peer}, invocation {invocation})")
+            elif rule.kind == "notice":
+                pass  # pure signal: the applied list IS the payload
             elif rule.kind == "io_error":
                 raise_after = OSError(
                     f"chaos: injected IO error ({seam} invocation "
@@ -272,14 +289,16 @@ def engine() -> Optional[ChaosEngine]:
     return _engine
 
 
-def fire(seam: str, index: Optional[int] = None) -> List[Tuple[str, str]]:
+def fire(seam: str, index: Optional[int] = None,
+         peer=None) -> List[Tuple[str, str]]:
     """Fire a seam if a plan is armed; the no-plan fast path is one
     module-global None check (the instrumented call sites stay free when
-    chaos is off)."""
+    chaos is off).  ``peer`` carries the request target for the
+    ``kv.partition`` seam."""
     eng = _engine
     if eng is None:
         return ()
-    return eng.fire(seam, index=index)
+    return eng.fire(seam, index=index, peer=peer)
 
 
 def step_tick(step: int) -> List[Tuple[str, str]]:

@@ -36,6 +36,14 @@ Rule fields:
   ACROSS process restarts: a rule whose marker file exists is disarmed,
   and firing creates it.  Without this, a ``step``-seam ``kill`` under an
   elastic driver would kill every replacement at the same step forever.
+  A ``{rank}`` placeholder expands to the firing rank — one correlated
+  multi-host rule (``"rank": [2, 3]``) then takes out EVERY rank of the
+  group exactly once each, instead of the first kill's marker disarming
+  the rest of the group.
+* ``groups`` — ``kv.partition`` only: the two sides of the cut, each a
+  list of worker ranks (the literal ``"driver"`` names the root KV
+  server).  A KV request whose sender and target fall on opposite sides
+  is refused, in both directions, for the rule's window.
 * kind parameters: ``delay_ms`` (delay/slow kinds), ``peer``
   (transport kinds; int or ``"*"``), ``stall_s`` (step stall),
   ``exit_code`` (step exit).
@@ -58,8 +66,19 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 #: ``HVD_TPU_CHAOS_TRANSPORT`` env spec at install time.
 SEAMS: Dict[str, frozenset] = {
     "kv.request": frozenset({"error", "blackout", "delay"}),
+    # network partition between HOST GROUPS: a KV request whose sender
+    # and target fall on opposite sides of the cut is refused, in BOTH
+    # directions.  Fired by the KV clients with the target's identity
+    # (a worker rank for relay hops, the literal "driver" for the root
+    # KV) — see docs/CHAOS.md.
+    "kv.partition": frozenset({"partition"}),
     "checkpoint.write": frozenset({"io_error", "slow_fsync"}),
     "step": frozenset({"kill", "stall", "exit"}),
+    # advance preemption notice (the TPU maintenance-event analog):
+    # non-destructive — the PreemptionWatcher polls this seam and treats
+    # a fire as "this host is doomed", driving the proactive drain path
+    # (docs/ELASTIC.md "Proactive drain & preemption").
+    "preemption": frozenset({"notice"}),
     "transport.send": frozenset({"delay", "drop", "close"}),
     "transport.recv": frozenset({"delay", "drop", "close"}),
 }
@@ -86,10 +105,21 @@ class FaultRule:
     stall_s: float = 0.0
     exit_code: int = 1
     marker: str = ""
+    # kv.partition only: the two sides of the cut.  Members are worker
+    # ranks (ints) or the literal "driver" (the root KV server).
+    groups: Optional[tuple] = None      # (frozenset, frozenset)
     index: int = 0                      # position in the plan (rule id)
 
     def matches_rank(self, rank: int) -> bool:
         return self.ranks is None or rank in self.ranks
+
+    def matches_pair(self, rank, peer) -> bool:
+        """kv.partition: does (sender ``rank``, target ``peer``) cross
+        the cut?  Bidirectional by construction."""
+        if self.groups is None or peer is None:
+            return False
+        a, b = self.groups
+        return (rank in a and peer in b) or (rank in b and peer in a)
 
     def in_window(self, invocation: int) -> bool:
         return self.start <= invocation < self.stop
@@ -132,7 +162,39 @@ def _parse_ranks(v: Any) -> Optional[frozenset]:
 
 _RULE_KEYS = {"seam", "kind", "rank", "start", "stop", "count",
               "probability", "delay_ms", "peer", "stall_s", "exit_code",
-              "marker"}
+              "marker", "groups"}
+
+
+def _parse_groups(v: Any, index: int) -> tuple:
+    """kv.partition ``groups``: exactly two disjoint, non-empty sides;
+    members are ints (worker ranks) or the literal ``"driver"``."""
+    if not (isinstance(v, (list, tuple)) and len(v) == 2):
+        raise FaultPlanError(
+            f"fault #{index}: 'groups' must be a list of exactly two "
+            "host groups")
+    sides = []
+    for side in v:
+        if not isinstance(side, (list, tuple)) or not side:
+            raise FaultPlanError(
+                f"fault #{index}: each partition group must be a "
+                "non-empty list")
+        members = set()
+        for m in side:
+            if m == "driver":
+                members.add("driver")
+            elif isinstance(m, bool) or not isinstance(m, int):
+                raise FaultPlanError(
+                    f"fault #{index}: bad group member {m!r} (worker "
+                    "rank int or 'driver')")
+            else:
+                members.add(m)
+        sides.append(frozenset(members))
+    if sides[0] & sides[1]:
+        raise FaultPlanError(
+            f"fault #{index}: partition groups overlap "
+            f"({sorted(map(str, sides[0] & sides[1]))}) — a member "
+            "cannot sit on both sides of the cut")
+    return (sides[0], sides[1])
 
 
 def _parse_rule(doc: Dict[str, Any], index: int) -> FaultRule:
@@ -195,11 +257,22 @@ def _parse_rule(doc: Dict[str, Any], index: int) -> FaultRule:
     if kind == "stall" and stall_s <= 0:
         raise FaultPlanError(
             f"fault #{index}: kind 'stall' needs stall_s > 0")
+    groups = None
+    if seam == "kv.partition":
+        if "groups" not in doc:
+            raise FaultPlanError(
+                f"fault #{index}: kv.partition needs 'groups' — the two "
+                "sides of the cut")
+        groups = _parse_groups(doc["groups"], index)
+    elif "groups" in doc:
+        raise FaultPlanError(
+            f"fault #{index}: 'groups' is only valid for the "
+            "kv.partition seam")
     return FaultRule(seam=seam, kind=kind, ranks=_parse_ranks(
         doc.get("rank", "*")), start=start, stop=stop, count=count,
         probability=probability, delay_ms=delay_ms, peer=peer,
         stall_s=stall_s, exit_code=exit_code,
-        marker=marker, index=index)
+        marker=marker, groups=groups, index=index)
 
 
 def _ranks_overlap(a: Optional[frozenset], b: Optional[frozenset]) -> bool:
@@ -212,11 +285,18 @@ def _check_overlaps(rules: Sequence[FaultRule]) -> None:
     """Two rules with the same (seam, kind) firing on overlapping ranks
     over overlapping windows are ambiguous (which one's parameters
     apply?) — reject the plan."""
+    def effective_ranks(r: FaultRule) -> Optional[frozenset]:
+        # partition rules scope by their groups, not by `rank`: two cuts
+        # over disjoint member sets are independent schedules
+        if r.groups is not None:
+            return r.groups[0] | r.groups[1]
+        return r.ranks
+
     for i, a in enumerate(rules):
         for b in rules[i + 1:]:
             if a.seam != b.seam or a.kind != b.kind:
                 continue
-            if not _ranks_overlap(a.ranks, b.ranks):
+            if not _ranks_overlap(effective_ranks(a), effective_ranks(b)):
                 continue
             if a.seam.startswith("transport.") and a.peer != b.peer \
                     and a.peer != -1 and b.peer != -1:

@@ -234,6 +234,15 @@ def init(ranks: Optional[Sequence[int]] = None,
         # reports live state, and a bind failure only warns.
         from horovod_tpu.metrics.exporter import start_worker_exporter
         _state.metrics_exporter = start_worker_exporter(_state)
+        # Proactive preemption watcher (docs/ELASTIC.md "Proactive drain
+        # & preemption"): armed only under an elastic driver; idempotent
+        # across re-meshes (the singleton reads identity from env live).
+        try:
+            from horovod_tpu.elastic import preemption as _preemption
+            _preemption.ensure_watcher()
+        except Exception:
+            get_logger().debug("preemption watcher not armed",
+                               exc_info=True)
         # compile observability (docs/OBSERVABILITY.md "Compile & memory
         # observability"): compile-time metrics + the recompile_storm
         # detector; idempotent, gated on HVD_TPU_COMPILE_METRICS

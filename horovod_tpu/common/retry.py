@@ -30,6 +30,8 @@ import random
 import time
 from typing import Callable, Optional, Tuple, Type
 
+from horovod_tpu.common.safe_metrics import safe_inc as _metric
+
 # module-level singleton RNG for jitter; deterministic tests inject their
 # own via the rng= parameter
 _RNG = random.Random()
@@ -87,30 +89,22 @@ def retry_call(fn: Callable,
         except give_up_on:
             raise
         except retry_on as e:
-            _metric("hvd_retry_attempts_total", site,
-                    "transient errors absorbed by retry_call, per site")
+            _metric("hvd_retry_attempts_total",
+                    "transient errors absorbed by retry_call, per site",
+                    site=site)
             last_chance = attempt == attempts - 1
             delay = min(max_delay_s, base_delay_s * backoff ** attempt)
             delay *= 1.0 + jitter * (2.0 * r.random() - 1.0)
             over_budget = (deadline_s is not None and
                            clock() - start + delay > deadline_s)
             if last_chance or over_budget:
-                _metric("hvd_retry_exhausted_total", site,
+                _metric("hvd_retry_exhausted_total",
                         "retry_call gave up (attempts or deadline spent), "
-                        "per site")
+                        "per site", site=site)
                 _log_exhausted(site, attempt + 1, clock() - start, e)
                 raise
             sleep(max(delay, 0.0))
     raise AssertionError("unreachable")  # pragma: no cover
-
-
-def _metric(name: str, site: str, help_text: str) -> None:
-    try:
-        from horovod_tpu.metrics.registry import default_registry
-        default_registry().counter(name, help=help_text,
-                                   labels={"site": site}).inc()
-    except Exception:
-        pass  # metrics must never fail the guarded call
 
 
 def _log_exhausted(site: str, tried: int, elapsed: float,

@@ -1,0 +1,19 @@
+"""Never-raise metric increments for control-plane paths.
+
+Accounting must not fail the operation it counts (a KV request, a
+retried call, a drain-notice publish), and the callers are light
+infrastructure modules that must not pull the metrics package — whose
+``__init__`` eagerly imports the whole subsystem — at import time, so
+the registry import happens at the first call.
+"""
+
+from __future__ import annotations
+
+
+def safe_inc(name: str, help_text: str = "", **labels) -> None:
+    try:
+        from horovod_tpu.metrics.registry import default_registry
+        default_registry().counter(name, help=help_text,
+                                   labels=labels or None).inc()
+    except Exception:
+        pass

@@ -130,11 +130,15 @@ def _world_update(poll: bool = True) -> Optional[dict]:
     if not poll:
         return None
     try:
-        from horovod_tpu.runner.http_kv import kv_get
+        from horovod_tpu.runner import kv_relay
         # short timeout: commit() must stay cheap even if the driver's
-        # port silently drops packets
-        raw = kv_get(addr, int(port), "world", "current", timeout=3.0,
-                     site="elastic.world_poll")
+        # port silently drops packets.  Routed through the KV relay tree
+        # when enabled (HVD_TPU_KV_RELAY_ARITY): the poll hits an
+        # O(arity) parent's cache instead of the root, and degrades to a
+        # direct root read when the parent is dead (docs/ELASTIC.md
+        # "Relayed control-plane KV").
+        raw = kv_relay.client(addr, int(port)).get(
+            "world", "current", timeout=3.0, site="elastic.world_poll")
     except OSError:
         return None  # driver KV transiently unreachable: not our problem
     return _validate_doc(raw)
@@ -190,13 +194,25 @@ def _apply_world_update(update: dict, force_shutdown: bool = False) -> None:
     hvd.init()
 
 
+class _NoWorldUpdateYet(Exception):
+    """Internal: the driver has not published a newer world document
+    (the retryable condition in :func:`_await_world_update`)."""
+
+
 def _await_world_update(timeout_s: Optional[float] = None) -> Optional[dict]:
     """Poll the driver for a newer world document for up to ``timeout_s``
     (default ``HVD_ELASTIC_SHRINK_WAIT_S`` or 15s). Used after a
     HorovodInternalError: if a peer died, the driver notices its process
     exit and publishes the shrunken world within moments — the survivors
-    wait here for it instead of dying for a generation restart."""
-    import time
+    wait here for it instead of dying for a generation restart.
+
+    The wait rides :func:`horovod_tpu.common.retry.retry_call` (jittered
+    exponential backoff under the window as a deadline budget): a whole
+    pod's survivors re-polling in lockstep after a shared failure is
+    exactly the thundering herd the jitter de-correlates, and the
+    attempts land on ``hvd_retry_*_total{site="elastic.await_world"}``
+    — exhaustion there means "no recovery world inside the window"
+    (the same-world retry follows), not an outage."""
     if not os.environ.get("HVD_ELASTIC_KV"):
         # no driver manages this job: a recovery world can never arrive,
         # and waiting out the full window would stall EVERY
@@ -204,12 +220,24 @@ def _await_world_update(timeout_s: Optional[float] = None) -> Optional[dict]:
         return None
     if timeout_s is None:
         timeout_s = float(os.environ.get("HVD_ELASTIC_SHRINK_WAIT_S", "15"))
-    deadline = time.time() + timeout_s
-    while True:
+
+    from horovod_tpu.common.retry import retry_call
+
+    def poll():
         update = _world_update(poll=True)
-        if update is not None or time.time() >= deadline:
-            return update
-        time.sleep(0.5)
+        if update is None:
+            raise _NoWorldUpdateYet()
+        return update
+
+    try:
+        return retry_call(
+            poll, site="elastic.await_world",
+            retry_on=(_NoWorldUpdateYet,),
+            attempts=1_000_000,  # the deadline is the real bound
+            base_delay_s=0.25, backoff=1.5, max_delay_s=2.0, jitter=0.25,
+            deadline_s=timeout_s)
+    except _NoWorldUpdateYet:
+        return None
 
 
 class State:
@@ -542,7 +570,40 @@ def run(func: Callable) -> Callable:
                     remesh.note_same_world_retry()
                     state.sync()
             except HostsUpdatedInterrupt as e:
-                remesh.begin("hosts_updated", old_size=size())
+                # a world doc carrying a drain stamp is the PLANNED
+                # re-mesh of the proactive preemption path
+                # (docs/ELASTIC.md "Proactive drain & preemption"): the
+                # doomed host announced itself, the driver published
+                # around it, and detection cost ~nothing — record the
+                # failure_detect phase anyway (≈0) so the
+                # hvd_remesh_seconds series makes the planned-vs-
+                # reactive difference a measured quantity, not a gap
+                trigger = "preemption_drain" \
+                    if isinstance(e.update, dict) and e.update.get("drain") \
+                    else "hosts_updated"
+                remesh.begin(trigger, old_size=size())
+                with remesh.phase("failure_detect"):
+                    pass  # the doc arrived WITH the interrupt
+                if trigger == "preemption_drain":
+                    # the interrupt is only ever raised from commit()'s
+                    # check_host_updates, so state.save() ran moments
+                    # ago under the OLD world — while the doomed host is
+                    # still alive (that is the whole point of advance
+                    # notice).  What remains is to DRAIN any async
+                    # durable commits to disk before the doomed worker
+                    # exits, so its shard of the sharded store lands
+                    # and the planned path hands the new world a
+                    # complete checkpoint instead of hoping the pickle
+                    # tier survives the host
+                    try:
+                        flush = getattr(state, "flush", None)
+                        if callable(flush):
+                            flush()
+                    except Exception:
+                        from horovod_tpu.common.logging import get_logger
+                        get_logger().warning(
+                            "final drain flush failed; continuing with "
+                            "the last committed state", exc_info=True)
                 if e.update is not None:
                     _apply_world_update(e.update)  # in-place re-mesh
                 with remesh.phase("restore"):

@@ -37,16 +37,40 @@ _registered_as = None  # (rank, generation) the listener is known to hold
 
 
 class WorkerNotificationListener:
-    """Per-worker push endpoint + registration with the driver KV."""
+    """Per-worker push endpoint + registration with the driver KV.
 
-    def __init__(self) -> None:
-        from horovod_tpu.runner.http_kv import KVStoreServer
-        self._kv = KVStoreServer()
+    With the KV relay enabled (``HVD_TPU_KV_RELAY_ARITY`` > 0,
+    docs/ELASTIC.md "Relayed control-plane KV") the listener doubles as
+    this worker's RELAY NODE: children's world polls are served from its
+    cache and their registrations forwarded up the tree, so the driver's
+    root KV handles O(arity) sessions instead of O(world)."""
+
+    def __init__(self, driver_addr: Optional[str] = None,
+                 driver_port: Optional[int] = None) -> None:
+        from horovod_tpu.runner import kv_relay
+        self._driver = (driver_addr, driver_port)
+        if kv_relay.relay_arity() > 0 and driver_addr is not None:
+            self._kv = kv_relay.RelayKVServer(self._upstream)
+        else:
+            from horovod_tpu.runner.http_kv import KVStoreServer
+            self._kv = KVStoreServer()
         self._kv.start()
+
+    def _upstream(self):
+        from horovod_tpu.runner import kv_relay
+        addr, port = self._driver
+        if addr is None:
+            return None
+        return kv_relay.client(addr, int(port))
 
     @property
     def port(self) -> int:
         return self._kv.port
+
+    @property
+    def kv(self):
+        """The underlying KV server (relay diagnostics / tests)."""
+        return self._kv
 
     def pending_raw(self) -> Optional[bytes]:
         """The most recently pushed world document (unvalidated bytes)."""
@@ -55,8 +79,10 @@ class WorkerNotificationListener:
     def register(self, driver_addr: str, driver_port: int) -> None:
         """Record ``notify/<rank> -> host:port`` in the driver KV so the
         driver knows where to push (host = this worker's slot hostname,
-        which the driver can route to by construction)."""
-        from horovod_tpu.runner.http_kv import kv_put
+        which the driver can route to by construction).  Routed through
+        the KV relay when enabled — the registration travels up the tree
+        to the root, falling back to a direct root PUT."""
+        from horovod_tpu.runner import kv_relay
         my_host = os.environ.get("HOROVOD_HOSTNAME") or socket.getfqdn()
         rank = os.environ.get("HOROVOD_RANK",
                               os.environ.get("HVD_TPU_RANK", "0"))
@@ -64,9 +90,9 @@ class WorkerNotificationListener:
         # own retry series, not blended into generic KV traffic — a
         # worker whose registrations keep exhausting is a worker the
         # driver will deem unrecoverable (docs/ELASTIC.md)
-        kv_put(driver_addr, driver_port, "notify", rank,
-               f"{my_host}:{self.port}".encode(), timeout=5.0,
-               site="elastic.notify.register")
+        kv_relay.client(driver_addr, driver_port).put(
+            "notify", rank, f"{my_host}:{self.port}".encode(),
+            timeout=5.0, site="elastic.notify.register")
 
     def stop(self) -> None:
         self._kv.stop()
@@ -94,7 +120,7 @@ def ensure_listener(driver_addr: str, driver_port: int) -> \
                     pass  # poll-at-commit still works
             return _listener
         try:
-            listener = WorkerNotificationListener()
+            listener = WorkerNotificationListener(driver_addr, driver_port)
             listener.register(driver_addr, driver_port)
         except OSError as e:
             # an unreachable driver KV or unbindable port must never break
@@ -135,3 +161,5 @@ def reset_listener() -> None:
         _listener = None
         _disabled = False
         _registered_as = None
+    from horovod_tpu.runner import kv_relay
+    kv_relay.reset()

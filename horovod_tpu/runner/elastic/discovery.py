@@ -11,9 +11,20 @@ from __future__ import annotations
 
 import subprocess
 import threading
-from typing import Dict, List, Optional, Set
+import time
+from typing import Dict, List, Optional
 
 from horovod_tpu.runner.hosts import HostInfo
+
+
+def blocklist_cooldown_s() -> float:
+    """``HVD_TPU_BLOCKLIST_COOLDOWN_S``: how long a blocklisted host
+    stays excluded before it is retried (maintenance ends, the host
+    comes back — a permanent blocklist turns every transient host event
+    into permanently lost capacity).  0 = never re-admit (the
+    pre-cooldown behavior)."""
+    from horovod_tpu.common.config import env_float
+    return max(0.0, env_float("BLOCKLIST_COOLDOWN_S", 600.0))
 
 
 class HostDiscovery:
@@ -56,32 +67,100 @@ class FixedHosts(HostDiscovery):
 
 
 class HostManager:
-    """Tracks current/blacklisted hosts and computes ordered assignments
-    with rank stability (reference: ``HostManager`` + the driver's
-    stable-rank assignment, ``elastic/driver.py:233-275``)."""
+    """Tracks current/blacklisted/draining hosts and computes ordered
+    assignments with rank stability (reference: ``HostManager`` + the
+    driver's stable-rank assignment, ``elastic/driver.py:233-275``).
+
+    Two time-bounded exclusion mechanisms ride the discovery refresh:
+
+    * **blocklist cooldown** — a blocklisted host is re-admitted (and
+      retried) once ``HVD_TPU_BLOCKLIST_COOLDOWN_S`` has passed; a host
+      that was merely under maintenance is capacity again, and a host
+      that is genuinely bad earns its way straight back onto the list.
+    * **drain reservations** — a preemption drain reserves N slots on
+      the doomed host for a cooldown window, so replacement placement
+      cannot land workers back on a host that announced its own death;
+      expiry re-admits the capacity (→ the growth path re-spawns).
+    """
 
     def __init__(self, discovery: HostDiscovery) -> None:
         self._discovery = discovery
         self._lock = threading.Lock()
         self._current: Dict[str, int] = {}
-        self._blacklist: Set[str] = set()
+        self._blacklist: Dict[str, float] = {}   # host -> listed-at
+        self._drained: Dict[str, tuple] = {}     # host -> (slots, expiry)
         self._order: List[str] = []   # stable ordering of known hosts
 
     def blacklist(self, host: str) -> None:
         with self._lock:
-            self._blacklist.add(host)
+            self._blacklist[host] = time.monotonic()
 
     def is_blacklisted(self, host: str) -> bool:
         with self._lock:
             return host in self._blacklist
+
+    def drain(self, host: str, slots: int, cooldown_s: float) -> None:
+        """Reserve ``slots`` on ``host`` for ``cooldown_s`` (stacking
+        onto any live reservation, capped later against the host's real
+        capacity) and apply it to the CURRENT view immediately — the
+        drain re-mesh places replacements in the same loop iteration,
+        before the discovery thread's next refresh."""
+        with self._lock:
+            prev_slots, prev_expiry = self._drained.get(host, (0, 0.0))
+            now = time.monotonic()
+            live = prev_slots if prev_expiry > now else 0
+            self._drained[host] = (live + max(0, slots),
+                                   now + max(0.0, cooldown_s))
+            if host in self._current:
+                self._current[host] = max(
+                    0, self._current[host] - max(0, slots))
+
+    def undrain(self, host: str, slots: int) -> None:
+        """Release ``slots`` of a drain reservation (the driver found no
+        viable planned world and is falling back to reactive recovery —
+        the doomed host must stay usable until it actually dies)."""
+        with self._lock:
+            prev_slots, expiry = self._drained.get(host, (0, 0.0))
+            left = max(0, prev_slots - max(0, slots))
+            if left:
+                self._drained[host] = (left, expiry)
+            else:
+                self._drained.pop(host, None)
+            if host in self._current:
+                self._current[host] += max(0, slots)
+
+    def _usable(self, found: Dict[str, int]) -> Dict[str, int]:
+        """Apply blocklist (with cooldown re-admission) and unexpired
+        drain reservations to a discovery result.  Caller holds _lock."""
+        now = time.monotonic()
+        cooldown = blocklist_cooldown_s()
+        for host in [h for h, at in self._blacklist.items()
+                     if cooldown > 0 and now - at >= cooldown]:
+            del self._blacklist[host]
+            try:
+                from horovod_tpu.common.logging import get_logger
+                get_logger().info(
+                    "blocklist cooldown expired: re-admitting host %s",
+                    host)
+            except Exception:
+                pass
+        for host in [h for h, (_s, exp) in self._drained.items()
+                     if exp <= now]:
+            del self._drained[host]
+        usable = {}
+        for h, s in found.items():
+            if h in self._blacklist:
+                continue
+            drained_slots = self._drained.get(h, (0, 0.0))[0]
+            usable[h] = max(0, s - drained_slots)
+        return usable
 
     def update_available_hosts(self) -> bool:
         """Refresh; True if the usable host set changed (reference:
         discovery thread, ``driver.py:181-201``)."""
         found = self._discovery.find_available_hosts_and_slots()
         with self._lock:
-            usable = {h: s for h, s in found.items()
-                      if h not in self._blacklist}
+            usable = self._usable(found)
             changed = usable != self._current
             self._current = usable
             # stable order: keep existing positions, append new hosts
@@ -91,7 +170,8 @@ class HostManager:
 
     def current_hosts(self) -> List[HostInfo]:
         with self._lock:
-            return [HostInfo(h, self._current[h]) for h in self._order]
+            return [HostInfo(h, self._current[h]) for h in self._order
+                    if self._current[h] > 0]
 
     def slot_count(self) -> int:
         with self._lock:

@@ -43,14 +43,36 @@ from typing import Dict, List, Optional
 
 from horovod_tpu.common.logging import get_logger
 from horovod_tpu.runner.elastic.discovery import HostDiscovery, HostManager
-from horovod_tpu.runner.elastic.registration import (FAILURE, SUCCESS,
-                                                     TERMINATED,
+from horovod_tpu.runner.elastic.registration import (DRAINED, FAILURE,
+                                                     SUCCESS, TERMINATED,
                                                      WorkerStateRegistry)
 from horovod_tpu.runner.exec_run import (free_port, slot_command)
 from horovod_tpu.runner.hosts import HostInfo, get_host_assignments
 from horovod_tpu.runner.safe_exec import safe_execute
 
 DISCOVERY_INTERVAL_S = 1.0
+
+
+def loss_settle_s() -> float:
+    """``HVD_TPU_LOSS_SETTLE_S``: how long the driver lets a worker loss
+    SETTLE before planning recovery.  A correlated failure (a whole host
+    group dying in one chaos window, a switch losing a rack) lands as
+    several process exits milliseconds apart; recovering after the first
+    one would plan a world containing peers that are already dead —
+    a second recovery round at best, a spurious generation restart at
+    worst.  The settle window collapses the burst into ONE re-mesh."""
+    from horovod_tpu.common.config import env_float
+    return max(0.0, env_float("LOSS_SETTLE_S", 0.3))
+
+
+def drain_cooldown_s() -> float:
+    """``HVD_TPU_DRAIN_COOLDOWN_S``: how long a drained host's capacity
+    stays reserved after its preemption notice — long enough for the
+    maintenance/preemption to actually happen, short enough that a
+    repaired host rejoins promptly (expiry re-admits the capacity and
+    the growth path re-spawns onto it)."""
+    from horovod_tpu.common.config import env_float
+    return max(0.0, env_float("DRAIN_COOLDOWN_S", 60.0))
 
 
 class ElasticDriver:
@@ -150,18 +172,24 @@ class ElasticDriver:
                    self._hosts.slot_count())
 
     def _publish_world(self, gen: int, slots, coord_addr: str,
-                       coord_port: int, keyed_slots=None) -> None:
+                       coord_port: int, keyed_slots=None,
+                       extra=None) -> None:
         """Publish a signed world doc. ``slots`` keys the doc by each
         slot's own (stable) rank — the growth case. ``keyed_slots``
         overrides with an explicit ``{lookup_rank: env}`` mapping — the
         shrink case, where survivors look themselves up by their OLD
-        rank but adopt a smaller new one from the env."""
+        rank but adopt a smaller new one from the env.  ``extra`` merges
+        additional signed fields into the doc (the ``drain`` stamp of a
+        planned preemption re-mesh, which survivors use to label their
+        re-mesh episode ``preemption_drain``)."""
         import json
         from horovod_tpu.elastic import world_doc_signature
         doc = {"generation": gen, "size": len(slots),
                "coord_addr": coord_addr, "coord_port": coord_port,
                "slots": keyed_slots if keyed_slots is not None
                else {str(s.rank): s.to_env() for s in slots}}
+        if extra:
+            doc.update(extra)
         doc["sig"] = world_doc_signature(self._world_secret, doc)
         body = json.dumps(doc).encode()
         self._kv.put("world", "current", body)
@@ -199,7 +227,8 @@ class ElasticDriver:
     # -- in-place crash recovery --------------------------------------------
     def _try_inplace_recovery(self, survivors, results, threads,
                               slot_by_key, current_rank, target_np,
-                              host_crashes, charge_reset=True):
+                              host_crashes, charge_reset=True,
+                              drain=None):
         """A worker died mid-generation: publish a new world around the
         SURVIVORS so they re-rendezvous IN PLACE (params stay in host
         memory, PIDs unchanged — reference: the reset loop after
@@ -305,11 +334,14 @@ class ElasticDriver:
         gen = self._generation
         self._generation += 1
         get_logger().info(
-            "elastic generation %d (in-place crash recovery): np=%d "
-            "(%d survivors + %d replacements)", gen, new_np,
+            "elastic generation %d (%s): np=%d "
+            "(%d survivors + %d replacements)", gen,
+            "planned preemption drain" if drain
+            else "in-place crash recovery", new_np,
             len(survivors), len(replacements))
         self._publish_world(gen, new_slots, coord_addr, coord_port,
-                            keyed_slots=keyed)
+                            keyed_slots=keyed,
+                            extra={"drain": drain} if drain else None)
         # driver-side half of the re-mesh timeline: the survivors
         # measure their own phases (hvd_remesh_seconds); the driver
         # stamps WHEN it published the recovery world, so a merged
@@ -323,6 +355,10 @@ class ElasticDriver:
         # re-register at their first commit in the new world, and a crash
         # BEFORE that commit conservatively takes the restart path
         self._kv.clear("notify")
+        # so are drain notices: a notice names the rank its publisher
+        # held in the OLD numbering — left behind, an unhandled notice
+        # would match whichever innocent worker inherits that rank
+        self._kv.clear("drain")
         return new_slots, gen, replacements, coord_addr, coord_port
 
     # -- one generation ------------------------------------------------------
@@ -344,6 +380,11 @@ class ElasticDriver:
         # could hand the doc to an unrelated process. This generation's
         # workers re-register at their first commit.
         self._kv.clear("notify")
+        # stale drain notices die with their generation too: the rank a
+        # notice names is only meaningful in the world that published it,
+        # and the doomed HOST is already held out by its HostManager
+        # drain reservation regardless
+        self._kv.clear("drain")
         self._hosts_changed.clear()
         gen = self._generation
         self._generation += 1
@@ -369,6 +410,16 @@ class ElasticDriver:
         # workers a capacity-loss shrink dropped from the world: their
         # exit (the not-in-new-world path) is EXPECTED, not a crash
         expected_exits: set = set()
+        # workers a preemption drain planned out of the world: EXPECTED
+        # exits recorded DRAINED — never FAILURE, never a host_crashes
+        # charge, never blocklist evidence
+        drained_exits: set = set()
+        handled_drains: set = set()  # drain-notice KV keys already acted on
+        # drain notices whose planned world was not viable yet (min_np,
+        # last host, completion race): token -> (next_try, delay).  The
+        # world can BECOME viable — discovery adds a host — so the
+        # notice is retried with backoff instead of burned.
+        deferred_drains: dict = {}
 
         def run_slot(slot, slot_gen):
             extra_env = {
@@ -425,9 +476,17 @@ class ElasticDriver:
                     if not casualty:
                         originators.add(key)
                     worker_lost.set()
-            state = TERMINATED if (torn_down or casualty or expected) \
-                else FAILURE
-            results[key] = state
+                # classification is atomic with the membership checks:
+                # the drain branch's no-viable-world revert edits these
+                # sets under the same lock and must observe either a
+                # fully recorded exit or none at all
+                if key in drained_exits:
+                    state = DRAINED
+                elif torn_down or casualty or expected:
+                    state = TERMINATED
+                else:
+                    state = FAILURE
+                results[key] = state
             self._registry.record(slot.rank, slot.hostname, state)
 
         threads: Dict[tuple, threading.Thread] = {}
@@ -451,6 +510,19 @@ class ElasticDriver:
         # raced the scale-up) must not hold the driver hostage
         essential_keys = [(gen, s.rank) for s in slots]
         essential_gen = gen  # growth below reuses the name `gen`
+        # the generation of the most recently PUBLISHED world — what the
+        # workers' HVD_ELASTIC_GENERATION reads after they adopt it, and
+        # therefore what their drain notices carry.  Tracked separately
+        # from essential_gen because in-place GROWTH publishes a new
+        # generation (rank numbering unchanged — the stable-assignment
+        # check guarantees it) without touching the essential set.
+        world_gen = gen
+        # the generation of the last publish that CHANGED the rank
+        # numbering: growth keeps numbering stable, so drain notices
+        # stamped anywhere in [numbering_gen, world_gen] still name a
+        # valid rank; in-place shrink recoveries compact ranks and
+        # bump it
+        numbering_gen = gen
 
         while any(t.is_alive() for t in threads.values()):
             time.sleep(0.25)
@@ -462,6 +534,11 @@ class ElasticDriver:
             # -- a worker crashed: recover the world in place --------------
             if worker_lost.is_set() and not failure.is_set() and \
                     not teardown.is_set():
+                # let a correlated burst finish dying before planning:
+                # the other ranks of a doomed host group are typically
+                # milliseconds behind the first exit, and one settled
+                # re-mesh beats a cascade of partial ones
+                time.sleep(loss_settle_s())
                 with fail_lock:
                     worker_lost.clear()
                     lost_now = set(lost_keys)
@@ -497,10 +574,194 @@ class ElasticDriver:
                         spawn(s, rec_gen)
                     essential_keys = survivors + [
                         (rec_gen, s.rank) for s in replacements]
-                    essential_gen = rec_gen
+                    essential_gen = world_gen = numbering_gen = rec_gen
                     slots = new_slots2
                     np = len(new_slots2)
                 continue
+            # -- a preemption/maintenance drain notice arrived --------------
+            # (docs/ELASTIC.md "Proactive drain & preemption"): a doomed
+            # worker's PreemptionWatcher published drain/<rank> through
+            # the KV; plan its world out AROUND it instead of waiting for
+            # the death + transport-timeout detection the reactive path
+            # pays. The notice names the rank the notifier held when it
+            # published — valid for the current world only, which is why
+            # _run_generation clears the scope per generation.
+            if not failure.is_set() and not teardown.is_set():
+                import json as _json
+                doomed: set = set()
+                notice_meta: list = []
+                tokens: list = []
+                for dkey, raw in self._kv.scope("drain").items():
+                    token = (dkey, raw)
+                    if token in handled_drains:
+                        continue
+                    deferred = deferred_drains.get(token)
+                    if deferred and deferred[0] > time.monotonic():
+                        continue  # no-viable-world backoff window
+                    try:
+                        notice = _json.loads(raw)
+                        if not isinstance(notice, dict):
+                            raise TypeError("drain notice is not an "
+                                            "object")
+                        nrank = int(notice.get("rank"))
+                        ngen = int(notice.get("generation", -1))
+                    except (ValueError, TypeError):
+                        handled_drains.add(token)  # never retried
+                        get_logger().warning(
+                            "ignoring malformed drain notice %r", dkey)
+                        continue
+                    if not numbering_gen <= ngen <= world_gen:
+                        # published under another rank NUMBERING —
+                        # matching it against the current one could
+                        # drain an innocent worker.  Growth publishes
+                        # bump the generation but keep the numbering
+                        # (stable-assignment check), so any notice
+                        # since the last RENUMBERING publish is still
+                        # valid — the watcher latches after its one
+                        # publish and would never re-stamp a notice
+                        # that raced a growth.  Older ones are left
+                        # unhandled (not burned): the next re-mesh
+                        # clears the scope; worst case the host dies
+                        # reactively.
+                        continue
+                    origin = next(
+                        (k for k in essential_keys
+                         if current_rank.get(k) == nrank
+                         and results.get(k) is None
+                         and threads[k].is_alive()), None)
+                    if origin is None:
+                        handled_drains.add(token)
+                        continue  # already gone or renumbered: stale
+                    tokens.append(token)
+                    if notice.get("scope") == "host":
+                        # host-wide maintenance dooms every worker there
+                        h = slot_by_key[origin].hostname
+                        doomed |= {k for k in essential_keys
+                                   if slot_by_key[k].hostname == h
+                                   and results.get(k) is None
+                                   and threads[k].is_alive()}
+                    else:
+                        doomed.add(origin)
+                    notice_meta.append(
+                        {"rank": nrank,
+                         "host": slot_by_key[origin].hostname,
+                         "source": notice.get("source", "unknown")})
+                if doomed:
+                    # the planned path needs every involved worker able
+                    # to APPLY a world doc (elastic listener registered,
+                    # i.e. it has committed once).  A notice racing the
+                    # job's first commits — a preemption can announce
+                    # itself during hvd.init — is DEFERRED to a later
+                    # tick, not burned on a generation restart.
+                    notify = {str(r) for r in self._kv.scope("notify")}
+                    involved = set(doomed) | {
+                        k for k in essential_keys
+                        if k not in doomed and results.get(k) is None
+                        and threads[k].is_alive()}
+                    if any(str(current_rank[k]) not in notify
+                           for k in involved):
+                        doomed = set()
+                    else:
+                        handled_drains.update(tokens)
+                if doomed:
+                    cooldown = drain_cooldown_s()
+                    by_host: Dict[str, int] = {}
+                    for k in doomed:
+                        h = slot_by_key[k].hostname
+                        by_host[h] = by_host.get(h, 0) + 1
+                    for h, n in by_host.items():
+                        # reserve the doomed capacity so replacement
+                        # placement cannot land back on a host that
+                        # announced its own death; expiry re-admits it
+                        self._hosts.drain(h, n, cooldown)
+                    with fail_lock:
+                        # BEFORE the publish (same reason as the shrink
+                        # path): the doomed worker can read the pushed
+                        # doc and exit before this loop resumes, and
+                        # that exit is DRAINED, never a crash
+                        expected_exits.update(doomed)
+                        drained_exits.update(doomed)
+                    survivors = [k for k in essential_keys
+                                 if k not in doomed]
+                    from horovod_tpu.diagnostics.flight_recorder import \
+                        record_event
+                    record_event(
+                        "drain_notice_handled",
+                        notices=notice_meta,
+                        drained_ranks=sorted(current_rank[k]
+                                             for k in doomed),
+                        hosts=sorted(by_host), cooldown_s=cooldown)
+                    get_logger().warning(
+                        "drain notice(s) %s: planning world around "
+                        "doomed rank(s) %s (hosts %s reserved for %.0fs)",
+                        notice_meta,
+                        sorted(current_rank[k] for k in doomed),
+                        sorted(by_host), cooldown)
+                    recovered = self._try_inplace_recovery(
+                        survivors, results, threads, slot_by_key,
+                        current_rank, self._cap_np(), host_crashes,
+                        charge_reset=False,
+                        drain={"ranks": sorted(current_rank[k]
+                                               for k in doomed),
+                               "hosts": sorted(by_host),
+                               "sources": sorted({m["source"]
+                                                  for m in notice_meta})})
+                    if recovered is None:
+                        # no viable planned world (the doomed host was
+                        # the last one, min_np would be violated, or a
+                        # completion race): the notice is ADVISORY —
+                        # the host has not died, and may never (a GCE
+                        # MIGRATE event usually survives).  Tearing the
+                        # generation down here would turn advance
+                        # notice into a guaranteed restart the reactive
+                        # path never pays, so revert the bookkeeping
+                        # and fall back to reactive recovery instead.
+                        with fail_lock:
+                            expected_exits.difference_update(doomed)
+                            drained_exits.difference_update(doomed)
+                            # a doomed worker that exited DURING the
+                            # failed planning attempt was classified an
+                            # expected DRAINED exit, so run_slot never
+                            # marked it lost — re-mark it here or no
+                            # recovery would ever be planned for a
+                            # genuinely dead worker and the generation
+                            # would wedge
+                            gone = [k for k in doomed
+                                    if results.get(k) is not None]
+                            if gone:
+                                lost_keys.update(gone)
+                                worker_lost.set()
+                        for h, n in by_host.items():
+                            self._hosts.undrain(h, n)
+                        # un-burn the notices: the world can BECOME
+                        # viable (discovery adds a host) before the
+                        # doomed host dies, and the watcher is latched
+                        # after its one publish — without the retry
+                        # the advance notice would be permanently lost.
+                        # Backoff bounds the replanning churn.
+                        for t in tokens:
+                            handled_drains.discard(t)
+                            delay = min(
+                                deferred_drains.get(t, (0.0, 1.0))[1]
+                                * 2, 30.0)
+                            deferred_drains[t] = (
+                                time.monotonic() + delay, delay)
+                        get_logger().warning(
+                            "no viable planned world for drain "
+                            "notice(s) %s; retrying with backoff, "
+                            "reactive recovery covers an actual death",
+                            notice_meta)
+                        continue
+                    new_slots2, rec_gen, replacements, coord_addr, \
+                        coord_port = recovered
+                    for s in replacements:
+                        spawn(s, rec_gen)
+                    essential_keys = survivors + [
+                        (rec_gen, s.rank) for s in replacements]
+                    essential_gen = world_gen = numbering_gen = rec_gen
+                    slots = new_slots2
+                    np = len(new_slots2)
+                    continue
             if failure.is_set() or not self._hosts_changed.is_set():
                 continue
             # -- membership changed mid-generation -------------------------
@@ -552,7 +813,7 @@ class ElasticDriver:
                     spawn(s, rec_gen)
                 essential_keys = kept + [(rec_gen, s.rank)
                                          for s in replacements]
-                essential_gen = rec_gen
+                essential_gen = world_gen = numbering_gen = rec_gen
                 slots = new_slots2
                 np = len(new_slots2)
                 continue
@@ -577,6 +838,7 @@ class ElasticDriver:
                 "elastic generation %d (growth, in-place): np=%d->%d",
                 gen, np, new_np)
             self._publish_world(gen, new_slots, coord_addr, coord_port)
+            world_gen = gen  # survivors adopt this gen; notices carry it
             for s in new_slots[np:]:
                 spawn(s, gen)
             slots = new_slots

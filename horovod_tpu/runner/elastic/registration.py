@@ -14,6 +14,11 @@ FAILURE = "FAILURE"
 # another worker's failure or a host change) — not the worker's own fault,
 # so it must not count toward host blacklisting
 TERMINATED = "TERMINATED"
+# exited because a preemption/maintenance drain PLANNED it out of the world
+# (docs/ELASTIC.md "Proactive drain & preemption") — an orderly, announced
+# departure: never a FAILURE, never charged to host_crashes, never
+# blocklisted, and the host is re-admitted after its drain cooldown
+DRAINED = "DRAINED"
 
 
 class WorkerStateRegistry:

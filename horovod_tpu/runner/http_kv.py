@@ -20,6 +20,7 @@ from urllib.request import Request, urlopen
 from urllib.error import HTTPError
 
 from horovod_tpu.common.retry import retry_call
+from horovod_tpu.common.safe_metrics import safe_inc as _metric
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -34,6 +35,7 @@ class _KVHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         scope, key = self._split()
+        self.server.note_request("GET", scope)
         with self.server.kv_lock:
             val = self.server.kv.get(scope, {}).get(key)
         if val is None:
@@ -47,6 +49,7 @@ class _KVHandler(BaseHTTPRequestHandler):
 
     def do_PUT(self):
         scope, key = self._split()
+        self.server.note_request("PUT", scope)
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
         with self.server.kv_lock:
@@ -56,6 +59,7 @@ class _KVHandler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         scope, _ = self._split()
+        self.server.note_request("DELETE", scope)
         with self.server.kv_lock:
             self.server.kv.pop(scope, None)
         self.send_response(200)
@@ -74,7 +78,19 @@ class ThreadedHTTPServer(ThreadingHTTPServer):
 
 
 class _KVServer(ThreadedHTTPServer):
-    pass
+    """Request accounting lives on the server object (one per
+    KVStoreServer): the KV-relay fan-in proof (docs/ELASTIC.md "Relayed
+    control-plane KV") needs each NODE's request load to be measurable —
+    rank 0's root must be shown handling O(arity) worker traffic while
+    the relay nodes carry the rest."""
+
+    def note_request(self, method: str, scope: str) -> None:
+        key = (method, scope)
+        with self.req_lock:
+            self.req_counts[key] = self.req_counts.get(key, 0) + 1
+        _metric("hvd_kv_server_requests_total",
+                "requests handled by this process's KV servers, "
+                "per method/scope", method=method, scope=scope)
 
 
 class KVStoreServer:
@@ -82,10 +98,15 @@ class KVStoreServer:
     ``http_server.py:152``)."""
 
     def __init__(self, port: int = 0) -> None:
-        self._httpd = _KVServer(("0.0.0.0", port), _KVHandler)
+        self._httpd = self._make_server(port)
         self._httpd.kv = {}
         self._httpd.kv_lock = threading.Lock()
+        self._httpd.req_counts = {}
+        self._httpd.req_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
+
+    def _make_server(self, port: int):
+        return _KVServer(("0.0.0.0", port), _KVHandler)
 
     @property
     def port(self) -> int:
@@ -128,6 +149,17 @@ class KVStoreServer:
         with self._httpd.kv_lock:
             self._httpd.kv.pop(scope, None)
 
+    def request_counts(self) -> Dict[Tuple[str, str], int]:
+        """Requests this server has handled, keyed by (method, scope) —
+        the per-node load evidence behind the KV-relay fan-in proof."""
+        with self._httpd.req_lock:
+            return dict(self._httpd.req_counts)
+
+    def requests_for(self, scope: str, method: Optional[str] = None) -> int:
+        with self._httpd.req_lock:
+            return sum(n for (m, s), n in self._httpd.req_counts.items()
+                       if s == scope and (method is None or m == method))
+
 
 def _with_retries(do, attempts: int = 4,
                   deadline_s: Optional[float] = None,
@@ -150,29 +182,39 @@ def _with_retries(do, attempts: int = 4,
 
 
 def kv_put(addr: str, port: int, scope: str, key: str, value: bytes,
-           timeout: float = 30.0, site: str = "http_kv.put") -> None:
+           timeout: float = 30.0, site: str = "http_kv.put",
+           peer=None, attempts: int = 4) -> None:
+    """``peer`` names the request's TARGET for the chaos ``kv.partition``
+    seam (a worker rank for relay hops, ``"driver"`` for the root KV);
+    None = target unknown, partition rules cannot match.  ``attempts=1``
+    makes the call fail fast — the relay client uses it for parent hops,
+    where the root fallback IS the retry."""
     req = Request(f"http://{addr}:{port}/{scope}/{key}", data=value,
                   method="PUT")
 
     def do():
         from horovod_tpu import chaos
         chaos.fire("kv.request")
+        chaos.fire("kv.partition", peer=peer)
         return urlopen(req, timeout=timeout).read()
 
-    _with_retries(do, deadline_s=2.0 * timeout, site=site)
+    _with_retries(do, attempts=attempts, deadline_s=2.0 * timeout,
+                  site=site)
 
 
 def kv_get(addr: str, port: int, scope: str, key: str,
-           timeout: float = 30.0, site: str = "http_kv.get"
-           ) -> Optional[bytes]:
+           timeout: float = 30.0, site: str = "http_kv.get",
+           peer=None, attempts: int = 4) -> Optional[bytes]:
     def do():
         from horovod_tpu import chaos
         chaos.fire("kv.request")
+        chaos.fire("kv.partition", peer=peer)
         return urlopen(f"http://{addr}:{port}/{scope}/{key}",
                        timeout=timeout).read()
 
     try:
-        return _with_retries(do, deadline_s=2.0 * timeout, site=site)
+        return _with_retries(do, attempts=attempts,
+                             deadline_s=2.0 * timeout, site=site)
     except HTTPError as e:
         if e.code == 404:
             return None

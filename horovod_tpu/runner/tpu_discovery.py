@@ -32,6 +32,12 @@ from horovod_tpu.runner.hosts import HostInfo
 
 DEFAULT_ENDPOINT = "http://metadata.google.internal"
 _ATTR_BASE = "/computeMetadata/v1/instance/attributes/"
+_INSTANCE_BASE = "/computeMetadata/v1/instance/"
+
+#: ``instance/maintenance-event`` value meaning "nothing scheduled";
+#: anything else (``TERMINATE_ON_HOST_MAINTENANCE``, ``MIGRATE_ON_...``)
+#: is an advance notice that this host is doomed.
+MAINTENANCE_NONE = "NONE"
 
 
 def _endpoint(endpoint: Optional[str]) -> str:
@@ -40,7 +46,8 @@ def _endpoint(endpoint: Optional[str]) -> str:
 
 
 def metadata_get(attribute: str, endpoint: Optional[str] = None,
-                 timeout: float = 5.0, attempts: int = 3) -> str:
+                 timeout: float = 5.0, attempts: int = 3,
+                 base: str = _ATTR_BASE) -> str:
     """Fetch one instance attribute; raises ``OSError`` when not on a TPU
     VM (no metadata server) or the attribute is absent.
 
@@ -54,7 +61,7 @@ def metadata_get(attribute: str, endpoint: Optional[str] = None,
     from horovod_tpu.common.retry import retry_call
 
     req = urllib.request.Request(
-        _endpoint(endpoint) + _ATTR_BASE + attribute,
+        _endpoint(endpoint) + base + attribute,
         headers={"Metadata-Flavor": "Google"})
 
     def do():
@@ -104,6 +111,19 @@ def tpu_worker_index(endpoint: Optional[str] = None) -> int:
 
 def tpu_accelerator_type(endpoint: Optional[str] = None) -> str:
     return metadata_get("accelerator-type", endpoint)
+
+
+def tpu_maintenance_event(endpoint: Optional[str] = None,
+                          timeout: float = 2.0) -> str:
+    """``instance/maintenance-event`` — the advance preemption /
+    maintenance notice (GCE surface; ``NONE`` when nothing is scheduled,
+    ``TERMINATE_ON_HOST_MAINTENANCE`` when the host is doomed).  The
+    PreemptionWatcher (:mod:`horovod_tpu.elastic.preemption`) polls this
+    to drive a *planned* elastic drain instead of waiting for the host
+    to die.  Raises ``OSError`` off-TPU like every other probe here —
+    the watcher latches metadata polling off after repeated failures."""
+    return metadata_get("maintenance-event", endpoint, timeout=timeout,
+                        attempts=1, base=_INSTANCE_BASE)
 
 
 def running_on_tpu_vm(endpoint: Optional[str] = None,

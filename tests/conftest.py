@@ -26,6 +26,14 @@ os.environ.setdefault(
     "HVD_TPU_PROFILE_DIR",
     os.path.join(tempfile.gettempdir(), f"hvd_profile_test_{os.getpid()}"))
 
+# Same treatment for autopsy bundles (ISSUE 10 satellite): chaos kills /
+# hang autopsies flush flight rings to HVD_TPU_AUTOPSY_DIR, which
+# defaults to ./hvd_autopsy — debris in the checkout. Tests that assert
+# on bundle contents point it at their own tmp_path.
+os.environ.setdefault(
+    "HVD_TPU_AUTOPSY_DIR",
+    os.path.join(tempfile.gettempdir(), f"hvd_autopsy_test_{os.getpid()}"))
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

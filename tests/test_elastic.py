@@ -58,6 +58,21 @@ def test_host_manager_blacklist():
     assert hm.slot_count() == 2
 
 
+def test_host_manager_undrain_restores_capacity():
+    """The driver reverts a drain reservation when no viable planned
+    world exists (fall back to reactive recovery): the doomed host must
+    stay usable until it actually dies."""
+    disc = MockDiscovery([{"a": 2, "b": 2}])
+    hm = HostManager(disc)
+    hm.update_available_hosts()
+    hm.drain("b", 2, cooldown_s=60.0)
+    assert hm.slot_count() == 2          # reservation applied inline
+    hm.undrain("b", 2)
+    assert hm.slot_count() == 4          # capacity restored inline
+    hm.update_available_hosts()
+    assert hm.slot_count() == 4          # and across a refresh
+
+
 def test_worker_state_registry():
     reg = WorkerStateRegistry(reset_limit=2)
     reg.reset(2)

@@ -1,0 +1,297 @@
+"""KV relay battery (ISSUE 10): tree addressing, parent-cache routing,
+upstream forwarding, relay-death fallback, re-mesh client rebuild, and
+the in-process fan-in proof — rank 0's root KV handling O(arity) world
+traffic while the relay nodes carry the rest (virtual hosts: every node
+is a server object in this process, exactly how the acceptance allows).
+"""
+
+import threading
+
+import pytest
+
+from horovod_tpu.runner import kv_relay
+from horovod_tpu.runner.http_kv import KVStoreServer, kv_get, kv_put
+from horovod_tpu.runner.kv_relay import (RelayClient, RelayKVServer,
+                                         relay_parent)
+
+
+@pytest.fixture(autouse=True)
+def _clean_relay(monkeypatch):
+    monkeypatch.delenv("HVD_TPU_KV_RELAY_ARITY", raising=False)
+    monkeypatch.delenv("HVD_TPU_KV_RELAY_TTL_S", raising=False)
+    kv_relay.reset()
+    yield
+    kv_relay.reset()
+
+
+def _root():
+    srv = KVStoreServer()
+    srv.start()
+    return srv
+
+
+def _node(rank, root, arity, ttl=None):
+    """A relay node for ``rank``: its upstream is the same parent-or-root
+    client a real WorkerNotificationListener would build."""
+    client = RelayClient(rank, "127.0.0.1", root.port, arity=arity)
+    srv = RelayKVServer(lambda c=client: c)
+    srv.start()
+    return srv, client
+
+
+# -- tree addressing ---------------------------------------------------------
+
+def test_relay_parent_addressing():
+    # complete arity-2 tree: parent(r) = (r-1)//2, rank 0 routes direct
+    assert relay_parent(0, 2) is None
+    assert [relay_parent(r, 2) for r in range(1, 8)] == \
+        [0, 0, 1, 1, 2, 2, 3]
+    # arity 4 (the fleet-metrics default shape)
+    assert [relay_parent(r, 4) for r in (1, 4, 5, 20)] == [0, 0, 1, 4]
+    # relay disabled: everyone routes direct
+    assert relay_parent(5, 0) is None
+
+
+def test_relay_arity_env(monkeypatch):
+    assert kv_relay.relay_arity() == 0  # default: flat topology
+    monkeypatch.setenv("HVD_TPU_KV_RELAY_ARITY", "4")
+    assert kv_relay.relay_arity() == 4
+    monkeypatch.setenv("HVD_TPU_KV_RELAY_ARITY", "-2")
+    assert kv_relay.relay_arity() == 0
+
+
+# -- routing through the parent ----------------------------------------------
+
+def test_world_poll_served_from_parent_cache(monkeypatch):
+    """Children's world polls land on the parent's relay node; the node
+    refreshes from upstream at most once per TTL — N child polls cost
+    ONE root fetch, which is the whole point."""
+    monkeypatch.setenv("HVD_TPU_KV_RELAY_TTL_S", "30")
+    root = _root()
+    node1 = client1 = None
+    try:
+        root.put("world", "current", b"doc-gen-1")
+        node1, client1 = _node(1, root, arity=2)
+        # rank 1's listener registered with the driver; rank 3 resolves
+        # its parent (rank 1) from that registration
+        root.put("notify", "1", f"127.0.0.1:{node1.port}".encode())
+        child = RelayClient(3, "127.0.0.1", root.port, arity=2)
+        for _ in range(5):
+            assert child.get("world", "current") == b"doc-gen-1"
+        # the node carried all 5 polls; the root saw ONE refresh (rank
+        # 1's own client goes root-direct: its parent rank 0 never
+        # registered, so resolution falls through to the root)
+        assert node1.requests_for("world", "GET") == 5
+        assert root.requests_for("world", "GET") == 1
+    finally:
+        if node1 is not None:
+            node1.stop()
+        root.stop()
+
+
+def test_driver_push_lands_fresh_in_node_cache(monkeypatch):
+    """The driver's world push is a direct PUT at the listener (scope
+    ``world`` is not forwarded): it must land locally and count as fresh
+    truth — children polling right after see the pushed doc with zero
+    upstream traffic."""
+    monkeypatch.setenv("HVD_TPU_KV_RELAY_TTL_S", "30")
+    root = _root()
+    node1 = None
+    try:
+        node1, _ = _node(1, root, arity=2)
+        root.put("notify", "1", f"127.0.0.1:{node1.port}".encode())
+        kv_put("127.0.0.1", node1.port, "world", "current", b"pushed")
+        child = RelayClient(3, "127.0.0.1", root.port, arity=2)
+        assert child.get("world", "current") == b"pushed"
+        assert root.requests_for("world", "GET") == 0
+    finally:
+        if node1 is not None:
+            node1.stop()
+        root.stop()
+
+
+def test_registration_put_forwarded_to_root(monkeypatch):
+    """Forward scopes (notify/drain) travel up the tree: the child PUTs
+    at its parent, the parent forwards upstream, the value materializes
+    at the ROOT (where the driver reads it) — not in the node's cache."""
+    root = _root()
+    node1 = None
+    try:
+        node1, _ = _node(1, root, arity=2)
+        root.put("notify", "1", f"127.0.0.1:{node1.port}".encode())
+        child = RelayClient(3, "127.0.0.1", root.port, arity=2)
+        child.put("notify", "3", b"hostX:4242")
+        child.put("drain", "3", b'{"rank": 3}')
+        assert root.get("notify", "3") == b"hostX:4242"
+        assert root.get("drain", "3") == b'{"rank": 3}'
+        # the relay node forwarded, it did not adopt
+        assert node1.get("notify", "3") is None
+        assert node1.requests_for("notify", "PUT") == 1
+        assert node1.requests_for("drain", "PUT") == 1
+    finally:
+        if node1 is not None:
+            node1.stop()
+        root.stop()
+
+
+# -- failure handling ---------------------------------------------------------
+
+def test_dead_relay_degrades_to_root_without_failing(monkeypatch):
+    """A killed relay node costs latency, never a failed call: the child
+    marks the parent dead and degrades to direct root requests for both
+    reads and writes."""
+    root = _root()
+    try:
+        node1, _ = _node(1, root, arity=2)
+        root.put("notify", "1", f"127.0.0.1:{node1.port}".encode())
+        root.put("world", "current", b"doc")
+        node1.stop()  # the relay node dies
+        child = RelayClient(3, "127.0.0.1", root.port, arity=2)
+        assert child.get("world", "current", timeout=3.0) == b"doc"
+        child.put("notify", "3", b"hostY:1", timeout=3.0)
+        assert root.get("notify", "3") == b"hostY:1"
+        # dead-listed: follow-up calls skip the corpse entirely
+        assert child._parent_usable(1.0) is None
+    finally:
+        root.stop()
+
+
+def test_unregistered_parent_falls_through_to_root():
+    """Mid-registration (parent listener not in the driver KV yet): the
+    lookup fails softly, the negative result is cached briefly, and the
+    call proceeds root-direct."""
+    root = _root()
+    try:
+        root.put("world", "current", b"doc")
+        child = RelayClient(3, "127.0.0.1", root.port, arity=2)
+        assert child.get("world", "current") == b"doc"
+        assert child._resolve_failed_until > 0  # negative cache armed
+    finally:
+        root.stop()
+
+
+def test_node_without_upstream_rejects_forward_scope():
+    """A relay node whose upstream is unresolved must 503 forwarded
+    scopes — the CHILD then falls back to the root — rather than
+    swallowing a registration into a cache the driver never reads."""
+    root = _root()
+    node = None
+    try:
+        node = RelayKVServer(lambda: None)
+        node.start()
+        with pytest.raises(OSError):
+            kv_put("127.0.0.1", node.port, "notify", "9", b"x",
+                   timeout=2.0)
+    finally:
+        if node is not None:
+            node.stop()
+        root.stop()
+
+
+# -- re-mesh client rebuild ---------------------------------------------------
+
+def test_client_rebuilt_when_identity_or_root_moves(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_KV_RELAY_ARITY", "2")
+    monkeypatch.setenv("HOROVOD_RANK", "3")
+    monkeypatch.setenv("HVD_ELASTIC_GENERATION", "0")
+    c1 = kv_relay.client("127.0.0.1", 19999)
+    assert c1.rank == 3 and c1.parent_rank == 1
+    assert kv_relay.client("127.0.0.1", 19999) is c1  # cached
+    # an elastic re-mesh renumbers the worker: the route must follow
+    monkeypatch.setenv("HOROVOD_RANK", "1")
+    monkeypatch.setenv("HVD_ELASTIC_GENERATION", "1")
+    c2 = kv_relay.client("127.0.0.1", 19999)
+    assert c2 is not c1 and c2.rank == 1 and c2.parent_rank == 0
+    # a moved root rebuilds too
+    c3 = kv_relay.client("127.0.0.1", 19998)
+    assert c3 is not c2 and c3.root_port == 19998
+
+
+def test_listener_upgrades_to_relay_node(monkeypatch):
+    """WorkerNotificationListener doubles as the relay node exactly when
+    the relay is enabled and a driver address is known."""
+    from horovod_tpu.elastic.notification import WorkerNotificationListener
+    root = _root()
+    lst = None
+    try:
+        monkeypatch.setenv("HVD_TPU_KV_RELAY_ARITY", "2")
+        monkeypatch.setenv("HOROVOD_RANK", "1")
+        monkeypatch.setenv("HOROVOD_HOSTNAME", "127.0.0.1")
+        lst = WorkerNotificationListener("127.0.0.1", root.port)
+        assert isinstance(lst.kv, RelayKVServer)
+        lst.register("127.0.0.1", root.port)
+        reg = root.scope("notify")
+        assert "1" in reg and reg["1"].endswith(b":%d" % lst.port)
+    finally:
+        if lst is not None:
+            lst.stop()
+        root.stop()
+
+
+def test_listener_stays_plain_without_relay(monkeypatch):
+    from horovod_tpu.elastic.notification import WorkerNotificationListener
+    root = _root()
+    lst = None
+    try:
+        monkeypatch.setenv("HOROVOD_RANK", "1")
+        lst = WorkerNotificationListener("127.0.0.1", root.port)
+        assert not isinstance(lst.kv, RelayKVServer)
+    finally:
+        if lst is not None:
+            lst.stop()
+        root.stop()
+
+
+# -- the fan-in proof ---------------------------------------------------------
+
+def test_fanin_world8_root_sees_one_world_fetch(monkeypatch):
+    """The acceptance shape (virtual world 8, arity 2): every worker
+    runs a relay node, workers 1..7 poll the world 3 times each — 21
+    polls — and the ROOT serves exactly ONE world fetch (rank 0's node
+    refreshing its cache).  The per-node request counters prove where
+    the load actually went."""
+    monkeypatch.setenv("HVD_TPU_KV_RELAY_TTL_S", "30")
+    arity, world, polls = 2, 8, 3
+    root = _root()
+    nodes, clients = {}, {}
+    try:
+        root.put("world", "current", b"doc-gen-0")
+        for r in range(world):
+            nodes[r], clients[r] = _node(r, root, arity=arity)
+            root.put("notify", str(r),
+                     f"127.0.0.1:{nodes[r].port}".encode())
+        for r in range(1, world):
+            for _ in range(polls):
+                assert clients[r].get("world", "current") == b"doc-gen-0"
+        root_world_gets = root.requests_for("world", "GET")
+        node_world_gets = {r: n.requests_for("world", "GET")
+                          for r, n in nodes.items()}
+        # O(arity): the root saw one cache refresh, not 21 polls
+        assert root_world_gets == 1, (root_world_gets, node_world_gets)
+        # the tree carried the polls plus the internal refresh hops
+        assert sum(node_world_gets.values()) == \
+            (world - 1) * polls + 3, node_world_gets
+        # no node carries more than its own children + refresh traffic
+        assert max(node_world_gets.values()) <= arity * polls + arity, \
+            node_world_gets
+    finally:
+        for n in nodes.values():
+            n.stop()
+        root.stop()
+
+
+def test_fanin_counters_exported_to_metrics():
+    """The per-node counters land on /metrics too
+    (hvd_kv_server_requests_total) so the fan-in is observable in a
+    real fleet, not only in tests."""
+    from horovod_tpu.metrics.registry import default_registry
+    key = 'hvd_kv_server_requests_total{method="GET",scope="fanin_t"}'
+    before = default_registry().snapshot().get(key, {}).get("value", 0)
+    root = _root()
+    try:
+        root.put("fanin_t", "k", b"v")
+        assert kv_get("127.0.0.1", root.port, "fanin_t", "k") == b"v"
+    finally:
+        root.stop()
+    snap = default_registry().snapshot()
+    assert snap[key]["value"] == before + 1

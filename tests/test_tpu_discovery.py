@@ -35,11 +35,20 @@ class _FakeMetadata:
                 if self.headers.get("Metadata-Flavor") != "Google":
                     self.send_error(403, "Missing Metadata-Flavor header")
                     return
-                prefix = "/computeMetadata/v1/instance/attributes/"
-                if not self.path.startswith(prefix):
+                # instance attributes plus the top-level instance/
+                # surface (maintenance-event lives there, not under
+                # attributes/ — mirroring the real server's layout)
+                base = "/computeMetadata/v1/instance/"
+                if not self.path.startswith(base):
                     self.send_error(404)
                     return
-                val = outer.attrs.get(self.path[len(prefix):])
+                name = self.path[len(base):]
+                if name.startswith("attributes/"):
+                    name = name[len("attributes/"):]
+                elif name != "maintenance-event":
+                    self.send_error(404)
+                    return
+                val = outer.attrs.get(name)
                 if val is None:
                     self.send_error(404)
                     return
@@ -148,3 +157,37 @@ def test_elastic_discovery_tracks_slice_changes(metadata):
     assert mgr.update_available_hosts() is True
     assert mgr.slot_count() == 3
     assert "10.164.0.12" not in [h.hostname for h in mgr.current_hosts()]
+
+
+def test_maintenance_event_surface(metadata):
+    """The advance-notice surface (ISSUE 10): ``instance/maintenance-
+    event`` reads through the same metadata client, with NONE meaning
+    "nothing scheduled" and anything else meaning the host is doomed."""
+    from horovod_tpu.runner.tpu_discovery import (MAINTENANCE_NONE,
+                                                  tpu_maintenance_event)
+    metadata.attrs["maintenance-event"] = "NONE"
+    assert tpu_maintenance_event() == MAINTENANCE_NONE
+    metadata.attrs["maintenance-event"] = "TERMINATE_ON_HOST_MAINTENANCE"
+    assert tpu_maintenance_event() == "TERMINATE_ON_HOST_MAINTENANCE"
+
+
+def test_preemption_watcher_reads_metadata_notice(metadata):
+    """PreemptionWatcher's metadata source: NONE is quiet, a scheduled
+    maintenance event reads as a notice."""
+    from horovod_tpu.elastic.preemption import PreemptionWatcher
+    metadata.attrs["maintenance-event"] = "NONE"
+    w = PreemptionWatcher()
+    assert w.check_once() is None
+    metadata.attrs["maintenance-event"] = "MIGRATE_ON_HOST_MAINTENANCE"
+    assert w.check_once() == "metadata"
+
+
+def test_preemption_watcher_latches_metadata_off(monkeypatch):
+    """Off-TPU there is no metadata server: after 3 consecutive probe
+    failures the watcher stops paying the connect timeout forever."""
+    from horovod_tpu.elastic.preemption import PreemptionWatcher
+    monkeypatch.setenv("HVD_TPU_METADATA_ENDPOINT", "http://127.0.0.1:1")
+    w = PreemptionWatcher()
+    for _ in range(3):
+        assert w.check_once() is None
+    assert w._metadata_dead is True
