@@ -587,7 +587,19 @@ def _child_gpt() -> None:
     (HVD_BENCH_MODEL=gpt): the model family behind the 5-axis parallel
     path (``horovod_tpu/models/transformer.py``). Defaults to a ~350M
     GPT-medium shape; HVD_BENCH_GPT_{LAYERS,DMODEL,HEADS,DFF}, HVD_BENCH_BATCH
-    and HVD_BENCH_SEQ tune it."""
+    and HVD_BENCH_SEQ tune it.
+
+    DP x PP pipelined training (docs/PERF.md "Pipeline parallelism"):
+    ``HVD_BENCH_PP`` > 1 splits the mesh dp x pp and runs the decoder
+    blocks as a compiled in-graph pipeline with
+    ``HVD_BENCH_MICROBATCHES`` microbatches (default ``2*pp``).
+    ``HVD_BENCH_SCHEDULE`` names the schedule; the transformer child
+    runs ``gpipe`` (GPipe-by-autodiff — with a vocab-sized loss head an
+    SPMD in-schedule 1F1B tail would pay the head on every stage every
+    tick; the 1f1b/interleaved measurements live in
+    ``benchmarks/pipeline_bench.py`` on layer-major models). The
+    artifact records the locked parallelism plan and the analytic
+    bubble fraction, gated by ``ci/check_bench.py --pipeline``."""
     import numpy as np
     import jax
     import optax
@@ -599,8 +611,22 @@ def _child_gpt() -> None:
 
     _log(f"devices: {jax.devices()}")
     hvd.init()
-    mesh = hvd.build_mesh(dp=-1)
+    pp = max(1, int(os.environ.get("HVD_BENCH_PP", "1") or 1))
+    schedule = (os.environ.get("HVD_BENCH_SCHEDULE", "").strip().lower()
+                or "gpipe")
+    from horovod_tpu.parallel.plan import SCHEDULES
+    if schedule not in SCHEDULES:
+        raise ValueError(f"HVD_BENCH_SCHEDULE={schedule!r}; expected one "
+                         f"of {SCHEDULES}")
+    if pp > 1 and schedule != "gpipe":
+        raise ValueError(
+            "the gpt child's in-graph transformer pipeline is "
+            "GPipe-by-autodiff; for measured 1f1b/interleaved schedules "
+            "run benchmarks/pipeline_bench.py (layer-major models)")
+    mesh = hvd.build_mesh(dp=-1, pp=pp)
     n_chips = int(np.prod(list(mesh.shape.values())))
+    n_micro = int(os.environ.get("HVD_BENCH_MICROBATCHES", "0") or 0) \
+        or (2 * pp if pp > 1 else 1)
 
     cfg = TransformerConfig(
         vocab_size=32000,
@@ -608,11 +634,21 @@ def _child_gpt() -> None:
         n_heads=int(os.environ.get("HVD_BENCH_GPT_HEADS", "16")),
         n_layers=int(os.environ.get("HVD_BENCH_GPT_LAYERS", "24")),
         d_ff=int(os.environ.get("HVD_BENCH_GPT_DFF", "4096")),
-        max_seq=int(os.environ.get("HVD_BENCH_SEQ", "2048")))
+        max_seq=int(os.environ.get("HVD_BENCH_SEQ", "2048")),
+        n_microbatches=n_micro)
+    if cfg.n_layers % pp != 0:
+        raise ValueError(f"HVD_BENCH_PP={pp} must divide "
+                         f"{cfg.n_layers} layers")
     B = int(os.environ.get("HVD_BENCH_BATCH", "8")) * n_chips
     S = cfg.max_seq
+    dp = n_chips // pp
+    if pp > 1 and (B // dp) % n_micro != 0:
+        raise ValueError(
+            f"per-replica batch {B}/{dp} not divisible by "
+            f"HVD_BENCH_MICROBATCHES={n_micro}")
 
-    params = shard_params(init_params(np.random.RandomState(0), cfg),
+    params = shard_params(init_params(np.random.RandomState(0), cfg,
+                                      n_stages=pp),
                           cfg, mesh)
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     _log(f"gpt params: {n_params/1e6:.1f}M, batch {B} x seq {S}")
@@ -633,6 +669,7 @@ def _child_gpt() -> None:
         run.args[0], run.args[1] = p, o
         return run, loss
 
+    from horovod_tpu.parallel.pipeline import bubble_fraction
     _measure_and_report(
         step_fn, run, readback=float,
         analytic_flops_per_device=lambda:
@@ -642,7 +679,14 @@ def _child_gpt() -> None:
         vs_baseline_per_unit=None,  # reference publishes no LM absolute
         extra={"batch_per_chip": B // n_chips, "seq_len": S,
                "scan_steps": scan, "compression": compression,
-               "n_params_m": round(n_params / 1e6, 1)},
+               "n_params_m": round(n_params / 1e6, 1),
+               # the locked parallelism plan + its analytic bubble
+               # (ci/check_bench.py --pipeline gates the pair)
+               "parallel_plan": {
+                   "dp": dp, "pp": pp, "schedule": schedule,
+                   "n_microbatches": n_micro, "virtual_stages": 1},
+               "bubble_fraction": round(
+                   bubble_fraction(schedule, pp, n_micro), 4)},
         hlo_flops_factor=scan)
 
 

@@ -1,0 +1,142 @@
+"""Pipeline schedule microbench: measured step seconds per schedule.
+
+Times the SAME layer-major model through
+:func:`horovod_tpu.train.pipeline.make_pipeline_train_step` under each
+pipeline schedule at a fixed (pp, n_microbatches):
+
+* ``gpipe``       — all forwards then autodiff backward. Fewest
+                    tick-slots on an SPMD mesh (each pass pays its own
+                    fill bubble once), but the live-residual stack grows
+                    with M.
+* ``1f1b``        — combined fwd+bwd ticks with the remat ring: bounded
+                    activation memory, at the price of the combined
+                    bubble ``2(S-1)`` ticks and the remat recompute.
+* ``interleaved`` — 1F1B with ``v`` virtual chunks per device: the same
+                    bounded memory with a ``~1/v`` smaller bubble —
+                    strictly fewer compute-unit-ticks than plain 1F1B
+                    at the same M (docs/PERF.md "Pipeline parallelism").
+
+Repeats are INTERLEAVED round-robin across schedules (the PR-8 sweep
+design): box-load drift penalizes every schedule equally, and the
+best-of over interleaved windows is what the acceptance gate in
+``tests/test_parallel_plan.py`` asserts on. Each measurement also
+reports the schedule's ANALYTIC bubble fraction, so measured ordering
+can be checked against the tick-count model.
+
+Run standalone::
+
+    python benchmarks/pipeline_bench.py       # 8 virtual CPU devices
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+N_DEVICES = int(os.environ.get("HVD_PIPELINE_BENCH_DEVICES", "8"))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if __name__ == "__main__":  # force the virtual mesh before jax imports
+    sys.path.insert(0, REPO)
+    os.environ.setdefault(
+        "XLA_FLAGS", f"--xla_force_host_platform_device_count={N_DEVICES}")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+SCHEDULES = ("gpipe", "1f1b", "interleaved")
+
+
+def _sweep_model(d_model, n_layers):
+    """Layer-major tanh-matmul stack (the factory's model contract):
+    every leaf carries the layer dim, one matmul per layer."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(
+        rng.randn(n_layers, d_model, d_model).astype(np.float32)
+        / np.sqrt(d_model))}
+
+    def layer_fn(lp, x):
+        return jnp.tanh(x @ lp["w"])
+
+    def loss_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    return params, layer_fn, loss_fn
+
+
+def run_schedule_sweep(mesh=None, *, pp: int = 4, virtual_stages: int = 2,
+                       n_micro: int = 8, d_model: int = 384,
+                       n_layers: int = 8, rows_per_microbatch: int = 16,
+                       iters: int = 4, repeats: int = 3,
+                       schedules=SCHEDULES) -> dict:
+    """Measure each schedule, best-of interleaved repeats. Returns
+    ``{"schedules": {name: s}, "bubble": {name: frac}, ...}``."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.parallel.pipeline import bubble_fraction
+    from horovod_tpu.train.pipeline import make_pipeline_train_step
+
+    if mesh is None:
+        mesh = hvd.dp_pp_mesh(pp=pp)
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    dp = n_dev // pp
+    params, layer_fn, loss_fn = _sweep_model(d_model, n_layers)
+    tx = optax.sgd(1e-3)
+    rng = np.random.RandomState(1)
+    batch = dp * n_micro * rows_per_microbatch
+    x = jnp.asarray(rng.randn(batch, d_model).astype(np.float32))
+    y = jnp.asarray(rng.randn(batch, d_model).astype(np.float32))
+
+    state = {}
+    for schedule in schedules:
+        v = virtual_stages if schedule == "interleaved" else 1
+        step = make_pipeline_train_step(
+            layer_fn, loss_fn, tx, n_layers=n_layers, mesh=mesh,
+            schedule=schedule, pp=pp, n_micro=n_micro, virtual_stages=v,
+            donate=False, autotune=False)
+        p = step.prepare_params(params)
+        s = step.prepare_params(tx.init(params))
+        p, s, loss = step(p, s, (x, y))          # compile
+        jax.block_until_ready(loss)
+        state[schedule] = (step, p, s)
+    times = {schedule: float("inf") for schedule in schedules}
+    for _ in range(max(1, repeats)):
+        for schedule in schedules:
+            step, p, s = state[schedule]
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                p, s, loss = step(p, s, (x, y))
+            jax.block_until_ready(loss)
+            jax.block_until_ready(p)
+            times[schedule] = min(times[schedule],
+                                  (time.perf_counter() - t0) / iters)
+            state[schedule] = (step, p, s)
+    return {
+        "metric": "pipeline_schedule_step_seconds",
+        "n_devices": n_dev, "dp": dp, "pp": pp,
+        "virtual_stages": virtual_stages, "n_micro": n_micro,
+        "d_model": d_model, "n_layers": n_layers,
+        "schedules": {k: round(v, 5) for k, v in times.items()},
+        "bubble": {
+            s: round(bubble_fraction(
+                s, pp, n_micro,
+                virtual_stages if s == "interleaved" else 1), 4)
+            for s in schedules},
+    }
+
+
+def main() -> int:
+    doc = run_schedule_sweep()
+    print(json.dumps(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

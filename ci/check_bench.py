@@ -35,6 +35,16 @@ baseline artifact predating the contract passes with a note (NEW
 becomes the baseline); a NEW artifact with a real measured value but
 no compile time fails — the recording contract broke.
 
+``--pipeline ARTIFACT`` is the parallelism-plan contract gate
+(ISSUE 11): a bench doc produced with ``HVD_BENCH_PP`` > 1 must record
+the locked parallelism plan (``parallel_plan``: dp/pp/schedule/
+n_microbatches/virtual_stages) and an analytic ``bubble_fraction`` that
+MATCHES the schedule's tick-count model
+(``horovod_tpu.parallel.pipeline.bubble_fraction``) — a plan/bubble
+pair that disagrees means the child measured one layout while
+reporting another. ``dp * pp`` must equal ``n_chips``. A doc without
+a plan (pp=1 run) passes with a note.
+
 ``--trajectory ARTIFACT [--tolerance T]`` is the within-window drift
 gate (ISSUE 7): the bench doc now records ``step_time_series`` — every
 iteration of the timing window — so a run whose *mean* looks fine but
@@ -232,6 +242,81 @@ def compile_budget_main(argv) -> int:
     return 0
 
 
+def check_pipeline_plan(doc: dict):
+    """None when the parallel_plan/bubble_fraction pair is coherent,
+    else a failure string — NEVER an exception: a corrupt artifact must
+    fail the gate with a message, not kill it with a traceback. Docs
+    without a plan are not judged here."""
+    plan = doc.get("parallel_plan")
+    if plan is None:
+        return None
+    if not isinstance(plan, dict):
+        return f"parallel_plan is not an object: {plan!r}"
+    for key in ("dp", "pp", "schedule", "n_microbatches"):
+        if key not in plan:
+            return f"parallel_plan missing key {key!r}: {plan}"
+    try:
+        dp, pp = int(plan["dp"]), int(plan["pp"])
+        n_micro = int(plan["n_microbatches"])
+        v = int(plan.get("virtual_stages", 1))
+        bubble = float(doc["bubble_fraction"]) \
+            if doc.get("bubble_fraction") is not None else None
+    except (TypeError, ValueError) as e:
+        return f"parallel_plan carries non-numeric fields ({e}): {plan}"
+    schedule = str(plan["schedule"])
+    if schedule not in ("gpipe", "1f1b", "interleaved"):
+        return f"unknown schedule {schedule!r} in parallel_plan"
+    if not (1 <= dp and 1 <= pp and 1 <= v):
+        return f"non-positive plan dimensions: {plan}"
+    if not (1 <= n_micro <= 65536):
+        # also bounds the pure-Python interleaved table build below — a
+        # corrupt huge M must not hang the gate for minutes
+        return f"implausible n_microbatches {n_micro} in parallel_plan"
+    if bubble is None:
+        return "parallel_plan recorded without bubble_fraction"
+    if not (0.0 <= bubble < 1.0):
+        return f"bubble_fraction {bubble} outside [0, 1)"
+    n_chips = doc.get("n_chips")
+    if n_chips and dp * pp != int(n_chips):
+        return (f"plan dp*pp = {dp}*{pp} does not tile "
+                f"n_chips={n_chips}")
+    sys.path.insert(0, REPO)
+    try:
+        from horovod_tpu.parallel.pipeline import bubble_fraction
+        expect = bubble_fraction(schedule, pp, n_micro, v)
+    except Exception as e:
+        return f"analytic bubble model rejected {plan}: {e}"
+    finally:
+        sys.path.remove(REPO)
+    if abs(bubble - expect) > 5e-4:
+        return (f"recorded bubble_fraction {bubble} disagrees with the "
+                f"analytic value {expect:.4f} for {plan} — the child "
+                "measured one layout while reporting another")
+    return None
+
+
+def pipeline_main(argv) -> int:
+    path = argv[argv.index("--pipeline") + 1]
+    doc = _load_bench_doc(path)
+    if not doc:
+        print(f"no bench doc in {path}")
+        return 1
+    problem = check_pipeline_plan(doc)
+    if problem:
+        print(f"pipeline gate FAILED for {path}: {problem}")
+        return 1
+    plan = doc.get("parallel_plan")
+    if plan is None:
+        print(f"pipeline gate: {path} carries no parallel_plan "
+              "(pp=1 run); nothing to judge")
+    else:
+        print(f"pipeline gate OK for {path}: dp{plan['dp']} x "
+              f"pp{plan['pp']} {plan['schedule']} "
+              f"m{plan['n_microbatches']} v{plan.get('virtual_stages', 1)}"
+              f" bubble={doc['bubble_fraction']}")
+    return 0
+
+
 def trajectory_main(argv) -> int:
     path = argv[argv.index("--trajectory") + 1]
     tolerance = float(argv[argv.index("--tolerance") + 1]) \
@@ -413,6 +498,12 @@ def main() -> int:
             if problem:
                 print(f"bench {problem}")
                 return 1
+    # parallelism-plan contract (ISSUE 11): a doc that names a plan must
+    # name it coherently (automatic form of the --pipeline gate)
+    problem = check_pipeline_plan(doc)
+    if problem:
+        print(f"bench {problem}")
+        return 1
     print(f"bench contract OK: {doc}")
     return 0
 
@@ -426,4 +517,6 @@ if __name__ == "__main__":
         sys.exit(scaling_main(sys.argv))
     if "--trajectory" in sys.argv:
         sys.exit(trajectory_main(sys.argv))
+    if "--pipeline" in sys.argv:
+        sys.exit(pipeline_main(sys.argv))
     sys.exit(main())

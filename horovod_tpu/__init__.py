@@ -101,9 +101,21 @@ from horovod_tpu.parallel import (  # noqa: F401
     AXIS_ORDER,
     MeshSpec,
     build_mesh,
+    dp_pp_mesh,
     single_axis_mesh,
     batch_sharding,
     logical_sharding,
+)
+# Unified parallelism plan (docs/PERF.md "Pipeline parallelism"): the
+# frozen dp x pp / schedule / microbatch / comms decision object, the
+# single compile seam behind the step factories, and the composed
+# DP x PP pipelined train step.
+from horovod_tpu.parallel.plan import (  # noqa: F401
+    ParallelPlan,
+    compile_step_with_plan,
+)
+from horovod_tpu.train.pipeline import (  # noqa: F401
+    make_pipeline_train_step,
 )
 
 # High-level training API (reference: horovod/torch/optimizer.py,
@@ -139,6 +151,8 @@ from horovod_tpu.common.topology import (  # noqa: F401
 from horovod_tpu.train.autotune import (  # noqa: F401
     AutotuneOptions,
     Plan as AutotunePlan,
+    make_parallel_train_step,
+    parallel_candidate_plans,
 )
 from horovod_tpu.train.fused_apply import (  # noqa: F401
     fused_adam,

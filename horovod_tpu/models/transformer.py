@@ -317,10 +317,17 @@ def forward_loss_spmd(params, tokens, targets, cfg: TransformerConfig):
     aux_total = jnp.zeros((), jnp.float32)
 
     if _axis_live("pp"):
-        from horovod_tpu.parallel.pipeline import pipeline_spmd
+        from horovod_tpu.parallel.pipeline import (pipeline_spmd,
+                                                   psum_cotangent)
         stage_fn = _stage_fn_factory(cfg, positions)
         aux_col = jnp.zeros(x.shape[:-1] + (1,), jnp.float32)
         xa = jnp.concatenate([x.astype(jnp.float32), aux_col], -1)
+        # the embedding is computed replicated over pp, but only stage 0
+        # CONSUMES its output — without this, the lookup's gradient
+        # contribution exists only on the pp-rank-0 shards and the
+        # assembled embed gradient depends on which replica the
+        # out_specs pick (pipeline.py module docstring)
+        xa = psum_cotangent(xa, "pp")
         M = cfg.n_microbatches
         xm = xa.reshape((M, B // M) + xa.shape[1:])
         ym = pipeline_spmd(stage_fn, lp, xm, "pp")

@@ -10,9 +10,24 @@ from horovod_tpu.parallel.mesh import (  # noqa: F401
     batch_sharding,
     build_mesh,
     default_rules,
+    dp_pp_mesh,
     logical_sharding,
     mesh_axis_size,
     replicated,
     sharded,
     single_axis_mesh,
+)
+from horovod_tpu.parallel.plan import (  # noqa: F401
+    ParallelPlan,
+    SCHEDULES,
+    compile_step_with_plan,
+    plan_from_dict,
+)
+from horovod_tpu.parallel.pipeline import (  # noqa: F401
+    bubble_fraction,
+    pipeline_1f1b_apply,
+    pipeline_apply,
+    pipeline_interleaved_apply,
+    schedule_ticks,
+    stage_stacked,
 )

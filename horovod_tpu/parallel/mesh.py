@@ -97,6 +97,21 @@ def build_mesh(spec: Optional[MeshSpec] = None,
     return Mesh(dev_array, AXIS_ORDER)
 
 
+def dp_pp_mesh(dp: int = -1, pp: int = 1,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """The documented two-axis dp x pp mesh for pipelined data-parallel
+    training (docs/PERF.md "Pipeline parallelism"): ``dp`` replicas each
+    running a ``pp``-deep pipeline. ``dp=-1`` (default) absorbs the
+    remaining devices, so ``dp_pp_mesh(pp=4)`` on 8 devices is the
+    2x4 layout. ``pp`` is innermost (the canonical axis order), keeping
+    stage-to-stage ``ppermute`` traffic on the most tightly coupled
+    links while dp gradient reduction can ride slower links. This is
+    the mesh constructor behind
+    :func:`horovod_tpu.train.pipeline.make_pipeline_train_step` and
+    :meth:`horovod_tpu.parallel.plan.ParallelPlan.build_mesh`."""
+    return build_mesh(MeshSpec(dp=dp, pp=pp), devices=devices)
+
+
 def single_axis_mesh(axis: str = DATA_AXIS,
                      devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())

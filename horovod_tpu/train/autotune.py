@@ -54,7 +54,7 @@ from horovod_tpu.common.logging import get_logger
 
 log = get_logger()
 
-PLAN_CACHE_VERSION = 1
+PLAN_CACHE_VERSION = 2   # v2: the cache may hold a ParallelPlan (ISSUE 11)
 _ALGORITHMS = ("psum", "ring", "hier")
 _CODECS = ("none", "int8", "fp8")
 DEFAULT_SMALL_FLOOR = 32 * 1024  # latency-path floor candidate (bytes)
@@ -192,16 +192,25 @@ def candidate_plans(topology=None, *, baseline: Optional[Plan] = None,
 # Fingerprint + persistent plan cache
 # ---------------------------------------------------------------------------
 
-def topology_key(topology) -> Dict[str, int]:
+def topology_key(topology, pp: int = 1) -> Dict[str, int]:
     """Canonical mesh/topology component of the cache fingerprint:
     reduction width plus the (hosts × local) structure, WITHOUT the
     mesh axis name — a plan tuned over axis "dp" must warm-start the
     same model reduced over an axis called "data", and the eager
     ``DistributedOptimizer(autotune=True)`` seam (which has no mesh at
-    all) must be able to reconstruct the same key from the world size."""
+    all) must be able to reconstruct the same key from the world size.
+
+    ``pp`` (ISSUE 11): the pipeline dimension of the key. A
+    communication plan is tuned UNDER a fixed dp x pp mesh, so its key
+    carries that mesh's pp size (default 1). A parallelism-plan search
+    passes ``pp=0`` — the sentinel for "the dp x pp split is an axis of
+    the search space, keyed by the whole world" — so comm-plan and
+    parallel-plan entries for the same model can never shadow each
+    other."""
     return {"world": int(topology.world),
             "hosts": int(topology.num_hosts),
-            "local": int(topology.local_size)}
+            "local": int(topology.local_size),
+            "pp": int(pp)}
 
 
 def plan_fingerprint(tree, mesh_shape: Dict[str, int], world: int,
@@ -280,7 +289,11 @@ class PlanCache:
                     "(stale entry for a different tree/mesh/world); "
                     "retuning", path)
                 return None
-            return Plan.from_dict(doc["plan"])
+            # the cache holds either kind of plan: a communication Plan
+            # or a full ParallelPlan (dp x pp split + schedule +
+            # microbatches + nested comms) — dispatch on the doc
+            from horovod_tpu.parallel.plan import plan_from_dict
+            return plan_from_dict(doc["plan"])
         except (KeyError, TypeError, ValueError) as e:
             log.warning("autotune plan cache %s carries an invalid "
                         "plan (%s); retuning", path, e)
@@ -346,6 +359,11 @@ def _record_locked_plan(plan: Plan, best_s: Optional[float],
         reg.gauge("hvd_autotune_best_step_seconds",
                   help="measured step seconds of the locked plan"
                   ).set(best_s)
+    if hasattr(plan, "schedule"):
+        # a locked ParallelPlan also lands the pipeline-layout gauges
+        # (hvd_pipeline_*, docs/OBSERVABILITY.md "Pipeline metrics")
+        from horovod_tpu.train.pipeline import _pipeline_metrics
+        _pipeline_metrics(plan)
     if from_cache:
         reg.counter("hvd_autotune_cache_hits_total",
                     help="runs that started from a cached tuned plan "
@@ -537,6 +555,9 @@ class AutotuneController:
 
     # -- CSV trace (like the C++ core's HVD_TPU_AUTOTUNE_LOG) ---------------
 
+    _CSV_HEADER = ("round,bucket_bytes,algorithm,codec,small_floor,"
+                   "plan,step_s,final\n")
+
     def _log_trial(self, plan: Plan, score: float,
                    final: bool = False) -> None:
         if not self._log_path:
@@ -545,16 +566,27 @@ class AutotuneController:
             # append-only: a second controller in the same process (an
             # elastic re-mesh retuning) must extend the audit trail, not
             # truncate the previous search's rows. Header only when the
-            # file is new/empty.
+            # file is new/empty. A trace written under an OLDER column
+            # schema is rotated to <path>.v1 first — appending 8-field
+            # rows under a 7-column header would silently misalign every
+            # consumer parsing by header.
+            if not self._log_header_written \
+                    and os.path.exists(self._log_path):
+                with open(self._log_path) as f:
+                    first = f.readline()
+                if first and first != self._CSV_HEADER:
+                    os.replace(self._log_path, self._log_path + ".v1")
+                    log.info("autotune CSV trace %s used an older "
+                             "schema; rotated to %s.v1",
+                             self._log_path, self._log_path)
             with open(self._log_path, "a") as f:
                 if not self._log_header_written:
                     if f.tell() == 0:
-                        f.write("round,bucket_bytes,algorithm,codec,"
-                                "small_floor,step_s,final\n")
+                        f.write(self._CSV_HEADER)
                     self._log_header_written = True
                 f.write(f"{self._round},{plan.bucket_bytes},"
                         f"{plan.algorithm},{plan.codec},"
-                        f"{plan.small_floor},{score:.6f},"
+                        f"{plan.small_floor},{plan.key},{score:.6f},"
                         f"{1 if final else 0}\n")
         except OSError:
             pass  # the trace is advisory, never fatal
@@ -689,7 +721,10 @@ def make_autotuned_train_step(loss_fn, optimizer, mesh,
         topo, baseline=baseline, include_fp8=opts.include_fp8)
     cache_dir = resolve_cache_dir(opts.cache_dir)
     cache = PlanCache(cache_dir) if cache_dir else None
-    mesh_shape = topology_key(topo)
+    # comm plans are tuned UNDER a fixed mesh: the key carries that
+    # mesh's pp size (the eager DistributedOptimizer seam has no mesh
+    # and reconstructs the key with the default pp=1)
+    mesh_shape = topology_key(topo, pp=int(mesh.shape.get("pp", 1)))
 
     def build_step(plan: Plan):
         # autotune=False is load-bearing: with HVD_TPU_AUTOTUNE_MESH=1
@@ -711,3 +746,232 @@ def make_autotuned_train_step(loss_fn, optimizer, mesh,
         return ctl
 
     return AutotunedStep(build_step, controller_factory)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11: the PARALLELISM plan joins the same search
+# ---------------------------------------------------------------------------
+
+def parallel_candidate_plans(world: int, n_layers: int, *,
+                             baseline=None,
+                             schedules: Sequence[str] = ("1f1b", "gpipe",
+                                                         "interleaved"),
+                             max_pp: Optional[int] = None,
+                             include_comms: bool = True) -> List[Any]:
+    """The discrete (dp x pp) x schedule x n_microbatches x comms search
+    space for :func:`make_parallel_train_step`, most-promising-first.
+
+    Layout candidates: every pp that divides both the world and the
+    layer count (pp=1 — pure DP with the comm defaults — is the
+    baseline and always first: the search can only confirm or beat it).
+    Per pipeline layout: each schedule, microbatch counts {pp, 2*pp}
+    (enough to fill the pipe vs halve the bubble), and interleaved adds
+    ``virtual_stages=2`` where the layers split. ``include_comms`` adds
+    an int8-codec bucketed-sync variant of each layout with dp > 1 —
+    (pp, M, schedule) joining bucket x algorithm x codec as axes of ONE
+    search, per the ROADMAP. The tail is ordered cheapest-compile-first
+    so budget trimming (the controller's no-silent-caps warning) drops
+    the speculative end."""
+    from horovod_tpu.parallel.plan import ParallelPlan
+    from horovod_tpu.train.buckets import resolve_bucket_bytes
+
+    plans: List[Any] = []
+    if baseline is not None:
+        plans.append(baseline)
+    plans.append(ParallelPlan(dp=world, pp=1))
+    pps = [p for p in range(2, (max_pp or world) + 1)
+           if world % p == 0 and n_layers % p == 0]
+    comm_variant = Plan(resolve_bucket_bytes(None), "psum", "int8") \
+        if include_comms else None
+    for pp in pps:
+        dp = world // pp
+        for M in (pp, 2 * pp):
+            for schedule in schedules:
+                if schedule == "interleaved":
+                    if n_layers % (pp * 2) != 0:
+                        continue
+                    v = 2
+                else:
+                    v = 1
+                plans.append(ParallelPlan(
+                    dp=dp, pp=pp, schedule=schedule, n_microbatches=M,
+                    virtual_stages=v))
+                if comm_variant is not None and dp > 1:
+                    plans.append(ParallelPlan(
+                        dp=dp, pp=pp, schedule=schedule,
+                        n_microbatches=M, virtual_stages=v,
+                        comms=comm_variant))
+    seen, out = set(), []
+    for p in plans:
+        if p not in seen:
+            seen.add(p)
+            out.append(p)
+    return out
+
+
+class ParallelAutotunedStep:
+    """Searching/serving step over whole :class:`ParallelPlan`\\ s.
+
+    Like :class:`AutotunedStep`, but candidate filtering needs the BATCH
+    (a plan whose ``dp * n_microbatches`` does not tile the global batch
+    cannot compile), so the controller is constructed on the first call
+    when params AND batch are finally in hand. Candidate steps keep the
+    caller's params in natural layer order — each candidate permutes
+    in/out of its own storage layout internally — so one (params,
+    opt_state) pair flows through every trial unchanged. Once locked,
+    ``pin()`` returns the underlying
+    :class:`~horovod_tpu.train.pipeline.PipelineTrainStep` for
+    permutation-free steady state (pin once, re-``prepare_params``)."""
+
+    def __init__(self, plans: Sequence[Any],
+                 build_step: Callable[[Any], Any],
+                 controller_factory: Callable, n_layers: int) -> None:
+        self._plans = list(plans)
+        self._build_step = build_step
+        self._controller_factory = controller_factory
+        self._n_layers = n_layers
+        self._steps: Dict[Any, Callable] = {}
+        self._raw: Dict[Any, Any] = {}
+        self.autotune: Optional[AutotuneController] = None
+        self._locked_fn: Optional[Callable] = None
+
+    def _fits(self, plan, batch_dim: int, n_layers: int) -> bool:
+        per_replica = batch_dim // plan.dp if batch_dim % plan.dp == 0 \
+            else 0
+        return (batch_dim % plan.dp == 0
+                and per_replica % plan.n_microbatches == 0
+                and n_layers % plan.total_stages == 0)
+
+    def _get(self, plan):
+        fn = self._steps.get(plan)
+        if fn is None:
+            raw = self._build_step(plan)
+            self._raw[plan] = raw
+
+            def fn(params, opt_state, batch, _raw=raw):
+                p = _raw.prepare_params(params)
+                o = _raw.prepare_params(opt_state)
+                p, o, loss = _raw(p, o, batch)
+                return (_raw.restore_params(p), _raw.restore_params(o),
+                        loss)
+            self._steps[plan] = fn
+        return fn
+
+    def pin(self):
+        """The locked plan's bare step (natural-order permutation
+        stripped); None while still searching."""
+        ctl = self.autotune
+        if ctl is None or ctl.locked_plan is None:
+            return None
+        self._get(ctl.locked_plan)
+        return self._raw[ctl.locked_plan]
+
+    def __call__(self, params, opt_state, batch):
+        import jax
+        if self.autotune is None:
+            leaves = jax.tree_util.tree_leaves(batch)
+            batch_dim = int(leaves[0].shape[0])
+            self.autotune = self._controller_factory(
+                params, batch_dim,
+                lambda plan: self._fits(plan, batch_dim,
+                                        self._n_layers))
+        ctl = self.autotune
+        if self._locked_fn is None and ctl.locked_plan is not None:
+            self._locked_fn = self._get(ctl.locked_plan)
+        if self._locked_fn is not None:
+            return self._locked_fn(params, opt_state, batch)
+        plan = ctl.begin_step()
+        fn = self._get(plan)
+        t0 = time.perf_counter()
+        out = fn(params, opt_state, batch)
+        jax.block_until_ready(out)
+        ctl.end_step(time.perf_counter() - t0)
+        if ctl.locked_plan is not None:
+            self._locked_fn = self._get(ctl.locked_plan)
+        return out
+
+
+def make_parallel_train_step(layer_fn, loss_fn, optimizer, *,
+                             n_layers: int,
+                             devices=None,
+                             autotune=True,
+                             op=None,
+                             donate: bool = True
+                             ) -> ParallelAutotunedStep:
+    """Search the unified parallelism space (ROADMAP 1, ISSUE 11): the
+    dp x pp split, pipeline schedule, microbatch count and dp
+    communication plan are scored together by measured step time on the
+    layer-major model, successive-halving style, and the winner is
+    fingerprinted into the SAME persistent plan cache as the
+    communication tuner — a warm hit on a re-meshed world locks the
+    full parallelism plan with zero trials.
+
+    Called by ``make_pipeline_train_step(..., autotune=...)``; the model
+    contract is that factory's layer-major one. The pure-DP layout
+    (dp=world, pp=1) is always the baseline candidate."""
+    import jax
+
+    from horovod_tpu.common.topology import detect_topology, flat_topology
+    from horovod_tpu.ops.reduce_op import Average
+
+    if op is None:
+        op = Average
+    opts = AutotuneOptions.resolve(autotune)
+    devs = list(devices) if devices is not None else list(jax.devices())
+    world = len(devs)
+    try:
+        topo = detect_topology(n=world)
+    except Exception:
+        topo = flat_topology(world)
+    plans = list(opts.plans) if opts.plans else parallel_candidate_plans(
+        world, n_layers)
+    cache_dir = resolve_cache_dir(opts.cache_dir)
+    cache = PlanCache(cache_dir) if cache_dir else None
+    # pp=0: the dp x pp split is itself a searched axis (see
+    # topology_key); the key identifies the WORLD + model
+    mesh_shape = topology_key(topo, pp=0)
+
+    def build_step(plan):
+        from horovod_tpu.train.pipeline import make_pipeline_train_step
+        return make_pipeline_train_step(
+            layer_fn, loss_fn, optimizer, plan=plan, n_layers=n_layers,
+            devices=devs, op=op, donate=donate, autotune=False)
+
+    def controller_factory(params, batch_dim: int,
+                           fits) -> AutotuneController:
+        usable = [p for p in plans if fits(p)]
+        dropped = [p for p in plans if not fits(p)]
+        if dropped:
+            log.info(
+                "parallel autotune: %d of %d candidate plans cannot "
+                "tile batch=%d x %d layers and were skipped: %s",
+                len(dropped), len(plans), batch_dim, n_layers,
+                ", ".join(p.key for p in dropped[:8])
+                + ("..." if len(dropped) > 8 else ""))
+        if not usable:
+            raise ValueError(
+                f"no parallelism plan tiles global batch {batch_dim} "
+                f"over {world} devices with {n_layers} layers")
+        fp = plan_fingerprint(params, mesh_shape, world)
+        ctl = AutotuneController(
+            usable, budget_steps=opts.budget_steps,
+            steps_per_trial=opts.steps_per_trial,
+            log_path=opts.resolved_log_path(),
+            cache=cache, fingerprint=fp)
+        # the fingerprint covers tree+world, NOT the batch: a cached
+        # plan tuned at another global batch size may not tile this
+        # one. Validate BEFORE adopting — the documented cache contract
+        # is "stale entries retune, never crash"
+        cached = cache.load(fp) if cache is not None else None
+        if cached is not None and (not hasattr(cached, "total_stages")
+                                   or not fits(cached)):
+            log.warning(
+                "cached parallelism plan %s cannot tile global batch "
+                "%d x %d layers on this run; retuning",
+                getattr(cached, "key", cached), batch_dim, n_layers)
+        else:
+            ctl.try_cache()
+        return ctl
+
+    return ParallelAutotunedStep(plans, build_step, controller_factory,
+                                 n_layers)

@@ -422,3 +422,61 @@ def test_failure_identity_names():
             assert bench._failure_identity() == (metric, unit)
         finally:
             del os.environ["HVD_BENCH_MODEL"]
+
+
+def test_pipeline_plan_gate(tmp_path):
+    """ci/check_bench.py --pipeline (ISSUE 11): the parallel_plan /
+    bubble_fraction pair must be coherent with the analytic tick-count
+    model; a doc without a plan passes with nothing to judge."""
+    sys.path.insert(0, REPO)
+    try:
+        from ci.check_bench import check_pipeline_plan, pipeline_main
+    finally:
+        sys.path.remove(REPO)
+    good = {"metric": "gpt_tokens_per_sec_per_chip", "value": 1.0,
+            "n_chips": 8,
+            "parallel_plan": {"dp": 4, "pp": 2, "schedule": "gpipe",
+                              "n_microbatches": 4, "virtual_stages": 1},
+            "bubble_fraction": 0.2}    # 2(M+S-1)=10 vs 2M=8 -> 0.2
+    assert check_pipeline_plan(good) is None
+    assert check_pipeline_plan({"value": 1.0}) is None  # pp=1 run
+    wrong_bubble = dict(good, bubble_fraction=0.4286)
+    assert "disagrees" in check_pipeline_plan(wrong_bubble)
+    bad_tile = dict(good, n_chips=6)
+    assert "does not tile" in check_pipeline_plan(bad_tile)
+    missing = dict(good)
+    del missing["bubble_fraction"]
+    assert "without bubble_fraction" in check_pipeline_plan(missing)
+    # the CLI form
+    path = tmp_path / "doc.json"
+    path.write_text(json.dumps(good))
+    assert pipeline_main(["--pipeline", str(path)]) == 0
+    path.write_text(json.dumps(wrong_bubble))
+    assert pipeline_main(["--pipeline", str(path)]) == 1
+
+
+def test_pipeline_plan_gate_never_raises_on_corrupt_docs():
+    """Corrupt artifacts must FAIL the gate with a message, not kill it
+    with a traceback (review hardening)."""
+    sys.path.insert(0, REPO)
+    try:
+        from ci.check_bench import check_pipeline_plan
+    finally:
+        sys.path.remove(REPO)
+    base = {"n_chips": 8,
+            "parallel_plan": {"dp": 4, "pp": 2, "schedule": "gpipe",
+                              "n_microbatches": 4, "virtual_stages": 1},
+            "bubble_fraction": 0.2}
+    for mutate in (
+            lambda d: d["parallel_plan"].update(schedule="xyz"),
+            lambda d: d["parallel_plan"].update(n_microbatches="many"),
+            lambda d: d["parallel_plan"].update(
+                schedule="interleaved", n_microbatches=10**9),
+            lambda d: d.update(bubble_fraction="0.2x"),
+            lambda d: d.update(parallel_plan=["dp", 4]),
+            lambda d: d["parallel_plan"].update(pp=0),
+    ):
+        doc = json.loads(json.dumps(base))
+        mutate(doc)
+        problem = check_pipeline_plan(doc)
+        assert isinstance(problem, str) and problem, doc

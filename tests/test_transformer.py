@@ -109,3 +109,36 @@ def test_train_step_pipeline_moe():
     jax.block_until_ready(loss)
     assert np.isfinite(float(loss))
     assert np.isfinite(float(aux))
+
+
+def test_train_step_pipeline_matches_pure_dp_trajectory():
+    """Pipeline-parallel training must be a pure LAYOUT change (ISSUE
+    11): at the same data-parallel width, dp4 alone (4 devices) and
+    dp4 x pp2 (8 devices) run identical math, so their loss
+    trajectories must agree to fp tolerance. This is the regression
+    test for the pipeline gradient-scale bug — differentiating the
+    replicated loss inside shard_map over-counted every STAGE gradient
+    by pp while the embed/head gradients stayed x1, silently skewing
+    stage-vs-embedding training balance on every pp>1 mesh
+    (parallel/pipeline.py `replicate_from_stage`)."""
+    tokens, targets = _batch()
+
+    def run(mesh_kw, n_stages, n_dev, steps=4):
+        mesh = build_mesh(**mesh_kw, devices=jax.devices()[:n_dev])
+        params_host = init_params(np.random.RandomState(42), CFG,
+                                  n_stages=n_stages)
+        p = shard_params(params_host, CFG, mesh)
+        t, y = shard_batch(tokens, targets, mesh)
+        tx = optax.sgd(5e-2)
+        step = make_train_step(CFG, mesh, tx)
+        s = init_opt_state(tx, p, mesh, CFG)
+        out = []
+        for _ in range(steps):
+            p, s, loss, aux = step(p, s, t, y)
+            jax.block_until_ready(loss)
+            out.append(float(loss))
+        return out
+
+    ref = run(dict(dp=4), 1, 4)
+    pp2 = run(dict(dp=4, pp=2), 2, 8)
+    np.testing.assert_allclose(pp2, ref, rtol=1e-5, atol=1e-5)
