@@ -20,8 +20,12 @@ Repeats are INTERLEAVED round-robin across schedules (the PR-8 sweep
 design): box-load drift penalizes every schedule equally, and the
 best-of over interleaved windows is what the acceptance gate in
 ``tests/test_parallel_plan.py`` asserts on. Each measurement also
-reports the schedule's ANALYTIC bubble fraction, so measured ordering
-can be checked against the tick-count model.
+reports the schedule's ANALYTIC bubble fraction, and — via a pp=1
+compute-only baseline riding the same interleaved repeats (same
+per-device work, zero pipeline dependencies; the overlap_bench
+attribution pattern) — the MEASURED bubble per schedule
+(``bubble_measured``), so analytic-vs-measured drift is a recorded
+number, not a guess.
 
 Run standalone::
 
@@ -95,41 +99,73 @@ def run_schedule_sweep(mesh=None, *, pp: int = 4, virtual_stages: int = 2,
     y = jnp.asarray(rng.randn(batch, d_model).astype(np.float32))
 
     state = {}
-    for schedule in schedules:
-        v = virtual_stages if schedule == "interleaved" else 1
-        step = make_pipeline_train_step(
-            layer_fn, loss_fn, tx, n_layers=n_layers, mesh=mesh,
-            schedule=schedule, pp=pp, n_micro=n_micro, virtual_stages=v,
-            donate=False, autotune=False)
+    configs = list(schedules)
+    # compute-only baseline (ISSUE 12 satellite): pp=1 on the SAME
+    # devices with the SAME global batch does exactly the per-device
+    # work of a zero-bubble pipeline (n_layers*M*rows/pp either way)
+    # with no cross-stage dependency — the overlap_bench attribution
+    # pattern, so 1 - t_compute/t_schedule is the MEASURED bubble.
+    # Needs rows_per_microbatch % pp == 0 so the pp=1 mesh can
+    # re-microbatch the same batch.
+    measure_compute = rows_per_microbatch % pp == 0
+    if measure_compute:
+        configs.append("compute")
+    for config in configs:
+        if config == "compute":
+            # the SAME devices as the sweep mesh (a caller-supplied
+            # sub-mesh must keep per-device work identical, or the
+            # measured bubble silently inflates), flattened onto dp
+            step = make_pipeline_train_step(
+                layer_fn, loss_fn, tx, n_layers=n_layers,
+                mesh=hvd.dp_pp_mesh(
+                    pp=1, devices=list(mesh.devices.flat)),
+                pp=1, n_micro=n_micro,
+                donate=False, autotune=False)
+        else:
+            v = virtual_stages if config == "interleaved" else 1
+            step = make_pipeline_train_step(
+                layer_fn, loss_fn, tx, n_layers=n_layers, mesh=mesh,
+                schedule=config, pp=pp, n_micro=n_micro,
+                virtual_stages=v, donate=False, autotune=False)
         p = step.prepare_params(params)
         s = step.prepare_params(tx.init(params))
         p, s, loss = step(p, s, (x, y))          # compile
         jax.block_until_ready(loss)
-        state[schedule] = (step, p, s)
-    times = {schedule: float("inf") for schedule in schedules}
+        state[config] = (step, p, s)
+    times = {config: float("inf") for config in configs}
     for _ in range(max(1, repeats)):
-        for schedule in schedules:
-            step, p, s = state[schedule]
+        for config in configs:
+            step, p, s = state[config]
             t0 = time.perf_counter()
             for _ in range(iters):
                 p, s, loss = step(p, s, (x, y))
             jax.block_until_ready(loss)
             jax.block_until_ready(p)
-            times[schedule] = min(times[schedule],
-                                  (time.perf_counter() - t0) / iters)
-            state[schedule] = (step, p, s)
-    return {
+            times[config] = min(times[config],
+                                (time.perf_counter() - t0) / iters)
+            state[config] = (step, p, s)
+    doc = {
         "metric": "pipeline_schedule_step_seconds",
         "n_devices": n_dev, "dp": dp, "pp": pp,
         "virtual_stages": virtual_stages, "n_micro": n_micro,
         "d_model": d_model, "n_layers": n_layers,
-        "schedules": {k: round(v, 5) for k, v in times.items()},
+        "schedules": {k: round(v, 5) for k, v in times.items()
+                      if k != "compute"},
         "bubble": {
             s: round(bubble_fraction(
                 s, pp, n_micro,
                 virtual_stages if s == "interleaved" else 1), 4)
             for s in schedules},
     }
+    if measure_compute:
+        t_c = times["compute"]
+        doc["compute_step_s"] = round(t_c, 5)
+        # measured vs analytic drift per schedule: remat recompute and
+        # collective latency the tick model cannot see land here
+        doc["bubble_measured"] = {
+            s: round(max(0.0, 1.0 - t_c / times[s]), 4)
+            for s in schedules if times[s] > 0}
+    return doc
 
 
 def main() -> int:

@@ -43,7 +43,12 @@ MATCHES the schedule's tick-count model
 (``horovod_tpu.parallel.pipeline.bubble_fraction``) — a plan/bubble
 pair that disagrees means the child measured one layout while
 reporting another. ``dp * pp`` must equal ``n_chips``. A doc without
-a plan (pp=1 run) passes with a note.
+a plan (pp=1 run) passes with a note.  When the doc also carries a
+MEASURED bubble (``bubble_measured``, from the pp=1 compute-only
+attribution baseline — ISSUE 12) it is range-checked and printed next
+to the analytic value with their drift, so analytic-vs-measured
+divergence is visible per round without being a gate (remat recompute
+and collective latency legitimately live in the gap).
 
 ``--trajectory ARTIFACT [--tolerance T]`` is the within-window drift
 gate (ISSUE 7): the bench doc now records ``step_time_series`` — every
@@ -292,6 +297,17 @@ def check_pipeline_plan(doc: dict):
         return (f"recorded bubble_fraction {bubble} disagrees with the "
                 f"analytic value {expect:.4f} for {plan} — the child "
                 "measured one layout while reporting another")
+    measured = doc.get("bubble_measured")
+    if measured is not None:
+        # the MEASURED bubble (compute-only attribution) is judged for
+        # plausibility only — drift vs the analytic value is expected
+        # (remat recompute, collective latency) and PRINTED, not gated
+        try:
+            measured = float(measured)
+        except (TypeError, ValueError):
+            return f"bubble_measured is not a number: {measured!r}"
+        if not (0.0 <= measured < 1.0):
+            return f"bubble_measured {measured} outside [0, 1)"
     return None
 
 
@@ -310,10 +326,23 @@ def pipeline_main(argv) -> int:
         print(f"pipeline gate: {path} carries no parallel_plan "
               "(pp=1 run); nothing to judge")
     else:
+        measured = doc.get("bubble_measured")
+        analytic = doc["bubble_fraction"]
+        # analytic AND measured, plus their drift, every round: the
+        # analytic value is the tick model, the measured one is what
+        # the devices actually did (remat + comm land in the gap)
+        if measured is not None:
+            detail = (f" bubble_analytic={analytic} "
+                      f"bubble_measured={measured} "
+                      f"drift={round(float(measured) - float(analytic), 4)}")
+        else:
+            detail = (f" bubble_analytic={analytic} "
+                      "bubble_measured=n/a (no compute-only baseline "
+                      "in this artifact)")
         print(f"pipeline gate OK for {path}: dp{plan['dp']} x "
               f"pp{plan['pp']} {plan['schedule']} "
               f"m{plan['n_microbatches']} v{plan.get('virtual_stages', 1)}"
-              f" bubble={doc['bubble_fraction']}")
+              + detail)
     return 0
 
 

@@ -1,0 +1,237 @@
+"""The wired remediations: what a fired policy actually does.
+
+Two shapes (docs/OBSERVABILITY.md "Autopilot"):
+
+* **Driver actions** (``drain_and_replace``, ``commit_restart``)
+  travel worker→driver as a JSON request PUT into the KV ``action/``
+  scope — relay-routed up the same tree as drain notices
+  (:mod:`horovod_tpu.runner.kv_relay`), consumed by the elastic
+  driver's poll loop (``runner/elastic/driver.py``), which plans the
+  target worker out of the world through the PR-10 drain plumbing: the
+  exit is DRAINED, never FAILURE, never blocklist evidence.
+  ``drain_and_replace`` reserves the sick host for the drain cooldown
+  (the replacement lands elsewhere when capacity exists);
+  ``commit_restart`` leaves the host admitted so the planned restart
+  respawns in place immediately — the drain-stamped world doc already
+  guarantees the doomed worker's final durable commit is flushed
+  before it exits (``elastic.run``'s preemption_drain branch).
+* **Local actions** (``freeze_alert``, ``retune``) act in-process:
+  ``freeze_alert`` names the offending function loudly and adds it to
+  the frozen set (``hvd_autopilot_frozen_functions``); ``retune``
+  invalidates the persistent autotune plan cache
+  (:func:`horovod_tpu.train.autotune.invalidate_plan_cache`) and runs
+  any registered re-tune hooks in the background, so the next plan
+  lookup re-searches against the CURRENT topology.
+
+Dispatch always happens on a short-lived daemon thread: the decision
+itself is made under the anomaly engine's lock, and a KV round-trip
+(or a slow shared filesystem) must never stall detection.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Set
+
+from horovod_tpu.autopilot.policy import Policy
+
+_lock = threading.Lock()
+_seq = 0
+_frozen: Set[str] = set()
+_retune_hooks: List[Callable[[], None]] = []
+
+
+def dispatch(policy: Policy, finding: dict, decision: dict) -> None:
+    """Run the policy's remediation asynchronously (never raises)."""
+    t = threading.Thread(target=_run, args=(policy, finding, decision),
+                         name=f"hvd-tpu-autopilot-{policy.action}",
+                         daemon=True)
+    t.start()
+
+
+def _run(policy: Policy, finding: dict, decision: dict) -> None:
+    try:
+        if policy.action == "drain_and_replace":
+            _request_driver_action("drain", int(finding["rank"]),
+                                   policy, decision)
+        elif policy.action == "commit_restart":
+            _request_driver_action("restart", _own_rank(),
+                                   policy, decision)
+        elif policy.action == "freeze_alert":
+            freeze(str(finding.get("function", "unknown")), policy,
+                   finding)
+        elif policy.action == "retune":
+            retune(policy, finding)
+    except Exception:
+        try:
+            from horovod_tpu.common.logging import get_logger
+            get_logger().warning("autopilot: %s remediation failed",
+                                 policy.action, exc_info=True)
+        except Exception:
+            pass
+
+
+def _own_rank() -> int:
+    v = os.environ.get("HOROVOD_RANK", os.environ.get("HVD_TPU_RANK",
+                                                      "0"))
+    try:
+        return int(v)
+    except ValueError:
+        return 0
+
+
+def _flight(kind: str, **fields) -> None:
+    try:
+        from horovod_tpu.diagnostics.flight_recorder import record_event
+        record_event(kind, **fields)
+    except Exception:
+        pass
+
+
+# -- driver actions (the KV ``action/`` scope) --------------------------------
+def _request_driver_action(kind: str, target_rank: int, policy: Policy,
+                           decision: dict) -> bool:
+    """PUT the action request at the elastic driver's KV, relay-routed.
+    Returns False (with the evidence recorded) when no driver manages
+    this job — a standalone run's decision is still a first-class audit
+    artifact, it just has nobody to drain for it."""
+    global _seq
+    from horovod_tpu.runner import kv_relay
+    try:
+        endpoint = kv_relay.elastic_kv_endpoint()
+    except ValueError as e:
+        from horovod_tpu.common.logging import get_logger
+        get_logger().warning(
+            "autopilot: %s; %s for rank %d dropped", e, kind,
+            target_rank)
+        return False
+    if endpoint is None:
+        from horovod_tpu.common.logging import get_logger
+        get_logger().warning(
+            "autopilot: %s for rank %d has nowhere to go: no elastic "
+            "driver KV (HVD_ELASTIC_KV)", kind, target_rank)
+        _flight("autopilot_action_unroutable", action=kind,
+                target_rank=target_rank, policy=policy.name)
+        return False
+    addr, port_i = endpoint
+    with _lock:
+        _seq += 1
+        seq = _seq
+    doc = json.dumps({
+        "action": kind,
+        "rank": int(target_rank),
+        "policy": policy.name,
+        "finding": decision.get("finding"),
+        "source": "autopilot",
+        "from_rank": _own_rank(),
+        "generation": int(os.environ.get("HVD_ELASTIC_GENERATION", "0")),
+        "at": time.time()}).encode()
+    kv_relay.client(addr, port_i).put(
+        "action", f"{_own_rank()}-{seq}", doc, timeout=5.0,
+        site="autopilot.action")
+    _flight("autopilot_action_published", action=kind,
+            target_rank=target_rank, policy=policy.name)
+    return True
+
+
+# -- local actions ------------------------------------------------------------
+def freeze(function: str, policy: Optional[Policy] = None,
+           finding: Optional[dict] = None) -> None:
+    """Repeated recompile storms on one function: name it LOUDLY and
+    add it to the frozen set.  The alert is the remediation — shape
+    drift is a code bug only the owner can fix; what the autopilot can
+    do is make sure the function's NAME reaches the operator through
+    every channel instead of dying as compiler mush."""
+    with _lock:
+        _frozen.add(function)
+        n = len(_frozen)
+    try:
+        from horovod_tpu.metrics.registry import default_registry
+        default_registry().gauge(
+            "hvd_autopilot_frozen_functions",
+            help="functions frozen by the recompile-storm policy"
+        ).set(float(n))
+    except Exception:
+        pass
+    _flight("autopilot_freeze", function=function,
+            policy=policy.name if policy else None,
+            compiles=(finding or {}).get("compiles"))
+    try:
+        from horovod_tpu.common.logging import get_logger
+        get_logger().error(
+            "autopilot: function %r is in a recompile storm (%s "
+            "compiles) — its input shapes/dtypes are drifting every "
+            "step; pin them (pad the ragged batch, hash-check traced "
+            "python scalars).  See docs/TROUBLESHOOTING.md.",
+            function, (finding or {}).get("compiles", "?"))
+    except Exception:
+        pass
+
+
+def frozen_functions() -> Set[str]:
+    with _lock:
+        return set(_frozen)
+
+
+def register_retune_hook(fn: Callable[[], None]) -> None:
+    """Training loops that hold a live autotuned step register a zero-
+    arg callable here; the ``retune`` remediation runs every hook (in
+    the background) after invalidating the plan cache."""
+    with _lock:
+        _retune_hooks.append(fn)
+
+
+def retune(policy: Optional[Policy] = None,
+           finding: Optional[dict] = None) -> int:
+    """Topology/world change: drop every persisted autotune plan (the
+    tuned plans encode the OLD world's measured tradeoffs) and kick the
+    registered re-tune hooks.  Returns how many cache entries were
+    invalidated."""
+    removed = 0
+    try:
+        from horovod_tpu.train.autotune import invalidate_plan_cache
+        removed = invalidate_plan_cache()
+    except Exception:
+        try:
+            from horovod_tpu.common.logging import get_logger
+            get_logger().warning("autopilot: plan-cache invalidation "
+                                 "failed", exc_info=True)
+        except Exception:
+            pass
+    with _lock:
+        hooks = list(_retune_hooks)
+    for fn in hooks:
+        try:
+            fn()
+        except Exception:
+            try:
+                from horovod_tpu.common.logging import get_logger
+                get_logger().warning("autopilot: retune hook %r failed",
+                                     fn, exc_info=True)
+            except Exception:
+                pass
+    _flight("autopilot_retune", policy=policy.name if policy else None,
+            invalidated=removed, hooks=len(hooks),
+            old_size=(finding or {}).get("old_size"),
+            new_size=(finding or {}).get("new_size"))
+    try:
+        from horovod_tpu.common.logging import get_logger
+        get_logger().warning(
+            "autopilot: topology change — invalidated %d cached "
+            "autotune plan(s), ran %d retune hook(s)", removed,
+            len(hooks))
+    except Exception:
+        pass
+    return removed
+
+
+def reset() -> None:
+    """Tests: forget frozen functions, hooks, and the action sequence."""
+    global _seq
+    with _lock:
+        _frozen.clear()
+        _retune_hooks.clear()
+        _seq = 0

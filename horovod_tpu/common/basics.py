@@ -251,6 +251,13 @@ def init(ranks: Optional[Sequence[int]] = None,
             compile_watch.ensure_installed()
         except Exception:
             pass
+        # autopilot policy engine (docs/OBSERVABILITY.md "Autopilot"):
+        # armed here so a typo'd HVD_TPU_AUTOPILOT_POLICY fails the job
+        # LOUDLY at init — the same contract as a typo'd chaos fault
+        # plan — instead of running policy-free; no-op when
+        # HVD_TPU_AUTOPILOT=off
+        from horovod_tpu import autopilot as _autopilot
+        _autopilot.ensure_engine()
         _ep = _remesh.current()
         if _ep is not None and not _ep.finished:
             _ep.add_phase("rebuild", _time.perf_counter() - _t_rebuild)

@@ -291,6 +291,16 @@ def write_autopsy(out_dir: Optional[str] = None, reason: str = "",
         return recent_findings()
 
     anomalies = step(_anomalies) or []
+
+    def _actions():
+        # the autopilot decision trail (docs/OBSERVABILITY.md
+        # "Autopilot"): a job that remediated itself — or decided not
+        # to — and then died ships the evidence of what it tried,
+        # gate inputs included
+        from horovod_tpu.autopilot import recent_decisions
+        return recent_decisions()
+
+    actions = step(_actions) or []
     step(lambda: _write_json(
         os.path.join(bundle, f"summary_rank{rank}.json"), {
         "reason": reason,
@@ -298,6 +308,7 @@ def write_autopsy(out_dir: Optional[str] = None, reason: str = "",
         "written_at": time.time(),
         "suspects": suspects,
         "anomalies": anomalies,
+        "actions": actions,
         "profiles": profiles,
         "peers_fetched": fetched,
         "peers_unreachable": unreachable,
@@ -312,6 +323,12 @@ def write_autopsy(out_dir: Optional[str] = None, reason: str = "",
             "autopsy: %d anomaly finding(s) preceded this bundle; last: "
             "%s at step %s", len(anomalies), last.get("kind"),
             last.get("step"))
+    if actions:
+        last = actions[-1]
+        get_logger().error(
+            "autopsy: %d autopilot decision(s) preceded this bundle; "
+            "last: %s %s (%s)", len(actions), last.get("policy"),
+            last.get("outcome"), last.get("action"))
     if suspects:
         top = suspects[0]
         get_logger().error(

@@ -147,23 +147,22 @@ class PreemptionWatcher:
         get_logger().warning(
             "preemption notice (%s): publishing drain for rank %s on %s",
             source, rank, host)
-        kv = os.environ.get("HVD_ELASTIC_KV", "")
-        if not kv:
+        from horovod_tpu.runner import kv_relay
+        try:
+            endpoint = kv_relay.elastic_kv_endpoint()
+        except ValueError as e:
+            # a config bug, not a transient: retrying cannot help, and
+            # this must not die as a debug-level line in the poll loop
+            get_logger().warning(
+                "drain notice has nowhere to go: %s — this process "
+                "will be lost reactively", e)
+            return False
+        if endpoint is None:
             get_logger().warning(
                 "drain notice has nowhere to go: no elastic driver KV "
                 "(HVD_ELASTIC_KV) — this process will be lost reactively")
             return False
-        addr, _, port = kv.rpartition(":")
-        try:
-            port_i = int(port)
-        except ValueError:
-            # a config bug, not a transient: retrying cannot help, and
-            # this must not die as a debug-level line in the poll loop
-            get_logger().warning(
-                "drain notice has nowhere to go: malformed "
-                "HVD_ELASTIC_KV %r — this process will be lost "
-                "reactively", kv)
-            return False
+        addr, port_i = endpoint
         notice = json.dumps({
             "rank": int(rank), "host": host, "source": source,
             # metadata maintenance dooms the whole HOST; a chaos or

@@ -189,6 +189,21 @@ def mark_recovered(new_size: Optional[int] = None,
     if generation is not None:
         ep.generation = generation
     ep.mark_recovered()
+    if new_size is not None and ep.old_size is not None \
+            and new_size != ep.old_size:
+        # the world genuinely changed shape: surface it as an external
+        # finding so the autopilot's topology policy can invalidate the
+        # plan cache + re-tune (docs/OBSERVABILITY.md "Autopilot").  A
+        # same-size recovery (replacement respawned) keeps the cached
+        # plans — they are keyed by the world fingerprint and still
+        # describe this topology.
+        try:
+            from horovod_tpu.metrics.anomaly import report_finding
+            report_finding("world_changed", old_size=ep.old_size,
+                           new_size=new_size, generation=generation,
+                           trigger=ep.trigger)
+        except Exception:
+            pass
 
 
 def note_step_end(step: Optional[int] = None) -> None:

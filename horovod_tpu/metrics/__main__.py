@@ -8,6 +8,9 @@
 * ``history`` — tabular dump of the persisted step time-series
   (``HVD_TPU_OBS_DIR`` JSONL, docs/OBSERVABILITY.md "Step time-series
   history"); plot-free by design — pipe into your tool of choice.
+  ``--remesh`` renders the re-mesh phase table, ``--actions`` the
+  autopilot decision audit trail ("my job re-meshed itself — why?"
+  starts here, docs/TROUBLESHOOTING.md).
 
 Both are stdlib-only, like everything else in the metrics plane.
 """
@@ -137,6 +140,22 @@ def render_top(series: Dict[str, float], source: str) -> str:
         kinds = ", ".join(f"{k.split('=')[1].strip(chr(34))}×{int(v)}"
                           for k, v in sorted(anomalies.items()))
         lines.append(f"ANOMALIES       : {kinds}")
+    # autopilot decisions (docs/OBSERVABILITY.md "Autopilot"): the
+    # per-policy/outcome counters plus the mode, one line — the full
+    # audit trail is `history --actions`
+    decisions = _labeled(series, "hvd_autopilot_decisions_total")
+    mode_v = series.get("hvd_autopilot_mode")
+    if decisions or mode_v is not None:
+        mode_name = {0: "off", 1: "observe", 2: "act"}.get(
+            int(mode_v) if mode_v is not None else 1, "?")
+        cells = []
+        for labels, v in sorted(decisions.items()):
+            parts = dict(p.split("=", 1) for p in labels.split(","))
+            cells.append(
+                f"{parts.get('policy', '?').strip(chr(34))} "
+                f"{parts.get('outcome', '?').strip(chr(34))}×{int(v)}")
+        lines.append(f"AUTOPILOT [{mode_name}]: "
+                     + (", ".join(cells) if cells else "no decisions"))
     per_rank = _labeled(series, "hvd_fleet_rank_step_time_seconds")
     if per_rank:
         lines.append("per-rank windowed step time:")
@@ -209,7 +228,57 @@ def render_remesh_table(points) -> str:
     return "\n".join(lines)
 
 
+def render_actions_table(decisions) -> str:
+    """The autopilot decision audit table (docs/OBSERVABILITY.md
+    "Autopilot"): one row per recorded decision — fired, dry-run, or
+    suppressed — with the gate input that mattered."""
+    head = (f"{'ts':<19} {'rank':>4} {'policy':<20} {'action':<18} "
+            f"{'finding':<22} {'outcome':<10} {'reason/gate'}")
+    lines = [head]
+    for d in decisions:
+        ts = time.strftime("%Y-%m-%d %H:%M:%S",
+                           time.localtime(d.get("ts", 0)))
+        gate = d.get("gate") or {}
+        detail = d.get("reason", "")
+        extras = []
+        if d.get("target_rank") is not None:
+            extras.append(f"target_rank={d['target_rank']}")
+        if d.get("key") is not None:
+            extras.append(f"key={d['key']}")
+        for k in ("remesh_p50_s", "projected_loss_s", "margin_frac",
+                  "cooldown_remaining_s", "actions_in_window"):
+            if gate.get(k) is not None:
+                extras.append(f"{k}={gate[k]}")
+        if extras:
+            detail = (detail + " " if detail else "") + " ".join(extras)
+        lines.append(
+            f"{ts:<19} {str(d.get('rank', '-')):>4} "
+            f"{str(d.get('policy', '-')):<20} "
+            f"{str(d.get('action', '-')):<18} "
+            f"{str(d.get('finding', '-')):<22} "
+            f"{str(d.get('outcome', '-')):<10} {detail}")
+    lines.append(f"-- {len(decisions)} decision(s)")
+    return "\n".join(lines)
+
+
 def cmd_history(args: argparse.Namespace) -> int:
+    if getattr(args, "actions", False):
+        # the autopilot action log rides its own JSONL files
+        # (actions_rank<r>.jsonl) in the same store
+        decisions = read_series(args.dir, rank=args.rank,
+                                basename="actions")
+        if args.last:
+            decisions = decisions[-args.last:]
+        if not decisions:
+            print(f"no autopilot decisions recorded under {args.dir}",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            for d in decisions:
+                print(json.dumps(d))
+            return 0
+        print(render_actions_table(decisions))
+        return 0
     points = read_series(args.dir, rank=args.rank)
     if getattr(args, "remesh", False):
         episodes = [p for p in points if isinstance(p.get("remesh"), dict)]
@@ -273,6 +342,11 @@ def main(argv=None) -> int:
     h.add_argument("--remesh", action="store_true",
                    help="render the re-mesh phase table instead of the "
                         "step series (one row per recovery episode)")
+    h.add_argument("--actions", action="store_true",
+                   help="render the autopilot decision audit trail "
+                        "(actions_rank<r>.jsonl) instead of the step "
+                        "series — one row per fired/dry-run/suppressed "
+                        "decision")
     h.set_defaults(fn=cmd_history)
     args = p.parse_args(argv)
     try:

@@ -274,6 +274,16 @@ class AnomalyEngine:
         except Exception:
             pass
         try:
+            # autopilot seam (docs/OBSERVABILITY.md "Autopilot"): every
+            # finding — native detectors and report_finding() externals
+            # alike — is offered to the policy engine, which records a
+            # decision (fired / dry-run / suppressed) per matching
+            # policy; a cheap None check when HVD_TPU_AUTOPILOT=off
+            from horovod_tpu.autopilot import on_finding
+            on_finding(finding)
+        except Exception:
+            pass
+        try:
             from horovod_tpu.common.logging import get_logger
             get_logger().warning("anomaly: %s %s", kind,
                                  {k: v for k, v in finding.items()

@@ -76,6 +76,13 @@ def on_anomaly(finding: dict) -> Optional[dict]:
     K steps and stamp the planned path into the finding (the engine
     stores the same dict, so the path shows up in
     ``recent_findings()`` / the autopsy summary / the flight event)."""
+    if finding.get("kind") == "world_changed":
+        # a control-plane event, not a degradation: the re-mesh
+        # timeline already measures recovery, a trace of the freshly
+        # recompiling world would be pure noise, and burning the
+        # rate-limited capture here would starve a REAL post-re-mesh
+        # anomaly of its evidence
+        return None
     from horovod_tpu.profiling.manager import on_anomaly_enabled
     if not on_anomaly_enabled():
         return None

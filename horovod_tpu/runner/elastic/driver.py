@@ -75,6 +75,94 @@ def drain_cooldown_s() -> float:
     return max(0.0, env_float("DRAIN_COOLDOWN_S", 60.0))
 
 
+def restart_cooldown_s() -> float:
+    """``HVD_TPU_RESTART_COOLDOWN_S``: reservation window for an
+    autopilot ``restart`` action (the hbm_growth planned restart,
+    docs/OBSERVABILITY.md "Autopilot").  Unlike a preemption drain the
+    host is HEALTHY — the restarted worker should respawn onto it as
+    soon as the old process has exited and released its chip, so the
+    default is seconds, not the drain cooldown's minute."""
+    from horovod_tpu.common.config import env_float
+    return max(0.0, env_float("RESTART_COOLDOWN_S", 5.0))
+
+
+class _GenRuntime:
+    """Mutable bookkeeping of ONE running generation — the poll loop's
+    former closure state, promoted to an object so the drain-notice and
+    autopilot-action handlers can be driver METHODS instead of blocks
+    inlined in ``_run_generation``'s poll loop (PR 10's documented
+    debt, paid down as the autopilot action channel landed in the same
+    loop)."""
+
+    def __init__(self, slots, gen: int, coord_addr: str,
+                 coord_port: int) -> None:
+        self.failure = threading.Event()
+        self.teardown = threading.Event()  # restart path: kill survivors
+        self.worker_lost = threading.Event()  # crash: in-place shrink 1st
+        self.fail_lock = threading.Lock()
+        # per-worker bookkeeping keyed by (spawn_generation, rank): ranks
+        # are reused across in-generation worlds (shrink renumbers,
+        # growth appends), so the rank alone is not a stable identity
+        self.results: Dict[tuple, str] = {}
+        self.lost_keys: set = set()
+        # keys whose exit was classified as the ORIGINATING failure (not
+        # a casualty of someone else's crash): only these charge their
+        # host's crash budget — a cascade must not blocklist every host
+        # whose healthy workers died from the collective error
+        self.originators: set = set()
+        self.host_crashes: Dict[str, int] = {}
+        # workers a capacity-loss shrink dropped from the world: their
+        # exit (the not-in-new-world path) is EXPECTED, not a crash
+        self.expected_exits: set = set()
+        # workers a preemption drain (or an autopilot action) planned
+        # out of the world: EXPECTED exits recorded DRAINED — never
+        # FAILURE, never a host_crashes charge, never blocklist evidence
+        self.drained_exits: set = set()
+        # drain-notice / action-request tokens already acted on; tokens
+        # are (scope, key, payload) so the two KV scopes cannot collide
+        self.handled_tokens: set = set()
+        # tokens whose planned world was not viable yet (min_np, last
+        # host, completion race): token -> (next_try, delay).  The world
+        # can BECOME viable — discovery adds a host — so the request is
+        # retried with backoff instead of burned.
+        self.deferred_tokens: dict = {}
+        self.threads: Dict[tuple, threading.Thread] = {}
+        self.slot_by_key: Dict[tuple, object] = {}
+        self.current_rank: Dict[tuple, int] = {}  # rank in CURRENT world
+        self.slots = slots
+        self.np = len(slots)
+        # the job is DONE when every worker of the generation it started
+        # with succeeds (minus crash-shrunken ones) — growth-spawned
+        # stragglers whose world the survivors never joined (completion
+        # raced the scale-up) must not hold the driver hostage
+        self.essential_keys: List[tuple] = [(gen, s.rank) for s in slots]
+        self.essential_gen = gen
+        # the generation of the most recently PUBLISHED world — what the
+        # workers' HVD_ELASTIC_GENERATION reads after they adopt it, and
+        # therefore what their drain notices / action requests carry.
+        # Tracked separately from essential_gen because in-place GROWTH
+        # publishes a new generation (rank numbering unchanged — the
+        # stable-assignment check guarantees it) without touching the
+        # essential set.
+        self.world_gen = gen
+        # the generation of the last publish that CHANGED the rank
+        # numbering: growth keeps numbering stable, so notices stamped
+        # anywhere in [numbering_gen, world_gen] still name a valid
+        # rank; in-place shrink recoveries compact ranks and bump it
+        self.numbering_gen = gen
+        self.coord_addr = coord_addr
+        self.coord_port = coord_port
+        self.spawn = None  # bound by _run_generation
+
+
+#: autopilot action kinds the driver honors, mapped to whether the
+#: target's host capacity is reserved for the full drain cooldown
+#: (True: the host is suspect — place the replacement elsewhere) or
+#: only the short restart window (False: the host is healthy, the
+#: replacement should respawn onto it as soon as the chip is free)
+_ACTION_KINDS = {"drain": True, "restart": False}
+
+
 class ElasticDriver:
     def __init__(self, discovery: HostDiscovery, command: List[str],
                  min_np: int = 1, max_np: Optional[int] = None,
@@ -359,7 +447,383 @@ class ElasticDriver:
         # held in the OLD numbering — left behind, an unhandled notice
         # would match whichever innocent worker inherits that rank
         self._kv.clear("drain")
+        # and so are autopilot action requests, for the same reason: the
+        # rank an action targets is only meaningful in the numbering
+        # whose finding fired it
+        self._kv.clear("action")
         return new_slots, gen, replacements, coord_addr, coord_port
+
+    # -- drain notices & autopilot actions (poll-loop handlers) -------------
+    def _scan_scope(self, g: _GenRuntime, scope: str, label: str):
+        """THE one validation core for worker→driver request scopes
+        (drain notices and autopilot actions share it — a fix to the
+        gating below must never apply to one and silently diverge the
+        other).  For each entry: skip already-handled tokens and those
+        inside their no-viable-world backoff window; burn (never retry)
+        malformed JSON; require the stamped generation inside
+        ``[numbering_gen, world_gen]`` — published under another rank
+        NUMBERING, matching it against the current one could doom an
+        innocent worker, while growth publishes bump the generation but
+        keep the numbering (stable-assignment check) so anything since
+        the last RENUMBERING publish is still valid; out-of-window
+        entries are left unhandled (not burned): the next re-mesh
+        clears the scope, worst case the worker dies reactively.
+        Finally resolve the named rank to a live essential worker; a
+        miss (already gone or renumbered) burns the token as stale.
+        Returns ``[(token, doc, origin key, named rank)]``."""
+        import json as _json
+        out = []
+        for key, raw in self._kv.scope(scope).items():
+            token = (scope, key, raw)
+            if token in g.handled_tokens:
+                continue
+            deferred = g.deferred_tokens.get(token)
+            if deferred and deferred[0] > time.monotonic():
+                continue  # no-viable-world backoff window
+            try:
+                doc = _json.loads(raw)
+                if not isinstance(doc, dict):
+                    raise TypeError(f"{label} is not an object")
+                nrank = int(doc.get("rank"))
+                ngen = int(doc.get("generation", -1))
+            except (ValueError, TypeError):
+                g.handled_tokens.add(token)  # never retried
+                get_logger().warning(
+                    "ignoring malformed %s %r", label, key)
+                continue
+            if not g.numbering_gen <= ngen <= g.world_gen:
+                continue  # another numbering (docstring above)
+            origin = next(
+                (k for k in g.essential_keys
+                 if g.current_rank.get(k) == nrank
+                 and g.results.get(k) is None
+                 and g.threads[k].is_alive()), None)
+            if origin is None:
+                g.handled_tokens.add(token)
+                continue  # already gone or renumbered: stale
+            out.append((token, doc, origin, nrank))
+        return out
+
+    def _scan_drain_notices(self, g: _GenRuntime):
+        """Collect actionable drain notices from the KV ``drain`` scope
+        (docs/ELASTIC.md "Proactive drain & preemption"): a doomed
+        worker's PreemptionWatcher published ``drain/<rank>``; plan its
+        world out AROUND it instead of waiting for the death +
+        transport-timeout detection the reactive path pays.  Returns
+        ``(doomed keys, notice meta, tokens)``."""
+        doomed: set = set()
+        notice_meta: list = []
+        tokens: list = []
+        for token, notice, origin, nrank in self._scan_scope(
+                g, "drain", "drain notice"):
+            tokens.append(token)
+            if notice.get("scope") == "host":
+                # host-wide maintenance dooms every worker there
+                h = g.slot_by_key[origin].hostname
+                doomed |= {k for k in g.essential_keys
+                           if g.slot_by_key[k].hostname == h
+                           and g.results.get(k) is None
+                           and g.threads[k].is_alive()}
+            else:
+                doomed.add(origin)
+            notice_meta.append(
+                {"rank": nrank,
+                 "host": g.slot_by_key[origin].hostname,
+                 "source": notice.get("source", "unknown")})
+        return doomed, notice_meta, tokens
+
+    def _scan_action_requests(self, g: _GenRuntime):
+        """Collect actionable autopilot requests from the KV ``action``
+        scope (ISSUE 12; docs/OBSERVABILITY.md "Autopilot"): a policy
+        engine's fired remediation asked the driver to plan a worker
+        out of the world — ``drain`` (sick host: reserve its capacity
+        for the full cooldown) or ``restart`` (healthy host: final
+        durable commit, then respawn in place after the short restart
+        window).  Validation is :meth:`_scan_scope`, shared with the
+        drain notices; an unknown action kind is burned here.  Returns
+        ``{kind: (doomed keys, meta, tokens)}``."""
+        groups = {kind: (set(), [], []) for kind in _ACTION_KINDS}
+        for token, req, origin, nrank in self._scan_scope(
+                g, "action", "autopilot action"):
+            kind = req.get("action")
+            if kind not in _ACTION_KINDS:
+                g.handled_tokens.add(token)
+                get_logger().warning(
+                    "ignoring autopilot action %r with unknown kind %r",
+                    token[1], kind)
+                continue
+            doomed, meta, tokens = groups[kind]
+            doomed.add(origin)
+            tokens.append(token)
+            meta.append({"rank": nrank,
+                         "host": g.slot_by_key[origin].hostname,
+                         "source": "autopilot",
+                         "policy": req.get("policy"),
+                         "action": kind})
+        return groups
+
+    def _plan_world_out(self, g: _GenRuntime, doomed: set,
+                        notice_meta: list, tokens: list,
+                        cooldown: float, event_kind: str) -> bool:
+        """Plan the current world around ``doomed`` (shared by drain
+        notices and autopilot actions): reserve the doomed capacity,
+        mark the exits DRAINED, publish the survivor world, spawn
+        replacements onto free capacity — or, when no viable world
+        exists, REVERT every piece of that bookkeeping and retry the
+        request with backoff (reactive recovery covers an actual
+        death).  Returns True when this tick is consumed (the caller
+        ``continue``s), False when the request was deferred untouched
+        (workers still registering their elastic listeners)."""
+        # the planned path needs every involved worker able to APPLY a
+        # world doc (elastic listener registered, i.e. it has committed
+        # once).  A request racing the job's first commits — a
+        # preemption can announce itself during hvd.init — is DEFERRED
+        # to a later tick, not burned on a generation restart.
+        notify = {str(r) for r in self._kv.scope("notify")}
+        involved = set(doomed) | {
+            k for k in g.essential_keys
+            if k not in doomed and g.results.get(k) is None
+            and g.threads[k].is_alive()}
+        if any(str(g.current_rank[k]) not in notify for k in involved):
+            return False
+        g.handled_tokens.update(tokens)
+        by_host: Dict[str, int] = {}
+        for k in doomed:
+            h = g.slot_by_key[k].hostname
+            by_host[h] = by_host.get(h, 0) + 1
+        for h, n in by_host.items():
+            # reserve the doomed capacity so replacement placement
+            # cannot land back on it before the cooldown re-admits it
+            # (a drain's host announced its own death; a restart's is
+            # healthy and re-admits within seconds)
+            self._hosts.drain(h, n, cooldown)
+        with g.fail_lock:
+            # BEFORE the publish (same reason as the shrink path): the
+            # doomed worker can read the pushed doc and exit before
+            # this loop resumes, and that exit is DRAINED, never a
+            # crash
+            g.expected_exits.update(doomed)
+            g.drained_exits.update(doomed)
+        survivors = [k for k in g.essential_keys if k not in doomed]
+        from horovod_tpu.diagnostics.flight_recorder import record_event
+        record_event(
+            event_kind,
+            notices=notice_meta,
+            drained_ranks=sorted(g.current_rank[k] for k in doomed),
+            hosts=sorted(by_host), cooldown_s=cooldown)
+        get_logger().warning(
+            "%s %s: planning world around doomed rank(s) %s (hosts %s "
+            "reserved for %.0fs)", event_kind, notice_meta,
+            sorted(g.current_rank[k] for k in doomed),
+            sorted(by_host), cooldown)
+        recovered = self._try_inplace_recovery(
+            survivors, g.results, g.threads, g.slot_by_key,
+            g.current_rank, self._cap_np(), g.host_crashes,
+            charge_reset=False,
+            drain={"ranks": sorted(g.current_rank[k] for k in doomed),
+                   "hosts": sorted(by_host),
+                   "sources": sorted({m["source"]
+                                      for m in notice_meta})})
+        if recovered is None:
+            # no viable planned world (the doomed host was the last
+            # one, min_np would be violated, or a completion race): the
+            # request is ADVISORY — the worker has not died, and may
+            # never.  Tearing the generation down here would turn
+            # advance notice into a guaranteed restart the reactive
+            # path never pays, so revert the bookkeeping and fall back
+            # to reactive recovery instead.
+            with g.fail_lock:
+                g.expected_exits.difference_update(doomed)
+                g.drained_exits.difference_update(doomed)
+                # a doomed worker that exited DURING the failed
+                # planning attempt was classified an expected DRAINED
+                # exit, so run_slot never marked it lost — re-mark it
+                # here or no recovery would ever be planned for a
+                # genuinely dead worker and the generation would wedge
+                gone = [k for k in doomed
+                        if g.results.get(k) is not None]
+                if gone:
+                    g.lost_keys.update(gone)
+                    g.worker_lost.set()
+            for h, n in by_host.items():
+                self._hosts.undrain(h, n)
+            # un-burn the requests: the world can BECOME viable
+            # (discovery adds a host) before the doomed worker dies,
+            # and a drain watcher is latched after its one publish —
+            # without the retry the advance notice would be permanently
+            # lost.  Backoff bounds the replanning churn.
+            for t in tokens:
+                g.handled_tokens.discard(t)
+                delay = min(
+                    g.deferred_tokens.get(t, (0.0, 1.0))[1] * 2, 30.0)
+                g.deferred_tokens[t] = (time.monotonic() + delay, delay)
+            get_logger().warning(
+                "no viable planned world for %s %s; retrying with "
+                "backoff, reactive recovery covers an actual death",
+                event_kind, notice_meta)
+            return True
+        # rebind the coordinator BEFORE spawning: run_slot reads the
+        # runtime's coord fields at call time, and a replacement
+        # pointed at the dead world's port would never find the mesh
+        new_slots2, rec_gen, replacements, g.coord_addr, \
+            g.coord_port = recovered
+        for s in replacements:
+            g.spawn(s, rec_gen)
+        g.essential_keys = survivors + [
+            (rec_gen, s.rank) for s in replacements]
+        g.essential_gen = g.world_gen = g.numbering_gen = rec_gen
+        g.slots = new_slots2
+        g.np = len(new_slots2)
+        return True
+
+    def _poll_drain_notices(self, g: _GenRuntime) -> bool:
+        doomed, notice_meta, tokens = self._scan_drain_notices(g)
+        if not doomed:
+            return False
+        return self._plan_world_out(g, doomed, notice_meta, tokens,
+                                    drain_cooldown_s(),
+                                    "drain_notice_handled")
+
+    def _poll_action_requests(self, g: _GenRuntime) -> bool:
+        groups = self._scan_action_requests(g)
+        for kind, reserve_full in _ACTION_KINDS.items():
+            doomed, meta, tokens = groups[kind]
+            if not doomed:
+                continue
+            cooldown = drain_cooldown_s() if reserve_full \
+                else restart_cooldown_s()
+            if self._plan_world_out(g, doomed, meta, tokens, cooldown,
+                                    "autopilot_action_handled"):
+                return True
+        return False
+
+    def _recover_lost_workers(self, g: _GenRuntime) -> None:
+        """A worker crashed mid-generation: recover the world in place
+        (or set the failure flag for the generation-restart backstop).
+        Lets a correlated burst finish dying before planning: the other
+        ranks of a doomed host group are typically milliseconds behind
+        the first exit, and one settled re-mesh beats a cascade of
+        partial ones."""
+        time.sleep(loss_settle_s())
+        with g.fail_lock:
+            g.worker_lost.clear()
+            lost_now = set(g.lost_keys)
+            blamed = lost_now & g.originators
+            # this round handles exactly lost_now; clearing lets the
+            # NEXT crash classify as an originator again and keeps
+            # host_crashes from re-counting old losses (originators
+            # pruned alongside: keys are per-instance, a handled one
+            # can never recur)
+            g.lost_keys.clear()
+            g.originators -= lost_now
+            survivors = [k for k in g.essential_keys
+                         if k not in lost_now]
+        # only the originating FAILURE charges its host's crash budget;
+        # casualties are fallout, not evidence the host is bad (their
+        # replacement still respawns below)
+        for k in blamed:
+            h = g.slot_by_key[k].hostname
+            g.host_crashes[h] = g.host_crashes.get(h, 0) + 1
+        recovered = self._try_inplace_recovery(
+            survivors, g.results, g.threads, g.slot_by_key,
+            g.current_rank, g.np, g.host_crashes)
+        if recovered is None:
+            g.failure.set()  # not viable: generation-restart path
+            return
+        # rebind the coordinator BEFORE spawning (see _plan_world_out)
+        new_slots2, rec_gen, replacements, g.coord_addr, \
+            g.coord_port = recovered
+        for s in replacements:
+            g.spawn(s, rec_gen)
+        g.essential_keys = survivors + [
+            (rec_gen, s.rank) for s in replacements]
+        g.essential_gen = g.world_gen = g.numbering_gen = rec_gen
+        g.slots = new_slots2
+        g.np = len(new_slots2)
+
+    def _apply_membership_change(self, g: _GenRuntime) -> None:
+        """Discovery changed the host set mid-generation: shrink in
+        place (capacity loss), grow in place (new slots spawned into
+        the RUNNING generation), or set the teardown flag for a
+        generation restart when neither is safe."""
+        new_hosts = self._hosts.current_hosts()
+        new_np = self._cap_np()
+        old_hostnames = {s.hostname for s in g.slots}
+        still_there = old_hostnames.issubset(
+            {h.hostname for h in new_hosts})
+        if not still_there or new_np < g.np:
+            # capacity loss: keep the remaining workers IN PLACE when
+            # they can all apply a world doc (elastic state committed
+            # at least once); dropped workers exit via the
+            # not-in-new-world path at their next commit. Anything
+            # else — a finished essential, unregistered workers, too
+            # little capacity — takes the generation-restart path.
+            if any(g.results.get(k) is not None
+                   for k in g.essential_keys):
+                g.teardown.set()
+                return
+            # keep workers per host up to that host's NEW slot count
+            # (the downscaled host must actually lose workers) in
+            # current-rank order, capped at the new world size
+            new_caps = {h.hostname: h.slots for h in new_hosts}
+            alive = [k for k in g.essential_keys
+                     if g.threads[k].is_alive()]
+            kept, used = [], {}
+            for k in sorted(alive, key=lambda k: g.current_rank[k]):
+                h = g.slot_by_key[k].hostname
+                if len(kept) < new_np and \
+                        used.get(h, 0) < new_caps.get(h, 0):
+                    kept.append(k)
+                    used[h] = used.get(h, 0) + 1
+            dropped = [k for k in g.essential_keys if k not in kept]
+            with g.fail_lock:
+                # BEFORE the publish: a dropped worker can read the
+                # pushed doc and exit before this loop resumes, and
+                # that exit must not be classified as a crash
+                g.expected_exits.update(dropped)
+            recovered = self._try_inplace_recovery(
+                kept, g.results, g.threads, g.slot_by_key,
+                g.current_rank, new_np, g.host_crashes,
+                charge_reset=False)
+            if recovered is None:
+                g.teardown.set()
+                return
+            new_slots2, rec_gen, replacements, g.coord_addr, \
+                g.coord_port = recovered
+            for s in replacements:
+                g.spawn(s, rec_gen)
+            g.essential_keys = kept + [(rec_gen, s.rank)
+                                       for s in replacements]
+            g.essential_gen = g.world_gen = g.numbering_gen = rec_gen
+            g.slots = new_slots2
+            g.np = len(new_slots2)
+            return
+        if new_np <= g.np:
+            return  # capacity we are not using anyway
+        # GROWTH: stable assignment keeps existing ranks; spawn only
+        # the new slots, publish the new world for survivor resync
+        new_slots = get_host_assignments(new_hosts, new_np)
+        if not all(ns.rank == s.rank and ns.hostname == s.hostname
+                   for ns, s in zip(new_slots, g.slots)):
+            # assignment reshuffled existing ranks (host reordering):
+            # in-place resync would double-assign ranks — restart
+            get_logger().warning(
+                "growth reshuffled existing ranks; falling back to a "
+                "generation restart")
+            g.teardown.set()
+            return
+        g.coord_port = free_port()  # fresh rendezvous for the new world
+        gen = self._generation
+        self._generation += 1
+        get_logger().info(
+            "elastic generation %d (growth, in-place): np=%d->%d",
+            gen, g.np, new_np)
+        self._publish_world(gen, new_slots, g.coord_addr, g.coord_port)
+        g.world_gen = gen  # survivors adopt this gen; notices carry it
+        for s in new_slots[g.np:]:
+            g.spawn(s, gen)
+        g.slots = new_slots
+        g.np = new_np
 
     # -- one generation ------------------------------------------------------
     def _run_generation(self) -> str:
@@ -385,6 +849,10 @@ class ElasticDriver:
         # and the doomed HOST is already held out by its HostManager
         # drain reservation regardless
         self._kv.clear("drain")
+        # autopilot action requests die with their generation too: the
+        # rank a request targets is only meaningful in the world whose
+        # finding fired it
+        self._kv.clear("action")
         self._hosts_changed.clear()
         gen = self._generation
         self._generation += 1
@@ -392,34 +860,7 @@ class ElasticDriver:
                           [h.hostname for h in hosts])
         self._publish_world(gen, slots, coord_addr, coord_port)
 
-        failure = threading.Event()
-        teardown = threading.Event()  # restart path: kill survivors
-        worker_lost = threading.Event()  # crash: try in-place shrink first
-        fail_lock = threading.Lock()
-        # per-worker bookkeeping keyed by (spawn_generation, rank): ranks
-        # are reused across in-generation worlds (shrink renumbers, growth
-        # appends), so the rank alone is not a stable identity
-        results: Dict[tuple, str] = {}
-        lost_keys: set = set()
-        # keys whose exit was classified as the ORIGINATING failure (not
-        # a casualty of someone else's crash): only these charge their
-        # host's crash budget — a cascade must not blocklist every host
-        # whose healthy workers died from the collective error
-        originators: set = set()
-        host_crashes: Dict[str, int] = {}
-        # workers a capacity-loss shrink dropped from the world: their
-        # exit (the not-in-new-world path) is EXPECTED, not a crash
-        expected_exits: set = set()
-        # workers a preemption drain planned out of the world: EXPECTED
-        # exits recorded DRAINED — never FAILURE, never a host_crashes
-        # charge, never blocklist evidence
-        drained_exits: set = set()
-        handled_drains: set = set()  # drain-notice KV keys already acted on
-        # drain notices whose planned world was not viable yet (min_np,
-        # last host, completion race): token -> (next_try, delay).  The
-        # world can BECOME viable — discovery adds a host — so the
-        # notice is retried with backoff instead of burned.
-        deferred_drains: dict = {}
+        g = _GenRuntime(slots, gen, coord_addr, coord_port)
 
         def run_slot(slot, slot_gen):
             extra_env = {
@@ -435,7 +876,7 @@ class ElasticDriver:
                 # agent transport: ship the RAW worker command + env; the
                 # agent on slot.hostname execs it locally (no ssh wrap)
                 from horovod_tpu.runner.exec_run import build_worker_env
-                wenv = build_worker_env(slot, coord_addr, coord_port,
+                wenv = build_worker_env(slot, g.coord_addr, g.coord_port,
                                         self._env)
                 wenv.update(extra_env)
                 if self._preshared_secret is not None:
@@ -443,19 +884,19 @@ class ElasticDriver:
                     # trusted channel; keep it off the wire
                     wenv.pop("HVD_ELASTIC_SECRET", None)
                 rc = self._remote_exec(slot, self._command, wenv,
-                                       [failure, teardown])
+                                       [g.failure, g.teardown])
             else:
                 # local-vs-ssh dispatch shared with the static launcher so
                 # multi-host elastic jobs actually place workers remotely
                 cmd, env = slot_command(
-                    slot, self._command, coord_addr, coord_port, self._env,
-                    extra_env=extra_env)
+                    slot, self._command, g.coord_addr, g.coord_port,
+                    self._env, extra_env=extra_env)
                 rc = safe_execute(cmd, env=env, prefix=prefix,
-                                  events=[failure, teardown],
+                                  events=[g.failure, g.teardown],
                                   timestamp=self._timestamp_output)
             key = (slot_gen, slot.rank)
             if rc == 0:
-                results[key] = SUCCESS
+                g.results[key] = SUCCESS
                 self._registry.record(slot.rank, slot.hostname, SUCCESS)
                 return
             # Distinguish the ORIGINATING failure from its fallout:
@@ -466,408 +907,93 @@ class ElasticDriver:
             # the restart decision see one crash, not a cascade. A crash
             # does not fail the generation outright anymore: the main
             # loop first tries to recover the world in place.
-            with fail_lock:
-                torn_down = failure.is_set() or teardown.is_set()
-                expected = key in expected_exits
-                casualty = bool(lost_keys) and not torn_down \
+            with g.fail_lock:
+                torn_down = g.failure.is_set() or g.teardown.is_set()
+                expected = key in g.expected_exits
+                casualty = bool(g.lost_keys) and not torn_down \
                     and not expected
                 if not torn_down and not expected:
-                    lost_keys.add(key)
+                    g.lost_keys.add(key)
                     if not casualty:
-                        originators.add(key)
-                    worker_lost.set()
+                        g.originators.add(key)
+                    g.worker_lost.set()
                 # classification is atomic with the membership checks:
-                # the drain branch's no-viable-world revert edits these
+                # _plan_world_out's no-viable-world revert edits these
                 # sets under the same lock and must observe either a
                 # fully recorded exit or none at all
-                if key in drained_exits:
+                if key in g.drained_exits:
                     state = DRAINED
                 elif torn_down or casualty or expected:
                     state = TERMINATED
                 else:
                     state = FAILURE
-                results[key] = state
+                g.results[key] = state
             self._registry.record(slot.rank, slot.hostname, state)
-
-        threads: Dict[tuple, threading.Thread] = {}
-        slot_by_key: Dict[tuple, object] = {}
-        current_rank: Dict[tuple, int] = {}  # rank in the CURRENT world
 
         def spawn(slot, slot_gen):
             key = (slot_gen, slot.rank)
             t = threading.Thread(target=run_slot, args=(slot, slot_gen),
                                  daemon=True)
-            threads[key] = t
-            slot_by_key[key] = slot
-            current_rank[key] = slot.rank
+            g.threads[key] = t
+            g.slot_by_key[key] = slot
+            g.current_rank[key] = slot.rank
             t.start()
 
+        g.spawn = spawn
         for s in slots:
             spawn(s, gen)
-        # the job is DONE when every worker of the generation it started
-        # with succeeds (minus crash-shrunken ones) — growth-spawned
-        # stragglers whose world the survivors never joined (completion
-        # raced the scale-up) must not hold the driver hostage
-        essential_keys = [(gen, s.rank) for s in slots]
-        essential_gen = gen  # growth below reuses the name `gen`
-        # the generation of the most recently PUBLISHED world — what the
-        # workers' HVD_ELASTIC_GENERATION reads after they adopt it, and
-        # therefore what their drain notices carry.  Tracked separately
-        # from essential_gen because in-place GROWTH publishes a new
-        # generation (rank numbering unchanged — the stable-assignment
-        # check guarantees it) without touching the essential set.
-        world_gen = gen
-        # the generation of the last publish that CHANGED the rank
-        # numbering: growth keeps numbering stable, so drain notices
-        # stamped anywhere in [numbering_gen, world_gen] still name a
-        # valid rank; in-place shrink recoveries compact ranks and
-        # bump it
-        numbering_gen = gen
 
-        while any(t.is_alive() for t in threads.values()):
+        while any(t.is_alive() for t in g.threads.values()):
             time.sleep(0.25)
-            if not failure.is_set() and not teardown.is_set() and \
-                    all(results.get(k) == SUCCESS for k in essential_keys):
+            if not g.failure.is_set() and not g.teardown.is_set() and \
+                    all(g.results.get(k) == SUCCESS
+                        for k in g.essential_keys):
                 # survivors finished; kill growth stragglers still waiting
                 # for a rendezvous that will never complete
-                teardown.set()
+                g.teardown.set()
             # -- a worker crashed: recover the world in place --------------
-            if worker_lost.is_set() and not failure.is_set() and \
-                    not teardown.is_set():
-                # let a correlated burst finish dying before planning:
-                # the other ranks of a doomed host group are typically
-                # milliseconds behind the first exit, and one settled
-                # re-mesh beats a cascade of partial ones
-                time.sleep(loss_settle_s())
-                with fail_lock:
-                    worker_lost.clear()
-                    lost_now = set(lost_keys)
-                    blamed = lost_now & originators
-                    # this round handles exactly lost_now; clearing lets
-                    # the NEXT crash classify as an originator again and
-                    # keeps host_crashes from re-counting old losses
-                    # (originators pruned alongside: keys are
-                    # per-instance, a handled one can never recur)
-                    lost_keys.clear()
-                    originators -= lost_now
-                    survivors = [k for k in essential_keys
-                                 if k not in lost_now]
-                # only the originating FAILURE charges its host's crash
-                # budget; casualties are fallout, not evidence the host
-                # is bad (their replacement still respawns below)
-                for k in blamed:
-                    h = slot_by_key[k].hostname
-                    host_crashes[h] = host_crashes.get(h, 0) + 1
-                recovered = self._try_inplace_recovery(
-                    survivors, results, threads, slot_by_key,
-                    current_rank, np, host_crashes)
-                if recovered is None:
-                    failure.set()  # not viable: generation-restart path
-                else:
-                    # rebind the coordinator BEFORE spawning: run_slot
-                    # reads these closure variables at call time, and a
-                    # replacement pointed at the dead world's port would
-                    # never find the new mesh
-                    new_slots2, rec_gen, replacements, coord_addr, \
-                        coord_port = recovered
-                    for s in replacements:
-                        spawn(s, rec_gen)
-                    essential_keys = survivors + [
-                        (rec_gen, s.rank) for s in replacements]
-                    essential_gen = world_gen = numbering_gen = rec_gen
-                    slots = new_slots2
-                    np = len(new_slots2)
+            if g.worker_lost.is_set() and not g.failure.is_set() and \
+                    not g.teardown.is_set():
+                self._recover_lost_workers(g)
                 continue
-            # -- a preemption/maintenance drain notice arrived --------------
-            # (docs/ELASTIC.md "Proactive drain & preemption"): a doomed
-            # worker's PreemptionWatcher published drain/<rank> through
-            # the KV; plan its world out AROUND it instead of waiting for
-            # the death + transport-timeout detection the reactive path
-            # pays. The notice names the rank the notifier held when it
-            # published — valid for the current world only, which is why
-            # _run_generation clears the scope per generation.
-            if not failure.is_set() and not teardown.is_set():
-                import json as _json
-                doomed: set = set()
-                notice_meta: list = []
-                tokens: list = []
-                for dkey, raw in self._kv.scope("drain").items():
-                    token = (dkey, raw)
-                    if token in handled_drains:
-                        continue
-                    deferred = deferred_drains.get(token)
-                    if deferred and deferred[0] > time.monotonic():
-                        continue  # no-viable-world backoff window
-                    try:
-                        notice = _json.loads(raw)
-                        if not isinstance(notice, dict):
-                            raise TypeError("drain notice is not an "
-                                            "object")
-                        nrank = int(notice.get("rank"))
-                        ngen = int(notice.get("generation", -1))
-                    except (ValueError, TypeError):
-                        handled_drains.add(token)  # never retried
-                        get_logger().warning(
-                            "ignoring malformed drain notice %r", dkey)
-                        continue
-                    if not numbering_gen <= ngen <= world_gen:
-                        # published under another rank NUMBERING —
-                        # matching it against the current one could
-                        # drain an innocent worker.  Growth publishes
-                        # bump the generation but keep the numbering
-                        # (stable-assignment check), so any notice
-                        # since the last RENUMBERING publish is still
-                        # valid — the watcher latches after its one
-                        # publish and would never re-stamp a notice
-                        # that raced a growth.  Older ones are left
-                        # unhandled (not burned): the next re-mesh
-                        # clears the scope; worst case the host dies
-                        # reactively.
-                        continue
-                    origin = next(
-                        (k for k in essential_keys
-                         if current_rank.get(k) == nrank
-                         and results.get(k) is None
-                         and threads[k].is_alive()), None)
-                    if origin is None:
-                        handled_drains.add(token)
-                        continue  # already gone or renumbered: stale
-                    tokens.append(token)
-                    if notice.get("scope") == "host":
-                        # host-wide maintenance dooms every worker there
-                        h = slot_by_key[origin].hostname
-                        doomed |= {k for k in essential_keys
-                                   if slot_by_key[k].hostname == h
-                                   and results.get(k) is None
-                                   and threads[k].is_alive()}
-                    else:
-                        doomed.add(origin)
-                    notice_meta.append(
-                        {"rank": nrank,
-                         "host": slot_by_key[origin].hostname,
-                         "source": notice.get("source", "unknown")})
-                if doomed:
-                    # the planned path needs every involved worker able
-                    # to APPLY a world doc (elastic listener registered,
-                    # i.e. it has committed once).  A notice racing the
-                    # job's first commits — a preemption can announce
-                    # itself during hvd.init — is DEFERRED to a later
-                    # tick, not burned on a generation restart.
-                    notify = {str(r) for r in self._kv.scope("notify")}
-                    involved = set(doomed) | {
-                        k for k in essential_keys
-                        if k not in doomed and results.get(k) is None
-                        and threads[k].is_alive()}
-                    if any(str(current_rank[k]) not in notify
-                           for k in involved):
-                        doomed = set()
-                    else:
-                        handled_drains.update(tokens)
-                if doomed:
-                    cooldown = drain_cooldown_s()
-                    by_host: Dict[str, int] = {}
-                    for k in doomed:
-                        h = slot_by_key[k].hostname
-                        by_host[h] = by_host.get(h, 0) + 1
-                    for h, n in by_host.items():
-                        # reserve the doomed capacity so replacement
-                        # placement cannot land back on a host that
-                        # announced its own death; expiry re-admits it
-                        self._hosts.drain(h, n, cooldown)
-                    with fail_lock:
-                        # BEFORE the publish (same reason as the shrink
-                        # path): the doomed worker can read the pushed
-                        # doc and exit before this loop resumes, and
-                        # that exit is DRAINED, never a crash
-                        expected_exits.update(doomed)
-                        drained_exits.update(doomed)
-                    survivors = [k for k in essential_keys
-                                 if k not in doomed]
-                    from horovod_tpu.diagnostics.flight_recorder import \
-                        record_event
-                    record_event(
-                        "drain_notice_handled",
-                        notices=notice_meta,
-                        drained_ranks=sorted(current_rank[k]
-                                             for k in doomed),
-                        hosts=sorted(by_host), cooldown_s=cooldown)
-                    get_logger().warning(
-                        "drain notice(s) %s: planning world around "
-                        "doomed rank(s) %s (hosts %s reserved for %.0fs)",
-                        notice_meta,
-                        sorted(current_rank[k] for k in doomed),
-                        sorted(by_host), cooldown)
-                    recovered = self._try_inplace_recovery(
-                        survivors, results, threads, slot_by_key,
-                        current_rank, self._cap_np(), host_crashes,
-                        charge_reset=False,
-                        drain={"ranks": sorted(current_rank[k]
-                                               for k in doomed),
-                               "hosts": sorted(by_host),
-                               "sources": sorted({m["source"]
-                                                  for m in notice_meta})})
-                    if recovered is None:
-                        # no viable planned world (the doomed host was
-                        # the last one, min_np would be violated, or a
-                        # completion race): the notice is ADVISORY —
-                        # the host has not died, and may never (a GCE
-                        # MIGRATE event usually survives).  Tearing the
-                        # generation down here would turn advance
-                        # notice into a guaranteed restart the reactive
-                        # path never pays, so revert the bookkeeping
-                        # and fall back to reactive recovery instead.
-                        with fail_lock:
-                            expected_exits.difference_update(doomed)
-                            drained_exits.difference_update(doomed)
-                            # a doomed worker that exited DURING the
-                            # failed planning attempt was classified an
-                            # expected DRAINED exit, so run_slot never
-                            # marked it lost — re-mark it here or no
-                            # recovery would ever be planned for a
-                            # genuinely dead worker and the generation
-                            # would wedge
-                            gone = [k for k in doomed
-                                    if results.get(k) is not None]
-                            if gone:
-                                lost_keys.update(gone)
-                                worker_lost.set()
-                        for h, n in by_host.items():
-                            self._hosts.undrain(h, n)
-                        # un-burn the notices: the world can BECOME
-                        # viable (discovery adds a host) before the
-                        # doomed host dies, and the watcher is latched
-                        # after its one publish — without the retry
-                        # the advance notice would be permanently lost.
-                        # Backoff bounds the replanning churn.
-                        for t in tokens:
-                            handled_drains.discard(t)
-                            delay = min(
-                                deferred_drains.get(t, (0.0, 1.0))[1]
-                                * 2, 30.0)
-                            deferred_drains[t] = (
-                                time.monotonic() + delay, delay)
-                        get_logger().warning(
-                            "no viable planned world for drain "
-                            "notice(s) %s; retrying with backoff, "
-                            "reactive recovery covers an actual death",
-                            notice_meta)
-                        continue
-                    new_slots2, rec_gen, replacements, coord_addr, \
-                        coord_port = recovered
-                    for s in replacements:
-                        spawn(s, rec_gen)
-                    essential_keys = survivors + [
-                        (rec_gen, s.rank) for s in replacements]
-                    essential_gen = world_gen = numbering_gen = rec_gen
-                    slots = new_slots2
-                    np = len(new_slots2)
+            if not g.failure.is_set() and not g.teardown.is_set():
+                # -- a preemption/maintenance drain notice arrived ---------
+                if self._poll_drain_notices(g):
                     continue
-            if failure.is_set() or not self._hosts_changed.is_set():
+                # -- an autopilot action request arrived (ISSUE 12) --------
+                if self._poll_action_requests(g):
+                    continue
+            if g.failure.is_set() or not self._hosts_changed.is_set():
                 continue
             # -- membership changed mid-generation -------------------------
             self._hosts_changed.clear()
-            new_hosts = self._hosts.current_hosts()
-            new_np = self._cap_np()
-            old_hostnames = {s.hostname for s in slots}
-            still_there = old_hostnames.issubset(
-                {h.hostname for h in new_hosts})
-            if not still_there or new_np < np:
-                # capacity loss: keep the remaining workers IN PLACE when
-                # they can all apply a world doc (elastic state committed
-                # at least once); dropped workers exit via the
-                # not-in-new-world path at their next commit. Anything
-                # else — a finished essential, unregistered workers, too
-                # little capacity — takes the generation-restart path.
-                if any(results.get(k) is not None
-                       for k in essential_keys):
-                    teardown.set()
-                    continue
-                # keep workers per host up to that host's NEW slot count
-                # (the downscaled host must actually lose workers) in
-                # current-rank order, capped at the new world size
-                new_caps = {h.hostname: h.slots for h in new_hosts}
-                alive = [k for k in essential_keys
-                         if threads[k].is_alive()]
-                kept, used = [], {}
-                for k in sorted(alive, key=lambda k: current_rank[k]):
-                    h = slot_by_key[k].hostname
-                    if len(kept) < new_np and \
-                            used.get(h, 0) < new_caps.get(h, 0):
-                        kept.append(k)
-                        used[h] = used.get(h, 0) + 1
-                dropped = [k for k in essential_keys if k not in kept]
-                with fail_lock:
-                    # BEFORE the publish: a dropped worker can read the
-                    # pushed doc and exit before this loop resumes, and
-                    # that exit must not be classified as a crash
-                    expected_exits.update(dropped)
-                recovered = self._try_inplace_recovery(
-                    kept, results, threads, slot_by_key, current_rank,
-                    new_np, host_crashes, charge_reset=False)
-                if recovered is None:
-                    teardown.set()
-                    continue
-                new_slots2, rec_gen, replacements, coord_addr, \
-                    coord_port = recovered
-                for s in replacements:
-                    spawn(s, rec_gen)
-                essential_keys = kept + [(rec_gen, s.rank)
-                                         for s in replacements]
-                essential_gen = world_gen = numbering_gen = rec_gen
-                slots = new_slots2
-                np = len(new_slots2)
-                continue
-            if new_np <= np:
-                continue  # capacity we are not using anyway
-            # GROWTH: stable assignment keeps existing ranks; spawn only
-            # the new slots, publish the new world for survivor resync
-            new_slots = get_host_assignments(new_hosts, new_np)
-            if not all(ns.rank == s.rank and ns.hostname == s.hostname
-                       for ns, s in zip(new_slots, slots)):
-                # assignment reshuffled existing ranks (host reordering):
-                # in-place resync would double-assign ranks — restart
-                get_logger().warning(
-                    "growth reshuffled existing ranks; falling back to a "
-                    "generation restart")
-                teardown.set()
-                continue
-            coord_port = free_port()  # fresh rendezvous for the new world
-            gen = self._generation
-            self._generation += 1
-            get_logger().info(
-                "elastic generation %d (growth, in-place): np=%d->%d",
-                gen, np, new_np)
-            self._publish_world(gen, new_slots, coord_addr, coord_port)
-            world_gen = gen  # survivors adopt this gen; notices carry it
-            for s in new_slots[np:]:
-                spawn(s, gen)
-            slots = new_slots
-            np = new_np
+            self._apply_membership_change(g)
 
         ess_ok = all(
-            results.get(k) == SUCCESS for k in essential_keys)
+            g.results.get(k) == SUCCESS for k in g.essential_keys)
         if ess_ok:
             # only the ESSENTIAL workers are guaranteed complete —
             # in-place growth may have raised np while its stragglers
             # were torn down after the survivors finished in the old
             # world, and crash-shrunken workers' FAILURE records were
             # absorbed by the in-place re-mesh
-            self._final_np = len(essential_keys)
-            self._final_gen = essential_gen
+            self._final_np = len(g.essential_keys)
+            self._final_gen = g.essential_gen
             return SUCCESS
-        if (teardown.is_set() or self._hosts_changed.is_set()) and \
+        if (g.teardown.is_set() or self._hosts_changed.is_set()) and \
                 self._registry.count(FAILURE) == 0:
             return "HOSTS_CHANGED"
         if self._registry.count(FAILURE) > 0:
             for host, n in self._registry.failed_hosts().items():
                 # a host whose every worker failed is blacklisted
                 # (reference: driver blacklist, driver.py:297-313)
-                host_slots = sum(1 for s in slots if s.hostname == host)
+                host_slots = sum(1 for s in g.slots
+                                 if s.hostname == host)
                 if n >= host_slots:
                     self._hosts.blacklist(host)
             return FAILURE
-        self._final_np = len(essential_keys)
-        self._final_gen = essential_gen
+        self._final_np = len(g.essential_keys)
+        self._final_gen = g.essential_gen
         return SUCCESS
 
     @property

@@ -49,8 +49,10 @@ from horovod_tpu.runner.http_kv import (KVStoreServer, _KVHandler,
                                         _KVServer, kv_get, kv_put)
 
 #: scopes relayed upstream toward the root (worker -> driver traffic);
-#: everything else is local to the node (e.g. the driver's world pushes)
-FORWARD_SCOPES = ("notify", "drain")
+#: everything else is local to the node (e.g. the driver's world pushes).
+#: "action" carries the autopilot's remediation requests (ISSUE 12):
+#: finding→action decisions ride the same tree as drain notices.
+FORWARD_SCOPES = ("notify", "drain", "action")
 
 #: scopes a relay node serves from its TTL cache (driver -> worker
 #: traffic).  GETs for any other scope go root-direct: the relay
@@ -325,6 +327,24 @@ class RelayKVServer(KVStoreServer):
             return self._upstream_fn()
         except Exception:
             return None
+
+
+def elastic_kv_endpoint() -> Optional[Tuple[str, int]]:
+    """The managing elastic driver's KV endpoint from
+    ``HVD_ELASTIC_KV`` (``host:port``) — THE one parse of that env
+    contract, shared by every worker→driver publisher (drain notices,
+    autopilot action requests).  Returns None when no driver manages
+    this job; raises :class:`ValueError` on a malformed value so the
+    caller can say, in its own words, that this is a config bug and
+    not a transient."""
+    kv = os.environ.get("HVD_ELASTIC_KV", "")
+    if not kv:
+        return None
+    addr, _, port = kv.rpartition(":")
+    try:
+        return addr, int(port)
+    except ValueError:
+        raise ValueError(f"malformed HVD_ELASTIC_KV {kv!r}") from None
 
 
 # -- process-wide client ------------------------------------------------------

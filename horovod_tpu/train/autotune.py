@@ -299,6 +299,33 @@ class PlanCache:
                         "plan (%s); retuning", path, e)
             return None
 
+    def invalidate(self, fingerprint: Optional[str] = None) -> int:
+        """Drop one cached plan (or, with ``fingerprint=None``, every
+        plan in the directory); returns how many entries were removed.
+        Failures are swallowed — invalidation is hygiene, never an
+        error (a missing entry is already the desired state)."""
+        if not self.directory:
+            return 0
+        if fingerprint is not None:
+            try:
+                os.remove(self.path(fingerprint))
+                return 1
+            except OSError:
+                return 0
+        removed = 0
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return 0
+        for name in names:
+            if name.startswith("plan_") and name.endswith(".json"):
+                try:
+                    os.remove(os.path.join(self.directory, name))
+                    removed += 1
+                except OSError:
+                    pass
+        return removed
+
     def store(self, fingerprint: str, plan: Plan,
               meta: Optional[Dict[str, Any]] = None) -> Optional[str]:
         """Atomic write (tmp + rename) so a killed run can't leave a
@@ -322,6 +349,23 @@ class PlanCache:
             log.warning("autotune plan cache write failed (%s); the "
                         "tuned plan will not survive this process", e)
             return None
+
+
+def invalidate_plan_cache(cache_dir: Optional[str] = None) -> int:
+    """Drop every persisted tuned plan under the resolved cache
+    directory (argument > ``HVD_TPU_AUTOTUNE_CACHE_DIR``); returns the
+    number of entries removed (0 when persistence is off).  The
+    autopilot's ``retune`` remediation calls this on a topology/world
+    change (docs/OBSERVABILITY.md "Autopilot"): the cached plans encode
+    the OLD world's measured tradeoffs, and the next search must run
+    against the world that actually exists."""
+    directory = resolve_cache_dir(cache_dir)
+    removed = PlanCache(directory).invalidate()
+    if removed:
+        log.warning("autotune plan cache invalidated: %d entr%s removed "
+                    "from %s", removed, "y" if removed == 1 else "ies",
+                    directory)
+    return removed
 
 
 # ---------------------------------------------------------------------------

@@ -73,6 +73,30 @@ def _pipeline_metrics(plan) -> None:
         log.debug("pipeline metrics unavailable", exc_info=True)
 
 
+def record_measured_bubble(measured: float) -> None:
+    """Land the MEASURED bubble fraction of the active pipeline step on
+    /metrics next to the analytic one (docs/OBSERVABILITY.md "Pipeline
+    metrics").  Derivation is the overlap_bench attribution pattern
+    (``benchmarks/overlap_bench.py``): time the same model + global
+    batch at ``pp=1`` — per-device compute is identical
+    (``n_layers·M·rows/pp`` either way) with zero pipeline
+    dependencies — and ``1 − t_compute / t_pipelined`` is the fraction
+    of the pipelined step the devices spent NOT computing.  The
+    analytic gauge says what the schedule should cost; this one says
+    what it did — drift between them is remat/comm overhead the tick
+    model cannot see (``ci/check_bench.py --pipeline`` prints both)."""
+    try:
+        from horovod_tpu.metrics.registry import default_registry
+        default_registry().gauge(
+            "hvd_pipeline_bubble_fraction_measured",
+            help="measured bubble fraction of the active pipeline "
+                 "step: 1 - compute-only (pp=1) step time / pipelined "
+                 "step time").set(
+            max(0.0, min(1.0, float(measured))))
+    except Exception:
+        log.debug("measured-bubble gauge unavailable", exc_info=True)
+
+
 def stage_layout_permutation(n_layers: int, pp: int,
                              virtual_stages: int = 1) -> np.ndarray:
     """Natural-layer-order -> storage-order permutation for a pp x v

@@ -1,0 +1,900 @@
+"""Autopilot battery (ISSUE 12; docs/OBSERVABILITY.md "Autopilot"):
+policy-spec validation, the policy engine's gate pipeline (hysteresis,
+cooldown, action budget, SLO gates) driven through BOTH finding paths
+— the engine's native ``_flag`` detectors and the external
+``report_finding()`` seam — observe-vs-act decision parity, the
+four-channel audit trail (metrics, flight, JSONL + CLI, autopsy), the
+driver's ``action/`` scope validation, and the (slow) end-to-end
+acceptance pair: a chaos-injected persistent straggler drained and
+replaced autonomously under ``act``, with the IDENTICAL decision
+recorded and nothing acted under ``observe``."""
+
+import io
+import json
+import os
+import socket
+import sys
+import textwrap
+import time
+from contextlib import redirect_stdout
+
+import pytest
+
+from horovod_tpu import autopilot
+from horovod_tpu.autopilot import actions as ap_actions
+from horovod_tpu.autopilot.engine import PolicyEngine
+from horovod_tpu.autopilot.policy import (ACTIONS, AutopilotError, Policy,
+                                          default_policies,
+                                          load_policies_from_env,
+                                          parse_policies)
+from horovod_tpu.metrics.registry import Registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_singletons(monkeypatch):
+    from horovod_tpu.diagnostics.flight_recorder import recorder
+    from horovod_tpu.metrics import anomaly, timeseries
+    monkeypatch.delenv("HVD_TPU_AUTOPILOT", raising=False)
+    monkeypatch.delenv("HVD_TPU_AUTOPILOT_POLICY", raising=False)
+    monkeypatch.delenv("HVD_TPU_OBS_DIR", raising=False)
+    # manufactured findings must not arm real device-trace captures
+    monkeypatch.setenv("HVD_TPU_PROFILE_ON_ANOMALY", "0")
+    autopilot.reset()
+    anomaly.reset()
+    timeseries.reset()
+    recorder().clear()
+    yield
+    autopilot.reset()
+    anomaly.reset()
+    timeseries.reset()
+
+
+def _counter(reg, name, **labels):
+    c = reg.get(name, labels=labels or None)
+    return c.value if c is not None else 0.0
+
+
+# -- policy spec validation --------------------------------------------------
+
+def test_parse_minimal_policy_doc():
+    ps = parse_policies(json.dumps({"policies": [
+        {"name": "p", "finding": "persistent_straggler",
+         "action": "drain_and_replace"}]}))
+    assert len(ps) == 1
+    assert ps[0].cooldown_s == 300.0 and ps[0].hysteresis == 1
+    assert ps[0].needs_driver()
+
+
+def test_unknown_keys_rejected():
+    with pytest.raises(AutopilotError, match="unknown keys"):
+        parse_policies(json.dumps({"policies": [
+            {"name": "p", "finding": "x", "action": "retune",
+             "cooldwn_s": 1}]}))
+    with pytest.raises(AutopilotError, match="unknown document keys"):
+        parse_policies(json.dumps({"policies": [], "polices": []}))
+
+
+def test_unknown_action_rejected():
+    with pytest.raises(AutopilotError, match="unknown action"):
+        parse_policies(json.dumps({"policies": [
+            {"name": "p", "finding": "x", "action": "reboot_planet"}]}))
+
+
+def test_duplicate_names_rejected():
+    doc = {"policies": [
+        {"name": "p", "finding": "a", "action": "retune"},
+        {"name": "p", "finding": "b", "action": "freeze_alert"}]}
+    with pytest.raises(AutopilotError, match="duplicate policy names"):
+        parse_policies(json.dumps(doc))
+
+
+def test_bad_numbers_rejected():
+    for bad in ({"cooldown_s": -1}, {"hysteresis": 0}, {"max_actions": 0},
+                {"window_s": 0}, {"horizon_steps": 0},
+                {"max_margin_frac": 1.5}, {"cooldown_s": "soon"}):
+        doc = {"policies": [dict(
+            {"name": "p", "finding": "x", "action": "retune"}, **bad)]}
+        with pytest.raises(AutopilotError):
+            parse_policies(json.dumps(doc))
+
+
+def test_malformed_json_rejected():
+    with pytest.raises(AutopilotError, match="not valid JSON"):
+        parse_policies('{"policies": [')
+
+
+def test_env_inline_and_file_loading(tmp_path, monkeypatch):
+    doc = {"policies": [{"name": "only", "finding": "x",
+                         "action": "freeze_alert"}]}
+    monkeypatch.setenv("HVD_TPU_AUTOPILOT_POLICY", json.dumps(doc))
+    assert [p.name for p in load_policies_from_env()] == ["only"]
+    path = tmp_path / "pol.json"
+    path.write_text(json.dumps(doc))
+    monkeypatch.setenv("HVD_TPU_AUTOPILOT_POLICY", str(path))
+    assert [p.name for p in load_policies_from_env()] == ["only"]
+    monkeypatch.setenv("HVD_TPU_AUTOPILOT_POLICY", str(tmp_path / "nope"))
+    with pytest.raises(AutopilotError, match="unreadable"):
+        load_policies_from_env()
+
+
+def test_default_policies_cover_the_four_remediations():
+    ps = default_policies()
+    assert {p.action for p in ps} == set(ACTIONS)
+    assert {p.finding for p in ps} == {
+        "persistent_straggler", "hbm_growth", "recompile_storm",
+        "world_changed"}
+    # unset env -> the default set
+    assert [p.name for p in load_policies_from_env()] == \
+        [p.name for p in ps]
+
+
+def test_mode_knob(monkeypatch):
+    assert autopilot.mode() == "observe"  # the default
+    monkeypatch.setenv("HVD_TPU_AUTOPILOT", "act")
+    assert autopilot.mode() == "act"
+    monkeypatch.setenv("HVD_TPU_AUTOPILOT", "bogus")
+    assert autopilot.mode() == "observe"  # safe fallback, warned
+    monkeypatch.setenv("HVD_TPU_AUTOPILOT", "off")
+    assert autopilot.mode() == "off"
+    assert autopilot.default_engine() is None
+    assert autopilot.on_finding({"kind": "persistent_straggler"}) == []
+
+
+def test_engine_identity_follows_rank_across_reinit(tmp_path,
+                                                    monkeypatch):
+    """Review hardening: the engine survives elastic re-inits (its
+    cooldown/budget state must persist), but a re-mesh can renumber
+    this worker — decisions and the JSONL filename must carry the
+    CURRENT rank, like every other channel."""
+    monkeypatch.setenv("HVD_TPU_AUTOPILOT", "observe")
+    monkeypatch.setenv("HVD_TPU_AUTOPILOT_POLICY", json.dumps(
+        {"policies": [{"name": "p", "finding": "k",
+                       "action": "freeze_alert", "cooldown_s": 0}]}))
+    monkeypatch.setenv("HVD_TPU_OBS_DIR", str(tmp_path))
+    monkeypatch.setenv("HVD_TPU_RANK", "2")
+    autopilot.reset()
+    eng = autopilot.ensure_engine()
+    autopilot.on_finding({"kind": "k"})
+    assert eng.recent_decisions()[-1]["rank"] == 2
+    # the re-mesh renumbered us; hvd.init re-arms the SAME engine
+    monkeypatch.setenv("HVD_TPU_RANK", "1")
+    assert autopilot.ensure_engine() is eng
+    autopilot.on_finding({"kind": "k"})
+    assert eng.recent_decisions()[-1]["rank"] == 1
+    assert (tmp_path / "actions_rank2.jsonl").exists()
+    assert (tmp_path / "actions_rank1.jsonl").exists()
+
+
+def test_ensure_engine_is_the_loud_path(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_AUTOPILOT_POLICY", '{"policies": [')
+    assert autopilot.default_engine() is None  # quiet path degrades
+    with pytest.raises(AutopilotError):
+        autopilot.ensure_engine()  # hvd.init path fails the job loudly
+
+
+# -- the gate pipeline -------------------------------------------------------
+
+def _engine(policies, mode="observe"):
+    return PolicyEngine(policies=policies, registry=Registry(),
+                        mode=mode, rank=0)
+
+
+def test_decision_recorded_with_metrics_and_flight():
+    from horovod_tpu.diagnostics.flight_recorder import recorder
+    eng = _engine([Policy(name="p", finding="k", action="freeze_alert",
+                          cooldown_s=0.0)])
+    out = eng.on_finding({"kind": "k", "function": "f"})
+    assert len(out) == 1 and out[0]["outcome"] == "dry_run"
+    assert _counter(eng._reg, "hvd_autopilot_decisions_total",
+                    policy="p", outcome="dry_run") == 1
+    events = [e for e in recorder().events()
+              if e["kind"] == "autopilot_decision"]
+    assert events and events[-1]["policy"] == "p"
+    assert events[-1]["outcome"] == "dry_run"
+    assert eng.recent_decisions()[-1]["action"] == "freeze_alert"
+    # no policy subscribes to this kind: no decision
+    assert eng.on_finding({"kind": "unrelated"}) == []
+
+
+def test_cooldown_suppresses_then_rearms():
+    eng = _engine([Policy(name="p", finding="k", action="freeze_alert",
+                          cooldown_s=0.2, max_actions=10,
+                          window_s=3600)])
+    assert eng.on_finding({"kind": "k"})[0]["outcome"] == "dry_run"
+    d = eng.on_finding({"kind": "k"})[0]
+    assert d["outcome"] == "suppressed" and d["reason"] == "cooldown"
+    assert d["gate"]["cooldown_remaining_s"] >= 0
+    time.sleep(0.25)
+    assert eng.on_finding({"kind": "k"})[0]["outcome"] == "dry_run"
+
+
+def test_hysteresis_needs_consecutive_findings():
+    eng = _engine([Policy(name="p", finding="k", action="freeze_alert",
+                          hysteresis=3, cooldown_s=0.0)])
+    for expected in ("suppressed", "suppressed", "dry_run"):
+        d = eng.on_finding({"kind": "k"})[0]
+        assert d["outcome"] == expected, d
+        if expected == "suppressed":
+            assert d["reason"] == "hysteresis"
+
+
+def test_budget_exhaustion_within_window():
+    eng = _engine([Policy(name="p", finding="k", action="freeze_alert",
+                          cooldown_s=0.0, max_actions=2,
+                          window_s=3600)])
+    assert eng.on_finding({"kind": "k"})[0]["outcome"] == "dry_run"
+    assert eng.on_finding({"kind": "k"})[0]["outcome"] == "dry_run"
+    d = eng.on_finding({"kind": "k"})[0]
+    assert d["outcome"] == "suppressed" and d["reason"] == "budget"
+    assert d["gate"]["actions_in_window"] == 2
+
+
+def test_key_field_scopes_the_gates_per_value():
+    eng = _engine([Policy(name="p", finding="recompile_storm",
+                          action="freeze_alert", hysteresis=2,
+                          cooldown_s=3600, key_field="function")])
+    # two functions storm interleaved: each needs ITS OWN second report
+    assert eng.on_finding({"kind": "recompile_storm",
+                           "function": "a"})[0]["outcome"] == "suppressed"
+    assert eng.on_finding({"kind": "recompile_storm",
+                           "function": "b"})[0]["outcome"] == "suppressed"
+    da = eng.on_finding({"kind": "recompile_storm", "function": "a"})[0]
+    db = eng.on_finding({"kind": "recompile_storm", "function": "b"})[0]
+    assert da["outcome"] == "dry_run" and da["key"] == "a"
+    assert db["outcome"] == "dry_run" and db["key"] == "b"
+
+
+def test_observe_and_act_record_identical_decisions():
+    """The acceptance contract: the same finding stream under observe
+    and act yields the same decision stream — policy, action, gates,
+    suppression reasons — differing ONLY in fired-vs-dry_run."""
+    pol = [Policy(name="p", finding="k", action="retune",
+                  cooldown_s=0.2, max_actions=1, window_s=3600)]
+    streams = {}
+    for mode in ("observe", "act"):
+        eng = _engine([Policy(**vars(pol[0]))], mode=mode)
+        out = []
+        for _ in range(3):
+            out += eng.on_finding({"kind": "k"})
+        streams[mode] = out
+    strip = ("ts", "outcome", "mode", "gate")
+    norm = lambda ds: [{k: v for k, v in d.items() if k not in strip}
+                       for d in ds]
+    assert norm(streams["observe"]) == norm(streams["act"])
+    assert [d["outcome"] for d in streams["observe"]] == \
+        ["dry_run", "suppressed", "suppressed"]
+    assert [d["outcome"] for d in streams["act"]] == \
+        ["fired", "suppressed", "suppressed"]
+
+
+def test_fired_action_dispatches():
+    eng = _engine([Policy(name="p", finding="recompile_storm",
+                          action="freeze_alert", cooldown_s=0.0)],
+                  mode="act")
+    d = eng.on_finding({"kind": "recompile_storm", "function": "hot_fn",
+                        "compiles": 9})[0]
+    assert d["outcome"] == "fired"
+    deadline = time.time() + 5.0
+    while time.time() < deadline and \
+            "hot_fn" not in ap_actions.frozen_functions():
+        time.sleep(0.02)
+    assert "hot_fn" in ap_actions.frozen_functions()
+    assert _counter(eng._reg, "hvd_autopilot_actions_total",
+                    action="freeze_alert") == 1
+
+
+# -- SLO gates ---------------------------------------------------------------
+
+def _straggler_finding(excess=1.0):
+    return {"kind": "persistent_straggler", "rank": 2,
+            "win_step_time": 0.2 + excess, "fleet_mean": 0.2,
+            "windows": 3}
+
+
+def test_straggler_gate_fires_without_remesh_evidence():
+    eng = _engine([Policy(name="p", finding="persistent_straggler",
+                          action="drain_and_replace", cooldown_s=0.0)])
+    d = eng.on_finding(_straggler_finding())[0]
+    assert d["outcome"] == "dry_run"
+    assert d["gate"]["remesh_p50_s"] is None
+    assert d["gate"]["projected_loss_s"] > 0
+    assert d["target_rank"] == 2
+
+
+def test_straggler_gate_refuses_remesh_costlier_than_the_disease():
+    from horovod_tpu.metrics import timeseries
+    # measured history: re-meshes cost ~40s on this fleet
+    for total in (35.0, 40.0, 45.0):
+        timeseries.record_point({"remesh": {"rendezvous": total},
+                                 "remesh_total_s": total,
+                                 "complete": True})
+    eng = _engine([Policy(name="p", finding="persistent_straggler",
+                          action="drain_and_replace", cooldown_s=0.0,
+                          horizon_steps=100)])
+    # 0.1s excess * 100 steps = 10s projected loss < 40s p50: suppress
+    d = eng.on_finding(_straggler_finding(excess=0.1))[0]
+    assert d["outcome"] == "suppressed" and d["reason"] == "slo"
+    assert d["gate"]["remesh_p50_s"] == pytest.approx(40.0)
+    assert d["gate"]["projected_loss_s"] == pytest.approx(10.0)
+    # 1s excess * 100 steps = 100s projected loss > 40s p50: worth it
+    d = eng.on_finding(_straggler_finding(excess=1.0))[0]
+    assert d["outcome"] == "dry_run"
+
+
+def test_remesh_p50_deduplicates_ring_and_disk(tmp_path, monkeypatch):
+    """Review hardening: a point still in the ring is ALSO on disk (the
+    recorder writes both) — counting it twice weighted the p50 toward
+    recent episodes and skewed the drain SLO gate."""
+    from horovod_tpu.autopilot.engine import remesh_p50_s
+    from horovod_tpu.metrics import timeseries
+    monkeypatch.setenv("HVD_TPU_OBS_DIR", str(tmp_path))
+    timeseries.reset()
+    # two OLD episodes on disk only (rotated out of the ring)
+    with open(tmp_path / "obs_rank0.jsonl", "w") as f:
+        for ts in (1.0, 2.0):
+            f.write(json.dumps({"ts": ts, "remesh_total_s": 10.0,
+                                "remesh": {}, "complete": True}) + "\n")
+    # two RECENT episodes through the recorder: ring AND disk
+    for total in (100.0, 100.0):
+        timeseries.record_point({"remesh": {}, "remesh_total_s": total,
+                                 "complete": True})
+    # median over the four DISTINCT episodes (10,10,100,100) = 55;
+    # double-counting the recent pair would have said 100
+    assert remesh_p50_s() == pytest.approx(55.0)
+
+
+def test_straggler_gate_absolute_p50_cap():
+    from horovod_tpu.metrics import timeseries
+    timeseries.record_point({"remesh": {"rendezvous": 50.0},
+                             "remesh_total_s": 50.0, "complete": True})
+    eng = _engine([Policy(name="p", finding="persistent_straggler",
+                          action="drain_and_replace", cooldown_s=0.0,
+                          horizon_steps=10_000,
+                          max_remesh_p50_s=30.0)])
+    d = eng.on_finding(_straggler_finding(excess=1.0))[0]
+    assert d["outcome"] == "suppressed" and d["reason"] == "slo"
+    assert d["gate"]["max_remesh_p50_s"] == 30.0
+
+
+def test_hbm_gate_needs_margin_evidence():
+    eng = _engine([Policy(name="p", finding="hbm_growth",
+                          action="commit_restart", cooldown_s=0.0,
+                          max_margin_frac=0.1)])
+    # no hbm gauges at all: growth alone is not "past the OOM margin"
+    d = eng.on_finding({"kind": "hbm_growth", "growth_ratio": 1.4})[0]
+    assert d["outcome"] == "suppressed" and d["reason"] == "slo"
+    # comfortable margin: still suppressed, with the fraction recorded
+    reg = eng._reg
+    reg.gauge("hvd_hbm_oom_margin_bytes", agg="min").set(8e9)
+    reg.gauge("hvd_hbm_limit_bytes", agg="min").set(16e9)
+    d = eng.on_finding({"kind": "hbm_growth"})[0]
+    assert d["outcome"] == "suppressed"
+    assert d["gate"]["margin_frac"] == pytest.approx(0.5)
+    # margin collapsed below the policy line: the planned restart fires
+    reg.gauge("hvd_hbm_oom_margin_bytes", agg="min").set(1e9)
+    d = eng.on_finding({"kind": "hbm_growth"})[0]
+    assert d["outcome"] == "dry_run"
+    assert d["gate"]["margin_frac"] == pytest.approx(1 / 16)
+
+
+# -- the external report_finding() path --------------------------------------
+
+def test_report_finding_path_matches_step_path(monkeypatch):
+    """The recompile-storm policy depends on report_finding() findings
+    flowing through matching/cooldown/budget IDENTICALLY to native
+    ``_flag`` findings — drive the real anomaly engine both ways and
+    assert the autopilot singleton saw both."""
+    from horovod_tpu.metrics import anomaly
+    doc = {"policies": [
+        {"name": "ext", "finding": "recompile_storm",
+         "action": "freeze_alert", "hysteresis": 2,
+         "key_field": "function", "cooldown_s": 0.0},
+        {"name": "native", "finding": "step_time_drift",
+         "action": "retune", "cooldown_s": 3600}]}
+    monkeypatch.setenv("HVD_TPU_AUTOPILOT_POLICY", json.dumps(doc))
+    monkeypatch.setenv("HVD_TPU_AUTOPILOT", "observe")
+    autopilot.reset()
+    anomaly.reset()
+    # external path: report_finding twice -> hysteresis then dry_run
+    anomaly.report_finding("recompile_storm", function="f", compiles=5)
+    anomaly.report_finding("recompile_storm", function="f", compiles=6)
+    # native path: a step-time drift through observe_step's _flag
+    eng = anomaly.default_engine()
+    for i in range(30):
+        eng.observe_step(i, 0.010)
+    for i in range(30, 40):
+        eng.observe_step(i, 0.300)
+    decisions = autopilot.recent_decisions()
+    by_policy = {}
+    for d in decisions:
+        by_policy.setdefault(d["policy"], []).append(d["outcome"])
+    assert by_policy["ext"] == ["suppressed", "dry_run"]
+    assert by_policy["native"] == ["dry_run"]
+    # both paths hit the same counters on the default registry
+    from horovod_tpu.metrics.registry import default_registry
+    assert _counter(default_registry(), "hvd_autopilot_decisions_total",
+                    policy="ext", outcome="dry_run") >= 1
+    assert _counter(default_registry(), "hvd_autopilot_decisions_total",
+                    policy="native", outcome="dry_run") >= 1
+
+
+def test_world_changed_finding_reported_on_resize():
+    from horovod_tpu.elastic import remesh
+    from horovod_tpu.metrics import anomaly
+    remesh.reset()
+    remesh.begin("internal_error", old_size=4)
+    remesh.mark_recovered(new_size=3, generation=7)
+    found = [f for f in anomaly.recent_findings()
+             if f["kind"] == "world_changed"]
+    assert found and found[0]["old_size"] == 4 \
+        and found[0]["new_size"] == 3
+    # the default topology-retune policy saw it (observe default)
+    assert any(d["policy"] == "topology-retune"
+               for d in autopilot.recent_decisions())
+    remesh.reset()
+    # same-size recovery: NOT a topology change
+    anomaly.reset()
+    remesh.begin("internal_error", old_size=3)
+    remesh.mark_recovered(new_size=3, generation=8)
+    assert not [f for f in anomaly.recent_findings()
+                if f["kind"] == "world_changed"]
+    remesh.reset()
+
+
+# -- local remediations ------------------------------------------------------
+
+def test_retune_invalidates_plan_cache_and_runs_hooks(tmp_path,
+                                                      monkeypatch):
+    cache = tmp_path / "plans"
+    cache.mkdir()
+    (cache / "plan_abc.json").write_text("{}")
+    (cache / "plan_def.json").write_text("{}")
+    (cache / "unrelated.txt").write_text("keep me")
+    monkeypatch.setenv("HVD_TPU_AUTOTUNE_CACHE_DIR", str(cache))
+    from horovod_tpu.common.config import reset_config
+    reset_config()
+    ran = []
+    ap_actions.register_retune_hook(lambda: ran.append(1))
+    removed = ap_actions.retune()
+    assert removed == 2
+    assert (cache / "unrelated.txt").exists()
+    assert ran == [1]
+    reset_config()
+
+
+def test_invalidate_plan_cache_off_is_zero(monkeypatch):
+    monkeypatch.delenv("HVD_TPU_AUTOTUNE_CACHE_DIR", raising=False)
+    from horovod_tpu.common.config import reset_config
+    reset_config()
+    from horovod_tpu.train.autotune import invalidate_plan_cache
+    assert invalidate_plan_cache() == 0
+    reset_config()
+
+
+# -- the audit trail: JSONL + CLI + autopsy ----------------------------------
+
+def test_actions_jsonl_and_history_cli(tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_OBS_DIR", str(tmp_path))
+    eng = _engine([Policy(name="audit-me", finding="k",
+                          action="freeze_alert", cooldown_s=0.0)])
+    eng.on_finding({"kind": "k", "function": "f"})
+    eng.on_finding({"kind": "k", "function": "f"})
+    path = tmp_path / "actions_rank0.jsonl"
+    assert path.exists()
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(rows) == 2 and rows[0]["policy"] == "audit-me"
+    # the CLI renders the decision table from the same files
+    from horovod_tpu.metrics.__main__ import main as metrics_main
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = metrics_main(["history", "--dir", str(tmp_path),
+                           "--actions"])
+    assert rc == 0
+    out = buf.getvalue()
+    assert "audit-me" in out and "dry_run" in out
+    assert "2 decision(s)" in out
+    # --json emits raw rows
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert metrics_main(["history", "--dir", str(tmp_path),
+                             "--actions", "--json", "--last", "1"]) == 0
+    assert json.loads(buf.getvalue())["policy"] == "audit-me"
+    # an empty dir reports cleanly
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert metrics_main(["history", "--dir", str(empty),
+                         "--actions"]) == 1
+
+
+def test_suppressed_decision_with_jsonl_log_does_not_deadlock(
+        tmp_path, monkeypatch):
+    """Regression: the suppressed-decision paths used to call the
+    recorder while still holding the engine's (non-reentrant) gate
+    lock — with ``HVD_TPU_OBS_DIR`` set the JSONL writer re-acquired
+    it and the process self-deadlocked on its second finding."""
+    monkeypatch.setenv("HVD_TPU_OBS_DIR", str(tmp_path))
+    eng = _engine([Policy(name="p", finding="k", action="freeze_alert",
+                          cooldown_s=3600)])
+    assert eng.on_finding({"kind": "k"})[0]["outcome"] == "dry_run"
+    d = eng.on_finding({"kind": "k"})[0]  # used to hang right here
+    assert d["outcome"] == "suppressed" and d["reason"] == "cooldown"
+    rows = [json.loads(l) for l in
+            (tmp_path / "actions_rank0.jsonl").read_text().splitlines()]
+    assert [r["outcome"] for r in rows] == ["dry_run", "suppressed"]
+
+
+def test_top_renders_autopilot_line():
+    from horovod_tpu.metrics.__main__ import render_top
+    series = {
+        "hvd_autopilot_mode": 2.0,
+        'hvd_autopilot_decisions_total{outcome="fired",policy="sd"}': 1.0,
+        'hvd_autopilot_decisions_total{outcome="suppressed",policy="sd"}':
+            3.0,
+    }
+    frame = render_top(series, "test")
+    line = next(l for l in frame.splitlines() if "AUTOPILOT" in l)
+    assert "[act]" in line
+    assert "sd fired×1" in line and "sd suppressed×3" in line
+
+
+def test_autopsy_summary_embeds_actions(tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_AUTOPILOT", "observe")
+    monkeypatch.setenv("HVD_TPU_AUTOPILOT_POLICY", json.dumps(
+        {"policies": [{"name": "aut", "finding": "k",
+                       "action": "freeze_alert"}]}))
+    autopilot.reset()
+    # go through the singleton: the autopsy reads recent_decisions()
+    autopilot.ensure_engine()
+    autopilot.on_finding({"kind": "k"})
+    from horovod_tpu.diagnostics.autopsy import write_autopsy
+    bundle = write_autopsy(str(tmp_path / "b"), reason="test",
+                           fetch_peers=False)
+    summary = json.load(open(os.path.join(
+        bundle, [f for f in os.listdir(bundle)
+                 if f.startswith("summary_rank")][0])))
+    assert summary["actions"], summary
+    assert summary["actions"][-1]["policy"] == "aut"
+
+
+# -- driver-side action validation -------------------------------------------
+
+class _AliveThread:
+    def is_alive(self):
+        return True
+
+
+class _Slot:
+    def __init__(self, hostname):
+        self.hostname = hostname
+
+
+def _fake_gen_runtime():
+    from horovod_tpu.runner.elastic.driver import _GenRuntime
+    g = _GenRuntime([], 0, "127.0.0.1", 0)
+    for r in (0, 1, 2):
+        key = (0, r)
+        g.essential_keys.append(key)
+        g.current_rank[key] = r
+        g.slot_by_key[key] = _Slot("localhost")
+        g.threads[key] = _AliveThread()
+    return g
+
+
+def test_driver_scans_and_validates_action_requests():
+    from horovod_tpu.runner.elastic.discovery import FixedHosts
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.hosts import HostInfo
+    driver = ElasticDriver(FixedHosts([HostInfo("localhost", 3)]),
+                           ["true"], min_np=1)
+    try:
+        g = _fake_gen_runtime()
+        put = driver._kv.put
+        put("action", "0-1", json.dumps(
+            {"action": "drain", "rank": 2, "generation": 0,
+             "policy": "straggler-drain"}).encode())
+        put("action", "0-2", json.dumps(
+            {"action": "restart", "rank": 1, "generation": 0,
+             "policy": "hbm-planned-restart"}).encode())
+        put("action", "0-3", b"not json")                # burned
+        put("action", "0-4", json.dumps(                  # unknown kind
+            {"action": "explode", "rank": 0,
+             "generation": 0}).encode())
+        put("action", "0-5", json.dumps(                  # stale gen
+            {"action": "drain", "rank": 0,
+             "generation": 99}).encode())
+        put("action", "0-6", json.dumps(                  # unknown rank
+            {"action": "drain", "rank": 7,
+             "generation": 0}).encode())
+        groups = driver._scan_action_requests(g)
+        drains, dmeta, dtokens = groups["drain"]
+        restarts, rmeta, rtokens = groups["restart"]
+        assert {g.current_rank[k] for k in drains} == {2}
+        assert dmeta[0]["policy"] == "straggler-drain"
+        assert dmeta[0]["source"] == "autopilot"
+        assert {g.current_rank[k] for k in restarts} == {1}
+        # malformed/unknown/stale-rank burned; stale GENERATION is not
+        # (the numbering window may catch up) — 3 burned tokens
+        burned = {t[1] for t in g.handled_tokens}
+        assert burned == {"0-3", "0-4", "0-6"}
+        # without notify registrations nothing can be planned: the
+        # request defers untouched (no tokens consumed, no reservation)
+        assert not driver._poll_action_requests(g)
+        assert "0-1" not in {t[1] for t in g.handled_tokens}
+    finally:
+        driver._kv.stop()
+
+
+def test_action_publish_requires_driver_kv(monkeypatch):
+    monkeypatch.delenv("HVD_ELASTIC_KV", raising=False)
+    pol = Policy(name="p", finding="persistent_straggler",
+                 action="drain_and_replace")
+    ok = ap_actions._request_driver_action("drain", 2, pol,
+                                           {"finding": "k"})
+    assert ok is False
+    from horovod_tpu.diagnostics.flight_recorder import recorder
+    assert any(e["kind"] == "autopilot_action_unroutable"
+               for e in recorder().events())
+
+
+def test_action_publish_lands_in_kv_scope(monkeypatch):
+    from horovod_tpu.runner.http_kv import KVStoreServer
+    from horovod_tpu.runner import kv_relay
+    srv = KVStoreServer()
+    srv.start()
+    try:
+        monkeypatch.setenv("HVD_ELASTIC_KV", f"127.0.0.1:{srv.port}")
+        monkeypatch.setenv("HVD_ELASTIC_GENERATION", "3")
+        kv_relay.reset()
+        pol = Policy(name="p", finding="persistent_straggler",
+                     action="drain_and_replace")
+        assert ap_actions._request_driver_action(
+            "drain", 2, pol, {"finding": "persistent_straggler"})
+        entries = srv.scope("action")
+        assert len(entries) == 1
+        req = json.loads(next(iter(entries.values())))
+        assert req["action"] == "drain" and req["rank"] == 2
+        assert req["generation"] == 3 and req["source"] == "autopilot"
+    finally:
+        srv.stop()
+        kv_relay.reset()
+
+
+# -- end-to-end acceptance (slow): chaos straggler -> autonomous drain -------
+
+def _free_port_base(n=3):
+    """Base port with base+1..base+n-1 also free (worker i binds
+    base + local_rank)."""
+    for _ in range(50):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        base = s.getsockname()[1]
+        s.close()
+        try:
+            probes = []
+            for i in range(1, n):
+                p = socket.socket()
+                p.bind(("127.0.0.1", base + i))
+                probes.append(p)
+            for p in probes:
+                p.close()
+            return base
+        except OSError:
+            continue
+    raise RuntimeError("no free port window")
+
+
+def _straggler_worker_prog(log, flights, metrics_out, finish_step,
+                           min_generation):
+    """Worker for the autopilot acceptance: an UNSYNCHRONIZED
+    telemetry loop (commit-only coordination — per-step collectives
+    would equalize step times across ranks and hide the straggler from
+    the fleet's win_step_time attribution), with the chaos ``step``
+    stall keyed on the SYNCED state.step so a drained worker's
+    replacement (which resumes past the window) does not re-straggle."""
+    return textwrap.dedent(f"""
+        import os, sys, time
+        sys.path.insert(0, {REPO!r})
+        import numpy as np
+        import horovod_tpu as hvd
+        from horovod_tpu import chaos, elastic
+        from horovod_tpu.diagnostics.flight_recorder import recorder
+        from horovod_tpu.train.callbacks import StepTimer
+
+        orig_rank = int(os.environ["HOROVOD_RANK"])
+        hvd.init()
+        with open({str(log)!r}, "a") as f:
+            f.write(f"BOOT rank={{orig_rank}} pid={{os.getpid()}}\\n")
+
+        state = elastic.ObjectState(name="autorun", step=0, durable=True)
+
+        @elastic.run
+        def train(state):
+            timer = StepTimer(unit="examples")
+            while True:
+                timer.start_step()
+                chaos.step_tick(state.step)   # the straggler stall
+                time.sleep(0.05)
+                timer.end_step(32)
+                state.step += 1
+                state.commit()
+                gen = int(os.environ.get("HVD_ELASTIC_GENERATION", "0"))
+                if state.step >= {finish_step} and hvd.size() == 3 \\
+                        and gen >= {min_generation}:
+                    return True
+
+        train(state)
+        state.flush()
+        if hvd.rank() == 0:
+            from horovod_tpu.metrics.registry import (default_registry,
+                                                      render_prometheus)
+            with open({str(metrics_out)!r}, "w") as f:
+                f.write(render_prometheus(default_registry().snapshot()))
+        recorder().dump_to(os.path.join(
+            {str(flights)!r}, f"rank{{hvd.rank()}}_pid{{os.getpid()}}.json"))
+        with open({str(log)!r}, "a") as f:
+            f.write(f"DONE rank={{hvd.rank()}} pid={{os.getpid()}} "
+                    f"size={{hvd.size()}} step={{state.step}}\\n")
+        hvd.shutdown()
+    """)
+
+
+def _run_straggler_scenario(tmp_path, monkeypatch, name, mode,
+                            min_generation):
+    from horovod_tpu.runner.elastic.discovery import FixedHosts
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.hosts import HostInfo
+    base = tmp_path / name
+    base.mkdir()
+    log = base / "events.log"
+    flights = base / "flights"
+    flights.mkdir()
+    obs = base / "obs"
+    metrics_out = base / "metrics_rank0.prom"
+    plan_file = base / "plan.json"
+    # rank 2 straggles: every step in [1, 6) stalls 1.2s INSIDE the
+    # timed window, against ~0.05s peers — an unambiguous persistent
+    # straggler for the fleet detector within two 0.4s windows
+    plan_file.write_text(json.dumps({"faults": [
+        {"seam": "step", "kind": "stall", "rank": 2,
+         "start": 1, "stop": 6, "stall_s": 1.2}]}))
+    prog = base / "train.py"
+    # 40 fast (~0.1s) steps keep the healthy ranks running well past
+    # the straggler's detection window before they may finish
+    prog.write_text(_straggler_worker_prog(
+        log, flights, metrics_out, finish_step=40,
+        min_generation=min_generation))
+    env = dict(os.environ)
+    env.update({
+        "HVD_TPU_FAULT_PLAN": str(plan_file),
+        "HVD_TPU_AUTOPILOT": mode,
+        "HVD_TPU_OBS_DIR": str(obs),
+        "HVD_TPU_METRICS_PORT": str(_free_port_base(3)),
+        "HVD_TPU_FLEET_PUSH_SECONDS": "0.4",
+        "HVD_TPU_ANOMALY_STRAGGLER_WINDOWS": "2",
+        "HVD_TPU_CHECKPOINT_DIR": str(base / "ckpt"),
+        "HVD_TPU_CHECKPOINT_COMMIT_TIMEOUT_S": "5",
+        "HVD_TPU_AUTOPSY_DIR": str(base / "autopsy"),
+        "HVD_TPU_METADATA_ENDPOINT": "http://127.0.0.1:1",
+        "HVD_TPU_PREEMPTION_POLL_S": "0.5",
+        "HVD_TPU_TRANSPORT_TIMEOUT_S": "20",
+    })
+    env.pop("HVD_TPU_AUTOPILOT_POLICY", None)  # the shipped policy set
+    monkeypatch.setenv("HVD_TPU_DRAIN_COOLDOWN_S", "2")
+    driver = ElasticDriver(
+        FixedHosts([HostInfo("localhost", 3)]),
+        [sys.executable, str(prog)],
+        min_np=2, max_np=3, target_np=3, reset_limit=4,
+        ckpt_dir=str(base), env=env)
+    rc = driver.run()
+    lines = log.read_text().strip().splitlines() if log.exists() else []
+    decisions = []
+    for f in sorted(obs.glob("actions_rank*.jsonl")) \
+            if obs.exists() else []:
+        decisions += [json.loads(l)
+                      for l in f.read_text().splitlines()]
+    return rc, lines, decisions, metrics_out, flights, driver
+
+
+@pytest.mark.slow
+def test_autopilot_straggler_drain_act(tmp_path, monkeypatch):
+    """The ISSUE 12 acceptance, act half: a chaos-injected persistent
+    straggler on a 3-process elastic job is detected by the fleet
+    anomaly engine, SLO-gated, and drain-replaced to a healthy
+    full-size world with ZERO human input — and the decision is
+    visible on /metrics, in the flight ring, and in
+    ``history --actions``."""
+    from horovod_tpu.core import core_available
+    if not core_available():
+        pytest.skip("libhvdcore.so not built")
+    rc, lines, decisions, metrics_out, flights, driver = \
+        _run_straggler_scenario(tmp_path, monkeypatch, "act", "act",
+                                min_generation=2)
+    assert rc == 0, lines
+    boots = [l for l in lines if l.startswith("BOOT")]
+    dones = [l for l in lines if l.startswith("DONE")]
+    # 3 originals + exactly one replacement after the drain cooldown
+    assert len(boots) == 4, lines
+    assert len(dones) == 3, lines
+    for d in dones:
+        assert "size=3" in d, lines  # healed back to full size
+    # the straggler's host was never treated as bad
+    assert not driver._hosts.is_blacklisted("localhost")
+    # driver-side evidence: the action was handled as a planned drain
+    from horovod_tpu.diagnostics.flight_recorder import recorder
+    handled = [e for e in recorder().events()
+               if e["kind"] == "autopilot_action_handled"]
+    assert any(e.get("drained_ranks") == [2]
+               and e.get("notices", [{}])[0].get("source") == "autopilot"
+               and e.get("notices", [{}])[0].get("policy")
+               == "straggler-drain" for e in handled), handled
+    # the decision audit trail: fired, with the gate inputs recorded
+    fired = [d for d in decisions if d["policy"] == "straggler-drain"]
+    assert fired and fired[0]["outcome"] == "fired", decisions
+    assert fired[0]["action"] == "drain_and_replace"
+    assert fired[0]["target_rank"] == 2
+    assert "remesh_p50_s" in fired[0]["gate"]
+    # /metrics carries the decision counters and the act mode
+    prom = metrics_out.read_text()
+    assert 'hvd_autopilot_decisions_total{outcome="fired",' \
+           'policy="straggler-drain"} 1' in prom, prom
+    assert 'hvd_autopilot_actions_total{action="drain_and_replace"} 1' \
+        in prom, prom
+    assert "hvd_autopilot_mode 2" in prom
+    # the worker flight ring carries the decision event
+    flight_kinds = set()
+    for f in flights.glob("*.json"):
+        for e in json.load(open(f)).get("events", []):
+            flight_kinds.add(e["kind"])
+    assert "autopilot_decision" in flight_kinds, sorted(flight_kinds)
+    # the survivors measured the planned re-mesh (drain-stamped world)
+    remesh = []
+    for f in flights.glob("*.json"):
+        remesh += [e for e in json.load(open(f)).get("events", [])
+                   if e["kind"] == "remesh_complete"]
+    assert any(e.get("trigger") == "preemption_drain" for e in remesh), \
+        remesh
+    # and the CLI renders the trail
+    import subprocess
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.metrics", "history",
+         "--actions", "--dir", str(tmp_path / "act" / "obs")],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    assert "straggler-drain" in out.stdout and "fired" in out.stdout
+
+
+@pytest.mark.slow
+def test_autopilot_straggler_observe_records_without_acting(
+        tmp_path, monkeypatch):
+    """The observe half: the IDENTICAL fault plan records the same
+    decision — same policy, action, target, gate inputs — and takes no
+    action: no re-mesh, no replacement, the job finishes with its
+    original three processes."""
+    from horovod_tpu.core import core_available
+    if not core_available():
+        pytest.skip("libhvdcore.so not built")
+    rc, lines, decisions, metrics_out, flights, _driver = \
+        _run_straggler_scenario(tmp_path, monkeypatch, "observe",
+                                "observe", min_generation=0)
+    assert rc == 0, lines
+    boots = [l for l in lines if l.startswith("BOOT")]
+    dones = [l for l in lines if l.startswith("DONE")]
+    assert len(boots) == 3, lines   # nobody was replaced
+    assert len(dones) == 3, lines
+    # the identical decision, recorded as a dry run
+    dry = [d for d in decisions if d["policy"] == "straggler-drain"]
+    assert dry and dry[0]["outcome"] == "dry_run", decisions
+    assert dry[0]["action"] == "drain_and_replace"
+    assert dry[0]["target_rank"] == 2
+    assert "remesh_p50_s" in dry[0]["gate"]
+    # and nothing acted: no re-mesh episode anywhere
+    for f in flights.glob("*.json"):
+        events = json.load(open(f)).get("events", [])
+        assert not [e for e in events if e["kind"] == "remesh_complete"]
+    prom = metrics_out.read_text()
+    assert 'hvd_autopilot_decisions_total{outcome="dry_run",' \
+           'policy="straggler-drain"} 1' in prom, prom
+    assert "hvd_autopilot_mode 1" in prom
+    assert "hvd_autopilot_actions_total" not in prom

@@ -447,9 +447,19 @@ def test_pipeline_plan_gate(tmp_path):
     missing = dict(good)
     del missing["bubble_fraction"]
     assert "without bubble_fraction" in check_pipeline_plan(missing)
+    # measured bubble (ISSUE 12 satellite): range-checked when present,
+    # drift vs analytic is printed, never gated
+    measured = dict(good, bubble_measured=0.31)
+    assert check_pipeline_plan(measured) is None
+    assert "outside" in check_pipeline_plan(
+        dict(good, bubble_measured=1.2))
+    assert "not a number" in check_pipeline_plan(
+        dict(good, bubble_measured="fast"))
     # the CLI form
     path = tmp_path / "doc.json"
     path.write_text(json.dumps(good))
+    assert pipeline_main(["--pipeline", str(path)]) == 0
+    path.write_text(json.dumps(measured))
     assert pipeline_main(["--pipeline", str(path)]) == 0
     path.write_text(json.dumps(wrong_bubble))
     assert pipeline_main(["--pipeline", str(path)]) == 1
