@@ -257,6 +257,19 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
         except Exception:
             return None
 
+    def _guard_skipped():
+        """Steps the numeric guardrail zeroed during this process
+        (train/guard.py).  Recorded in the artifact so a benched run
+        that silently skipped steps — doing less optimizer work per
+        "step" — cannot pass as a clean perf number; ci/check_bench.py
+        rejects a non-null value with skips."""
+        try:
+            from horovod_tpu.metrics.registry import default_registry
+            c = default_registry().get("hvd_guard_skipped_steps_total")
+            return int(c.value) if c is not None else 0
+        except Exception:
+            return 0
+
     def emit(value, dt_window, n_iters, provisional, flops_per_device,
              flops_src, compile_s, series=None):
         peak = _peak_flops(jax.devices()[0].device_kind)
@@ -290,6 +303,7 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
             "compile_seconds": _compile_seconds(),
             "hbm_peak_bytes": _hbm_peak(),
             "timing_iters": n_iters,
+            "guard_skipped_steps": _guard_skipped(),
             "commit": _git_commit(),
             "phases": dict(_PHASES),
             **ex,

@@ -533,6 +533,16 @@ def main() -> int:
     if problem:
         print(f"bench {problem}")
         return 1
+    # integrity contract (ISSUE 13): a run whose numeric guardrail
+    # skipped steps did LESS optimizer work per measured "step" — its
+    # throughput number is not comparable to a clean run and must not
+    # pass as one (the skips themselves point at a data-plane problem
+    # on the bench host)
+    if doc["value"] is not None and doc.get("guard_skipped_steps"):
+        print(f"bench run skipped {doc['guard_skipped_steps']} step(s) "
+              f"under the numeric guardrail — not a clean perf number: "
+              f"{doc}")
+        return 1
     print(f"bench contract OK: {doc}")
     return 0
 

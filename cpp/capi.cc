@@ -7,6 +7,7 @@
 #include <vector>
 
 #include "core.h"
+#include "wire.h"
 
 using hvd::Core;
 using hvd::CoreConfig;
@@ -106,6 +107,12 @@ CoreConfig ParseEnvConfig() {
   cfg.transport_timeout_secs =
       atof(EnvOr("HVD_TPU_TRANSPORT_TIMEOUT_S",
                  "HOROVOD_TRANSPORT_TIMEOUT_S", "0"));
+  // per-frame CRC32C on the eager wire, default ON (docs/CHAOS.md
+  // "Wire integrity"); must be set uniformly across the world — the
+  // frame header grows a crc field when enabled
+  cfg.wire_checksum =
+      atoi(EnvOr("HVD_TPU_WIRE_CHECKSUM",
+                 "HOROVOD_WIRE_CHECKSUM", "1")) != 0;
   return cfg;
 }
 
@@ -136,6 +143,7 @@ const char* hvd_cfg_dump() {
      << "\nautotune_gp_noise=" << c.autotune_gp_noise
      << "\nrendezvous_timeout_secs=" << c.rendezvous_timeout_secs
      << "\ntransport_timeout_s=" << c.transport_timeout_secs
+     << "\nwire_checksum=" << (c.wire_checksum ? 1 : 0)
      << "\nthread_affinity=" << c.thread_affinity
      << "\ntimeline=" << c.timeline_path
      << "\ntimeline_mark_cycles=" << (c.timeline_mark_cycles ? 1 : 0)
@@ -274,6 +282,13 @@ int hvd_stop_timeline() {
   return 0;
 }
 
+// CRC32C of a buffer — the exact function the wire integrity check runs
+// per frame (cpp/wire.h), exported so the Python unit battery can hold
+// it to the published Castagnoli test vectors without a 2-process run.
+unsigned int hvd_crc32c(const void* data, long long n) {
+  return hvd::wire::Crc32c(data, (size_t)n);
+}
+
 // Control-plane counters as one JSON object (steady-state observability:
 // cache-hit rate, fusion effectiveness, negotiation volume).
 // thread_local: concurrent callers each keep their own buffer, and the
@@ -297,6 +312,8 @@ const char* hvd_counters_json() {
      << ",\"stalled_tensors\":" << c.stalled_tensors.load()
      << ",\"transport_chaos_injected\":"
      << c.transport_chaos_injected.load()
+     << ",\"transport_checksum_failures\":"
+     << c.transport_checksum_failures.load()
      << ",\"autotune_fusion_bytes\":" << c.autotune_fusion_bytes.load()
      << ",\"autotune_cycle_ms\":"
      << (c.autotune_cycle_us.load() / 1000.0)

@@ -397,7 +397,12 @@ Status Core::Init(const CoreConfig& cfg) {
   transport_.reset(
       new Transport(cfg.rank, cfg.size, cfg.coord_addr, cfg.coord_port,
                     cfg.rendezvous_timeout_secs,
-                    cfg.transport_timeout_secs));
+                    cfg.transport_timeout_secs,
+                    cfg.wire_checksum));
+  // fresh transport, fresh per-life counters: re-baseline the mirror
+  // so counters_ keeps accumulating instead of absorbing a reset-to-0
+  seen_transport_chaos_ = 0;
+  seen_transport_checksum_ = 0;
   auto st = transport_->Init();
   if (!st.ok()) return st;
   timeline_.reset(new Timeline(cfg.rank, cfg.timeline_path,
@@ -794,9 +799,7 @@ void Core::Loop() {
         [this] { return cycle_kick_; });
     cycle_kick_ = false;
   }
-  if (transport_)
-    counters_.transport_chaos_injected.store(
-        transport_->chaos_injected(), std::memory_order_relaxed);
+  MirrorTransportCounters();
   loop_done_ = true;
   // Abnormal exits (peer death mid-collective) leave waiters pending —
   // finalize them with the real error instead of letting them time out
@@ -1159,13 +1162,30 @@ void Core::ApplyDomainLifecycle(const std::vector<int32_t>& activate,
   }
 }
 
+// Mirror the transport's chaos-injection and checksum-failure counts
+// into the long-lived Counters struct: only the loop thread may touch
+// transport_ (the metrics scraper reads counters_ concurrently with
+// elastic re-init).  Deltas, not absolute stores — a checksum failure
+// tears its transport down, and the replacement transport's 0 must not
+// erase the recorded evidence (Init re-baselines seen_*).
+void Core::MirrorTransportCounters() {
+  if (!transport_) return;
+  uint64_t chaos = transport_->chaos_injected();
+  if (chaos > seen_transport_chaos_) {
+    counters_.transport_chaos_injected.fetch_add(
+        chaos - seen_transport_chaos_, std::memory_order_relaxed);
+    seen_transport_chaos_ = chaos;
+  }
+  uint64_t ck = transport_->checksum_failures();
+  if (ck > seen_transport_checksum_) {
+    counters_.transport_checksum_failures.fetch_add(
+        ck - seen_transport_checksum_, std::memory_order_relaxed);
+    seen_transport_checksum_ = ck;
+  }
+}
+
 bool Core::RunOnce() {
-  // mirror the transport's chaos-injection count into the long-lived
-  // Counters struct: only the loop thread may touch transport_ (the
-  // metrics scraper reads counters_ concurrently with elastic re-init)
-  if (transport_)
-    counters_.transport_chaos_injected.store(
-        transport_->chaos_injected(), std::memory_order_relaxed);
+  MirrorTransportCounters();
   bool want_shutdown = shutdown_requested_.load();
   counters_.cycles++;
   if (timeline_ && timeline_->enabled() && timeline_->mark_cycles())

@@ -193,6 +193,10 @@ struct CoreConfig {
   // elastic recovery) instead of an infinite recv
   // (HVD_TPU_TRANSPORT_TIMEOUT_S; docs/CHAOS.md)
   double transport_timeout_secs = 0.0;
+  // CRC32C every eager-wire frame; a mismatch names the peer and fails
+  // the affected collectives (HVD_TPU_WIRE_CHECKSUM, default on —
+  // docs/CHAOS.md "Wire integrity"). Must be uniform across the world.
+  bool wire_checksum = true;
   // > 0: the coordinator logs a rank-attributed negotiation-wait summary
   // every this many seconds (HVD_TPU_STRAGGLER_REPORT_SECONDS); the
   // snapshot is queryable via hvd_stragglers_json either way
@@ -318,6 +322,9 @@ class Core {
     // scrape thread must never dereference transport_ (an elastic
     // re-init resets that pointer under it)
     std::atomic<uint64_t> transport_chaos_injected{0};
+    // eager-wire CRC32C failures (HVD_TPU_WIRE_CHECKSUM), mirrored from
+    // the Transport by the loop thread for the same reason as above
+    std::atomic<uint64_t> transport_checksum_failures{0};
     // live values of the autotune-managed knobs (docs/OBSERVABILITY.md
     // "Autotune metrics"): mirrored every negotiation cycle by the loop
     // thread so /metrics shows WHAT the tuner picked, not just that it
@@ -378,6 +385,14 @@ class Core {
 
   CoreConfig cfg_;
   Counters counters_;
+  // last values mirrored from the CURRENT transport: the long-lived
+  // counters_ accumulate DELTAS across transport lives, because every
+  // checksum failure tears its transport down (elastic re-init builds
+  // a fresh one at 0) and an absolute store would erase the very
+  // evidence the counter exists to carry
+  uint64_t seen_transport_chaos_ = 0;
+  uint64_t seen_transport_checksum_ = 0;
+  void MirrorTransportCounters();
   // straggler attribution state (coordinator-only writes, any-thread
   // reads through StragglersJson)
   struct StragglerStats {

@@ -56,6 +56,7 @@ std::unique_ptr<TransportChaos> ParseChaosEnv(int size) {
         if (v == "delay") rule.kind = 0;
         else if (v == "drop") rule.kind = 1;
         else if (v == "close") rule.kind = 2;
+        else if (v == "bit_flip") rule.kind = 3;
         else ok = false;
       } else if (k == "peer") {
         rule.peer = (v == "*") ? -1 : atoi(v.c_str());
@@ -65,6 +66,10 @@ std::unique_ptr<TransportChaos> ParseChaosEnv(int size) {
         rule.count = strtoull(v.c_str(), nullptr, 10);
       } else if (k == "ms") {
         rule.ms = atof(v.c_str());
+      } else if (k == "minb") {
+        rule.min_bytes = strtoull(v.c_str(), nullptr, 10);
+      } else if (k == "fires") {
+        rule.fires = strtoull(v.c_str(), nullptr, 10);
       } else {
         ok = false;
       }
@@ -77,6 +82,11 @@ std::unique_ptr<TransportChaos> ParseChaosEnv(int size) {
     }
   }
   if (chaos->rules.empty()) return nullptr;
+  chaos->rule_fired.reset(new std::atomic<uint64_t>[chaos->rules.size()]);
+  for (size_t i = 0; i < chaos->rules.size(); ++i) {
+    chaos->rule_fired[i] = 0;
+    if (chaos->rules[i].kind == 3) chaos->has_bit_flip = true;
+  }
   HVD_LOG(Warning) << "chaos: transport faults armed ("
                    << chaos->rules.size() << " rule(s): " << spec << ")";
   return chaos;
@@ -154,31 +164,40 @@ void SetNoDelay(int fd) {
 
 Transport::Transport(int rank, int size, const std::string& coord_addr,
                      int coord_port, double connect_timeout_secs,
-                     double recv_timeout_secs)
+                     double recv_timeout_secs, bool wire_checksum)
     : rank_(rank), size_(size), coord_addr_(coord_addr),
       coord_port_(coord_port),
       connect_timeout_secs_(connect_timeout_secs),
       recv_timeout_secs_(recv_timeout_secs),
+      checksum_enabled_(wire_checksum),
       chaos_(ParseChaosEnv(size)), last_rx_ns_(size) {
   for (int i = 0; i < size; ++i) last_rx_ns_[i] = 0;
   peer_fds_.assign(size, -1);
   inbox_.resize(size);
   dead_.assign(size, false);
+  peer_error_.assign(size, std::string());
   for (int i = 0; i < size; ++i)
     send_mu_.emplace_back(new std::mutex());
 }
 
-bool Transport::ChaosOnFrame(bool recv, int peer) {
+bool Transport::ChaosOnFrame(bool recv, int peer, uint8_t* payload,
+                             size_t len) {
   // chaos_ checked by the caller; frame indices count per peer per
   // direction so `after` means "the Nth frame exchanged with THAT peer"
   uint64_t seq = recv ? chaos_->recv_seen[peer].fetch_add(1)
                       : chaos_->send_seen[peer].fetch_add(1);
   bool drop = false;
-  for (const auto& r : chaos_->rules) {
+  for (size_t ri = 0; ri < chaos_->rules.size(); ++ri) {
+    const auto& r = chaos_->rules[ri];
     if (r.recv != recv) continue;
     if (r.peer != -1 && r.peer != peer) continue;
     if (seq < r.after) continue;
     if (r.count != 0 && seq >= r.after + r.count) continue;
+    if (r.min_bytes != 0 && len < r.min_bytes) continue;
+    if (r.fires != 0 &&
+        chaos_->rule_fired[ri].fetch_add(1) >= r.fires) {
+      continue;  // fire budget spent (fetch_add keeps it spent)
+    }
     chaos_->injected.fetch_add(1);
     if (r.kind == 0) {  // delay
       HVD_LOG(Warning) << "chaos: delaying " << (recv ? "recv" : "send")
@@ -189,6 +208,22 @@ bool Transport::ChaosOnFrame(bool recv, int peer) {
       HVD_LOG(Warning) << "chaos: dropping " << (recv ? "recv" : "send")
                        << " frame " << seq << " (peer " << peer << ")";
       drop = true;
+    } else if (r.kind == 3) {  // bit_flip: corrupt one payload byte.
+      // On the send side this runs AFTER the frame's CRC was computed
+      // — the flip models corruption ON THE WIRE, which is exactly
+      // what the checksum must catch (docs/CHAOS.md "Wire integrity").
+      if (payload != nullptr && len > 0) {
+        // bit 7 of the middle byte, not bit 0: for little-endian f32
+        // payloads the lowest mantissa bit of a flipped addend can
+        // ROUND AWAY in the reduction (1.0 + (1.0+2^-23) == 2.0f
+        // exactly), which would make the undetected-corruption half of
+        // the acceptance flaky — a higher-order bit always survives
+        payload[len / 2] ^= 0x80;
+        HVD_LOG(Warning) << "chaos: bit-flipping "
+                         << (recv ? "recv" : "send") << " frame " << seq
+                         << " (peer " << peer << ", " << len
+                         << " bytes, offset " << (len / 2) << ")";
+      }
     } else {  // close: reset the peer's socket mid-stream
       HVD_LOG(Warning) << "chaos: closing socket to peer " << peer
                        << " at frame " << seq;
@@ -358,19 +393,77 @@ std::shared_ptr<Transport::TagQueue> Transport::GetQueue(int peer,
 void Transport::ReaderLoop(int peer) {
   int fd = peer_fds_[peer];
   for (;;) {
-    int32_t hdr[2];  // tag, len
+    // tag, len [, frame crc32c, header crc32c with the checksum on]
+    int32_t hdr[4];
+    size_t hdr_len = checksum_enabled_ ? sizeof(hdr) : 8;
     int64_t before = last_rx_ns_[peer].load();
-    if (!ReadAll(fd, hdr, sizeof(hdr), &last_rx_ns_[peer]).ok()) break;
+    if (!ReadAll(fd, hdr, hdr_len, &last_rx_ns_[peer]).ok()) break;
+    bool bad_header = hdr[1] < 0;  // a negative length is never real,
+    // and would drive a garbage allocation below (the pre-checksum
+    // hazard too, so it is checked in both modes)
+    if (checksum_enabled_ && !bad_header) {
+      // validate the HEADER'S OWN crc before trusting the length: a
+      // flipped bit in the len field would otherwise block the reader
+      // (or blow the allocation) before the frame CRC could fail —
+      // exactly the corruption this layer must catch, not hang on
+      uint32_t want_h;
+      memcpy(&want_h, &hdr[3], 4);
+      bad_header = wire::Crc32c(hdr, 8) != want_h;
+    }
+    if (bad_header) {
+      if (checksum_enabled_) checksum_failures_.fetch_add(1);
+      char buf[128];
+      snprintf(buf, sizeof(buf),
+               "wire corruption from peer %d: frame header failed "
+               "verification (tag=%d, len=%d)", peer, hdr[0], hdr[1]);
+      HVD_LOG(Error) << buf;
+      {
+        std::lock_guard<std::mutex> lk(inbox_mu_);
+        peer_error_[peer] = buf;
+      }
+      ::shutdown(fd, SHUT_RDWR);
+      break;
+    }
     std::vector<uint8_t> payload(hdr[1]);
     if (hdr[1] > 0 &&
         !ReadAll(fd, payload.data(), hdr[1], &last_rx_ns_[peer]).ok())
       break;
     // chaos seam: zero-cost when off (one null test per frame)
-    if (chaos_ && ChaosOnFrame(/*recv=*/true, peer)) {
+    if (chaos_ && ChaosOnFrame(/*recv=*/true, peer, payload.data(),
+                               payload.size())) {
       // an injected drop/close must look like SILENCE to the recv
       // deadline — that is the wedged-peer scenario it simulates
       last_rx_ns_[peer].store(before);
       continue;
+    }
+    if (checksum_enabled_) {
+      // verify AFTER the chaos seam: a recv-side bit_flip models the
+      // same on-the-wire corruption a send-side one does
+      uint32_t want;
+      memcpy(&want, &hdr[2], 4);
+      uint32_t got = wire::Crc32c(hdr, 8);
+      got = wire::Crc32c(payload.data(), payload.size(), got);
+      if (got != want) {
+        checksum_failures_.fetch_add(1);
+        char buf[160];
+        snprintf(buf, sizeof(buf),
+                 "wire checksum mismatch on frame from peer %d (tag=%d,"
+                 " len=%d, crc 0x%08x != expected 0x%08x): corrupted"
+                 " data on the eager wire; closing the connection",
+                 peer, hdr[0], hdr[1], got, want);
+        HVD_LOG(Error) << buf
+                       << " (HVD_TPU_WIRE_CHECKSUM; "
+                       << "transport_checksum_failures counts these)";
+        {
+          std::lock_guard<std::mutex> lk(inbox_mu_);
+          peer_error_[peer] = buf;
+        }
+        // a corrupt stream is unrecoverable (the length field itself
+        // may be lying): reset the socket so the PEER also observes
+        // the failure and both sides enter elastic recovery
+        ::shutdown(fd, SHUT_RDWR);
+        break;
+      }
     }
     auto q = GetQueue(peer, hdr[0]);
     {
@@ -403,16 +496,46 @@ Status Transport::Send(int peer, int32_t tag, const void* data, size_t len) {
     return Status::OK();
   }
   std::lock_guard<std::mutex> lk(*send_mu_[peer]);
+  // header: {tag, len, frame_crc, hdr_crc}; the last two only when the
+  // wire checksum is on.  hdr_crc covers (tag, len) ALONE so the
+  // receiver can validate the length BEFORE allocating/reading the
+  // payload — a flipped bit in the length field must be detected
+  // immediately, not hang the reader waiting for bytes that never come
+  int32_t hdr[4] = {tag, (int32_t)len, 0, 0};
+  if (checksum_enabled_) {
+    // frame CRC over header (tag+len) then payload, computed BEFORE
+    // the chaos seam below may corrupt the bytes: a send-side bit_flip
+    // models corruption on the wire, after checksumming — the case the
+    // recv-side verification exists to catch
+    uint32_t crc = wire::Crc32c(hdr, 8);
+    crc = wire::Crc32c(data, len, crc);
+    memcpy(&hdr[2], &crc, 4);
+    uint32_t hcrc = wire::Crc32c(hdr, 8);
+    memcpy(&hdr[3], &hcrc, 4);
+  }
   // chaos seam: a dropped send is written NOWHERE — the peer starves,
-  // which is exactly the wedged-peer scenario the recv deadline catches
-  if (chaos_ && ChaosOnFrame(/*recv=*/false, peer))
-    return Status::OK();
+  // which is exactly the wedged-peer scenario the recv deadline
+  // catches; a bit_flip corrupts a COPY of the payload (the caller's
+  // tensor bytes must stay intact — the fault is on the wire, not in
+  // host memory)
+  std::vector<uint8_t> corrupted;
+  const void* out_data = data;
+  if (chaos_) {
+    uint8_t* mut = nullptr;
+    if (chaos_->has_bit_flip && len > 0) {
+      corrupted.assign((const uint8_t*)data, (const uint8_t*)data + len);
+      mut = corrupted.data();
+      out_data = mut;
+    }
+    if (ChaosOnFrame(/*recv=*/false, peer, mut, len))
+      return Status::OK();
+  }
   int fd = peer_fds_[peer];
   if (fd < 0) return Status::Error("no connection to peer");
-  int32_t hdr[2] = {tag, (int32_t)len};
-  auto st = WriteAll(fd, hdr, sizeof(hdr));
+  size_t hdr_len = checksum_enabled_ ? sizeof(hdr) : 8;
+  auto st = WriteAll(fd, hdr, hdr_len);
   if (!st.ok()) return st;
-  return WriteAll(fd, data, len);
+  return WriteAll(fd, out_data, len);
 }
 
 Status Transport::Recv(int peer, int32_t tag, std::vector<uint8_t>* out) {
@@ -445,8 +568,21 @@ Status Transport::Recv(int peer, int32_t tag, std::vector<uint8_t>* out) {
   } else {
     q->cv.wait(lk, [&] { return !q->q.empty() || q->closed; });
   }
-  if (q->q.empty())
+  if (q->q.empty()) {
+    // integrity failures carry their own cause: the waiter's error must
+    // NAME the corrupting peer, not read as a generic peer loss.
+    // Release the queue lock first — the reader's close-out path locks
+    // inbox_mu_ then each queue, so taking inbox_mu_ while holding
+    // q->mu would invert the order and risk a deadlock.
+    lk.unlock();
+    {
+      std::lock_guard<std::mutex> ik(inbox_mu_);
+      if (peer >= 0 && peer < (int)peer_error_.size() &&
+          !peer_error_[peer].empty())
+        return Status::Error(peer_error_[peer]);
+    }
     return Status::Aborted("connection closed");
+  }
   *out = std::move(q->q.front());
   q->q.pop();
   return Status::OK();

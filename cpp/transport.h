@@ -38,17 +38,28 @@ struct Frame {
 // null-pointer test per frame.
 struct TransportChaosRule {
   bool recv = true;       // direction this rule applies to
-  int kind = 0;           // 0 delay, 1 drop, 2 close
+  int kind = 0;           // 0 delay, 1 drop, 2 close, 3 bit_flip
   int peer = -1;          // -1 = any peer
   uint64_t after = 0;     // first affected frame index (0-based)
   uint64_t count = 0;     // frames affected; 0 = unlimited
   double ms = 0.0;        // delay milliseconds
+  // bit_flip extras (docs/CHAOS.md "Wire integrity"): only frames with
+  // at least `min_bytes` of payload qualify (so a flip targets tensor
+  // DATA frames, not the small lockstep negotiation frames whose index
+  // is timing-dependent), and at most `fires` frames are ever corrupted
+  // (0 = unlimited) — counted per fire, unlike the window `count`
+  uint64_t min_bytes = 0;
+  uint64_t fires = 0;
 };
 
 struct TransportChaos {
   std::vector<TransportChaosRule> rules;
   std::vector<std::atomic<uint64_t>> recv_seen, send_seen;  // per peer
   std::atomic<uint64_t> injected{0};
+  // per-rule fire counts (the `fires` budget); sized to rules.size()
+  // after parsing
+  std::unique_ptr<std::atomic<uint64_t>[]> rule_fired;
+  bool has_bit_flip = false;  // Send copies the payload only when true
   explicit TransportChaos(int size)
       : recv_seen(size), send_seen(size) {
     for (int i = 0; i < size; ++i) {
@@ -67,9 +78,16 @@ class Transport {
   // the pre-hardening behavior) — a dead-but-connected peer (SIGSTOP,
   // wedged host, chaos `drop`) then surfaces as a Status error instead
   // of an infinite block (knob: HVD_TPU_TRANSPORT_TIMEOUT_S).
+  // wire_checksum: CRC32C every frame (header + payload) on the eager
+  // wire (knob: HVD_TPU_WIRE_CHECKSUM, default ON; must be set
+  // uniformly across the world — the frame header grows frame- and header-crc fields).
+  // A mismatch names the corrupting peer, counts checksum_failures(),
+  // and kills the connection so both sides surface
+  // HorovodInternalError into the elastic recovery path.
   Transport(int rank, int size, const std::string& coord_addr,
             int coord_port, double connect_timeout_secs = 30.0,
-            double recv_timeout_secs = 0.0);
+            double recv_timeout_secs = 0.0,
+            bool wire_checksum = true);
   ~Transport();
 
   Status Init();            // rendezvous + full mesh
@@ -88,18 +106,24 @@ class Transport {
     return chaos_ ? chaos_->injected.load() : 0;
   }
 
+  // frames whose CRC32C failed verification (0 with the check off)
+  uint64_t checksum_failures() const { return checksum_failures_.load(); }
+
  private:
   void ReaderLoop(int peer);
   Status ConnectTo(const std::string& host, int port, int* fd_out);
-  // returns true when the frame must be dropped; may sleep or shut the
-  // peer's socket down per the armed rules
-  bool ChaosOnFrame(bool recv, int peer);
+  // returns true when the frame must be dropped; may sleep, corrupt
+  // `payload` in place (bit_flip), or shut the peer's socket down per
+  // the armed rules
+  bool ChaosOnFrame(bool recv, int peer, uint8_t* payload, size_t len);
 
   int rank_, size_;
   std::string coord_addr_;
   int coord_port_;
   double connect_timeout_secs_;
   double recv_timeout_secs_;
+  bool checksum_enabled_;
+  std::atomic<uint64_t> checksum_failures_{0};
   std::unique_ptr<TransportChaos> chaos_;  // null = chaos off
   // per-peer last-DELIVERED-byte stamp (steady ns), fed by ReaderLoop as
   // payload bytes stream in: the recv deadline measures true peer
@@ -122,6 +146,10 @@ class Transport {
   std::mutex inbox_mu_;
   std::vector<std::map<int32_t, std::shared_ptr<TagQueue>>> inbox_;
   std::vector<bool> dead_;  // peer's reader exited: new queues born closed
+  // why a peer's reader died, when it was an integrity failure rather
+  // than a plain close: Recv surfaces this instead of the generic
+  // "connection closed" so the collective error NAMES the bad peer
+  std::vector<std::string> peer_error_;  // guarded by inbox_mu_
   std::shared_ptr<TagQueue> GetQueue(int peer, int32_t tag);
   std::atomic<bool> shutting_down_{false};
 };

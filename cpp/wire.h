@@ -15,6 +15,34 @@
 namespace hvd {
 namespace wire {
 
+// CRC32C (Castagnoli, the iSCSI/ext4 polynomial) — the per-frame wire
+// integrity check of the eager TCP data plane (HVD_TPU_WIRE_CHECKSUM,
+// docs/CHAOS.md "Wire integrity").  Software table implementation: the
+// eager path moves host tensors, so the ~1 GB/s table walk is never the
+// bottleneck next to the TCP stack, and it needs no SSE4.2 dispatch.
+// Chainable: pass the previous return value as `crc` to extend a digest
+// over multiple buffers (header + payload).
+struct Crc32cTable {
+  uint32_t t[256];
+  Crc32cTable() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+      t[i] = c;
+    }
+  }
+};
+
+inline uint32_t Crc32c(const void* data, size_t n, uint32_t crc = 0) {
+  static const Crc32cTable table;
+  const uint8_t* p = (const uint8_t*)data;
+  crc = ~crc;
+  for (size_t i = 0; i < n; ++i)
+    crc = table.t[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
 class Writer {
  public:
   std::vector<uint8_t> buf;

@@ -117,6 +117,14 @@ from horovod_tpu.parallel.plan import (  # noqa: F401
 from horovod_tpu.train.pipeline import (  # noqa: F401
     make_pipeline_train_step,
 )
+# Data-plane integrity (ISSUE 13; docs/TROUBLESHOOTING.md "My loss
+# went NaN / my replicas disagree"): the numeric guardrail's spec and
+# the cross-replica SDC canary
+from horovod_tpu.train.guard import (  # noqa: F401
+    GuardSpec,
+    ReplicaCanary,
+    param_digest,
+)
 
 # High-level training API (reference: horovod/torch/optimizer.py,
 # horovod/tensorflow/__init__.py DistributedGradientTape)

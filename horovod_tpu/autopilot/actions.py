@@ -2,26 +2,36 @@
 
 Two shapes (docs/OBSERVABILITY.md "Autopilot"):
 
-* **Driver actions** (``drain_and_replace``, ``commit_restart``)
-  travel worker→driver as a JSON request PUT into the KV ``action/``
-  scope — relay-routed up the same tree as drain notices
-  (:mod:`horovod_tpu.runner.kv_relay`), consumed by the elastic
+* **Driver actions** (``drain_and_replace``, ``commit_restart``,
+  ``quarantine_rank``) travel worker→driver as a JSON request PUT into
+  the KV ``action/`` scope — relay-routed up the same tree as drain
+  notices (:mod:`horovod_tpu.runner.kv_relay`), consumed by the elastic
   driver's poll loop (``runner/elastic/driver.py``), which plans the
   target worker out of the world through the PR-10 drain plumbing: the
-  exit is DRAINED, never FAILURE, never blocklist evidence.
+  exit is DRAINED, never FAILURE.
   ``drain_and_replace`` reserves the sick host for the drain cooldown
   (the replacement lands elsewhere when capacity exists);
   ``commit_restart`` leaves the host admitted so the planned restart
   respawns in place immediately — the drain-stamped world doc already
   guarantees the doomed worker's final durable commit is flushed
-  before it exits (``elastic.run``'s preemption_drain branch).
-* **Local actions** (``freeze_alert``, ``retune``) act in-process:
-  ``freeze_alert`` names the offending function loudly and adds it to
-  the frozen set (``hvd_autopilot_frozen_functions``); ``retune``
-  invalidates the persistent autotune plan cache
+  before it exits (``elastic.run``'s preemption_drain branch);
+  ``quarantine_rank`` (ISSUE 13) is the one planned exit that IS held
+  against the hardware — after the drain re-mesh succeeds the driver
+  blocklists the divergent rank's host WITH the canary evidence that
+  convicted it (silent data corruption is a device property, and a
+  replacement landing back on the same chip would diverge again).
+* **Local actions** (``freeze_alert``, ``retune``,
+  ``rollback_restore``) act in-process: ``freeze_alert`` names the
+  offending function loudly and adds it to the frozen set
+  (``hvd_autopilot_frozen_functions``); ``retune`` invalidates the
+  persistent autotune plan cache
   (:func:`horovod_tpu.train.autotune.invalidate_plan_cache`) and runs
   any registered re-tune hooks in the background, so the next plan
-  lookup re-searches against the CURRENT topology.
+  lookup re-searches against the CURRENT topology;
+  ``rollback_restore`` (ISSUE 13) runs the registered rollback hooks
+  (:func:`register_rollback_hook`) so a run whose gradients went
+  persistently non-finite restores the last durable checkpoint instead
+  of committing a poisoned optimizer state forward.
 
 Dispatch always happens on a short-lived daemon thread: the decision
 itself is made under the anomaly engine's lock, and a KV round-trip
@@ -42,6 +52,13 @@ _lock = threading.Lock()
 _seq = 0
 _frozen: Set[str] = set()
 _retune_hooks: List[Callable[[], None]] = []
+_rollback_hooks: List[Callable[[], None]] = []
+
+#: finding fields carried as quarantine EVIDENCE into the driver's
+#: blocklist record (docs/OBSERVABILITY.md "Autopilot"): the canary
+#: digests that convicted the rank travel with the action, so the
+#: audit trail says WHY the host was blocklisted, not just that it was
+_EVIDENCE_FIELDS = ("step", "digest", "majority", "world", "consecutive")
 
 
 def dispatch(policy: Policy, finding: dict, decision: dict) -> None:
@@ -60,6 +77,13 @@ def _run(policy: Policy, finding: dict, decision: dict) -> None:
         elif policy.action == "commit_restart":
             _request_driver_action("restart", _own_rank(),
                                    policy, decision)
+        elif policy.action == "quarantine_rank":
+            _request_driver_action(
+                "quarantine", int(finding["rank"]), policy, decision,
+                evidence={k: finding[k] for k in _EVIDENCE_FIELDS
+                          if k in finding})
+        elif policy.action == "rollback_restore":
+            rollback(policy, finding)
         elif policy.action == "freeze_alert":
             freeze(str(finding.get("function", "unknown")), policy,
                    finding)
@@ -93,7 +117,7 @@ def _flight(kind: str, **fields) -> None:
 
 # -- driver actions (the KV ``action/`` scope) --------------------------------
 def _request_driver_action(kind: str, target_rank: int, policy: Policy,
-                           decision: dict) -> bool:
+                           decision: dict, evidence=None) -> bool:
     """PUT the action request at the elastic driver's KV, relay-routed.
     Returns False (with the evidence recorded) when no driver manages
     this job — a standalone run's decision is still a first-class audit
@@ -120,7 +144,7 @@ def _request_driver_action(kind: str, target_rank: int, policy: Policy,
     with _lock:
         _seq += 1
         seq = _seq
-    doc = json.dumps({
+    body = {
         "action": kind,
         "rank": int(target_rank),
         "policy": policy.name,
@@ -128,7 +152,10 @@ def _request_driver_action(kind: str, target_rank: int, policy: Policy,
         "source": "autopilot",
         "from_rank": _own_rank(),
         "generation": int(os.environ.get("HVD_ELASTIC_GENERATION", "0")),
-        "at": time.time()}).encode()
+        "at": time.time()}
+    if evidence:
+        body["evidence"] = evidence
+    doc = json.dumps(body).encode()
     kv_relay.client(addr, port_i).put(
         "action", f"{_own_rank()}-{seq}", doc, timeout=5.0,
         site="autopilot.action")
@@ -174,6 +201,63 @@ def freeze(function: str, policy: Optional[Policy] = None,
 def frozen_functions() -> Set[str]:
     with _lock:
         return set(_frozen)
+
+
+def register_rollback_hook(fn: Callable[[], None]) -> None:
+    """Training loops that own restorable durable state register a
+    zero-arg callable here (typically ``lambda: state.restore()`` over
+    an elastic ``ObjectState``, or a ``restore_latest`` into the live
+    pytree); the ``rollback_restore`` remediation runs every hook in
+    the background when persistent ``grad_nonfinite`` findings fire."""
+    with _lock:
+        _rollback_hooks.append(fn)
+
+
+def rollback(policy: Optional[Policy] = None,
+             finding: Optional[dict] = None) -> int:
+    """Persistent non-finite gradients: the optimizer state advancing
+    under a poisoned data plane must not be the state that commits
+    forward — restore the last durable checkpoint through the
+    registered hooks.  Returns how many hooks ran.  With no hooks
+    registered the decision is still a first-class audit artifact; the
+    alert names what SHOULD have been restored."""
+    with _lock:
+        hooks = list(_rollback_hooks)
+    ran = 0
+    for fn in hooks:
+        try:
+            fn()
+            ran += 1
+        except Exception:
+            try:
+                from horovod_tpu.common.logging import get_logger
+                get_logger().warning(
+                    "autopilot: rollback hook %r failed", fn,
+                    exc_info=True)
+            except Exception:
+                pass
+    _flight("autopilot_rollback", policy=policy.name if policy else None,
+            hooks=len(hooks), ran=ran,
+            step=(finding or {}).get("step"),
+            consecutive=(finding or {}).get("consecutive"))
+    try:
+        from horovod_tpu.common.logging import get_logger
+        if hooks:
+            get_logger().error(
+                "autopilot: persistent non-finite gradients (%s "
+                "consecutive skipped steps) — restored the last durable "
+                "checkpoint via %d/%d rollback hook(s)",
+                (finding or {}).get("consecutive", "?"), ran, len(hooks))
+        else:
+            get_logger().error(
+                "autopilot: persistent non-finite gradients (%s "
+                "consecutive skipped steps) and NO rollback hook is "
+                "registered — restore the last committed checkpoint "
+                "manually (docs/TROUBLESHOOTING.md \"My loss went "
+                "NaN\")", (finding or {}).get("consecutive", "?"))
+    except Exception:
+        pass
+    return ran
 
 
 def register_retune_hook(fn: Callable[[], None]) -> None:
@@ -234,4 +318,5 @@ def reset() -> None:
     with _lock:
         _frozen.clear()
         _retune_hooks.clear()
+        _rollback_hooks.clear()
         _seq = 0

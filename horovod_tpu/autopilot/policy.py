@@ -70,6 +70,15 @@ ACTIONS: Dict[str, bool] = {
     "commit_restart": True,      # final durable commit + planned restart
     "freeze_alert": False,       # name the offender, stop the bleeding
     "retune": False,             # invalidate plan cache + re-search
+    # ISSUE 13 (data-plane integrity, docs/OBSERVABILITY.md "Autopilot"):
+    "quarantine_rank": True,     # drain the SDC-divergent rank AND
+    #                              blocklist its host with evidence —
+    #                              unlike a preemption drain, the exit
+    #                              is held against the hardware
+    "rollback_restore": False,   # persistent grad_nonfinite: restore
+    #                              the last durable checkpoint via the
+    #                              registered rollback hooks instead of
+    #                              committing a poisoned state forward
 }
 
 MODES = ("off", "observe", "act")
@@ -201,8 +210,9 @@ def parse_policies(doc: Union[str, Dict[str, Any]]) -> List[Policy]:
 
 
 def default_policies() -> List[Policy]:
-    """The shipped policy set — the four wired remediations of ISSUE 12.
-    Used when ``HVD_TPU_AUTOPILOT_POLICY`` is unset; a custom document
+    """The shipped policy set — the four wired remediations of ISSUE 12
+    plus the two data-plane integrity remediations of ISSUE 13.  Used
+    when ``HVD_TPU_AUTOPILOT_POLICY`` is unset; a custom document
     REPLACES it (policies are explicit, not merged)."""
     return [
         Policy(name="straggler-drain", finding="persistent_straggler",
@@ -213,6 +223,18 @@ def default_policies() -> List[Policy]:
                action="freeze_alert", hysteresis=2, key_field="function"),
         Policy(name="topology-retune", finding="world_changed",
                action="retune", cooldown_s=60.0),
+        # a replica whose canary digest disagrees with the majority is
+        # producing silently-wrong math (docs/TROUBLESHOOTING.md "My
+        # replicas disagree"): one finding is enough — SDC does not
+        # heal, and every step it stays in the allreduce poisons the
+        # others' gradients
+        Policy(name="replica-quarantine", finding="replica_divergence",
+               action="quarantine_rank"),
+        # persistent non-finite gradients (the guard's escalation,
+        # train/guard.py): the optimizer state may already be poisoned
+        # — roll back to the last durable commit rather than carry it
+        Policy(name="nonfinite-rollback", finding="grad_nonfinite",
+               action="rollback_restore"),
     ]
 
 

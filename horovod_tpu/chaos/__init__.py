@@ -35,6 +35,7 @@ from horovod_tpu.chaos.plan import (FaultPlan, FaultPlanError, FaultRule,
                                     load_plan_from_env, parse_plan)
 
 __all__ = ["install", "uninstall", "active", "fire", "step_tick",
+           "grad_injection", "grad_rules_armed", "GRAD_CODES",
            "engine", "ChaosEngine", "FaultPlan", "FaultPlanError",
            "FaultRule", "SEAMS", "parse_plan"]
 
@@ -102,14 +103,22 @@ class ChaosEngine:
         """Evaluate ``seam`` at ``index`` (auto-incrementing per-seam
         counter when None).  Applies every matching rule's fault —
         delays sleep in place, error kinds RAISE, kill/exit terminate
-        the process, pure-signal kinds (``preemption``/``notice``) only
-        report.  ``peer`` names the request's TARGET for the
-        ``kv.partition`` seam (a worker rank or ``"driver"``); rules
-        whose cut the (self rank, peer) pair crosses fire
-        bidirectionally.  Returns the (seam, kind) pairs applied, for
-        tests and signal-kind consumers."""
+        the process, pure-signal kinds (``preemption``/``notice``, the
+        ``grad`` corruption kinds) only report.  ``peer`` names the
+        request's TARGET for the ``kv.partition`` seam (a worker rank or
+        ``"driver"``); rules whose cut the (self rank, peer) pair
+        crosses fire bidirectionally.  Returns the (seam, kind) pairs
+        applied, for tests and signal-kind consumers."""
+        return [(r.seam, r.kind)
+                for r in self.fire_rules(seam, index=index, peer=peer)]
+
+    def fire_rules(self, seam: str, index: Optional[int] = None,
+                   peer=None) -> List[FaultRule]:
+        """:meth:`fire`, but returning the applied RULES — consumers
+        that need a rule's parameters (the grad ``scale`` kind's
+        ``factor``) read them off the rule instead of a string pair."""
         invocation = self._next_index(seam) if index is None else index
-        applied: List[Tuple[str, str]] = []
+        applied: List[FaultRule] = []
         raise_after: Optional[BaseException] = None
         for rule in self.plan.rules_for(seam, self.rank):
             if rule.groups is not None and \
@@ -118,7 +127,7 @@ class ChaosEngine:
             if not self._should_fire(rule, invocation):
                 continue
             self._note(rule, invocation)
-            applied.append((seam, rule.kind))
+            applied.append(rule)
             if rule.kind in ("delay", "slow_fsync"):
                 time.sleep(rule.delay_ms / 1000.0)
             elif rule.kind == "stall":
@@ -135,8 +144,9 @@ class ChaosEngine:
                 raise_after = ConnectionRefusedError(
                     f"chaos: injected partition (rank {self.rank} -> "
                     f"{peer}, invocation {invocation})")
-            elif rule.kind == "notice":
+            elif rule.kind in ("notice", "nan", "inf", "scale"):
                 pass  # pure signal: the applied list IS the payload
+                # (grad kinds are consumed in-graph by train/guard.py)
             elif rule.kind == "io_error":
                 raise_after = OSError(
                     f"chaos: injected IO error ({seam} invocation "
@@ -309,3 +319,32 @@ def step_tick(step: int) -> List[Tuple[str, str]]:
     if eng is None:
         return ()
     return eng.fire("step", index=int(step))
+
+
+#: grad-seam kind -> the in-graph injection code train/guard.py applies
+#: (0 = clean; the float travels into the compiled step as data, so a
+#: firing window never triggers a recompile)
+GRAD_CODES = {"nan": 1, "inf": 2, "scale": 3}
+
+
+def grad_rules_armed() -> bool:
+    """Does the armed plan carry any ``grad`` rules for THIS rank?  The
+    train-step factories consult this at build time: only then is the
+    injection seam compiled into the step (zero cost otherwise)."""
+    eng = _engine
+    return bool(eng is not None
+                and eng.plan.rules_for("grad", eng.rank))
+
+
+def grad_injection(step: int) -> Tuple[int, float]:
+    """Evaluate the ``grad`` seam at training step ``step``; returns
+    ``(code, factor)`` — the :data:`GRAD_CODES` code of the first
+    applied rule (0 when clean) and its ``scale`` factor (0.0 for
+    nan/inf).  Counted/flight-recorded like every other injection."""
+    eng = _engine
+    if eng is None:
+        return (0, 0.0)
+    for rule in eng.fire_rules("grad", index=int(step)):
+        return (GRAD_CODES[rule.kind],
+                float(rule.factor) if rule.kind == "scale" else 0.0)
+    return (0, 0.0)

@@ -79,8 +79,17 @@ SEAMS: Dict[str, frozenset] = {
     # a fire as "this host is doomed", driving the proactive drain path
     # (docs/ELASTIC.md "Proactive drain & preemption").
     "preemption": frozenset({"notice"}),
-    "transport.send": frozenset({"delay", "drop", "close"}),
-    "transport.recv": frozenset({"delay", "drop", "close"}),
+    "transport.send": frozenset({"delay", "drop", "close", "bit_flip"}),
+    "transport.recv": frozenset({"delay", "drop", "close", "bit_flip"}),
+    # gradient corruption at the train step (docs/CHAOS.md): the seam
+    # index IS the training step (like ``step``); the armed kinds are
+    # read by the guard-integrated train-step factories
+    # (horovod_tpu/train/guard.py) and applied IN-GRAPH to the step's
+    # gradients — ``nan``/``inf`` poison them (the numeric guardrail
+    # must skip the step), ``scale`` multiplies them by ``factor``
+    # (a finite SDC stand-in the guard cannot see but the cross-replica
+    # canary must).  Pure signal at the seam: nothing raises here.
+    "grad": frozenset({"nan", "inf", "scale"}),
 }
 
 _UNBOUNDED = 2 ** 62
@@ -104,6 +113,13 @@ class FaultRule:
     peer: int = -1                      # transport seams; -1 = any peer
     stall_s: float = 0.0
     exit_code: int = 1
+    # transport bit_flip only: frames under this payload size are immune
+    # — flips target tensor DATA frames, not the small lockstep
+    # negotiation frames whose per-peer index is timing-dependent
+    min_bytes: int = 0
+    # grad scale only: the multiplicative spike applied to the rank's
+    # gradients while the rule fires
+    factor: float = 0.0
     marker: str = ""
     # kv.partition only: the two sides of the cut.  Members are worker
     # ranks (ints) or the literal "driver" (the root KV server).
@@ -162,7 +178,7 @@ def _parse_ranks(v: Any) -> Optional[frozenset]:
 
 _RULE_KEYS = {"seam", "kind", "rank", "start", "stop", "count",
               "probability", "delay_ms", "peer", "stall_s", "exit_code",
-              "marker", "groups"}
+              "marker", "groups", "min_bytes", "factor"}
 
 
 def _parse_groups(v: Any, index: int) -> tuple:
@@ -225,6 +241,8 @@ def _parse_rule(doc: Dict[str, Any], index: int) -> FaultRule:
         exit_code = int(doc.get("exit_code", 1))
         peer = doc.get("peer", -1)
         peer = -1 if peer in ("*", None) else int(peer)
+        min_bytes = int(doc.get("min_bytes", 0))
+        factor = float(doc.get("factor", 0.0))
     except (TypeError, ValueError) as e:
         raise FaultPlanError(f"fault #{index}: bad field value: {e}") \
             from None
@@ -257,6 +275,23 @@ def _parse_rule(doc: Dict[str, Any], index: int) -> FaultRule:
     if kind == "stall" and stall_s <= 0:
         raise FaultPlanError(
             f"fault #{index}: kind 'stall' needs stall_s > 0")
+    if min_bytes < 0:
+        raise FaultPlanError(f"fault #{index}: min_bytes must be >= 0")
+    if min_bytes and kind != "bit_flip":
+        raise FaultPlanError(
+            f"fault #{index}: 'min_bytes' is only valid for transport "
+            "bit_flip rules (the payload-size gate that keeps flips off "
+            "the small negotiation frames)")
+    if kind == "scale":
+        if factor <= 0 or factor == 1.0:
+            raise FaultPlanError(
+                f"fault #{index}: kind 'scale' needs factor > 0 and "
+                "!= 1 (a unit spike would count as injected while "
+                "corrupting nothing)")
+    elif "factor" in doc:
+        raise FaultPlanError(
+            f"fault #{index}: 'factor' is only valid for the grad "
+            "'scale' kind")
     groups = None
     if seam == "kv.partition":
         if "groups" not in doc:
@@ -271,8 +306,8 @@ def _parse_rule(doc: Dict[str, Any], index: int) -> FaultRule:
     return FaultRule(seam=seam, kind=kind, ranks=_parse_ranks(
         doc.get("rank", "*")), start=start, stop=stop, count=count,
         probability=probability, delay_ms=delay_ms, peer=peer,
-        stall_s=stall_s, exit_code=exit_code,
-        marker=marker, groups=groups, index=index)
+        stall_s=stall_s, exit_code=exit_code, min_bytes=min_bytes,
+        factor=factor, marker=marker, groups=groups, index=index)
 
 
 def _ranks_overlap(a: Optional[frozenset], b: Optional[frozenset]) -> bool:
@@ -380,9 +415,20 @@ def compile_transport_spec(plan: FaultPlan, rank: int) -> str:
                 f"fault #{r.index}: transport seams do not support "
                 "probability < 1 (the C++ injector is window/count based)")
         direction = "recv" if r.seam.endswith("recv") else "send"
+        window = 0 if r.stop == _UNBOUNDED else r.stop - r.start
+        if r.kind == "bit_flip":
+            # bit_flip counts FIRES, not window frames: the plan's
+            # ``count`` compiles to the C++ ``fires`` budget (at most N
+            # frames ever corrupted) while ``start``/``stop`` stay the
+            # frame-index window; ``min_bytes`` keeps the flip off the
+            # small (timing-indexed) negotiation frames
+            parts.append(
+                f"dir={direction}:kind=bit_flip:peer={r.peer}:"
+                f"after={r.start}:count={window}:ms=0:"
+                f"minb={r.min_bytes}:fires={r.count}")
+            continue
         stop_count = r.count
-        if r.stop != _UNBOUNDED:
-            window = r.stop - r.start
+        if window:
             stop_count = min(stop_count, window) if stop_count else window
         parts.append(
             f"dir={direction}:kind={r.kind}:peer={r.peer}:"

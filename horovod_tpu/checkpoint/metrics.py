@@ -15,7 +15,10 @@ registry, so the per-worker ``/metrics`` exporter and
 * ``hvd_checkpoint_inflight`` — async saves queued or being written,
 * ``hvd_checkpoint_last_step`` — last step this rank committed or
   restored (gauge, merged as ``max``),
-* ``hvd_checkpoint_failures_total`` — saves/commits that errored.
+* ``hvd_checkpoint_failures_total`` — saves/commits that errored,
+* ``hvd_checkpoint_restore_fallback_total`` — restores that skipped a
+  corrupt newest checkpoint for the next-older committed step
+  (``ShardedCheckpointer.restore_latest``).
 
 Instruments register lazily on first use so workers that never
 checkpoint export nothing.
@@ -51,19 +54,22 @@ def _instruments():
                       agg="max"),
             reg.counter("hvd_checkpoint_failures_total",
                         help="checkpoint saves that failed to commit"),
+            reg.counter("hvd_checkpoint_restore_fallback_total",
+                        help="restores that skipped a corrupt newest "
+                             "checkpoint for an older committed step"),
         )
     return _INSTRUMENTS
 
 
 def record_save(nbytes: int, seconds: float, step: int) -> None:
-    save_b, _, save_s, _, _, last, _ = _instruments()
+    save_b, _, save_s, _, _, last = _instruments()[:6]
     save_b.inc(nbytes)
     save_s.observe(seconds)
     last.set(step)
 
 
 def record_restore(nbytes: int, seconds: float, step: int) -> None:
-    _, rest_b, _, rest_s, _, last, _ = _instruments()
+    _, rest_b, _, rest_s, _, last = _instruments()[:6]
     rest_b.inc(nbytes)
     rest_s.observe(seconds)
     last.set(step)
@@ -71,6 +77,10 @@ def record_restore(nbytes: int, seconds: float, step: int) -> None:
 
 def record_failure() -> None:
     _instruments()[6].inc()
+
+
+def record_restore_fallback() -> None:
+    _instruments()[7].inc()
 
 
 def set_inflight(n: int) -> None:

@@ -420,11 +420,36 @@ class ShardedCheckpointer:
         return fmt.list_steps(self._dir)
 
     def restore_latest(self, like: Any = None) -> Optional[Any]:
-        step = self.latest_step()
-        if step is None:
+        """Restore the newest committed step — falling back to the next
+        older commit when the newest fails verification (sha256
+        mismatch, missing shard, corrupt manifest).  A corrupt NEWEST
+        checkpoint beside an intact older one used to fail the restore
+        outright, turning one bad write into a dead run; now it costs
+        the steps between the two commits, counted LOUDLY
+        (``hvd_checkpoint_restore_fallback_total``, an error log and a
+        ``ckpt_restore_fallback`` flight event per skipped step)."""
+        steps = fmt.list_steps(self._dir)
+        if not steps:
             self._warn_if_foreign_layout()
             return None
-        return self.restore(step, like)
+        for i, step in enumerate(reversed(steps)):
+            try:
+                return self.restore(step, like)
+            except CheckpointError as e:
+                if i == len(steps) - 1:
+                    raise  # the oldest commit: nothing left to fall to
+                older = steps[len(steps) - 2 - i]
+                ckpt_metrics.record_restore_fallback()
+                get_logger().error(
+                    "checkpoint step %d under %s failed verification "
+                    "(%s); FALLING BACK to older committed step %d — "
+                    "training resumes with the steps in between lost",
+                    step, self._dir, e, older)
+                from horovod_tpu.diagnostics.flight_recorder import (
+                    record_event)
+                record_event("ckpt_restore_fallback", step=step,
+                             fallback_step=older, error=str(e)[:200])
+        return None  # unreachable; loop raises or returns
 
     def _warn_if_foreign_layout(self) -> None:
         """Nothing restorable, but the directory isn't empty: most

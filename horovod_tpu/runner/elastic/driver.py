@@ -159,8 +159,12 @@ class _GenRuntime:
 #: target's host capacity is reserved for the full drain cooldown
 #: (True: the host is suspect — place the replacement elsewhere) or
 #: only the short restart window (False: the host is healthy, the
-#: replacement should respawn onto it as soon as the chip is free)
-_ACTION_KINDS = {"drain": True, "restart": False}
+#: replacement should respawn onto it as soon as the chip is free).
+#: ``quarantine`` (ISSUE 13) additionally BLOCKLISTS the host with the
+#: action's evidence once the planned re-mesh succeeds — the one
+#: planned exit that is held against the hardware, because silent data
+#: corruption is a device property, not a scheduling accident.
+_ACTION_KINDS = {"drain": True, "restart": False, "quarantine": True}
 
 
 class ElasticDriver:
@@ -555,25 +559,33 @@ class ElasticDriver:
             doomed, meta, tokens = groups[kind]
             doomed.add(origin)
             tokens.append(token)
-            meta.append({"rank": nrank,
-                         "host": g.slot_by_key[origin].hostname,
-                         "source": "autopilot",
-                         "policy": req.get("policy"),
-                         "action": kind})
+            entry = {"rank": nrank,
+                     "host": g.slot_by_key[origin].hostname,
+                     "source": "autopilot",
+                     "policy": req.get("policy"),
+                     "action": kind}
+            if isinstance(req.get("evidence"), dict):
+                # quarantine requests carry the canary digests that
+                # convicted the rank — recorded with the blocklist
+                entry["evidence"] = req["evidence"]
+            meta.append(entry)
         return groups
 
     def _plan_world_out(self, g: _GenRuntime, doomed: set,
                         notice_meta: list, tokens: list,
-                        cooldown: float, event_kind: str) -> bool:
+                        cooldown: float, event_kind: str):
         """Plan the current world around ``doomed`` (shared by drain
         notices and autopilot actions): reserve the doomed capacity,
         mark the exits DRAINED, publish the survivor world, spawn
         replacements onto free capacity — or, when no viable world
         exists, REVERT every piece of that bookkeeping and retry the
         request with backoff (reactive recovery covers an actual
-        death).  Returns True when this tick is consumed (the caller
-        ``continue``s), False when the request was deferred untouched
-        (workers still registering their elastic listeners)."""
+        death).  Returns ``"planned"`` when the survivor world was
+        published, ``"retry"`` when no viable world existed and the
+        request was re-armed with backoff — both truthy: the tick is
+        consumed and the caller ``continue``s — or False when the
+        request was deferred untouched (workers still registering
+        their elastic listeners)."""
         # the planned path needs every involved worker able to APPLY a
         # world doc (elastic listener registered, i.e. it has committed
         # once).  A request racing the job's first commits — a
@@ -661,7 +673,7 @@ class ElasticDriver:
                 "no viable planned world for %s %s; retrying with "
                 "backoff, reactive recovery covers an actual death",
                 event_kind, notice_meta)
-            return True
+            return "retry"
         # rebind the coordinator BEFORE spawning: run_slot reads the
         # runtime's coord fields at call time, and a replacement
         # pointed at the dead world's port would never find the mesh
@@ -674,7 +686,7 @@ class ElasticDriver:
         g.essential_gen = g.world_gen = g.numbering_gen = rec_gen
         g.slots = new_slots2
         g.np = len(new_slots2)
-        return True
+        return "planned"
 
     def _poll_drain_notices(self, g: _GenRuntime) -> bool:
         doomed, notice_meta, tokens = self._scan_drain_notices(g)
@@ -692,9 +704,31 @@ class ElasticDriver:
                 continue
             cooldown = drain_cooldown_s() if reserve_full \
                 else restart_cooldown_s()
-            if self._plan_world_out(g, doomed, meta, tokens, cooldown,
-                                    "autopilot_action_handled"):
-                return True
+            result = self._plan_world_out(g, doomed, meta, tokens,
+                                          cooldown,
+                                          "autopilot_action_handled")
+            if not result:
+                continue  # deferred: try the other action kinds
+            if kind == "quarantine" and result == "planned":
+                # ISSUE 13: unlike a preemption drain, a quarantine IS
+                # evidence against the hardware — blocklist the
+                # divergent rank's host, with the canary digests that
+                # convicted it on the record (re-admitted only by the
+                # HVD_TPU_BLOCKLIST_COOLDOWN_S expiry)
+                from horovod_tpu.diagnostics.flight_recorder import (
+                    record_event)
+                for m in meta:
+                    self._hosts.blacklist(m["host"])
+                    record_event("quarantine_blocklisted",
+                                 host=m["host"], rank=m["rank"],
+                                 policy=m.get("policy"),
+                                 evidence=m.get("evidence"))
+                    get_logger().error(
+                        "quarantine: host %s (rank %d) blocklisted for "
+                        "replica divergence — policy %s, evidence %s",
+                        m["host"], m["rank"], m.get("policy"),
+                        m.get("evidence"))
+            return True
         return False
 
     def _recover_lost_workers(self, g: _GenRuntime) -> None:

@@ -734,7 +734,8 @@ def make_autotuned_train_step(loss_fn, optimizer, mesh,
                               small_floor: Optional[int] = None,
                               overlap: bool = True,
                               sync: bool = True,
-                              donate: bool = True) -> AutotunedStep:
+                              donate: bool = True,
+                              guard=None) -> AutotunedStep:
     """Build the searching/serving step for
     ``make_overlap_train_step(..., autotune=...)``.
 
@@ -777,7 +778,7 @@ def make_autotuned_train_step(loss_fn, optimizer, mesh,
         return make_overlap_train_step(
             loss_fn, optimizer, mesh, axis_name, n_micro=n_micro, op=op,
             overlap=overlap, sync=sync, donate=donate, autotune=False,
-            **plan.step_kwargs(topo))
+            guard=guard, **plan.step_kwargs(topo))
 
     def controller_factory(params) -> AutotuneController:
         fp = plan_fingerprint(params, mesh_shape, world)
@@ -940,7 +941,8 @@ def make_parallel_train_step(layer_fn, loss_fn, optimizer, *,
                              devices=None,
                              autotune=True,
                              op=None,
-                             donate: bool = True
+                             donate: bool = True,
+                             guard=None
                              ) -> ParallelAutotunedStep:
     """Search the unified parallelism space (ROADMAP 1, ISSUE 11): the
     dp x pp split, pipeline schedule, microbatch count and dp
@@ -979,7 +981,8 @@ def make_parallel_train_step(layer_fn, loss_fn, optimizer, *,
         from horovod_tpu.train.pipeline import make_pipeline_train_step
         return make_pipeline_train_step(
             layer_fn, loss_fn, optimizer, plan=plan, n_layers=n_layers,
-            devices=devs, op=op, donate=donate, autotune=False)
+            devices=devs, op=op, donate=donate, autotune=False,
+            guard=guard)
 
     def controller_factory(params, batch_dim: int,
                            fits) -> AutotuneController:

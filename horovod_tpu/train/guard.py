@@ -1,0 +1,435 @@
+"""Numeric guardrails + cross-replica canary: the data-plane integrity
+layer of the train step (docs/TROUBLESHOOTING.md "My loss went NaN / my
+replicas disagree").
+
+Three pieces, one file, because they share the threat model — silently
+wrong math poisoning a run long before anyone looks:
+
+* **Grad guard** (:func:`guarded_apply`): a jit-friendly finiteness +
+  global-norm check fused into the train-step factories
+  (``make_overlap_train_step`` / ``make_pipeline_train_step``).  One
+  scalar — the gradient sum-of-squares, computed on the POST-sync
+  gradients, which are replicated across dp by construction — decides
+  the step: non-finite (or over ``HVD_TPU_GUARD_MAX_NORM``) means the
+  update is zeroed and the optimizer state preserved (the skip-step
+  policy), so one poisoned batch costs one step, not the run.  No added
+  collective round on the dp axis; the pipeline path psums the one
+  scalar over pp so every stage agrees.  Sum-of-squares overflow to inf
+  counts as a spike — that is the gradient explosion the guard exists
+  for.
+* **Skip accounting** (:class:`GuardObserver`): every skipped step
+  counts ``hvd_guard_skipped_steps_total`` and lands a ``guard_skip``
+  flight event; ``HVD_TPU_GUARD_ESCALATE`` consecutive skips escalate
+  into a ``grad_nonfinite`` anomaly finding — the autopilot's
+  ``rollback_restore`` policy subscribes to it (a persistently poisoned
+  run should restore the last durable checkpoint, not keep committing a
+  corrupt optimizer state forward).  Observation is one step deferred
+  (step k's verdict is read while step k+1 is in flight) so the guard
+  never forces a device sync onto the dispatch pipeline.
+* **Replica canary** (:class:`ReplicaCanary`): every
+  ``HVD_TPU_CANARY_EVERY`` steps, allgather a cheap digest of a fixed
+  parameter slice — bit-identical across DP replicas by construction —
+  and flag the odd rank out as a ``replica_divergence`` finding.  This
+  catches compute SDC (a device producing silently-wrong math) that the
+  wire CRC (``HVD_TPU_WIRE_CHECKSUM``, cpp/transport.cc) cannot: the
+  bytes traveled intact, they were wrong at birth.  The autopilot's
+  ``quarantine_rank`` policy subscribes to it.
+
+The chaos ``grad`` seam (docs/CHAOS.md) drives all of it
+deterministically: when a plan arms grad rules for this rank, the
+factories compile an injection seam that corrupts the step's gradients
+in-graph (nan / inf / ``factor``-scale) — the injection code travels as
+DATA, so a firing window never recompiles the step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu.common.config import env_bool, env_float, env_int
+from horovod_tpu.common.logging import get_logger
+
+log = get_logger()
+
+#: elements digested per leaf (a FIXED parameter slice — cheap, layout-
+#: independent, and enough that real divergence cannot hide: a replica
+#: whose math went wrong diverges everywhere, not in one element)
+DIGEST_ELEMS_PER_LEAF = 256
+
+
+# -- spec ---------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class GuardSpec:
+    """Resolved guard configuration (env defaults, see docs/KNOBS.md)."""
+    enabled: bool = True
+    max_norm: float = 0.0        # 0 = finiteness only, no norm cap
+    escalate_after: int = 3      # consecutive skips -> grad_nonfinite
+
+    @staticmethod
+    def from_env() -> "GuardSpec":
+        return GuardSpec(
+            enabled=env_bool("GUARD", True),
+            max_norm=max(0.0, env_float("GUARD_MAX_NORM", 0.0)),
+            escalate_after=max(1, env_int("GUARD_ESCALATE", 3)))
+
+
+def resolve_spec(guard) -> GuardSpec:
+    """The factories' ``guard=`` seam: ``None`` reads env, ``False``
+    disables, ``True`` is the env-tuned default, a :class:`GuardSpec`
+    pins everything."""
+    if isinstance(guard, GuardSpec):
+        return guard
+    if guard is None:
+        return GuardSpec.from_env()
+    if guard is False:
+        return GuardSpec(enabled=False)
+    if guard is True:
+        spec = GuardSpec.from_env()
+        return dataclasses.replace(spec, enabled=True)
+    raise TypeError(f"guard must be None/bool/GuardSpec, got {guard!r}")
+
+
+# -- the in-graph pieces ------------------------------------------------------
+
+def apply_injection(grads, inject):
+    """Chaos ``grad`` seam, in-graph: ``inject`` is a length-2 float32
+    vector ``[code, factor]`` (:data:`horovod_tpu.chaos.GRAD_CODES`).
+    Code 0 leaves the gradients numerically unchanged; 1 adds nan,
+    2 adds inf, 3 multiplies by ``factor``.  Data-dependent on purpose:
+    the same compiled step serves clean and fault-window steps."""
+    import jax
+    import jax.numpy as jnp
+
+    code = inject[0]
+    add = jnp.where(code == 1, jnp.float32(jnp.nan),
+                    jnp.where(code == 2, jnp.float32(jnp.inf),
+                              jnp.float32(0.0)))
+    mul = jnp.where(code == 3, inject[1], jnp.float32(1.0))
+    return jax.tree_util.tree_map(
+        lambda g: g * mul.astype(g.dtype) + add.astype(g.dtype), grads)
+
+
+def grads_ok(grads, spec: GuardSpec, pp_axis: Optional[str] = None):
+    """The one-scalar verdict: sum of squared gradients (float32) must
+    be finite, and under ``max_norm**2`` when a norm cap is set.  Call
+    on POST-dp-sync gradients (replicated across dp — no collective
+    needed); ``pp_axis`` psums the scalar across pipeline stages so
+    every stage reaches the same verdict."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    leaves = jax.tree_util.tree_leaves(grads)
+    sq = jnp.float32(0.0)
+    for leaf in leaves:
+        sq = sq + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    if pp_axis is not None:
+        sq = lax.psum(sq, pp_axis)
+    ok = jnp.isfinite(sq)
+    if spec.max_norm > 0:
+        ok = jnp.logical_and(ok, sq <= jnp.float32(spec.max_norm) ** 2)
+    return ok
+
+
+def guarded_apply(optimizer, grads, opt_state, params, spec: GuardSpec,
+                  pp_axis: Optional[str] = None):
+    """Skip-step optimizer apply: returns ``(params, opt_state, ok)``
+    where a failed verdict yields the UNCHANGED params and optimizer
+    state (a zeroed update that also keeps adam's moments clean of the
+    poisoned gradients — the optimizer state is preserved, not advanced
+    on garbage)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    ok = grads_ok(grads, spec, pp_axis=pp_axis)
+    updates, new_opt = optimizer.update(grads, opt_state, params)
+    new_params = optax.apply_updates(params, updates)
+
+    def sel(new, old):
+        return jnp.where(ok, new, old)
+
+    return (jax.tree_util.tree_map(sel, new_params, params),
+            jax.tree_util.tree_map(sel, new_opt, opt_state),
+            ok)
+
+
+# -- host-side skip accounting ------------------------------------------------
+
+class GuardObserver:
+    """Counts skipped steps and escalates persistent non-finiteness.
+
+    Fed by :class:`GuardedStep` with a ONE-STEP delay (step k's ``ok``
+    scalar is read at step k+1, when it is certainly resolved) so the
+    guard never stalls dispatch.  ``flush()`` drains the pending
+    verdict — tests and end-of-run paths call it."""
+
+    def __init__(self, spec: GuardSpec) -> None:
+        self.spec = spec
+        self.skipped = 0
+        self.consecutive = 0
+        self._counter = None
+
+    def observe(self, step: int, ok: bool) -> None:
+        if ok:
+            self.consecutive = 0
+            return
+        self.skipped += 1
+        self.consecutive += 1
+        try:
+            if self._counter is None:
+                from horovod_tpu.metrics.registry import default_registry
+                self._counter = default_registry().counter(
+                    "hvd_guard_skipped_steps_total",
+                    help="train steps skipped by the numeric guardrail "
+                         "(non-finite or over-norm gradients; update "
+                         "zeroed, optimizer state preserved)")
+            self._counter.inc()
+        except Exception:
+            pass
+        try:
+            from horovod_tpu.diagnostics.flight_recorder import record_event
+            record_event("guard_skip", step=int(step),
+                         consecutive=self.consecutive)
+        except Exception:
+            pass
+        log.warning(
+            "guard: skipped step %d (non-finite or over-norm gradients; "
+            "%d consecutive)", step, self.consecutive)
+        if self.consecutive % self.spec.escalate_after == 0:
+            # every Nth consecutive skip re-reports; the autopilot's
+            # cooldown gate dedups, and a run that stays poisoned keeps
+            # saying so instead of going quiet after one finding
+            try:
+                from horovod_tpu.metrics.anomaly import report_finding
+                report_finding("grad_nonfinite", step=int(step),
+                               consecutive=self.consecutive)
+            except Exception:
+                pass
+
+
+# -- replica canary -----------------------------------------------------------
+
+def param_digest(tree, elems_per_leaf: int = DIGEST_ELEMS_PER_LEAF) -> int:
+    """Deterministic CRC32 digest of a fixed slice of every leaf (the
+    first ``elems_per_leaf`` elements of its flattened value), chained
+    in tree-flatten order.  Mesh-layout invariant: ``np.asarray`` on a
+    (fully addressable) sharded ``jax.Array`` yields the logical global
+    value, so the same parameters digest identically on dp8 and
+    dp2xsp2xtp2.  Leaves this process cannot address whole (true
+    multi-controller shards) are skipped — the canary compares
+    DP-replicated state."""
+    import jax
+
+    crc = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        try:
+            # slice ON DEVICE first: only elems_per_leaf elements ever
+            # cross device->host, not the whole leaf — the digest must
+            # stay cheap on billion-parameter trees
+            flat = np.asarray(leaf.reshape(-1)[:elems_per_leaf])
+        except Exception:
+            try:
+                flat = np.asarray(leaf).reshape(-1)[:elems_per_leaf]
+            except Exception:
+                continue  # not fully addressable / not array-like
+        flat = np.ascontiguousarray(flat)
+        crc = zlib.crc32(flat.tobytes(), crc)
+        crc = zlib.crc32(str(flat.dtype).encode(), crc)
+    return crc & 0x7FFFFFFF
+
+
+def divergent_ranks(digests) -> List[int]:
+    """Majority vote over per-rank digests: ranks whose digest differs
+    from the STRICT-majority value are the odd ones out.  No strict
+    majority (a 50/50 split, or everyone different) attributes nothing
+    — flagging half the fleet on a tie would be worse than silence."""
+    values = [int(d) for d in digests]
+    if len(values) < 2:
+        return []
+    counts: dict = {}
+    for v in values:
+        counts[v] = counts.get(v, 0) + 1
+    modal, n = max(counts.items(), key=lambda kv: kv[1])
+    if n * 2 <= len(values):
+        return []
+    return [r for r, v in enumerate(values) if v != modal]
+
+
+class ReplicaCanary:
+    """Cross-replica divergence detector over the eager allgather.
+
+    ``check(step, tree)`` digests the caller's (DP-replicated) state,
+    allgathers one int64 per rank, and reports a ``replica_divergence``
+    anomaly finding naming each odd rank out.  Wired into
+    :class:`GuardedStep` every ``HVD_TPU_CANARY_EVERY`` steps (0 = off,
+    the default — the digest allgather is cheap but it IS a collective);
+    custom loops call ``check`` directly."""
+
+    def __init__(self, every: int,
+                 elems_per_leaf: int = DIGEST_ELEMS_PER_LEAF) -> None:
+        self.every = int(every)
+        self.elems_per_leaf = elems_per_leaf
+
+    @staticmethod
+    def from_env() -> Optional["ReplicaCanary"]:
+        every = env_int("CANARY_EVERY", 0)
+        return ReplicaCanary(every) if every > 0 else None
+
+    def maybe_check(self, step: int, tree) -> List[dict]:
+        if self.every <= 0 or step <= 0 or step % self.every != 0:
+            return []
+        return self.check(step, tree)
+
+    def check(self, step: int, tree) -> List[dict]:
+        """Returns the findings reported (usually []).  A no-op unless
+        hvd is initialized with a multi-process world — the canary
+        compares REPLICAS, and a single process holds only one."""
+        try:
+            from horovod_tpu.common.basics import is_initialized, rank, size
+            if not is_initialized() or size() < 2:
+                return []
+            world = size()
+            own_rank = rank()
+        except Exception:
+            return []
+        digest = param_digest(tree, self.elems_per_leaf)
+        try:
+            from horovod_tpu.ops.collectives import allgather
+            gathered = np.asarray(allgather(
+                np.array([digest], np.int64), name="hvd.canary.digest"))
+        except Exception:
+            log.warning("canary: digest allgather failed", exc_info=True)
+            raise
+        try:
+            from horovod_tpu.metrics.registry import default_registry
+            default_registry().counter(
+                "hvd_canary_checks_total",
+                help="cross-replica canary digest comparisons run").inc()
+        except Exception:
+            pass
+        digests = [int(d) for d in gathered.reshape(-1)[:world]]
+        odd = divergent_ranks(digests)
+        findings = []
+        if not odd and len(set(digests)) > 1:
+            # replicas DISAGREE but no strict majority can convict a
+            # rank (a 2-replica world, a 50/50 split, everyone
+            # different): quarantine has no target, but silence here
+            # would read as a green canary — count it and say so
+            try:
+                from horovod_tpu.metrics.registry import default_registry
+                default_registry().counter(
+                    "hvd_canary_divergence_total",
+                    help="canary checks that flagged a divergent "
+                         "replica").inc()
+            except Exception:
+                pass
+            try:
+                from horovod_tpu.diagnostics.flight_recorder import (
+                    record_event)
+                record_event("canary_mismatch", step=int(step),
+                             digests=[hex(d) for d in digests])
+            except Exception:
+                pass
+            log.error(
+                "canary: replica digests DISAGREE at step %d with no "
+                "attributable majority (world %d: %s) — data corruption "
+                "somewhere, but no rank can be convicted; compare the "
+                "replicas' state by hand (docs/TROUBLESHOOTING.md)",
+                step, world, [hex(d) for d in digests])
+        for r in odd:
+            try:
+                from horovod_tpu.metrics.registry import default_registry
+                default_registry().counter(
+                    "hvd_canary_divergence_total",
+                    help="canary checks that flagged a divergent "
+                         "replica").inc()
+            except Exception:
+                pass
+            log.error(
+                "canary: replica DIVERGENCE at step %d — rank %d digest "
+                "%#x disagrees with the majority (world %d, own rank "
+                "%d); silent data corruption upstream of the wire",
+                step, r, int(gathered[r]), world, own_rank)
+            try:
+                from horovod_tpu.metrics.anomaly import report_finding
+                f = report_finding(
+                    "replica_divergence", rank=int(r), step=int(step),
+                    digest=int(gathered[r]),
+                    majority=int(
+                        [d for i, d in enumerate(gathered.reshape(-1))
+                         if i not in odd][0]),
+                    world=int(world))
+                if f:
+                    findings.append(f)
+            except Exception:
+                pass
+        return findings
+
+
+# -- the step wrapper ---------------------------------------------------------
+
+class GuardedStep:
+    """Callable wrapper the guard-enabled factories return: same
+    ``step(params, opt_state, batch) -> (params, opt_state, loss)``
+    surface as before, with the compiled function's 4th output (the
+    guard verdict) stripped, observed one step late, and the canary run
+    every ``HVD_TPU_CANARY_EVERY`` steps.  Attribute access forwards to
+    the wrapped step (``.lower``, ``.plan``, ``.prepare_params``, ...)
+    so autotune/bench/pipeline callers keep working."""
+
+    def __init__(self, fn, spec: GuardSpec, inject: bool = False,
+                 observer: Optional[GuardObserver] = None,
+                 canary: Optional[ReplicaCanary] = "env") -> None:
+        self._fn = fn
+        self.guard_spec = spec
+        self._inject = inject
+        self.observer = observer or GuardObserver(spec)
+        self.canary = ReplicaCanary.from_env() if canary == "env" \
+            else canary
+        self._step = 0
+        self._pending: Optional[Tuple[int, Any]] = None
+        self._zero_inj = None  # cached clean-injection device array
+
+    def __call__(self, params, opt_state, batch):
+        import jax.numpy as jnp
+
+        self.flush()
+        code, factor = (0, 0.0)
+        if self._inject:
+            from horovod_tpu import chaos
+            code, factor = chaos.grad_injection(self._step)
+        if code == 0:
+            # the production path: one constant device array, built
+            # once — no per-step host allocation/transfer
+            if self._zero_inj is None:
+                self._zero_inj = jnp.zeros((2,), jnp.float32)
+            inj = self._zero_inj
+        else:
+            inj = jnp.asarray(np.array([code, factor], np.float32))
+        params, opt_state, loss, ok = self._fn(params, opt_state, batch,
+                                               inj)
+        self._pending = (self._step, ok)
+        if self.canary is not None:
+            self.canary.maybe_check(self._step, params)
+        self._step += 1
+        return params, opt_state, loss
+
+    def flush(self) -> None:
+        """Resolve the deferred verdict of the previous step (reads one
+        device scalar; it completed alongside that step's loss)."""
+        if self._pending is not None:
+            step, ok = self._pending
+            self._pending = None
+            try:
+                self.observer.observe(step, bool(np.asarray(ok)))
+            except Exception:
+                log.debug("guard verdict readback failed", exc_info=True)
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)

@@ -269,7 +269,8 @@ def make_overlap_train_step(loss_fn: Callable, optimizer, mesh,
                             overlap: bool = True,
                             sync: bool = True,
                             donate: bool = True,
-                            autotune=None) -> Callable:
+                            autotune=None,
+                            guard=None) -> Callable:
     """jit-compiled data-parallel train step with pipelined bucket
     overlap: ``shard_map`` over ``mesh[axis_name]``, ``n_micro``
     microbatches split from the batch's leading axis, gradients reduced
@@ -289,6 +290,18 @@ def make_overlap_train_step(loss_fn: Callable, optimizer, mesh,
     The returned step then measures candidate plans during early steps,
     locks the winner, and persists it to the plan cache; explicit values
     for the tuned knobs become the search's baseline candidate.
+
+    ``guard`` controls the numeric guardrail
+    (:mod:`horovod_tpu.train.guard`): ``None`` reads ``HVD_TPU_GUARD``
+    (default ON — a non-finite or over-``HVD_TPU_GUARD_MAX_NORM``
+    gradient skips the step with the optimizer state preserved, counted
+    on ``hvd_guard_skipped_steps_total``), ``False`` disables (the
+    exact pre-guard step, three outputs, no wrapper), ``True`` or a
+    :class:`~horovod_tpu.train.guard.GuardSpec` pins it.  With the
+    guard on, the returned callable is a
+    :class:`~horovod_tpu.train.guard.GuardedStep` — same call surface,
+    attributes forwarded — and the chaos ``grad`` seam (when armed) is
+    compiled into the step.
     """
     import optax
     from jax.sharding import PartitionSpec as P
@@ -307,30 +320,58 @@ def make_overlap_train_step(loss_fn: Callable, optimizer, mesh,
             n_micro=n_micro, op=op, bucket_bytes=bucket_bytes,
             compression=compression, ring=ring, algorithm=algorithm,
             topology=topology, small_floor=small_floor, overlap=overlap,
-            sync=sync, donate=donate)
+            sync=sync, donate=donate, guard=guard)
 
+    from horovod_tpu.train import guard as guard_mod
+    gspec = guard_mod.resolve_spec(guard)
     grad_fn = jax.value_and_grad(loss_fn)
 
-    def shard_body(params, opt_state, batch):
+    def _loss_and_grads(params, batch):
         def micro_grad(p, mb):
             return grad_fn(p, mb)
 
         micro = _tree.tree_map(
             lambda x: x.reshape((n_micro, x.shape[0] // n_micro)
                                 + x.shape[1:]), batch)
-        loss, grads = pipelined_accumulate(
+        return pipelined_accumulate(
             micro_grad, params, micro, axis_name=axis_name, op=op,
             bucket_bytes=bucket_bytes, compression=compression, ring=ring,
             algorithm=algorithm, topology=topology, small_floor=small_floor,
             overlap=overlap, sync=sync)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, lax.pmean(loss, axis_name)
+
+    if not gspec.enabled:
+        def shard_body(params, opt_state, batch):
+            loss, grads = _loss_and_grads(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, lax.pmean(loss, axis_name)
+
+        wrapped = shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(P(), P(), P(axis_name)),
+            out_specs=(P(), P(), P()),
+            check_vma=False)
+        return jax.jit(wrapped, donate_argnums=(0, 1) if donate else ())
+
+    # guard on: the body grows the chaos injection seam (data-driven —
+    # compiled in only when a grad fault plan is armed for this rank)
+    # and a 4th output, the guard verdict, which the GuardedStep wrapper
+    # strips and observes one step late
+    from horovod_tpu import chaos
+    inject = chaos.grad_rules_armed()
+
+    def shard_body(params, opt_state, batch, inj):
+        loss, grads = _loss_and_grads(params, batch)
+        if inject:
+            grads = guard_mod.apply_injection(grads, inj)
+        params, opt_state, ok = guard_mod.guarded_apply(
+            optimizer, grads, opt_state, params, gspec)
+        return params, opt_state, lax.pmean(loss, axis_name), ok
 
     wrapped = shard_map(
         shard_body, mesh=mesh,
-        in_specs=(P(), P(), P(axis_name)),
-        out_specs=(P(), P(), P()),
+        in_specs=(P(), P(), P(axis_name), P()),
+        out_specs=(P(), P(), P(), P()),
         check_vma=False)
-
-    return jax.jit(wrapped, donate_argnums=(0, 1) if donate else ())
+    fn = jax.jit(wrapped, donate_argnums=(0, 1) if donate else ())
+    return guard_mod.GuardedStep(fn, gspec, inject=inject)

@@ -157,10 +157,22 @@ class PipelineTrainStep:
         """Storage order back to natural layer order (for export)."""
         return self._permute(params, self._inv)
 
-    def __call__(self, params, opt_state, batch):
+    def __call__(self, params, opt_state, batch, *extra):
+        # *extra: the guard-enabled factory's injection scalars ride
+        # through to the compiled body (train/guard.py GuardedStep)
         if self._fn is None:
             self._fn = self._fn_builder(params, opt_state)
-        return self._fn(params, opt_state, batch)
+        return self._fn(params, opt_state, batch, *extra)
+
+    def __getattr__(self, name):
+        # forward to the wrapped step: the pp==1 degenerate path nests
+        # an (already guard-wrapped) overlap step INSIDE this shell, and
+        # its surface (flush(), observer, guard_spec — train/guard.py)
+        # must stay reachable through it
+        fn = self.__dict__.get("_fn")
+        if fn is None:
+            raise AttributeError(name)
+        return getattr(fn, name)
 
 
 def _layer_specs(tree, n_layers: int, axis_name: str):
@@ -194,7 +206,8 @@ def make_pipeline_train_step(layer_fn: Callable, loss_fn: Callable,
                              topology=None,
                              small_floor: Optional[int] = None,
                              donate: bool = True,
-                             autotune=None) -> PipelineTrainStep:
+                             autotune=None,
+                             guard=None) -> PipelineTrainStep:
     """Build the composed DP x PP train step for a layer-major model
     (module docstring for the contract).
 
@@ -232,7 +245,8 @@ def make_pipeline_train_step(layer_fn: Callable, loss_fn: Callable,
         from horovod_tpu.train.autotune import make_parallel_train_step
         return make_parallel_train_step(
             layer_fn, loss_fn, optimizer, n_layers=n_layers,
-            devices=devices, autotune=autotune, op=op, donate=donate)
+            devices=devices, autotune=autotune, op=op, donate=donate,
+            guard=guard)
 
     if plan is None:
         if mesh is not None:
@@ -303,11 +317,22 @@ def make_pipeline_train_step(layer_fn: Callable, loss_fn: Callable,
         inner = make_overlap_train_step(
             full_loss, optimizer, mesh, "dp",
             n_micro=plan.n_microbatches, op=op, donate=donate,
-            autotune=False, **comm_kwargs)
-        return PipelineTrainStep(lambda *_: inner, plan, mesh,
+            autotune=False, guard=guard, **comm_kwargs)
+        # the inner step is already guard-wrapped (or plain, guard off):
+        # the pipeline shell only carries the plan/permutation surface.
+        # Bind it EAGERLY — the guard surface (flush()/observer) must be
+        # reachable through __getattr__ before the first call too.
+        step = PipelineTrainStep(lambda *_: inner, plan, mesh,
                                  np.arange(n_layers))
+        step._fn = inner
+        return step
 
     perm = stage_layout_permutation(n_layers, plan.pp, plan.virtual_stages)
+
+    from horovod_tpu.train import guard as guard_mod
+    gspec = guard_mod.resolve_spec(guard)
+    from horovod_tpu import chaos as _chaos
+    inject_armed = gspec.enabled and _chaos.grad_rules_armed()
 
     def fn_builder(params_ex, opt_state_ex):
         import optax
@@ -338,7 +363,7 @@ def make_pipeline_train_step(layer_fn: Callable, loss_fn: Callable,
                     lambda g: lax.pmean(g, "dp"), grads)
             return bucketed_grad_sync(grads, "dp", op=op, **comm_kwargs)
 
-        def body(params, opt_state, batch):
+        def body(params, opt_state, batch, *inj):
             x, tgt = batch
             xm = x.reshape((M, x.shape[0] // M) + x.shape[1:])
             tm = tgt.reshape((M, tgt.shape[0] // M) + tgt.shape[1:])
@@ -375,9 +400,20 @@ def make_pipeline_train_step(layer_fn: Callable, loss_fn: Callable,
             grads = dp_reduce(grads)
             if dp_live:
                 loss = lax.pmean(loss, "dp")
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, loss
+            if not gspec.enabled:
+                updates, opt_state = optimizer.update(grads, opt_state,
+                                                      params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, loss
+            if inject_armed:
+                grads = guard_mod.apply_injection(grads, inj[0])
+            # the verdict scalar is psum'd over pp: stage grads are
+            # pp-SHARDED, and every stage must reach the same
+            # skip/apply decision (docs/TROUBLESHOOTING.md)
+            params, opt_state, ok = guard_mod.guarded_apply(
+                optimizer, grads, opt_state, params, gspec,
+                pp_axis="pp")
+            return params, opt_state, loss, ok
 
         # distinct per-plan name: the compile watcher labels compiles by
         # function name, and an autotune search compiling one `body` per
@@ -387,10 +423,18 @@ def make_pipeline_train_step(layer_fn: Callable, loss_fn: Callable,
         p_specs = _layer_specs(params_ex, n_layers, "pp")
         o_specs = _layer_specs(opt_state_ex, n_layers, "pp")
         batch_spec = P("dp")
+        in_specs = (p_specs, o_specs, (batch_spec, batch_spec))
+        out_specs = (p_specs, o_specs, P())
+        if gspec.enabled:
+            in_specs = in_specs + (P(),)       # the injection scalars
+            out_specs = out_specs + (P(),)     # the guard verdict
         return compile_step_with_plan(
             body, mesh,
-            in_specs=(p_specs, o_specs, (batch_spec, batch_spec)),
-            out_specs=(p_specs, o_specs, P()),
+            in_specs=in_specs,
+            out_specs=out_specs,
             donate_argnums=(0, 1) if donate else ())
 
-    return PipelineTrainStep(fn_builder, plan, mesh, perm)
+    step = PipelineTrainStep(fn_builder, plan, mesh, perm)
+    if gspec.enabled:
+        return guard_mod.GuardedStep(step, gspec, inject=inject_armed)
+    return step

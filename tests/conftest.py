@@ -77,6 +77,33 @@ if not os.path.exists(_SO):
         pass  # core tests skip cleanly when the .so is absent
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _no_artifact_debris_in_checkout():
+    """Regression guard for the PR 9/10 cleanup (ISSUE 13 satellite): no
+    test may leave autopsy bundles, flight dumps, or profiler trace
+    dirs in the repo checkout.  The env defaults above route everything
+    to tmp; a test overriding them must use its own tmp_path.  Runs at
+    session teardown so one stray writer fails the run visibly instead
+    of silently re-accumulating debris."""
+    import glob
+
+    def debris():
+        out = []
+        for pat in ("hvd_autopsy", "hvd_profile*",
+                    "hvd_flight_rank*.json", "autopsy_rank*",
+                    "summary_rank*.json"):
+            out += glob.glob(os.path.join(_REPO, pat))
+        return sorted(out)
+
+    before = debris()
+    yield
+    leaked = [p for p in debris() if p not in before]
+    assert not leaked, (
+        f"test run left autopsy/flight artifacts in the checkout: "
+        f"{leaked}; point HVD_TPU_AUTOPSY_DIR / HVD_TPU_PROFILE_DIR / "
+        f"flight dumps at tmp_path instead")
+
+
 @pytest.fixture
 def hvd():
     import horovod_tpu as hvd

@@ -1,0 +1,117 @@
+"""Wire-integrity multiprocess worker: the bit_flip detect/undetect pair.
+
+Scenario (docs/CHAOS.md "Wire integrity"): the fault plan arms a
+``transport.send`` BIT_FLIP on rank 1 — one payload byte of the first
+frame of at least ``min_bytes`` it sends to rank 0 is XOR'd AFTER the
+send-side CRC was computed, i.e. the corruption happens ON THE WIRE.
+
+* ``HVD_TEST_INTEGRITY_MODE=detect`` (checksum on, the default): rank
+  0's reader must catch the mismatch — the failed collective surfaces
+  ``HorovodInternalError`` NAMING peer 1 and the checksum, the engine
+  counter ``transport_checksum_failures`` counts it, and the connection
+  reset makes rank 1 fail too.  Both ranks then recover the way
+  ``elastic.run`` would: disarm, shutdown, re-init, retry — and the
+  retried collective is correct.
+* ``HVD_TEST_INTEGRITY_MODE=undetect`` (``HVD_TPU_WIRE_CHECKSUM=0``):
+  the IDENTICAL flip sails through — the job completes without any
+  error while the allreduce result is silently WRONG — proving the
+  checksum is load-bearing, not decorative.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from horovod_tpu.core.core_backend import CoreBackend  # noqa: E402
+from horovod_tpu.elastic import HorovodInternalError  # noqa: E402
+from horovod_tpu.ops.reduce_op import ReduceOp  # noqa: E402
+
+N = 4096  # 16 KiB payload: ring chunks are ~8 KiB, far over min_bytes
+
+
+def _await_counter(be, key, minimum=1, timeout=5.0):
+    """The loop thread mirrors transport counters once per cycle; a
+    read racing the event by one cycle must not flake the test."""
+    import time
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        c = be.counters()
+        if c.get(key, 0) >= minimum:
+            return c
+        time.sleep(0.01)
+    raise AssertionError(f"{key} never reached {minimum}: "
+                         f"{be.counters()}")
+
+
+def main():
+    mode = os.environ.get("HVD_TEST_INTEGRITY_MODE", "detect")
+    be = CoreBackend()
+    rank = be.rank
+
+    # healthy phase: tiny frames, under the bit_flip min_bytes gate
+    out = be.allreduce_async("warm", np.ones(4, np.float32),
+                             ReduceOp.SUM).wait(60)
+    np.testing.assert_allclose(out, 2.0)
+
+    if mode == "detect":
+        h = be.allreduce_async("big", np.ones(N, np.float32),
+                               ReduceOp.SUM)
+        try:
+            h.wait(60)
+            raise AssertionError(
+                "expected the flipped frame to fail the collective")
+        except HorovodInternalError as e:
+            msg = str(e)
+            if rank == 0:
+                # the receiver of the corrupted frame must NAME the
+                # corrupting peer and the failed check
+                assert "checksum" in msg, msg
+                assert "peer 1" in msg, msg
+                _await_counter(be, "transport_checksum_failures")
+        # recover through the elastic path's mechanics: disarm the
+        # fault, tear the core down, re-init, retry — exactly what
+        # elastic.run's HorovodInternalError branch does around
+        # state.restore()
+        os.environ.pop("HVD_TPU_FAULT_PLAN", None)
+        from horovod_tpu import chaos
+        chaos.uninstall()
+        be.shutdown()
+        be2 = CoreBackend()
+        out = be2.allreduce_async("after", np.ones(8, np.float32),
+                                  ReduceOp.SUM).wait(60)
+        np.testing.assert_allclose(out, 2.0)
+        if rank == 0:
+            # the evidence SURVIVES the recovery: counters accumulate
+            # across transport lives (a fresh transport's 0 must not
+            # erase the recorded failure — a scrape after the few-second
+            # recovery window still sees it)
+            c = be2.counters()
+            assert c.get("transport_checksum_failures", 0) >= 1, c
+        be2.barrier()
+        be2.shutdown()
+    else:  # undetect: checksum off, the same flip passes silently
+        assert os.environ.get("HVD_TPU_WIRE_CHECKSUM") == "0"
+        h = be.allreduce_async("big", np.ones(N, np.float32),
+                               ReduceOp.SUM)
+        out = np.asarray(h.wait(120))  # completes — no error at all
+        if rank == 1:
+            # the flip really happened (send-side injection counter)
+            c = _await_counter(be, "transport_chaos_injected")
+        else:
+            c = be.counters()
+        assert c.get("transport_checksum_failures", 0) == 0, c
+        if rank == 0:
+            # ... and the reduced result is silently WRONG: this is the
+            # failure mode the checksum exists to make impossible
+            assert not np.array_equal(out, np.full(N, 2.0, np.float32)), \
+                "flip armed but result uncorrupted — seam dead?"
+        be.barrier()
+        be.shutdown()
+
+    print(f"integrity worker {rank}: OK ({mode})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -119,12 +119,14 @@ def test_env_inline_and_file_loading(tmp_path, monkeypatch):
         load_policies_from_env()
 
 
-def test_default_policies_cover_the_four_remediations():
+def test_default_policies_cover_every_remediation():
+    """The shipped set: the four ISSUE 12 remediations plus the two
+    ISSUE 13 data-plane integrity ones (quarantine + rollback)."""
     ps = default_policies()
     assert {p.action for p in ps} == set(ACTIONS)
     assert {p.finding for p in ps} == {
         "persistent_straggler", "hbm_growth", "recompile_storm",
-        "world_changed"}
+        "world_changed", "replica_divergence", "grad_nonfinite"}
     # unset env -> the default set
     assert [p.name for p in load_policies_from_env()] == \
         [p.name for p in ps]
