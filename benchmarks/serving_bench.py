@@ -1,0 +1,149 @@
+"""Serving latency bench: closed-loop load against a local replica pair.
+
+Emits one ``BENCH_SERVE``-prefixed JSON line (and optionally a file) —
+the serving analog of the training bench's artifact contract
+(ci/check_bench.py ``--serving`` gates it): qps, windowed p50/p99,
+shed fraction, and the zero-drop audit.  A "clean" p99 that was bought
+by shedding requests is NOT clean — the artifact carries
+``shed_fraction`` precisely so the gate can refuse it.
+
+Default shape: ``--replicas 2`` replica PROCESSES (the fleet heals and
+swaps exactly as in production) driven by ``--clients`` closed-loop
+threads for ``--duration`` seconds.  ``--in-process`` swaps the
+subprocess fleet for two in-process replicas (faster start; used by the
+bench contract tests).
+
+Run:  python benchmarks/serving_bench.py --duration 5 --out BENCH_SERVE.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def run_bench(replicas: int = 2, clients: int = 4, duration_s: float = 5.0,
+              dim: int = 16, in_process: bool = False,
+              warmup_s: float = 1.0) -> dict:
+    from horovod_tpu.serving import ReplicaFleet, ReplicaServer, Router
+    from horovod_tpu.serving.batcher import SheddedError
+
+    servers = []
+    fleet = None
+    if in_process:
+        servers = [ReplicaServer(dim=dim, replica_id=f"bench{i}").start()
+                   for i in range(replicas)]
+        endpoints = [("127.0.0.1", s.port) for s in servers]
+        get_endpoints = lambda: endpoints  # noqa: E731
+    else:
+        fleet = ReplicaFleet(size=replicas, dim=dim).start(
+            ready_timeout_s=120.0)
+        get_endpoints = fleet.endpoints
+    router = Router(get_endpoints)
+
+    stop = threading.Event()
+    t_measure_start = [0.0]
+    counts = {"ok": 0, "shed": 0, "failed": 0}
+    counts_lock = threading.Lock()
+    latencies: list = []
+
+    def client(i: int) -> None:
+        n = 0
+        x = [float(i)] * dim
+        while not stop.is_set():
+            n += 1
+            t0 = time.monotonic()
+            try:
+                router.submit(x, req_id=f"bench-c{i}-{n}")
+                outcome = "ok"
+            except SheddedError:
+                outcome = "shed"
+            except Exception:
+                outcome = "failed"
+            dt = time.monotonic() - t0
+            if t_measure_start[0] and t0 >= t_measure_start[0]:
+                with counts_lock:
+                    counts[outcome] += 1
+                    if outcome == "ok":
+                        latencies.append(dt)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    time.sleep(warmup_s)  # absorb compile + connection setup
+    t_measure_start[0] = time.monotonic()
+    time.sleep(duration_s)
+    stop.set()
+    # join budget must COVER a request's full retry deadline: cutting
+    # a legitimately in-flight submit off mid-retry would record a
+    # false unanswered=1 and fail the zero-drop gate for a run that
+    # dropped nothing
+    join_deadline = time.monotonic() + router.default_deadline_s + 5.0
+    for t in threads:
+        t.join(timeout=max(join_deadline - time.monotonic(), 0.1))
+    measured_s = time.monotonic() - t_measure_start[0]
+    router.close()
+    acct = router.accounting()
+    if fleet is not None:
+        fleet.stop()
+    for s in servers:
+        s.stop()
+
+    latencies.sort()
+    from horovod_tpu.serving.metrics import percentile
+
+    def pct(q: float) -> float:
+        return percentile(latencies, q)
+
+    total = sum(counts.values())
+    return {
+        "bench": "serving",
+        "replicas": replicas,
+        "clients": clients,
+        "dim": dim,
+        "in_process": bool(in_process),
+        "duration_s": round(measured_s, 3),
+        "requests": total,
+        "qps": round(counts["ok"] / max(measured_s, 1e-9), 2),
+        "p50_s": round(pct(0.50), 6),
+        "p99_s": round(pct(0.99), 6),
+        "shed_fraction": round(counts["shed"] / total, 6) if total else 0.0,
+        "failed": counts["failed"],
+        "unanswered": len(acct["unanswered"]),
+        "answered_twice": len(acct["answered_twice"]),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="serving_bench")
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--clients", type=int, default=4)
+    p.add_argument("--duration", type=float, default=5.0)
+    p.add_argument("--warmup", type=float, default=1.0)
+    p.add_argument("--dim", type=int, default=16)
+    p.add_argument("--in-process", action="store_true")
+    p.add_argument("--out", default=None, help="also write the JSON here")
+    args = p.parse_args(argv)
+    doc = run_bench(replicas=args.replicas, clients=args.clients,
+                    duration_s=args.duration, dim=args.dim,
+                    in_process=args.in_process, warmup_s=args.warmup)
+    line = json.dumps(doc)
+    print(f"BENCH_SERVE {line}", flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,6 +50,15 @@ to the analytic value with their drift, so analytic-vs-measured
 divergence is visible per round without being a gate (remat recompute
 and collective latency legitimately live in the gap).
 
+``--serving NEW [--baseline OLD] [--tolerance T]`` is the serving
+latency gate (ISSUE 14): NEW/OLD are ``BENCH_SERVE`` artifacts from
+``benchmarks/serving_bench.py`` (raw JSON or captured output).  The
+gate fails when p99 regresses more than T (default 0.5) over the
+baseline's, and — baseline or not — when the artifact is not CLEAN:
+``shed_fraction > 0`` (a latency number bought by refusing load is not
+a measurement of the same system), failed requests, or a violated
+zero-drop audit (unanswered / double-answered ids) all fail.
+
 ``--trajectory ARTIFACT [--tolerance T]`` is the within-window drift
 gate (ISSUE 7): the bench doc now records ``step_time_series`` — every
 iteration of the timing window — so a run whose *mean* looks fine but
@@ -465,6 +474,96 @@ def scaling_main(argv) -> int:
     return 0
 
 
+def _load_serving_doc(path: str):
+    """A serving artifact: raw JSON, or the last ``BENCH_SERVE {json}``
+    line of captured bench output."""
+    with open(path) as f:
+        text = f.read()
+    doc = None
+    try:
+        parsed = json.loads(text)
+        if isinstance(parsed, dict) and parsed.get("bench") == "serving":
+            doc = parsed
+    except ValueError:
+        pass
+    if doc is None:
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("BENCH_SERVE "):
+                try:
+                    parsed = json.loads(line[len("BENCH_SERVE "):])
+                except ValueError:
+                    continue
+                if isinstance(parsed, dict):
+                    doc = parsed
+    return doc
+
+
+def check_serving(new: dict, baseline, tolerance: float):
+    """Problems with a serving artifact: list of failure strings.
+
+    Two rules (ISSUE 14): (1) a "clean" latency number that SHED
+    requests is not clean — load-shedding trades completeness for
+    latency, so a p99 bought that way must not pass as a measurement
+    of the same system; same for failed/unanswered/double-answered
+    requests (the zero-drop audit rides the artifact).  (2) p99 must
+    not regress more than ``tolerance`` over the baseline's."""
+    problems = []
+    if not new.get("requests"):
+        problems.append("no requests measured (empty window)")
+    if new.get("shed_fraction"):
+        problems.append(
+            f"shed_fraction={new['shed_fraction']} > 0: the latency "
+            "number was bought by shedding load — not a clean number "
+            "(lower the client count or raise the admission budget)")
+    if new.get("failed"):
+        problems.append(f"{new['failed']} request(s) FAILED during the "
+                        "measurement window")
+    if new.get("unanswered") or new.get("answered_twice"):
+        problems.append(
+            f"zero-drop audit violated: unanswered="
+            f"{new.get('unanswered')} answered_twice="
+            f"{new.get('answered_twice')}")
+    if baseline and baseline.get("p99_s") and new.get("p99_s"):
+        base_p99, new_p99 = baseline["p99_s"], new["p99_s"]
+        if new_p99 > base_p99 * (1.0 + tolerance):
+            problems.append(
+                f"p99 REGRESSION: {new_p99:.6f}s vs baseline "
+                f"{base_p99:.6f}s (> {tolerance:.0%} above)")
+    return problems
+
+
+def serving_main(argv) -> int:
+    new_path = argv[argv.index("--serving") + 1]
+    tolerance = float(argv[argv.index("--tolerance") + 1]) \
+        if "--tolerance" in argv else 0.5
+    new = _load_serving_doc(new_path)
+    if not new:
+        print(f"no serving artifact in {new_path}: run "
+              "benchmarks/serving_bench.py --out first")
+        return 1
+    baseline = None
+    base_path = None
+    if "--baseline" in argv:
+        base_path = argv[argv.index("--baseline") + 1]
+        baseline = _load_serving_doc(base_path)
+        if not baseline:
+            print(f"baseline {base_path} carries no serving artifact; "
+                  "judging the new run standalone")
+    problems = check_serving(new, baseline, tolerance)
+    if problems:
+        for p in problems:
+            print(f"serving gate FAILED for {new_path}: {p}")
+        return 1
+    note = f" vs {base_path}" if baseline else \
+        " (no baseline: standalone checks only)"
+    print(f"serving gate OK{note}: qps={new.get('qps')} "
+          f"p50={new.get('p50_s')}s p99={new.get('p99_s')}s "
+          f"shed_fraction={new.get('shed_fraction')} over "
+          f"{new.get('requests')} requests")
+    return 0
+
+
 def main() -> int:
     # budget = bench.py's own hard total wall-clock cap
     # (HVD_BENCH_TOTAL_BUDGET_S, default 1200 s) plus slack: bench must
@@ -558,4 +657,6 @@ if __name__ == "__main__":
         sys.exit(trajectory_main(sys.argv))
     if "--pipeline" in sys.argv:
         sys.exit(pipeline_main(sys.argv))
+    if "--serving" in sys.argv:
+        sys.exit(serving_main(sys.argv))
     sys.exit(main())

@@ -201,3 +201,8 @@ from horovod_tpu import diagnostics  # noqa: F401
 
 # Elastic worker API (reference: horovod.elastic)
 from horovod_tpu import elastic  # noqa: F401
+
+# Zero-drop online serving (docs/SERVING.md): replica fleet, dynamic
+# batcher, hedging router, hot weight swap (reference analog: the
+# elastic driver's Spark/Ray serving integrations)
+from horovod_tpu import serving  # noqa: F401

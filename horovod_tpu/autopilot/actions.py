@@ -53,6 +53,7 @@ _seq = 0
 _frozen: Set[str] = set()
 _retune_hooks: List[Callable[[], None]] = []
 _rollback_hooks: List[Callable[[], None]] = []
+_scale_out_hooks: List[Callable[[], None]] = []
 
 #: finding fields carried as quarantine EVIDENCE into the driver's
 #: blocklist record (docs/OBSERVABILITY.md "Autopilot"): the canary
@@ -84,6 +85,8 @@ def _run(policy: Policy, finding: dict, decision: dict) -> None:
                           if k in finding})
         elif policy.action == "rollback_restore":
             rollback(policy, finding)
+        elif policy.action == "scale_out":
+            scale_out(policy, finding)
         elif policy.action == "freeze_alert":
             freeze(str(finding.get("function", "unknown")), policy,
                    finding)
@@ -260,6 +263,62 @@ def rollback(policy: Optional[Policy] = None,
     return ran
 
 
+def register_scale_out_hook(fn: Callable[[], None]) -> None:
+    """A serving fleet registers a zero-arg callable raising its
+    replica target (``ReplicaFleet.register_autopilot_hook``); the
+    ``scale_out`` remediation runs every hook when a sustained
+    ``slo_breach`` finding fires (docs/SERVING.md)."""
+    with _lock:
+        _scale_out_hooks.append(fn)
+
+
+def scale_out(policy: Optional[Policy] = None,
+              finding: Optional[dict] = None) -> int:
+    """Sustained serving SLO breach: capacity, not tuning, is the
+    remediation the fleet owns — run the registered scale-out hooks.
+    Returns how many ran; with none registered the decision is still a
+    first-class audit artifact (the alert says what SHOULD have grown).
+    """
+    with _lock:
+        hooks = list(_scale_out_hooks)
+    ran = 0
+    for fn in hooks:
+        try:
+            fn()
+            ran += 1
+        except Exception:
+            try:
+                from horovod_tpu.common.logging import get_logger
+                get_logger().warning(
+                    "autopilot: scale-out hook %r failed", fn,
+                    exc_info=True)
+            except Exception:
+                pass
+    _flight("autopilot_scale_out",
+            policy=policy.name if policy else None, hooks=len(hooks),
+            ran=ran, p99_s=(finding or {}).get("p99_s"),
+            slo_s=(finding or {}).get("slo_s"))
+    try:
+        from horovod_tpu.common.logging import get_logger
+        if hooks:
+            get_logger().error(
+                "autopilot: serving p99 %.4fs over SLO %.4fs — scaled "
+                "the replica fleet out via %d/%d hook(s)",
+                (finding or {}).get("p99_s", float("nan")),
+                (finding or {}).get("slo_s", float("nan")),
+                ran, len(hooks))
+        else:
+            get_logger().error(
+                "autopilot: serving SLO breach (p99 %s over %s) and NO "
+                "scale-out hook is registered — grow the replica fleet "
+                "manually (docs/SERVING.md runbook)",
+                (finding or {}).get("p99_s"),
+                (finding or {}).get("slo_s"))
+    except Exception:
+        pass
+    return ran
+
+
 def register_retune_hook(fn: Callable[[], None]) -> None:
     """Training loops that hold a live autotuned step register a zero-
     arg callable here; the ``retune`` remediation runs every hook (in
@@ -319,4 +378,5 @@ def reset() -> None:
         _frozen.clear()
         _retune_hooks.clear()
         _rollback_hooks.clear()
+        _scale_out_hooks.clear()
         _seq = 0

@@ -79,6 +79,11 @@ ACTIONS: Dict[str, bool] = {
     #                              the last durable checkpoint via the
     #                              registered rollback hooks instead of
     #                              committing a poisoned state forward
+    # ISSUE 14 (zero-drop serving, docs/SERVING.md):
+    "scale_out": False,          # serving slo_breach: raise the replica
+    #                              fleet's target size via the
+    #                              registered scale-out hooks (the
+    #                              ReplicaFleet wires itself in)
 }
 
 MODES = ("off", "observe", "act")
@@ -210,10 +215,11 @@ def parse_policies(doc: Union[str, Dict[str, Any]]) -> List[Policy]:
 
 
 def default_policies() -> List[Policy]:
-    """The shipped policy set — the four wired remediations of ISSUE 12
-    plus the two data-plane integrity remediations of ISSUE 13.  Used
-    when ``HVD_TPU_AUTOPILOT_POLICY`` is unset; a custom document
-    REPLACES it (policies are explicit, not merged)."""
+    """The shipped policy set — the four wired remediations of ISSUE
+    12, the two data-plane integrity remediations of ISSUE 13, and the
+    serving SLO scale-out of ISSUE 14.  Used when
+    ``HVD_TPU_AUTOPILOT_POLICY`` is unset; a custom document REPLACES
+    it (policies are explicit, not merged)."""
     return [
         Policy(name="straggler-drain", finding="persistent_straggler",
                action="drain_and_replace"),
@@ -235,6 +241,13 @@ def default_policies() -> List[Policy]:
         # — roll back to the last durable commit rather than carry it
         Policy(name="nonfinite-rollback", finding="grad_nonfinite",
                action="rollback_restore"),
+        # serving p99 over SLO for consecutive windows (ISSUE 14,
+        # horovod_tpu/serving/metrics.py): more replicas is the
+        # remediation the fleet can apply itself; 60s cooldown — a
+        # scale-out needs a replica cold-start before it can help,
+        # re-firing faster than that just overshoots
+        Policy(name="serving-slo-scaleout", finding="slo_breach",
+               action="scale_out", cooldown_s=60.0),
     ]
 
 

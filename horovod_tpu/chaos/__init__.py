@@ -144,9 +144,13 @@ class ChaosEngine:
                 raise_after = ConnectionRefusedError(
                     f"chaos: injected partition (rank {self.rank} -> "
                     f"{peer}, invocation {invocation})")
-            elif rule.kind in ("notice", "nan", "inf", "scale"):
+            elif rule.kind in ("notice", "nan", "inf", "scale", "shed"):
                 pass  # pure signal: the applied list IS the payload
-                # (grad kinds are consumed in-graph by train/guard.py)
+                # (grad kinds are consumed in-graph by train/guard.py;
+                # the serving `shed` kind by the replica's /infer
+                # handler, which maps it to an explicit 429 — serving
+                # `error` takes the raising `error` branch above and
+                # surfaces as the handler's 500)
             elif rule.kind == "io_error":
                 raise_after = OSError(
                     f"chaos: injected IO error ({seam} invocation "

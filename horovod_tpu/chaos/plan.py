@@ -81,6 +81,14 @@ SEAMS: Dict[str, frozenset] = {
     "preemption": frozenset({"notice"}),
     "transport.send": frozenset({"delay", "drop", "close", "bit_flip"}),
     "transport.recv": frozenset({"delay", "drop", "close", "bit_flip"}),
+    # serving request path (docs/CHAOS.md, docs/SERVING.md): fired by
+    # the replica's /infer handler per request — ``error`` fails the
+    # request with 500 (the router must retry it to a survivor),
+    # ``delay`` sleeps in the handler (the router's hedge must cover
+    # it), ``shed`` forces an explicit 429 (backpressure must surface,
+    # never silently drop).  Invocation index = per-process request
+    # count.
+    "serving.request": frozenset({"error", "delay", "shed"}),
     # gradient corruption at the train step (docs/CHAOS.md): the seam
     # index IS the training step (like ``step``); the armed kinds are
     # read by the guard-integrated train-step factories

@@ -419,7 +419,8 @@ class ShardedCheckpointer:
     def all_steps(self) -> List[int]:
         return fmt.list_steps(self._dir)
 
-    def restore_latest(self, like: Any = None) -> Optional[Any]:
+    def restore_latest(self, like: Any = None,
+                       return_step: bool = False) -> Any:
         """Restore the newest committed step — falling back to the next
         older commit when the newest fails verification (sha256
         mismatch, missing shard, corrupt manifest).  A corrupt NEWEST
@@ -427,14 +428,22 @@ class ShardedCheckpointer:
         outright, turning one bad write into a dead run; now it costs
         the steps between the two commits, counted LOUDLY
         (``hvd_checkpoint_restore_fallback_total``, an error log and a
-        ``ckpt_restore_fallback`` flight event per skipped step)."""
+        ``ckpt_restore_fallback`` flight event per skipped step).
+
+        ``return_step=True`` returns ``(step, state)`` instead — the
+        step ACTUALLY restored, which on a fallback is older than
+        ``latest_step()``.  Callers that version what they serve by it
+        (the serving hot-swap path) must use this form: labeling
+        fallback state with ``latest_step()`` would misname the data
+        AND permanently mask the newer step."""
         steps = fmt.list_steps(self._dir)
         if not steps:
             self._warn_if_foreign_layout()
-            return None
+            return (None, None) if return_step else None
         for i, step in enumerate(reversed(steps)):
             try:
-                return self.restore(step, like)
+                state = self.restore(step, like)
+                return (step, state) if return_step else state
             except CheckpointError as e:
                 if i == len(steps) - 1:
                     raise  # the oldest commit: nothing left to fall to

@@ -132,6 +132,32 @@ def render_top(series: Dict[str, float], source: str) -> str:
                     if k.startswith("hvd_remesh_seconds_sum"))
         lines.append(f"re-meshes       : {int(remeshes)} "
                      f"({_fmt_seconds(rsecs)} total recovery)")
+    # serving view (docs/SERVING.md): the windowed SLO signal plus the
+    # robustness counters — sheds are EXPLICIT 429s, hedges/retries are
+    # requests that survived a slow or dead replica
+    qps = series.get("hvd_serving_qps")
+    accepted = series.get("hvd_serving_accepted_total")
+    if qps is not None or accepted is not None:
+        shed = sum(v for k, v in series.items()
+                   if k.startswith("hvd_serving_shed_total"))
+        lines.append(
+            f"SERVING         : {qps or 0.0:,.1f} qps  "
+            f"queue {int(series.get('hvd_serving_queue_depth', 0))}  "
+            f"p50 {_fmt_seconds(series.get('hvd_serving_p50_seconds'))}  "
+            f"p99 {_fmt_seconds(series.get('hvd_serving_p99_seconds'))}  "
+            f"shed {int(shed)}  "
+            f"hedged {int(series.get('hvd_serving_hedged_total', 0))}  "
+            f"retried {int(series.get('hvd_serving_retried_total', 0))}")
+        replicas = series.get("hvd_serving_replicas_live")
+        if replicas is not None:
+            target = series.get("hvd_serving_replicas_target", replicas)
+            gap = "" if replicas >= target else "  << FLEET BELOW TARGET"
+            lines.append(
+                f"replicas        : {int(replicas)}/{int(target)} ready"
+                f" (weights v{int(series.get('hvd_serving_weight_version', 0))},"
+                f" {int(series.get('hvd_serving_swaps_total', 0))} swaps,"
+                f" {int(series.get('hvd_serving_replica_respawns_total', 0))}"
+                f" respawns)" + gap)
     for key, value in sorted(series.items()):
         if key.endswith("_per_second") and "{" not in key:
             lines.append(f"{key[4:]:<16}: {value:,.1f}")
@@ -261,7 +287,44 @@ def render_actions_table(decisions) -> str:
     return "\n".join(lines)
 
 
+def render_serving_table(points) -> str:
+    """The per-window serving latency series (docs/SERVING.md): one row
+    per closed :class:`~horovod_tpu.serving.metrics.LatencyWindow` —
+    the trajectory behind "my p99 spiked" (docs/TROUBLESHOOTING.md)."""
+    head = (f"{'ts':<19} {'rank':>4} {'window':>8} {'requests':>9} "
+            f"{'qps':>9} {'p50':>10} {'p99':>10} {'shed':>6}")
+    lines = [head]
+    for p in points:
+        w = p["serving"]
+        ts = time.strftime("%Y-%m-%d %H:%M:%S",
+                           time.localtime(p.get("ts", 0)))
+        lines.append(
+            f"{ts:<19} {str(p.get('rank', '-')):>4} "
+            f"{w.get('window_s', 0):>7.1f}s {w.get('requests', 0):>9} "
+            f"{w.get('qps', 0):>9.1f} "
+            f"{_fmt_seconds(w.get('p50_s')):>10} "
+            f"{_fmt_seconds(w.get('p99_s')):>10} "
+            f"{w.get('shed', 0):>6}")
+    lines.append(f"-- {len(points)} serving window(s)")
+    return "\n".join(lines)
+
+
 def cmd_history(args: argparse.Namespace) -> int:
+    if getattr(args, "serving", False):
+        points = [p for p in read_series(args.dir, rank=args.rank)
+                  if isinstance(p.get("serving"), dict)]
+        if args.last:
+            points = points[-args.last:]
+        if not points:
+            print(f"no serving windows recorded under {args.dir}",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            for p in points:
+                print(json.dumps(p))
+            return 0
+        print(render_serving_table(points))
+        return 0
     if getattr(args, "actions", False):
         # the autopilot action log rides its own JSONL files
         # (actions_rank<r>.jsonl) in the same store
@@ -295,7 +358,8 @@ def cmd_history(args: argparse.Namespace) -> int:
         print(render_remesh_table(episodes))
         return 0
     # step points only: free-form episode points have their own view
-    points = [p for p in points if "remesh" not in p]
+    points = [p for p in points if "remesh" not in p
+              and "serving" not in p]
     if args.last:
         points = points[-args.last:]
     if not points:
@@ -347,6 +411,10 @@ def main(argv=None) -> int:
                         "(actions_rank<r>.jsonl) instead of the step "
                         "series — one row per fired/dry-run/suppressed "
                         "decision")
+    h.add_argument("--serving", action="store_true",
+                   help="render the per-window serving latency series "
+                        "(qps, p50/p99, shed) instead of the step "
+                        "series — one row per closed latency window")
     h.set_defaults(fn=cmd_history)
     args = p.parse_args(argv)
     try:

@@ -84,6 +84,16 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             doc = exporter.health()
             code = 200 if doc.get("status") == "ok" else 503
             self._send(code, json.dumps(doc).encode(), "application/json")
+        elif path == "/readyz":
+            # READINESS, split from /healthz LIVENESS (docs/SERVING.md):
+            # healthz answers "is the process alive and making progress"
+            # (restart me when not); readyz answers "should you route
+            # traffic/work at me right now" (a draining or still-
+            # restoring replica is alive but NOT ready) — orchestrators
+            # that conflate the two discover drain via errors
+            doc = exporter.ready()
+            code = 200 if doc.get("ready") else 503
+            self._send(code, json.dumps(doc).encode(), "application/json")
         elif path.startswith("/debug/"):
             self._debug(path[len("/debug/"):], query)
         else:
@@ -210,14 +220,23 @@ class MetricsExporter:
         gauges (e.g. :class:`horovod_tpu.metrics.engine.EngineCollector`).
       health_fn: optional callable returning the ``/healthz`` JSON doc;
         default reports ``{"status": "ok"}``.
+      ready_fn: optional callable returning the ``/readyz`` JSON doc
+        (must carry a boolean ``ready``); default derives readiness
+        from ``health_fn`` (ready iff healthy).  Custom embedders
+        install their own probe here; serving replicas implement the
+        SAME /readyz contract (model loaded + queue under budget + not
+        draining) on their own request server, since their HTTP plane
+        also carries /infer (:mod:`horovod_tpu.serving.replica`).
     """
 
     def __init__(self, registry: Optional[Registry] = None, port: int = 0,
                  collectors: Iterable[Callable[[], None]] = (),
-                 health_fn: Optional[Callable[[], dict]] = None) -> None:
+                 health_fn: Optional[Callable[[], dict]] = None,
+                 ready_fn: Optional[Callable[[], dict]] = None) -> None:
         self._registry = registry or default_registry()
         self._collectors = list(collectors)
         self._health_fn = health_fn
+        self._ready_fn = ready_fn
         self._httpd = ThreadedHTTPServer(("0.0.0.0", port), _MetricsHandler)
         self._httpd.exporter = self
         self._thread: Optional[threading.Thread] = None
@@ -247,6 +266,27 @@ class MetricsExporter:
             except Exception as e:
                 return {"status": "error", "error": repr(e)}
         return {"status": "ok"}
+
+    def set_ready_fn(self, fn: Optional[Callable[[], dict]]) -> None:
+        """Install (or clear) the readiness probe after construction —
+        a replica whose model loads asynchronously registers it once
+        the serving loop owns the state the probe reads."""
+        self._ready_fn = fn
+
+    def ready(self) -> dict:
+        """The ``/readyz`` doc.  A failing probe reads as NOT ready
+        (fail-closed: an orchestrator must not route at a replica whose
+        own readiness probe is broken), unlike ``health()`` where a
+        failing probe still reports the process alive-ish."""
+        if self._ready_fn is not None:
+            try:
+                doc = self._ready_fn()
+                doc.setdefault("ready", False)
+                return doc
+            except Exception as e:
+                return {"ready": False, "error": repr(e)}
+        h = self.health()
+        return {"ready": h.get("status") == "ok", "health": h}
 
     def start(self) -> int:
         self._thread = threading.Thread(

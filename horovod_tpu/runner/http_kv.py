@@ -69,12 +69,104 @@ class _KVHandler(BaseHTTPRequestHandler):
 class ThreadedHTTPServer(ThreadingHTTPServer):
     """Shared server base for the repo's tiny HTTP planes (KV/rendezvous
     here, the per-worker metrics exporter in
-    :mod:`horovod_tpu.metrics.exporter`): threaded, daemonized, with a
+    :mod:`horovod_tpu.metrics.exporter`, the serving replica endpoints
+    in :mod:`horovod_tpu.serving.replica`): threaded, daemonized, with a
     deep accept backlog — many agents poll concurrently and the
     socketserver default backlog of 5 resets connections under bursts on
-    slow machines."""
+    slow machines.
+
+    Hardened for the serving plane (docs/SERVING.md), benefiting every
+    endpoint that rides it (``/metrics`` scrapes, the KV relay, the
+    autopsy's ``/debug/*`` fetches):
+
+    * **bounded concurrent-handler pool** — at most
+      ``HVD_TPU_HTTP_MAX_HANDLERS`` (default 64) requests are handled
+      at once; beyond that the connection gets an immediate minimal
+      ``503`` + close instead of an unbounded thread pile-up (counted
+      as ``hvd_http_busy_rejected_total``).  The plain ThreadingMixIn
+      spawns one thread per accepted connection with no cap — a
+      misbehaving poller could grow threads until the process died.
+    * **per-request read/write timeouts** — every accepted socket gets
+      ``HVD_TPU_HTTP_TIMEOUT_S`` (default 30) as its socket timeout, so
+      one wedged or glacial client times out and frees its handler slot
+      instead of pinning a thread (and, with the pool bound, eventually
+      the whole plane) forever.
+
+    Both knobs can be overridden per server via the ``max_handlers`` /
+    ``handler_timeout_s`` constructor arguments (0 disables)."""
 
     request_queue_size = 128
+
+    def __init__(self, server_address, RequestHandlerClass,
+                 max_handlers: Optional[int] = None,
+                 handler_timeout_s: Optional[float] = None) -> None:
+        super().__init__(server_address, RequestHandlerClass)
+        from horovod_tpu.common.config import env_float, env_int
+        if max_handlers is None:
+            max_handlers = env_int("HTTP_MAX_HANDLERS", 64)
+        if handler_timeout_s is None:
+            handler_timeout_s = env_float("HTTP_TIMEOUT_S", 30.0)
+        self.handler_timeout_s = handler_timeout_s
+        self._handler_slots = (
+            threading.BoundedSemaphore(max_handlers)
+            if max_handlers and max_handlers > 0 else None)
+
+    def process_request(self, request, client_address):
+        if self.handler_timeout_s and self.handler_timeout_s > 0:
+            try:
+                # read/write deadline for the whole exchange: a client
+                # that stops sending (or reading) raises socket.timeout
+                # in the handler, which closes the connection
+                request.settimeout(self.handler_timeout_s)
+            except OSError:
+                pass
+        if self._handler_slots is not None and \
+                not self._handler_slots.acquire(blocking=False):
+            self._reject_busy(request)
+            return
+        try:
+            super().process_request(request, client_address)
+        except Exception:
+            if self._handler_slots is not None:
+                self._handler_slots.release()
+            raise
+
+    def process_request_thread(self, request, client_address):
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            if self._handler_slots is not None:
+                self._handler_slots.release()
+
+    def _reject_busy(self, request) -> None:
+        """Every handler slot is busy: answer a minimal 503 inline (on
+        the accept thread — no new thread, no handler parse) and close.
+        Explicit backpressure, never a silent drop: the client sees a
+        retryable status, the operator sees the counter."""
+        _metric("hvd_http_busy_rejected_total",
+                "connections rejected 503 because every handler slot "
+                "of a ThreadedHTTPServer was busy")
+        try:
+            request.sendall(
+                b"HTTP/1.1 503 Service Unavailable\r\n"
+                b"Retry-After: 1\r\nContent-Length: 5\r\n"
+                b"Connection: close\r\n\r\nbusy\n")
+        except OSError:
+            pass
+        try:
+            self.shutdown_request(request)
+        except OSError:
+            pass
+
+    def handle_error(self, request, client_address):
+        # a wedged client timing out (or vanishing mid-write) is the
+        # EXPECTED outcome of the per-request deadline policy, not a
+        # server bug — don't spray tracebacks on stderr for it
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (TimeoutError, ConnectionError, OSError)):
+            return
+        super().handle_error(request, client_address)
 
 
 class _KVServer(ThreadedHTTPServer):
@@ -161,6 +253,17 @@ class KVStoreServer:
                        if s == scope and (method is None or m == method))
 
 
+class HTTPBusyError(OSError):
+    """A 429/503 backpressure answer converted to a RETRYABLE error:
+    the hardened handler pool's inline 503 busy-reject advertises
+    ``Retry-After`` and means "again in a moment", not "never" — but
+    ``HTTPError`` sits in the retry shield's ``give_up_on``, so
+    without the conversion the first busy burst would terminally fail
+    a KV call that a 50ms backoff would have saved.  Subclasses
+    ``OSError`` so the relay client's broad fallback handling still
+    sees it as a transient transport problem."""
+
+
 def _with_retries(do, attempts: int = 4,
                   deadline_s: Optional[float] = None,
                   site: str = "http_kv"):
@@ -170,10 +273,23 @@ def _with_retries(do, attempts: int = 4,
     worker.  ``deadline_s`` caps TOTAL wall time (attempts + sleeps) so
     the call's cost stays tied to the caller's intent instead of
     ``attempts × per-attempt timeout``; ``site`` labels the per-call-site
-    retry metrics (``hvd_retry_*_total{site=...}``)."""
+    retry metrics (``hvd_retry_*_total{site=...}``).  HTTP 429/503 —
+    explicit backpressure, incl. the bounded handler pool's busy
+    reject — retries like a connection reset; other HTTP statuses
+    (404, 4xx) stay terminal."""
     import http.client
+
+    def do_busy_aware():
+        try:
+            return do()
+        except HTTPError as e:
+            if e.code in (429, 503):
+                raise HTTPBusyError(
+                    f"HTTP {e.code} (backpressure) from {e.url}") from e
+            raise
+
     return retry_call(
-        do, site=site,
+        do_busy_aware, site=site,
         retry_on=(ConnectionError, http.client.RemoteDisconnected,
                   TimeoutError, OSError),
         give_up_on=(HTTPError,),

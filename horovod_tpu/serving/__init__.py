@@ -1,0 +1,38 @@
+"""Zero-drop online serving: the trained model meets live traffic.
+
+The serving plane composes what the training stack already proved
+(docs/SERVING.md): an elastic replica fleet (process-managed, healed to
+target size, DRAINED-vs-FAILURE exit classification), a continuous
+dynamic batcher feeding a compiled forward step (bounded queue,
+max-batch/max-wait knobs, per-request deadlines), a hedging/retrying
+router with idempotent request ids (a replica killed mid-batch costs
+latency, never a dropped request), zero-downtime hot weight swap from
+the durable sharded checkpoint store, explicit 429 load-shedding under
+backpressure, drain semantics reusing the preemption-notice plumbing,
+and per-request p50/p99 SLO gauges with an autopilot ``slo_breach`` →
+scale-out policy.
+
+Reference analog: the reference's elastic driver plus its Spark/Ray
+integrations ship the serve-from-the-training-fleet story
+(PAPER.md L6/L7); here it ships as a robustness guarantee — under
+replica kills, preemption notices, and partitions, **no accepted
+request is ever dropped** (chaos-proven: tests/test_serving.py).
+"""
+
+from horovod_tpu.serving.batcher import (DeadlineError, DrainingError,
+                                         DynamicBatcher, PendingRequest,
+                                         SheddedError)
+from horovod_tpu.serving.fleet import ReplicaFleet
+from horovod_tpu.serving.metrics import LatencyWindow
+from horovod_tpu.serving.replica import (ReplicaServer, demo_apply,
+                                         demo_params)
+from horovod_tpu.serving.router import (RequestFailed, RequestLog,
+                                        RequestRejected, Router,
+                                        ready_endpoints)
+
+__all__ = [
+    "DynamicBatcher", "PendingRequest", "SheddedError", "DrainingError",
+    "DeadlineError", "ReplicaServer", "demo_apply", "demo_params",
+    "Router", "RequestLog", "RequestFailed", "RequestRejected",
+    "ready_endpoints", "ReplicaFleet", "LatencyWindow",
+]

@@ -1,0 +1,159 @@
+"""``python -m horovod_tpu.serving`` — run a local serving stack.
+
+Operator entry point (docs/SERVING.md "Running a local fleet"): spawns
+``--replicas`` replica processes over ``--store-dir``, wires them
+behind an in-process router, and serves the FRONT on ``--port``:
+
+* ``POST /infer`` — ``{"id": ..., "x": [...]}`` through the router
+  (admission control, hedging, retry); sheds answer 429 explicitly.
+* ``GET /readyz`` — 200 once the fleet serves at least one READY
+  replica; ``/healthz`` — process liveness + fleet view.
+* ``GET /metrics`` — the front process's registry: the router-side
+  ``hvd_serving_*`` counters/gauges (qps, p50/p99, shed/hedge/retry,
+  fleet size) that ``python -m horovod_tpu.metrics top`` renders as
+  the SERVING line.
+
+Intended for local smoke-serving and the bench; production runs embed
+:class:`ReplicaFleet`/:class:`Router` behind their own front end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+
+
+class _FrontHandler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):
+        pass
+
+    def _send(self, code: int, doc: dict) -> None:
+        try:
+            body = json.dumps(doc).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except OSError:
+            pass
+
+    def do_POST(self):
+        from horovod_tpu.serving.batcher import SheddedError
+        from horovod_tpu.serving.router import (RequestFailed,
+                                                RequestRejected)
+        if self.path.split("?", 1)[0].rstrip("/") != "/infer":
+            self._send(404, {"error": "not found"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(n))
+        except (ValueError, OSError):
+            self._send(400, {"error": "bad request body"})
+            return
+        router = self.server.router
+        try:
+            resp = router.submit(doc.get("x"), req_id=doc.get("id"),
+                                 deadline_s=(float(doc["deadline_ms"])
+                                             / 1000.0
+                                             if "deadline_ms" in doc
+                                             else None))
+            self._send(200, resp)
+        except SheddedError as e:
+            self._send(429, {"error": str(e)})
+        except RequestRejected as e:
+            self._send(e.code, e.doc)  # the replica's own 4xx verdict
+        except RequestFailed as e:
+            self._send(503, {"error": str(e)})
+        except Exception as e:  # the front must not die per request
+            self._send(500, {"error": repr(e)})
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        fleet = self.server.fleet
+        if path == "/metrics":
+            from horovod_tpu.metrics.registry import (default_registry,
+                                                      render_prometheus)
+            body = render_prometheus(default_registry().snapshot())
+            try:
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            except OSError:
+                pass
+        elif path == "/readyz":
+            live = fleet.live_count()
+            self._send(200 if live > 0 else 503,
+                       {"ready": live > 0, "replicas_live": live,
+                        "replicas_target": fleet.target})
+        elif path == "/healthz":
+            self._send(200, {"status": "ok",
+                             "replicas_live": fleet.live_count(),
+                             "replicas_target": fleet.target})
+        else:
+            self._send(404, {"error": "not found"})
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m horovod_tpu.serving")
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--port", type=int, default=0,
+                   help="front port for POST /infer + /metrics "
+                        "(0 = ephemeral, printed at startup)")
+    p.add_argument("--store-dir", default=None,
+                   help="durable sharded store to serve (and hot-swap) "
+                        "weights from")
+    p.add_argument("--dim", type=int, default=16)
+    p.add_argument("--status-interval", type=float, default=5.0)
+    p.add_argument("--duration", type=float, default=0.0,
+                   help="exit after this many seconds (0 = forever)")
+    args = p.parse_args(argv)
+
+    from horovod_tpu.runner.http_kv import ThreadedHTTPServer
+    from horovod_tpu.serving import ReplicaFleet, Router
+    fleet = ReplicaFleet(size=args.replicas, store_dir=args.store_dir,
+                         dim=args.dim).start()
+    router = Router(fleet.endpoints)
+    fleet.register_autopilot_hook()
+    # handler pool sized from the ADMISSION budget (same rule the
+    # replica applies to itself): the router's explicit 429 shed must
+    # be reachable — a pool smaller than max_inflight would answer raw
+    # 503 busy before admission control ever engaged.  An explicit
+    # HVD_TPU_HTTP_MAX_HANDLERS wins verbatim (0 = unbounded).
+    from horovod_tpu.common.config import env_int
+    env_pool = env_int("HTTP_MAX_HANDLERS", -1)
+    pool = env_pool if env_pool >= 0 else router.max_inflight + 16
+    front = ThreadedHTTPServer(("0.0.0.0", args.port), _FrontHandler,
+                               max_handlers=pool)
+    front.router, front.fleet = router, fleet
+    threading.Thread(target=front.serve_forever,
+                     name="hvd-serving-front", daemon=True).start()
+    print(f"serving: front on :{front.server_address[1]}/infer, "
+          f"{args.replicas} replicas {fleet.endpoints()}", flush=True)
+    start = time.monotonic()
+    try:
+        while not args.duration \
+                or time.monotonic() - start < args.duration:
+            time.sleep(args.status_interval)
+            acct = router.accounting()
+            print(f"serving: live={fleet.live_count()}/{fleet.target} "
+                  f"outcomes={acct['outcomes']}", flush=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        front.shutdown()
+        router.close()
+        fleet.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,322 @@
+"""The elastic replica fleet: spawn, watch, heal, drain, scale out.
+
+The serving analog of the elastic driver's world management
+(docs/SERVING.md "Fleet"): a :class:`ReplicaFleet` owns N replica
+PROCESSES (``python -m horovod_tpu.serving.replica``), monitors their
+``/readyz`` probes, classifies every exit — **DRAINED** (exit code 0:
+preemption/admin drain completed; a planned event, never failure
+evidence) vs **FAILURE** (crash/SIGKILL) — and heals back to the
+target size by respawning replacements on fresh ports.  The router's
+endpoint view is the fleet's live READY set, so a draining or dead
+replica drops out of rotation before requests discover it.
+
+``scale_out`` is the autopilot seam: the ``serving-slo-scaleout``
+policy (finding ``slo_breach`` → action ``scale_out``) runs the hook
+the fleet registers, raising the target size — detection to
+remediation with the same audit trail as every other autopilot action
+(docs/OBSERVABILITY.md "Autopilot").
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.common.config import env_float
+from horovod_tpu.common.logging import get_logger
+from horovod_tpu.serving import metrics as smetrics
+
+Endpoint = Tuple[str, int]
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _flight(kind: str, **fields) -> None:
+    try:
+        from horovod_tpu.diagnostics.flight_recorder import record_event
+        record_event(kind, **fields)
+    except Exception:
+        pass
+
+
+class _Replica:
+    def __init__(self, slot: int, incarnation: int, port: int,
+                 proc: subprocess.Popen, log_path: str) -> None:
+        self.slot = slot
+        self.incarnation = incarnation
+        self.port = port
+        self.proc = proc
+        self.log_path = log_path
+        self.ready = False
+
+    def log_tail(self, n: int = 2000) -> str:
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - n))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+    @property
+    def endpoint(self) -> Endpoint:
+        return ("127.0.0.1", self.port)
+
+    def name(self) -> str:
+        return f"slot{self.slot}.{self.incarnation}"
+
+
+class ReplicaFleet:
+    """Local replica-process fleet.
+
+    Args:
+      size: initial target replica count.
+      store_dir: durable sharded store every replica restores from and
+        watches for hot swaps.
+      dim: demo-model width forwarded to replicas.
+      extra_env: env overrides for spawned replicas (chaos plans,
+        serving knobs).
+      poll_s: monitor loop interval.
+    """
+
+    MAX_EXITS = 100  # bounded exit-classification audit
+
+    def __init__(self, size: int = 2, store_dir: Optional[str] = None,
+                 dim: int = 16, extra_env: Optional[dict] = None,
+                 poll_s: Optional[float] = None) -> None:
+        self.target = size
+        self.store_dir = store_dir
+        self.dim = dim
+        self.extra_env = dict(extra_env or {})
+        self.poll_s = poll_s if poll_s is not None \
+            else env_float("SERVING_FLEET_POLL_S", 0.25)
+        self._replicas: Dict[int, _Replica] = {}
+        self._incarnations = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self.exits: List[dict] = []  # classification audit
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, ready_timeout_s: float = 60.0) -> "ReplicaFleet":
+        for slot in range(self.target):
+            self._spawn(slot)
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         name="hvd-serving-fleet",
+                                         daemon=True)
+        self._monitor.start()
+        if not self.wait_ready(self.target, timeout_s=ready_timeout_s):
+            raise RuntimeError(
+                f"fleet: {self.target} replicas not ready within "
+                f"{ready_timeout_s}s")
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+        with self._lock:
+            replicas = list(self._replicas.values())
+        for r in replicas:
+            try:
+                r.proc.terminate()
+            except OSError:
+                pass
+        for r in replicas:
+            try:
+                r.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                r.proc.kill()
+            try:
+                os.unlink(r.log_path)
+            except OSError:
+                pass
+
+    # -- spawning -----------------------------------------------------------
+    def _spawn(self, slot: int) -> _Replica:
+        with self._lock:
+            self._incarnations += 1
+            inc = self._incarnations
+        port = _free_port()
+        env = dict(os.environ)
+        # rank-scoped chaos rules address replicas by SLOT (stable
+        # across respawns — a replacement in the slot is the same
+        # logical replica, and markers keep one-shot rules one-shot)
+        env["HVD_TPU_RANK"] = str(slot)
+        env.update(self.extra_env)
+        cmd = [sys.executable, "-m", "horovod_tpu.serving.replica",
+               "--port", str(port), "--dim", str(self.dim),
+               "--replica-id", f"slot{slot}.{inc}"]
+        if self.store_dir:
+            cmd += ["--store-dir", self.store_dir]
+        # log to a FILE, not a pipe: nobody drains a pipe while the
+        # replica lives, and a full pipe would wedge it mid-request
+        import tempfile
+        log_fd, log_path = tempfile.mkstemp(
+            prefix=f"hvd_serving_slot{slot}.{inc}_", suffix=".log")
+        log_fh = os.fdopen(log_fd, "wb")
+        proc = subprocess.Popen(cmd, env=env, stdout=log_fh,
+                                stderr=subprocess.STDOUT)
+        log_fh.close()  # the child holds its own handle now
+        replica = _Replica(slot, inc, port, proc, log_path)
+        with self._lock:
+            self._replicas[slot] = replica
+        _flight("serving_replica_spawn", slot=slot, incarnation=inc,
+                port=port)
+        return replica
+
+    # -- monitoring ---------------------------------------------------------
+    def _probe_ready(self, replica: _Replica) -> bool:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{replica.port}/readyz",
+                    timeout=1.0) as r:
+                return r.status == 200
+        except Exception:
+            return False
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            with self._lock:
+                replicas = dict(self._replicas)
+                target = self.target
+            live = 0
+            for slot, replica in replicas.items():
+                rc = replica.proc.poll()
+                if rc is None:
+                    replica.ready = self._probe_ready(replica)
+                    live += 1 if replica.ready else 0
+                    continue
+                # exited: classify.  Exit code 0 = the replica finished
+                # its drain (preemption notice, admin drain) — DRAINED,
+                # a planned event that is NEVER failure evidence
+                # against the slot; anything else (SIGKILL shows as a
+                # negative returncode) is a failure
+                outcome = "drained" if rc == 0 else "failure"
+                smetrics.inc_replica_exit(outcome)
+                self.exits.append({
+                    "slot": slot, "incarnation": replica.incarnation,
+                    "rc": rc, "outcome": outcome,
+                    "tail": replica.log_tail()})
+                # the tail is captured; the dead incarnation's log file
+                # must not accumulate under a respawn loop, nor may the
+                # audit list grow without bound
+                try:
+                    os.unlink(replica.log_path)
+                except OSError:
+                    pass
+                if len(self.exits) > self.MAX_EXITS:
+                    del self.exits[: len(self.exits) - self.MAX_EXITS]
+                _flight("serving_replica_exit", slot=slot,
+                        incarnation=replica.incarnation, rc=rc,
+                        outcome=outcome)
+                get_logger().warning(
+                    "serving fleet: replica %s exited rc=%s (%s); "
+                    "respawning", replica.name(), rc, outcome)
+                smetrics.inc_respawn()
+                self._spawn(slot)
+            # scale-out: spawn slots beyond the current map.  NOT a
+            # respawn — planned growth must not read as crash-healing
+            # on the respawns counter (hvd_serving_scale_out_total
+            # already audits it)
+            with self._lock:
+                missing = [s for s in range(target)
+                           if s not in self._replicas]
+            for slot in missing:
+                self._spawn(slot)
+            smetrics.set_fleet_gauges(live, target)
+
+    # -- views --------------------------------------------------------------
+    def endpoints(self) -> List[Endpoint]:
+        """READY endpoints — wire this as the router's endpoint
+        provider.  When NO replica reads ready (a probe-starved or
+        mid-heal moment), degrade to every LIVE endpoint instead of an
+        empty list: an accepted request retrying against a maybe-
+        overloaded replica (503s are retried) beats failing outright —
+        the zero-drop guarantee outranks probe freshness."""
+        with self._lock:
+            ready = [r.endpoint for r in self._replicas.values()
+                     if r.ready and r.proc.poll() is None]
+            if ready:
+                return ready
+            return [r.endpoint for r in self._replicas.values()
+                    if r.proc.poll() is None]
+
+    def all_endpoints(self) -> List[Endpoint]:
+        with self._lock:
+            return [r.endpoint for r in self._replicas.values()
+                    if r.proc.poll() is None]
+
+    def live_count(self) -> int:
+        """STRICTLY ready replicas — health surfaces (the front's
+        /readyz, heal checks) must not inherit endpoints()'s
+        degrade-to-live fallback: an alive-but-draining fleet is not
+        'ready'."""
+        with self._lock:
+            return sum(1 for r in self._replicas.values()
+                       if r.ready and r.proc.poll() is None)
+
+    def wait_ready(self, n: int, timeout_s: float = 60.0) -> bool:
+        end = time.monotonic() + timeout_s
+        while time.monotonic() < end:
+            with self._lock:
+                replicas = list(self._replicas.values())
+            ready = 0
+            for r in replicas:
+                if r.proc.poll() is None and self._probe_ready(r):
+                    r.ready = True
+                    ready += 1
+            if ready >= n:
+                return True
+            time.sleep(0.2)
+        return False
+
+    # -- actions ------------------------------------------------------------
+    def drain(self, slot: int) -> bool:
+        """Ask one replica to drain (admin path; preemption notices
+        reach replicas directly through the chaos/maintenance seam)."""
+        with self._lock:
+            replica = self._replicas.get(slot)
+        if replica is None or replica.proc.poll() is not None:
+            return False
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{replica.port}/drain", data=b"{}",
+                method="POST")
+            urllib.request.urlopen(req, timeout=2.0)
+            return True
+        except Exception:
+            return False
+
+    def scale_out(self, n: int = 1) -> int:
+        """Raise the target size (the autopilot ``scale_out`` hook).
+        Returns the new target; the monitor loop spawns the slots."""
+        with self._lock:
+            self.target += max(1, int(n))
+            target = self.target
+        _flight("serving_scale_out", target=target)
+        get_logger().warning("serving fleet: scaling out to %d replicas",
+                             target)
+        smetrics._reg().counter(
+            "hvd_serving_scale_out_total",
+            help="fleet scale-outs (autopilot slo_breach remediation "
+                 "or manual)").inc()
+        return target
+
+    def register_autopilot_hook(self) -> None:
+        """Wire this fleet as the ``scale_out`` remediation target."""
+        from horovod_tpu.autopilot import actions
+        actions.register_scale_out_hook(lambda: self.scale_out(1))

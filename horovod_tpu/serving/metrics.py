@@ -1,0 +1,256 @@
+"""Serving-plane metrics: per-request latency SLOs on the fleet plane.
+
+Every number the zero-drop guarantee is proven from lives here
+(docs/SERVING.md): request admission/completion/shed counters (a shed
+is EXPLICIT — counted and answered 429, never a silent drop), hedge and
+retry counters, queue/inflight gauges, the latency histogram, and a
+windowed percentile tracker that publishes ``hvd_serving_p50/p99``
+gauges, records one ``{"serving": ...}`` point per window into the
+step time-series store (rendered by ``python -m horovod_tpu.metrics
+history --serving``), and reports an ``slo_breach`` anomaly finding
+when the windowed p99 stays over ``HVD_TPU_SERVING_SLO_P99_MS`` —
+which the autopilot's ``serving-slo-scaleout`` policy turns into a
+fleet scale-out (docs/OBSERVABILITY.md "Autopilot").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from horovod_tpu.common.config import env_float, env_int
+from horovod_tpu.metrics.registry import default_registry
+
+#: latency buckets: serving answers in milliseconds, not the step-time
+#: seconds the default buckets are shaped for
+LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _reg():
+    return default_registry()
+
+
+def inc_accepted() -> None:
+    _reg().counter("hvd_serving_accepted_total",
+                   help="requests admitted past the router's "
+                        "admission control").inc()
+
+
+def inc_completed() -> None:
+    _reg().counter("hvd_serving_completed_total",
+                   help="accepted requests answered with exactly one "
+                        "successful response").inc()
+
+
+def inc_failed() -> None:
+    _reg().counter("hvd_serving_failed_total",
+                   help="accepted requests that exhausted every "
+                        "retry/hedge before their deadline").inc()
+
+
+def inc_shed(where: str) -> None:
+    """An EXPLICIT load-shed (429): ``where`` names the backpressure
+    point — ``admission`` (router inflight budget), ``queue`` (replica
+    batch queue full), ``deadline`` (expired before compute),
+    ``draining`` (replica refusing new work), ``chaos`` (injected)."""
+    _reg().counter("hvd_serving_shed_total",
+                   help="requests explicitly load-shed (429), per "
+                        "backpressure point",
+                   labels={"where": where}).inc()
+
+
+def inc_hedged() -> None:
+    _reg().counter("hvd_serving_hedged_total",
+                   help="hedge requests launched at a second replica "
+                        "after the hedge timeout").inc()
+
+
+def inc_retried() -> None:
+    _reg().counter("hvd_serving_retried_total",
+                   help="requests re-dispatched to a surviving replica "
+                        "after a replica error/death").inc()
+
+
+def inc_swap() -> None:
+    _reg().counter("hvd_serving_swaps_total",
+                   help="zero-downtime hot weight swaps applied from "
+                        "the durable sharded store").inc()
+
+
+def set_weight_version(step: int) -> None:
+    _reg().gauge("hvd_serving_weight_version",
+                 help="durable-store step of the weights currently "
+                      "serving").set(float(step))
+
+
+def set_queue_depth(depth: int) -> None:
+    _reg().gauge("hvd_serving_queue_depth",
+                 help="requests waiting in the dynamic batcher "
+                      "queue").set(float(depth))
+
+
+def set_inflight(n: int) -> None:
+    _reg().gauge("hvd_serving_inflight",
+                 help="requests admitted and not yet answered "
+                      "(router view)").set(float(n))
+
+
+def set_draining(draining: bool) -> None:
+    _reg().gauge("hvd_serving_draining",
+                 help="1 while this replica is draining (not "
+                      "admitting, finishing in-flight)").set(
+        1.0 if draining else 0.0)
+
+
+def observe_batch(size: int) -> None:
+    _reg().counter("hvd_serving_batches_total",
+                   help="forward batches executed by the serving "
+                        "loop").inc()
+    _reg().histogram("hvd_serving_batch_size",
+                     help="formed dynamic-batch sizes",
+                     buckets=(1, 2, 4, 8, 16, 32, 64, 128)
+                     ).observe(float(size))
+
+
+def observe_latency(seconds: float) -> None:
+    _reg().histogram("hvd_serving_latency_seconds",
+                     help="end-to-end request latency (admission to "
+                          "successful response)",
+                     buckets=LATENCY_BUCKETS).observe(seconds)
+
+
+def set_fleet_gauges(live: int, target: int) -> None:
+    _reg().gauge("hvd_serving_replicas_live",
+                 help="replica processes currently alive and "
+                      "ready").set(float(live))
+    _reg().gauge("hvd_serving_replicas_target",
+                 help="replica fleet target size").set(float(target))
+
+
+def inc_replica_exit(outcome: str) -> None:
+    """``outcome`` ∈ {``drained``, ``failure``}: a DRAINED exit is a
+    planned event (preemption/autopilot drain) and never counts as
+    failure evidence against the slot."""
+    _reg().counter("hvd_serving_replica_exits_total",
+                   help="replica process exits, per classification "
+                        "(drained=planned, failure=crash/kill)",
+                   labels={"outcome": outcome}).inc()
+
+
+def inc_respawn() -> None:
+    _reg().counter("hvd_serving_replica_respawns_total",
+                   help="replacement replicas spawned to heal the "
+                        "fleet back to target size").inc()
+
+
+def percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an ASCENDING-sorted list — THE one
+    implementation (the bench artifact's p99 and the SLO plane's p99
+    must mean the same thing, `ci/check_bench.py --serving` compares
+    them)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class LatencyWindow:
+    """Windowed latency/percentile tracker (one per router, feeding the
+    fleet SLO plane).
+
+    ``observe()`` per completed request; every ``HVD_TPU_SERVING_WINDOW_S``
+    (default 5s) the closing window publishes ``hvd_serving_p50/p99
+    _seconds`` + ``hvd_serving_qps`` gauges, records a ``{"serving":
+    {...}}`` time-series point, and — when ``HVD_TPU_SERVING_SLO_P99_MS``
+    is set (> 0) — checks the SLO: ``HVD_TPU_SERVING_SLO_WINDOWS``
+    (default 2) consecutive breaching windows report ONE ``slo_breach``
+    anomaly finding (hysteresis mirrors the anomaly engine's: one
+    finding per episode, re-armed after a healthy window)."""
+
+    def __init__(self, window_s: Optional[float] = None) -> None:
+        self.window_s = window_s if window_s is not None \
+            else env_float("SERVING_WINDOW_S", 5.0)
+        self.slo_p99_s = env_float("SERVING_SLO_P99_MS", 0.0) / 1000.0
+        self.slo_windows = max(1, env_int("SERVING_SLO_WINDOWS", 2))
+        self._lock = threading.Lock()
+        self._lat: List[float] = []
+        self._shed = 0
+        self._opened = time.monotonic()
+        self._breach_streak = 0
+        self._breach_active = False
+
+    def observe(self, seconds: float) -> None:
+        observe_latency(seconds)
+        with self._lock:
+            self._lat.append(seconds)
+        self.maybe_roll()
+
+    def note_shed(self) -> None:
+        with self._lock:
+            self._shed += 1
+
+    def maybe_roll(self, force: bool = False) -> Optional[dict]:
+        """Close the window if its time is up (or ``force``); returns
+        the window summary when one closed."""
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._opened < self.window_s:
+                return None
+            lat, shed = self._lat, self._shed
+            elapsed = max(now - self._opened, 1e-9)
+            self._lat, self._shed = [], 0
+            self._opened = now
+        lat.sort()
+        doc = {
+            "window_s": round(elapsed, 3),
+            "requests": len(lat),
+            "qps": round(len(lat) / elapsed, 3),
+            "p50_s": round(percentile(lat, 0.50), 6),
+            "p99_s": round(percentile(lat, 0.99), 6),
+            "shed": shed,
+        }
+        reg = _reg()
+        reg.gauge("hvd_serving_qps",
+                  help="completed requests per second over the last "
+                       "closed window").set(doc["qps"])
+        reg.gauge("hvd_serving_p50_seconds",
+                  help="windowed median request latency").set(doc["p50_s"])
+        reg.gauge("hvd_serving_p99_seconds",
+                  help="windowed p99 request latency — the serving SLO "
+                       "signal").set(doc["p99_s"])
+        try:
+            from horovod_tpu.metrics.timeseries import record_point
+            record_point({"serving": doc})
+        except Exception:
+            pass
+        self._check_slo(doc)
+        return doc
+
+    def _check_slo(self, doc: dict) -> None:
+        if self.slo_p99_s <= 0:
+            return
+        if not doc["requests"]:
+            # an idle window is not a breach — and a breach episode
+            # does not survive the traffic that caused it
+            self._breach_streak = 0
+            self._breach_active = False
+            return
+        if doc["p99_s"] > self.slo_p99_s:
+            self._breach_streak += 1
+            if self._breach_streak >= self.slo_windows \
+                    and not self._breach_active:
+                self._breach_active = True
+                try:
+                    from horovod_tpu.metrics.anomaly import report_finding
+                    report_finding(
+                        "slo_breach", p99_s=doc["p99_s"],
+                        slo_s=self.slo_p99_s, qps=doc["qps"],
+                        shed=doc["shed"],
+                        consecutive=self._breach_streak)
+                except Exception:
+                    pass
+        else:
+            self._breach_streak = 0
+            self._breach_active = False

@@ -1,0 +1,575 @@
+"""One serving replica: HTTP front, dynamic batcher, compiled forward
+loop, hot weight swap, and drain-to-DRAINED semantics.
+
+A replica is one worker process (spawned and healed by
+:class:`horovod_tpu.serving.fleet.ReplicaFleet`, or embedded in-process
+for tests/bench) that:
+
+* answers ``POST /infer`` by admitting the request into the bounded
+  :class:`~horovod_tpu.serving.batcher.DynamicBatcher` and blocking the
+  handler until the serving loop fulfills it (the handler threads are
+  the continuation — the hardened :class:`ThreadedHTTPServer`'s bounded
+  pool is the concurrency limit);
+* runs ONE serving loop thread pulling formed batches, padding them to
+  the fixed ``max_batch_size`` (a single compiled forward — batch-size
+  churn must not recompile), and executing the jit'd ``apply_fn``;
+* **hot weight swap** (docs/SERVING.md "Hot weight swap"): a swapper
+  thread polls the durable sharded store
+  (:class:`horovod_tpu.checkpoint.ShardedCheckpointer`) for commits
+  newer than the serving version, restores them ONTO THE SERVING MESH
+  (``restore_latest`` reshards — the training world's size is
+  irrelevant) while the old weights keep serving, then flips the
+  ``(version, params)`` pair atomically between batches.  A corrupt
+  newest commit falls back to the next-older one (store semantics) —
+  the replica never serves a half-loaded version;
+* **drains** on a chaos/maintenance ``preemption`` notice, SIGTERM (in
+  ``main()``), or ``POST /drain``: admission stops instantly
+  (``/readyz`` → 503 so routers stop sending; new submits get
+  :class:`DrainingError`), every in-flight request is answered, then
+  the replica reports DRAINED — and, under ``main()``, exits 0, which
+  the fleet classifies as a planned exit (never failure evidence);
+* is **idempotent** per request id: a bounded response cache plus an
+  in-flight table mean a hedged/retried duplicate of a request that
+  already ran (or is running) returns the SAME response instead of
+  recomputing — the router may fan a request out freely.
+
+``/readyz`` readiness = model loaded AND queue depth under budget AND
+not draining; ``/healthz`` liveness = process up + serving loop alive.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from horovod_tpu.common.config import env_float, env_int
+from horovod_tpu.common.logging import get_logger
+from horovod_tpu.runner.http_kv import ThreadedHTTPServer
+from horovod_tpu.serving import metrics as smetrics
+from horovod_tpu.serving.batcher import (DeadlineError, DrainingError,
+                                         DynamicBatcher, SheddedError)
+
+
+def _flight(kind: str, **fields) -> None:
+    try:
+        from horovod_tpu.diagnostics.flight_recorder import record_event
+        record_event(kind, **fields)
+    except Exception:
+        pass
+
+
+# -- demo model ---------------------------------------------------------------
+def demo_params(dim: int = 16, scale: float = 1.0) -> dict:
+    """Deterministic tiny affine model — the serving analog of the
+    bench's synthetic models.  ``scale`` distinguishes weight VERSIONS
+    observably: ``y = scale * mean(x) + bias`` per output lane."""
+    return {
+        "w": np.full((dim, dim), scale / dim, dtype=np.float32),
+        "b": np.zeros((dim,), dtype=np.float32),
+    }
+
+
+def demo_apply(params: dict, x):
+    import jax.numpy as jnp
+    return jnp.dot(x, params["w"]) + params["b"]
+
+
+# -- the replica --------------------------------------------------------------
+class ReplicaServer:
+    """One replica: HTTP server + batcher + serving loop + swapper.
+
+    Args:
+      apply_fn: ``(params, X[batch, ...]) -> Y[batch, ...]``; jit'd
+        here.  Default: the demo affine model.
+      params: initial weights pytree (ignored when ``store_dir``
+        already holds a commit — the store is the source of truth).
+      store_dir: durable sharded store to restore from / watch for hot
+        swaps (None = static weights).
+      dim: demo-model width (used when no params and no store commit).
+      port: HTTP port (0 = ephemeral).
+      replica_id: name stamped into responses/flight events.
+    """
+
+    def __init__(self, apply_fn: Optional[Callable] = None,
+                 params: Any = None, store_dir: Optional[str] = None,
+                 dim: int = 16, port: int = 0, replica_id: str = "r0",
+                 batcher: Optional[DynamicBatcher] = None,
+                 swap_poll_s: Optional[float] = None) -> None:
+        self.replica_id = replica_id
+        self.dim = dim
+        self._apply_fn = apply_fn or demo_apply
+        self._store_dir = store_dir
+        self._swap_poll_s = swap_poll_s if swap_poll_s is not None \
+            else env_float("SERVING_SWAP_POLL_S", 1.0)
+        self.batcher = batcher or DynamicBatcher()
+        self._ready_queue_max = env_int(
+            "SERVING_READY_QUEUE", max(1, int(self.batcher.max_queue * 0.9)))
+        self._params_lock = threading.Lock()
+        self._params = params
+        self._version = 0
+        self._compiled = None
+        self._model_loaded = False
+        self._stop = threading.Event()
+        self._drained_event = threading.Event()
+        self._drain_source: Optional[str] = None
+        self._loop_alive = False
+        # idempotency: answered requests (bounded LRU) + in-flight table
+        self._resp_cache: OrderedDict = OrderedDict()
+        self._resp_cache_max = env_int("SERVING_IDEMPOTENCY_CACHE", 4096)
+        self._pending: dict = {}
+        self._pending_lock = threading.Lock()
+        self._threads = []
+        # handler pool sized FROM the admission budget: every queued +
+        # in-batch request holds a handler thread awaiting its result,
+        # and the pool must exceed that so (a) the batcher's explicit
+        # queue shed is reachable over HTTP (a pool smaller than the
+        # queue 503s before the 429 path can answer) and (b) readiness
+        # probes / metrics scrapes are not starved by a full queue.
+        # An EXPLICIT HVD_TPU_HTTP_MAX_HANDLERS wins verbatim — incl.
+        # the documented 0 = unbounded — over the derived size.
+        env_pool = env_int("HTTP_MAX_HANDLERS", -1)
+        pool = env_pool if env_pool >= 0 else (
+            self.batcher.max_queue + 2 * self.batcher.max_batch_size
+            + 16)
+        self._httpd = ThreadedHTTPServer(("0.0.0.0", port),
+                                         _ReplicaHandler,
+                                         max_handlers=pool)
+        self._httpd.replica = self
+
+    # -- lifecycle ----------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "ReplicaServer":
+        self._load_initial_weights()
+        for name, target in (
+                ("serve-loop", self._serve_loop),
+                ("swapper", self._swap_loop),
+                ("preempt-watch", self._preemption_loop)):
+            t = threading.Thread(target=target,
+                                 name=f"hvd-serving-{name}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             name="hvd-serving-http", daemon=True)
+        t.start()
+        self._threads.append(t)
+        _flight("serving_replica_start", replica=self.replica_id,
+                port=self.port, version=self._version)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+
+    # -- weights ------------------------------------------------------------
+    def _store(self):
+        from horovod_tpu.checkpoint import ShardedCheckpointer
+        # world_size=1: the serving mesh is THIS replica; restore
+        # reshards whatever world wrote the commit onto it
+        return ShardedCheckpointer(self._store_dir, rank=0, world_size=1)
+
+    @staticmethod
+    def _extract_params(doc: Any) -> Any:
+        """A durable commit is usually an elastic-state dict; serve its
+        ``params`` leaf when present, the whole doc otherwise."""
+        if isinstance(doc, dict) and "params" in doc:
+            return doc["params"]
+        return doc
+
+    def _load_initial_weights(self) -> None:
+        if self._store_dir:
+            try:
+                store = self._store()
+                # return_step: on a corrupt-newest fallback the state
+                # is OLDER than latest_step(), and the serving version
+                # must name the weights actually loaded
+                step, doc = store.restore_latest(return_step=True)
+                if step is not None:
+                    self._set_params(self._extract_params(doc),
+                                     version=int(step), swap=False)
+            except Exception:
+                get_logger().warning(
+                    "serving: initial restore from %s failed; starting "
+                    "with built-in weights", self._store_dir,
+                    exc_info=True)
+        if self._params is None:
+            self._params = demo_params(self.dim)
+        self._compile()
+        self._model_loaded = True
+        smetrics.set_weight_version(self._version)
+
+    def _compile(self) -> None:
+        import jax
+        self._compiled = jax.jit(self._apply_fn)
+
+    def _set_params(self, params: Any, version: int,
+                    swap: bool = True) -> None:
+        import jax
+        device = jax.tree_util.tree_map(jax.numpy.asarray, params)
+        with self._params_lock:
+            self._params = device
+            self._version = version
+        smetrics.set_weight_version(version)
+        if swap:
+            smetrics.inc_swap()
+            _flight("serving_swap", replica=self.replica_id,
+                    version=version)
+            get_logger().info("serving: hot-swapped to weight version "
+                              "%d (replica %s)", version, self.replica_id)
+
+    def _swap_loop(self) -> None:
+        if not self._store_dir:
+            return
+        bad_newest = None  # a newest step whose restore fell back
+        while not self._stop.wait(self._swap_poll_s):
+            try:
+                store = self._store()
+                step = store.latest_step()
+                if step is None or step <= self._version \
+                        or step == bad_newest:
+                    continue
+                # restore while the OLD weights keep serving; flip is
+                # the lock-guarded pointer swap above — between batches.
+                # return_step: a corrupt newest FALLS BACK to an older
+                # commit (store semantics) — the version must name the
+                # weights actually restored, and a fallback onto what
+                # we already serve is NOT a swap (remember the bad
+                # newest so each poll doesn't re-pay the failed
+                # restore; a NEWER commit landing later clears it)
+                restored, doc = store.restore_latest(return_step=True)
+                if restored is not None and restored > self._version:
+                    self._set_params(self._extract_params(doc),
+                                     version=int(restored))
+                    bad_newest = None
+                else:
+                    bad_newest = step
+            except Exception:
+                get_logger().warning(
+                    "serving: weight-swap poll failed; still serving "
+                    "version %d", self._version, exc_info=True)
+
+    # -- drain --------------------------------------------------------------
+    def drain(self, source: str = "admin") -> None:
+        """Stop admitting, finish in-flight, then report DRAINED.  The
+        actual exit is the embedder's call (``main()`` exits 0)."""
+        if self.batcher.draining:
+            return
+        self._drain_source = source
+        _flight("serving_drain_begin", replica=self.replica_id,
+                source=source)
+        get_logger().warning("serving: replica %s draining (%s)",
+                             self.replica_id, source)
+        self.batcher.drain()
+
+        def _finish():
+            ok = self.batcher.wait_drained(
+                timeout_s=env_float("SERVING_DRAIN_TIMEOUT_S", 30.0))
+            _flight("serving_drained", replica=self.replica_id,
+                    source=source, clean=ok)
+            self._drained_event.set()
+
+        threading.Thread(target=_finish, name="hvd-serving-drain",
+                         daemon=True).start()
+
+    @property
+    def draining(self) -> bool:
+        return self.batcher.draining
+
+    def drained(self) -> bool:
+        return self._drained_event.is_set()
+
+    def wait_drained(self, timeout_s: float = 60.0) -> bool:
+        return self._drained_event.wait(timeout_s)
+
+    def _preemption_loop(self) -> None:
+        """The PR-10 doom sources, serving flavor: poll the chaos
+        ``preemption`` seam (the TPU maintenance-event analog the
+        training-side PreemptionWatcher also consumes) and drain on a
+        notice.  Zero cost with no plan armed."""
+        poll_s = env_float("SERVING_PREEMPT_POLL_S", 0.2)
+        from horovod_tpu import chaos
+        while not self._stop.wait(poll_s):
+            if self.batcher.draining:
+                return
+            try:
+                applied = chaos.fire("preemption")
+            except Exception:
+                continue
+            if any(kind == "notice" for _seam, kind in applied):
+                self.drain(source="preemption")
+                return
+
+    # -- readiness / health -------------------------------------------------
+    def ready_doc(self) -> dict:
+        depth = self.batcher.queue_depth()
+        ready = (self._model_loaded and not self.batcher.draining
+                 and depth <= self._ready_queue_max)
+        return {"ready": ready, "replica": self.replica_id,
+                "model_loaded": self._model_loaded,
+                "draining": self.batcher.draining,
+                "queue_depth": depth,
+                "queue_budget": self._ready_queue_max,
+                "version": self._version}
+
+    def health_doc(self) -> dict:
+        return {"status": "ok" if self._loop_alive else "starting",
+                "replica": self.replica_id,
+                "draining": self.batcher.draining,
+                "drained": self.drained(),
+                "version": self._version}
+
+    # -- request path -------------------------------------------------------
+    def handle_infer(self, doc: dict) -> tuple:
+        """(HTTP code, response doc).  Runs on a handler thread."""
+        from horovod_tpu import chaos
+        req_id = str(doc.get("id") or f"anon-{time.monotonic_ns()}")
+        # chaos seam: `error` RAISES inside fire() -> caught here as
+        # 500 (the router must retry it to a survivor); `shed` is a
+        # pure-signal kind -> explicit 429; `delay` sleeps in place
+        # (the router's hedge must cover it)
+        try:
+            applied = chaos.fire("serving.request")
+        except Exception as e:
+            return 500, {"id": req_id, "error": f"chaos: {e!r}"}
+        kinds = {kind for _seam, kind in applied}
+        if "shed" in kinds:
+            smetrics.inc_shed("chaos")
+            return 429, {"id": req_id, "error": "chaos: injected shed"}
+        # idempotency: an already-answered id returns the SAME response
+        cached = self._cached_response(req_id)
+        if cached is not None:
+            return 200, cached
+        try:
+            x = np.asarray(doc.get("x"), dtype=np.float32)
+        except (TypeError, ValueError):
+            return 400, {"id": req_id, "error": "bad 'x' payload"}
+        if x.shape != (self.dim,):
+            # reject at admission: a wrong-width payload co-batched
+            # with healthy requests would fail the WHOLE batch
+            return 400, {"id": req_id,
+                         "error": f"bad 'x' shape {x.shape}; this "
+                                  f"replica serves width {self.dim}"}
+        deadline_ms = doc.get("deadline_ms")
+        deadline_s = float(deadline_ms) / 1000.0 \
+            if deadline_ms is not None else None
+        # in-flight dedup: a hedged duplicate joins the live request
+        with self._pending_lock:
+            pending = self._pending.get(req_id)
+            fresh = pending is None
+            if fresh:
+                try:
+                    pending = self.batcher.submit(req_id, x,
+                                                  deadline_s=deadline_s)
+                except DrainingError:
+                    smetrics.inc_shed("draining")
+                    return 503, {"id": req_id, "error": "draining"}
+                except SheddedError as e:
+                    return 429, {"id": req_id, "error": str(e)}
+                self._pending[req_id] = pending
+        try:
+            wait_s = (pending.deadline - time.monotonic()) + 1.0
+            y, version = pending.wait(timeout=max(wait_s, 0.1))
+            resp = {"id": req_id, "y": np.asarray(y).tolist(),
+                    "version": version, "replica": self.replica_id}
+            if fresh:
+                # cache BEFORE the finally pops the in-flight entry: a
+                # duplicate arriving in between must hit one of the two
+                # (pop-then-cache would open a window where it
+                # recomputes — possibly against freshly-swapped weights
+                # — and returns a DIFFERENT answer)
+                self._cache_response(req_id, resp)
+            return 200, resp
+        except DeadlineError as e:
+            return 504, {"id": req_id, "error": str(e)}
+        except Exception as e:
+            return 500, {"id": req_id, "error": repr(e)}
+        finally:
+            if fresh:
+                with self._pending_lock:
+                    self._pending.pop(req_id, None)
+
+    def _cached_response(self, req_id: str) -> Optional[dict]:
+        with self._pending_lock:
+            resp = self._resp_cache.get(req_id)
+            if resp is not None:
+                self._resp_cache.move_to_end(req_id)
+                smetrics._reg().counter(
+                    "hvd_serving_duplicate_hits_total",
+                    help="hedged/retried duplicates answered from the "
+                         "idempotent response cache").inc()
+            return resp
+
+    def _cache_response(self, req_id: str, resp: dict) -> None:
+        with self._pending_lock:
+            self._resp_cache[req_id] = resp
+            while len(self._resp_cache) > self._resp_cache_max:
+                self._resp_cache.popitem(last=False)
+
+    # -- the serving loop ---------------------------------------------------
+    def _serve_loop(self) -> None:
+        self._loop_alive = True
+        while not self._stop.is_set():
+            if self.batcher.draining and self.batcher.drained():
+                # stay alive to answer /healthz while the embedder
+                # decides to exit; nothing left to serve
+                time.sleep(0.05)
+                continue
+            batch = self.batcher.next_batch(timeout_s=0.2)
+            if not batch:
+                continue
+            try:
+                self._run_batch(batch)
+            except Exception as e:
+                for req in batch:
+                    req.set_error(e)
+            finally:
+                self.batcher.batch_done()
+
+    def _run_batch(self, batch) -> None:
+        with self._params_lock:
+            params, version = self._params, self._version
+        n = len(batch)
+        xs = [np.atleast_1d(r.payload) for r in batch]
+        width = xs[0].shape[-1]
+        # pad to the FIXED max batch: one compiled forward per width
+        padded = np.zeros((self.batcher.max_batch_size, width),
+                          dtype=np.float32)
+        for i, x in enumerate(xs):
+            padded[i, :] = x
+        t0 = time.monotonic()
+        out = np.asarray(self._compiled(params, padded))
+        smetrics.observe_batch(n)
+        smetrics._reg().histogram(
+            "hvd_serving_forward_seconds",
+            help="compiled forward-pass wall time per batch",
+            buckets=smetrics.LATENCY_BUCKETS).observe(
+            time.monotonic() - t0)
+        for i, req in enumerate(batch):
+            # the version rides the result: a response must name the
+            # weights that COMPUTED it, not whatever is live by the
+            # time the handler unblocks (a swap can land in between)
+            req.set_result((out[i], version))
+
+
+# -- HTTP front ---------------------------------------------------------------
+class _ReplicaHandler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # silence access lines
+        pass
+
+    def _send(self, code: int, doc: dict,
+              ctype: str = "application/json") -> None:
+        try:
+            body = json.dumps(doc).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except OSError:
+            pass  # client vanished; accounting happened upstream
+
+    def do_GET(self):
+        replica: ReplicaServer = self.server.replica
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/readyz":
+            doc = replica.ready_doc()
+            self._send(200 if doc["ready"] else 503, doc)
+        elif path == "/healthz":
+            doc = replica.health_doc()
+            self._send(200 if doc["status"] == "ok" else 503, doc)
+        elif path == "/metrics":
+            from horovod_tpu.metrics.registry import (default_registry,
+                                                      render_prometheus)
+            body = render_prometheus(default_registry().snapshot())
+            try:
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            except OSError:
+                pass
+        elif path == "/status":
+            self._send(200, {"ready": replica.ready_doc(),
+                             "health": replica.health_doc()})
+        else:
+            self._send(404, {"error": "not found"})
+
+    def do_POST(self):
+        replica: ReplicaServer = self.server.replica
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path == "/infer":
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                doc = json.loads(self.rfile.read(length))
+            except (ValueError, OSError):
+                self._send(400, {"error": "bad request body"})
+                return
+            code, resp = replica.handle_infer(doc)
+            self._send(code, resp)
+        elif path == "/drain":
+            replica.drain(source="admin")
+            self._send(200, {"draining": True,
+                             "replica": replica.replica_id})
+        else:
+            self._send(404, {"error": "not found"})
+
+
+# -- subprocess entry ---------------------------------------------------------
+def main(argv=None) -> int:
+    """``python -m horovod_tpu.serving.replica`` — one fleet-managed
+    replica process.  Prints ``SERVING port=<p> version=<v>`` once
+    ready; exits 0 after a drain completes (the fleet classifies exit
+    code 0 as DRAINED — planned, never failure evidence)."""
+    p = argparse.ArgumentParser(prog="horovod_tpu.serving.replica")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--store-dir", default=None)
+    p.add_argument("--dim", type=int, default=16)
+    p.add_argument("--replica-id", default="r0")
+    args = p.parse_args(argv)
+
+    # the chaos plan (preemption notices, serving.request faults) arms
+    # from env exactly like a training worker; the fleet exports
+    # HVD_TPU_RANK=<slot> so rank-scoped rules can target ONE replica
+    from horovod_tpu import chaos
+    chaos.install()
+
+    replica = ReplicaServer(store_dir=args.store_dir, dim=args.dim,
+                            port=args.port,
+                            replica_id=args.replica_id).start()
+
+    import signal
+
+    def _sigterm(_sig, _frm):
+        threading.Thread(target=replica.drain,
+                         kwargs={"source": "sigterm"},
+                         daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    print(f"SERVING port={replica.port} version={replica._version}",
+          flush=True)
+    while not replica.wait_drained(timeout_s=1.0):
+        pass
+    print(f"DRAINED replica={args.replica_id} "
+          f"source={replica._drain_source}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

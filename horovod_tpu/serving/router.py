@@ -1,0 +1,395 @@
+"""The serving front door: admission control, dispatch, hedging,
+retry, and the request log the zero-drop guarantee is audited from.
+
+Invariant (docs/SERVING.md): **an accepted request gets exactly one
+successful response, or an explicit error — never a silent drop.**
+
+* **Admission** is the only shed point the router owns: past
+  ``max_inflight`` concurrently admitted requests, a submit is refused
+  with :class:`~horovod_tpu.serving.batcher.SheddedError` (HTTP 429),
+  counted (``hvd_serving_shed_total{where="admission"}``) and logged —
+  backpressure is explicit.
+* **Dispatch** posts the request to a ready replica.  A replica-side
+  backpressure answer (429/503) or death (connection reset/refused,
+  5xx, timeout) triggers **retry** against the next replica; a replica
+  that is merely SLOW past ``hedge_ms`` triggers a **hedge** — the
+  request is duplicated to a second replica and the first success
+  wins.  Replica-side idempotency (the response cache keyed by request
+  id) makes this fan-out safe: a duplicate never recomputes a request
+  that already answered.
+* The **request log** (JSONL, optional) records one ``accepted`` line
+  per admission and exactly one terminal line (``ok`` / ``failed`` /
+  with sheds logged at admission) — the chaos acceptance scenarios
+  replay it to prove zero drops under replica SIGKILL and drain.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from horovod_tpu.common.config import env_float, env_int
+from horovod_tpu.common.logging import get_logger
+from horovod_tpu.serving import metrics as smetrics
+from horovod_tpu.serving.batcher import SheddedError
+from horovod_tpu.serving.metrics import LatencyWindow
+
+Endpoint = Tuple[str, int]
+
+
+class RequestFailed(RuntimeError):
+    """An accepted request exhausted every retry/hedge (explicit
+    terminal error — logged, counted, surfaced; not a drop)."""
+
+
+class RequestRejected(RuntimeError):
+    """A replica answered a DEFINITIVE client error (4xx other than
+    backpressure): retrying it anywhere would get the same answer —
+    terminal immediately, logged as ``rejected``, never a retry storm
+    and never a zero-drop violation."""
+
+    def __init__(self, code: int, doc: dict) -> None:
+        super().__init__(f"HTTP {code}: {doc.get('error', doc)}")
+        self.code = code
+        self.doc = doc
+
+
+class RequestLog:
+    """Append-only JSONL accounting, thread-safe; ``None`` path = in-
+    memory only (the entries list is still kept, bounded)."""
+
+    MAX_MEMORY = 100_000
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        self._path = path
+        self._lock = threading.Lock()
+        self._fh = open(path, "a", buffering=1) if path else None
+        self.entries: List[dict] = []
+
+    def note(self, req_id: str, outcome: str, **fields) -> None:
+        doc = {"ts": round(time.time(), 4), "id": req_id,
+               "outcome": outcome, **fields}
+        with self._lock:
+            self.entries.append(doc)
+            if len(self.entries) > self.MAX_MEMORY:
+                del self.entries[: self.MAX_MEMORY // 10]
+            if self._fh is not None:
+                try:
+                    self._fh.write(json.dumps(doc) + "\n")
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+    def accounting(self) -> dict:
+        """{outcome: count} plus the exactly-once audit, keyed by the
+        per-SUBMISSION sequence number (``seq``): a client may reuse a
+        request id — that is what idempotency is FOR — but every
+        accepted submission must terminate exactly once.
+        ``unanswered`` = accepted with NO terminal entry at all (a
+        true accounting hole); explicit ``failed``/``rejected``
+        terminals are counted in ``outcomes``, not hidden there."""
+        with self._lock:
+            entries = list(self.entries)
+        by_outcome: dict = {}
+        accepted: dict = {}
+        ok: dict = {}
+        terminal: set = set()
+        for e in entries:
+            by_outcome[e["outcome"]] = by_outcome.get(e["outcome"], 0) + 1
+            seq = e.get("seq")
+            if seq is None:
+                continue
+            if e["outcome"] == "accepted":
+                accepted[seq] = e["id"]
+            elif e["outcome"] == "ok":
+                ok[seq] = ok.get(seq, 0) + 1
+                terminal.add(seq)
+            elif e["outcome"] in ("failed", "rejected"):
+                terminal.add(seq)
+        return {
+            "outcomes": by_outcome,
+            "accepted": len(accepted),
+            "answered_ok": len(ok),
+            "unanswered": sorted(accepted[s] for s in
+                                 set(accepted) - terminal),
+            "answered_twice": sorted(accepted.get(s, "?") for s, n in
+                                     ok.items() if n > 1),
+        }
+
+
+class Router:
+    """Dispatches requests across a replica fleet.
+
+    Args:
+      endpoints: static list of ``(host, port)`` replica endpoints, or
+        a zero-arg callable returning the CURRENT list (the fleet wires
+        its live view in, so respawns/scale-outs are picked up per
+        request).
+      max_inflight: admission budget (429 beyond it).
+      hedge_ms: duplicate a silent in-flight request to a second
+        replica after this long (0 disables hedging).
+      attempt_timeout_s: per-dispatch HTTP timeout.
+      max_attempts: total dispatch attempts per request (retries +
+        hedges; the deadline caps it too).
+      log_path: JSONL request-log path (None = in-memory only).
+    """
+
+    def __init__(self, endpoints, max_inflight: Optional[int] = None,
+                 hedge_ms: Optional[float] = None,
+                 attempt_timeout_s: Optional[float] = None,
+                 max_attempts: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None,
+                 log_path: Optional[str] = None) -> None:
+        self._endpoints = endpoints if callable(endpoints) \
+            else (lambda: list(endpoints))
+        self.max_inflight = max_inflight if max_inflight \
+            else env_int("SERVING_MAX_INFLIGHT", 256)
+        self.hedge_s = (hedge_ms if hedge_ms is not None
+                        else env_float("SERVING_HEDGE_MS", 150.0)) / 1000.0
+        self.attempt_timeout_s = attempt_timeout_s \
+            if attempt_timeout_s is not None \
+            else env_float("SERVING_ATTEMPT_TIMEOUT_S", 5.0)
+        self.max_attempts = max_attempts if max_attempts \
+            else env_int("SERVING_MAX_ATTEMPTS", 6)
+        self.default_deadline_s = default_deadline_s \
+            if default_deadline_s is not None \
+            else env_float("SERVING_DEADLINE_MS", 30_000.0) / 1000.0
+        self.log = RequestLog(log_path)
+        self.window = LatencyWindow()
+        self._inflight = threading.BoundedSemaphore(self.max_inflight)
+        self._inflight_n = 0
+        self._lock = threading.Lock()
+        self._seq = itertools.count()
+        self._rr = itertools.count()  # per-request round-robin offset
+        # windows must close on IDLE too: with rolls driven only by
+        # observe(), a fleet whose traffic stopped would freeze the
+        # qps/p50/p99 gauges at their last busy values forever
+        self._roller_stop = threading.Event()
+        threading.Thread(target=self._roll_loop, daemon=True,
+                         name="hvd-serving-window-roll").start()
+
+    def _roll_loop(self) -> None:
+        while not self._roller_stop.wait(self.window.window_s):
+            try:
+                self.window.maybe_roll()
+            except Exception:
+                pass
+
+    # -- dispatch plumbing --------------------------------------------------
+    def _post(self, ep: Endpoint, body: bytes,
+              timeout: float) -> Tuple[int, dict]:
+        url = f"http://{ep[0]}:{ep[1]}/infer"
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                doc = json.loads(e.read())
+            except Exception:
+                doc = {"error": str(e)}
+            return e.code, doc
+
+    def _fire(self, ep: Endpoint, body: bytes, deadline: float,
+              results: "queue.Queue") -> None:
+        def run():
+            timeout = min(self.attempt_timeout_s,
+                          max(deadline - time.monotonic(), 0.05))
+            try:
+                code, doc = self._post(ep, body, timeout)
+                results.put((ep, code, doc, None))
+            except Exception as e:
+                results.put((ep, None, None, e))
+
+        threading.Thread(target=run, daemon=True,
+                         name="hvd-serving-dispatch").start()
+
+    # -- the public request path --------------------------------------------
+    def submit(self, x, req_id: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> dict:
+        """Blocking request.  Returns the replica's response doc.
+        Raises :class:`SheddedError` at admission (429 — explicit
+        backpressure) or :class:`RequestFailed` when an ACCEPTED
+        request exhausts retries/hedges inside its deadline (explicit
+        terminal error, logged)."""
+        seq = next(self._seq)
+        if req_id is None:
+            req_id = f"req-{seq}-{time.monotonic_ns()}"
+        if not self._inflight.acquire(blocking=False):
+            smetrics.inc_shed("admission")
+            self.window.note_shed()
+            self.log.note(req_id, "shed", seq=seq, where="admission")
+            raise SheddedError("router inflight budget exhausted")
+        with self._lock:
+            self._inflight_n += 1
+            smetrics.set_inflight(self._inflight_n)
+        smetrics.inc_accepted()
+        self.log.note(req_id, "accepted", seq=seq)
+        t0 = time.monotonic()
+        try:
+            doc = self._dispatch(req_id, x, deadline_s)
+            latency = time.monotonic() - t0
+            smetrics.inc_completed()
+            if doc.get("version") is not None:
+                # the router-side registry mirrors the version it just
+                # OBSERVED serving — so a front-process /metrics scrape
+                # (metrics top "weights vN") reports live truth without
+                # reaching into replica registries
+                smetrics.set_weight_version(int(doc["version"]))
+            self.window.observe(latency)
+            self.log.note(req_id, "ok", seq=seq,
+                          latency_s=round(latency, 6),
+                          replica=doc.get("replica"),
+                          version=doc.get("version"))
+            return doc
+        except RequestRejected as e:
+            # the replica ANSWERED — with a client error.  Not a drop,
+            # not a fleet failure: its own outcome + counter
+            smetrics._reg().counter(
+                "hvd_serving_rejected_total",
+                help="accepted requests answered a definitive client "
+                     "error (4xx) by a replica — terminal, never "
+                     "retried").inc()
+            self.log.note(req_id, "rejected", seq=seq, code=e.code,
+                          error=str(e))
+            raise
+        except Exception as e:
+            smetrics.inc_failed()
+            self.log.note(req_id, "failed", seq=seq, error=repr(e))
+            raise
+        finally:
+            self._inflight.release()
+            with self._lock:
+                self._inflight_n -= 1
+                smetrics.set_inflight(self._inflight_n)
+
+    def _dispatch(self, req_id: str, x, deadline_s) -> dict:
+        deadline = time.monotonic() + (
+            deadline_s if deadline_s is not None
+            else self.default_deadline_s)
+        body = json.dumps({
+            "id": req_id,
+            "x": x if isinstance(x, list) else list(map(float, x)),
+            "deadline_ms": max((deadline - time.monotonic()) * 1000.0,
+                               1.0),
+        }).encode()
+        eps = list(self._endpoints())
+        if not eps:
+            raise RequestFailed("no replica endpoints")
+        # spread primaries round-robin across the fleet; retries/hedges
+        # continue the rotation so they land on a DIFFERENT replica
+        start = next(self._rr) % len(eps)
+        rotation = itertools.cycle(
+            list(range(start, len(eps))) + list(range(start)))
+        results: "queue.Queue" = queue.Queue()
+        attempts = 0
+        outstanding = 0
+        tried = []
+
+        def launch():
+            nonlocal attempts, outstanding
+            if attempts >= self.max_attempts:
+                return False
+            ep = eps[next(rotation)]
+            attempts += 1
+            outstanding += 1
+            tried.append(ep)
+            self._fire(ep, body, deadline, results)
+            return True
+
+        launch()
+        hedged = False
+        last_error: Optional[str] = None
+        while time.monotonic() < deadline:
+            # wait for an answer; hedge once if the fleet has a spare
+            # replica and the primary has gone silent past hedge_s
+            can_hedge = (self.hedge_s > 0 and not hedged and len(eps) > 1
+                         and attempts < self.max_attempts)
+            timeout = min(self.hedge_s if can_hedge else 0.25,
+                          max(deadline - time.monotonic(), 0.01))
+            try:
+                ep, code, doc, err = results.get(timeout=timeout)
+            except queue.Empty:
+                if can_hedge:
+                    hedged = True
+                    if launch():  # appends the hedge TARGET to tried
+                        smetrics.inc_hedged()
+                        self.log.note(req_id, "hedged",
+                                      to=str(tried[-1]))
+                elif outstanding == 0:
+                    # everything launched has answered badly and the
+                    # attempt budget may still allow a retry
+                    if not launch():
+                        break
+                continue
+            outstanding -= 1
+            if code == 200 and isinstance(doc, dict):
+                return doc
+            if code is not None and 400 <= code < 500 \
+                    and code not in (408, 429):
+                # a definitive client error (bad payload, bad width):
+                # every replica would answer the same — terminal, not
+                # a reason to burn the attempt budget fleet-wide
+                raise RequestRejected(code, doc if isinstance(doc, dict)
+                                      else {"error": str(doc)})
+            last_error = (f"{ep[0]}:{ep[1]} -> "
+                          + (repr(err) if err is not None
+                             else f"HTTP {code}: {doc}"))
+            # 429/503 = replica backpressure/drain; 5xx/conn-error =
+            # replica sick or dead: in every case the survivor is the
+            # answer — retry there (counted only when a retry actually
+            # LAUNCHES: an exhausted attempt budget is not a retry)
+            if launch():
+                smetrics.inc_retried()
+                self.log.note(req_id, "retried", after=last_error,
+                              to=str(tried[-1]))
+            elif outstanding == 0:
+                break
+            # tiny backoff so a fully-shedding fleet is not hammered
+            time.sleep(0.01)
+        raise RequestFailed(
+            f"request {req_id}: no successful response within "
+            f"deadline/attempts ({attempts} attempts; last: "
+            f"{last_error})")
+
+    # -- introspection ------------------------------------------------------
+    def accounting(self) -> dict:
+        return self.log.accounting()
+
+    def close(self) -> None:
+        self._roller_stop.set()
+        self.window.maybe_roll(force=True)
+        self.log.close()
+
+
+def ready_endpoints(candidates: Sequence[Endpoint],
+                    timeout: float = 1.0) -> List[Endpoint]:
+    """Filter ``candidates`` by their ``/readyz`` probe — the fleet's
+    router view (a draining or still-restoring replica answers 503 and
+    drops out of rotation here, BEFORE requests discover it)."""
+    out = []
+    for host, port in candidates:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/readyz", timeout=timeout) as r:
+                if r.status == 200:
+                    out.append((host, port))
+        except Exception:
+            pass
+    return out
