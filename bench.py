@@ -270,6 +270,18 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
         except Exception:
             return 0
 
+    def _tracing_enabled():
+        """Whether causal tracing (HVD_TPU_TRACE) was live during the
+        measurement.  Recorded so a standing perf number cannot
+        SILENTLY pay for always-on tracing: ci/check_bench.py refuses
+        a non-null value measured with tracing enabled unless the run
+        says so out loud (HVD_BENCH_ALLOW_TRACING=1)."""
+        try:
+            from horovod_tpu.tracing import enabled
+            return bool(enabled())
+        except Exception:
+            return False
+
     def emit(value, dt_window, n_iters, provisional, flops_per_device,
              flops_src, compile_s, series=None):
         peak = _peak_flops(jax.devices()[0].device_kind)
@@ -304,6 +316,7 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
             "hbm_peak_bytes": _hbm_peak(),
             "timing_iters": n_iters,
             "guard_skipped_steps": _guard_skipped(),
+            "tracing_enabled": _tracing_enabled(),
             "commit": _git_commit(),
             "phases": dict(_PHASES),
             **ex,
@@ -1124,6 +1137,13 @@ def _run_attempt(deadline_s):
     import tempfile
     lines = []
     env = dict(os.environ)
+    # causal tracing pinned OFF for the measured child unless the
+    # caller set it explicitly: the standing perf number must not
+    # silently pay for tracing — the artifact's tracing_enabled field
+    # + ci/check_bench.py enforce it (child-env only: bench.main() is
+    # also called in-process by the contract tests, and mutating the
+    # caller's environ would leak into unrelated code)
+    env.setdefault("HVD_TPU_TRACE", "0")
     # child exits cleanly 90s before we would have to kill it (a killed
     # TPU child can wedge the relay lease for the following run)
     env["HVD_BENCH_CHILD_DEADLINE"] = str(time.time() + deadline_s - 90)

@@ -54,6 +54,7 @@ def run_bench(replicas: int = 2, clients: int = 4, duration_s: float = 5.0,
     counts = {"ok": 0, "shed": 0, "failed": 0}
     counts_lock = threading.Lock()
     latencies: list = []
+    traced: list = []  # (latency, trace_id) per measured ok request
 
     def client(i: int) -> None:
         n = 0
@@ -62,7 +63,7 @@ def run_bench(replicas: int = 2, clients: int = 4, duration_s: float = 5.0,
             n += 1
             t0 = time.monotonic()
             try:
-                router.submit(x, req_id=f"bench-c{i}-{n}")
+                doc = router.submit(x, req_id=f"bench-c{i}-{n}")
                 outcome = "ok"
             except SheddedError:
                 outcome = "shed"
@@ -74,6 +75,8 @@ def run_bench(replicas: int = 2, clients: int = 4, duration_s: float = 5.0,
                     counts[outcome] += 1
                     if outcome == "ok":
                         latencies.append(dt)
+                        if doc.get("trace"):
+                            traced.append((dt, doc["trace"]))
 
     threads = [threading.Thread(target=client, args=(i,), daemon=True)
                for i in range(clients)]
@@ -104,8 +107,42 @@ def run_bench(replicas: int = 2, clients: int = 4, duration_s: float = 5.0,
     def pct(q: float) -> float:
         return percentile(latencies, q)
 
+    # the slowest-request trace (docs/OBSERVABILITY.md "Causal
+    # tracing"): the per-hop breakdown of the request CLOSEST TO the
+    # p99 — the artifact answers "where did the tail latency go", not
+    # just "how big is it".  Hops come from this process's flight ring
+    # (router spans always; replica spans too under --in-process —
+    # subprocess replicas keep theirs in their own rings).
+    slowest = None
+    if traced:
+        p99 = pct(0.99)
+        lat, trace_id = min(traced, key=lambda t: abs(t[0] - p99))
+        try:
+            from horovod_tpu import tracing  # noqa: F401
+            from horovod_tpu.diagnostics.flight_recorder import recorder
+            from horovod_tpu.tracing.reader import spans_from_events
+            spans, _pts = spans_from_events(recorder().events(),
+                                            trace_id=trace_id)
+            slowest = {
+                "trace": trace_id,
+                "latency_s": round(lat, 6),
+                "hops": [{"plane": s["plane"], "name": s["name"],
+                          "dur_s": s["dur_s"],
+                          **{k: s["attrs"][k]
+                             for k in ("target", "replica", "code")
+                             if s["attrs"].get(k) is not None}}
+                         for s in sorted(spans,
+                                         key=lambda s: s["start"])],
+            }
+        except Exception:
+            slowest = {"trace": trace_id, "latency_s": round(lat, 6),
+                       "hops": []}
+
+    from horovod_tpu.tracing import enabled as tracing_enabled
     total = sum(counts.values())
     return {
+        "tracing_enabled": bool(tracing_enabled()),
+        "slowest_request_trace": slowest,
         "bench": "serving",
         "replicas": replicas,
         "clients": clients,

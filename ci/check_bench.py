@@ -642,6 +642,13 @@ def main() -> int:
               f"under the numeric guardrail — not a clean perf number: "
               f"{doc}")
         return 1
+    if doc["value"] is not None and doc.get("tracing_enabled") \
+            and os.environ.get("HVD_BENCH_ALLOW_TRACING", "") != "1":
+        print("bench run measured with causal tracing ENABLED "
+              "(HVD_TPU_TRACE) — the standing perf number must not "
+              "silently pay the tracing overhead; rerun with tracing "
+              f"off or set HVD_BENCH_ALLOW_TRACING=1: {doc}")
+        return 1
     print(f"bench contract OK: {doc}")
     return 0
 

@@ -158,12 +158,22 @@ def _request_driver_action(kind: str, target_rank: int, policy: Policy,
         "at": time.time()}
     if evidence:
         body["evidence"] = evidence
+    # causal tracing: the action doc continues the decision's trace
+    # (which continued the finding's) — the driver childs from the
+    # embedded traceparent when it handles the request, so finding →
+    # decision → action → drain → re-mesh share ONE trace id
+    from horovod_tpu import tracing
+    actx = tracing.child(
+        tracing.decode(decision.get(tracing.TRACEPARENT)), "autopilot")
+    if actx is not None:
+        body[tracing.TRACEPARENT] = actx.traceparent
     doc = json.dumps(body).encode()
-    kv_relay.client(addr, port_i).put(
-        "action", f"{_own_rank()}-{seq}", doc, timeout=5.0,
-        site="autopilot.action")
-    _flight("autopilot_action_published", action=kind,
-            target_rank=target_rank, policy=policy.name)
+    with tracing.activate(actx):
+        kv_relay.client(addr, port_i).put(
+            "action", f"{_own_rank()}-{seq}", doc, timeout=5.0,
+            site="autopilot.action")
+        _flight("autopilot_action_published", action=kind,
+                target_rank=target_rank, policy=policy.name)
     return True
 
 

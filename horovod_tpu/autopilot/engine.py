@@ -315,6 +315,20 @@ class PolicyEngine:
             "rank": self.rank,
             "gate": gate,
         }
+        try:
+            # continue the finding's trace: the decision is a child
+            # span, and fired remediations child from the decision (the
+            # action/ doc carries decision["traceparent"] to the
+            # driver) — docs/OBSERVABILITY.md "Causal tracing"
+            from horovod_tpu import tracing
+            dctx = tracing.child(
+                tracing.decode(finding.get(tracing.TRACEPARENT)),
+                "autopilot")
+            if dctx is not None:
+                decision.update(dctx.fields())
+                decision[tracing.TRACEPARENT] = dctx.traceparent
+        except Exception:
+            pass
         if reason is not None:
             decision["reason"] = reason
         if key is not None:
@@ -337,7 +351,7 @@ class PolicyEngine:
                 record_event)
             record_event("autopilot_decision",
                          **{k: v for k, v in decision.items()
-                            if k != "ts"})
+                            if k not in ("ts", "traceparent")})
         except Exception:
             pass
         self._log_jsonl(decision)
@@ -360,9 +374,14 @@ class PolicyEngine:
         return decision
 
     def _log_jsonl(self, decision: dict) -> None:
-        """Append-only action log (``HVD_TPU_OBS_DIR`` unset = ring
-        only), same writer/rotation machinery as the step series."""
+        """Bounded action log (``HVD_TPU_OBS_DIR`` unset = ring only),
+        same writer/size-rotation machinery as the step series —
+        ``actions_rank<r>.jsonl`` rotates at
+        ``HVD_TPU_ACTIONS_MAX_BYTES`` (default: the OBS store's bound)
+        with one previous generation kept; ``history --actions`` reads
+        across the boundary."""
         try:
+            from horovod_tpu.common.config import env_int
             from horovod_tpu.metrics import timeseries
             d = timeseries.obs_dir()
             if not d:
@@ -370,7 +389,9 @@ class PolicyEngine:
             with self._writer_lock:
                 if self._writer is None or self._writer_dir != d:
                     self._writer = timeseries.SeriesWriter(
-                        d, rank=self.rank, basename="actions")
+                        d, rank=self.rank, basename="actions",
+                        max_bytes=env_int("ACTIONS_MAX_BYTES", 0)
+                        or None)
                     self._writer_dir = d
                 writer = self._writer
             writer.write(decision)

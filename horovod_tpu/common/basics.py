@@ -186,6 +186,12 @@ def init(ranks: Optional[Sequence[int]] = None,
             # hosts align in the merged trace
             from horovod_tpu.diagnostics.clock import estimate_wall_offset
             offset = estimate_wall_offset(_state.backend)
+            # the flight recorder shares the shard's offset so the
+            # merged timeline (diagnostics timeline) aligns flight
+            # events with shard spans across skew-clocked hosts
+            from horovod_tpu.diagnostics.flight_recorder import \
+                set_wall_offset
+            set_wall_offset(offset)
             _state.timeline.start_shard(
                 shard_path(cfg.timeline, _state.rank),
                 wall_offset_s=offset,

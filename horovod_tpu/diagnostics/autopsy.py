@@ -162,11 +162,18 @@ def _fetch(url: str) -> Optional[bytes]:
     connections under accept bursts — exactly what a multi-rank autopsy
     causes)."""
     from urllib.error import HTTPError
+    from urllib.request import Request
 
+    from horovod_tpu import tracing
     from horovod_tpu.common.retry import retry_call
+    headers = {}
+    ctx = tracing.current()
+    if ctx is not None:
+        headers[tracing.TRACEPARENT] = ctx.traceparent
     try:
         return retry_call(
-            lambda: urlopen(url, timeout=_FETCH_TIMEOUT_S).read(),
+            lambda: urlopen(Request(url, headers=headers),
+                            timeout=_FETCH_TIMEOUT_S).read(),
             site="autopsy.peer_fetch",
             retry_on=(OSError, TimeoutError),
             # an HTTP status (404/500: version skew, endpoint disabled)
@@ -184,19 +191,30 @@ def _collect_peers(bundle: str) -> tuple:
     unreachable when none of its /debug endpoints answered even with
     retries — recorded in the summary so a bundle missing a rank's
     evidence says so explicitly instead of looking complete."""
+    from horovod_tpu import tracing
+    root = tracing.new_trace("autopsy")
     fetched, unreachable = [], []
     for r, (host, port) in sorted(peer_debug_ports().items()):
         base = f"http://{host}:{port}/debug"
         got_any = False
-        for kind, suffix in (("stacks", "txt"), ("flight", "json"),
-                             ("engine", "json")):
-            body = _fetch(f"{base}/{kind}")
-            if body is None:
-                continue
-            got_any = True
-            with open(os.path.join(
-                    bundle, f"peer_rank{r}_{kind}.{suffix}"), "wb") as f:
-                f.write(body)
+        # one child span per peer: which rank's evidence was slow (or
+        # missing) is part of the autopsy's own story
+        ctx = tracing.child(root, "autopsy")
+        t0 = time.time()
+        with tracing.activate(ctx):
+            for kind, suffix in (("stacks", "txt"), ("flight", "json"),
+                                 ("engine", "json")):
+                body = _fetch(f"{base}/{kind}")
+                if body is None:
+                    continue
+                got_any = True
+                with open(os.path.join(
+                        bundle,
+                        f"peer_rank{r}_{kind}.{suffix}"), "wb") as f:
+                    f.write(body)
+        tracing.record_span("autopsy", "peer_fetch", ctx, start=t0,
+                            dur_s=time.time() - t0, peer=r,
+                            reached=got_any)
         (fetched if got_any else unreachable).append(r)
     return fetched, unreachable
 

@@ -51,10 +51,21 @@ class FlightRecorder:
         self._seq = 0
 
     def record(self, kind: str, **fields: Any) -> None:
-        """Append one event; never raises, never blocks on I/O."""
+        """Append one event; never raises, never blocks on I/O.  The
+        thread's ACTIVE trace context (docs/OBSERVABILITY.md "Causal
+        tracing") is stamped in as ``trace``/``span`` unless the caller
+        already carries explicit trace fields."""
         ev = {"ts": time.time(), "kind": kind}
         if fields:
             ev.update(fields)
+        if "trace" not in ev:
+            try:
+                from horovod_tpu import tracing
+                ctx = tracing.current()
+                if ctx is not None:
+                    ev.update(ctx.fields())
+            except Exception:
+                pass
         with self._lock:
             self._seq += 1
             ev["seq"] = self._seq
@@ -88,6 +99,12 @@ class FlightRecorder:
             "dropped": dropped,
             "recorded": len(events),
             "dumped_at": time.time(),
+            # the same per-rank wall offset the timeline shards carry
+            # (diagnostics/clock.py): the merged timeline maps flight
+            # evidence onto the coordinator's clock with it, so
+            # cross-rank flight events align with shard spans instead
+            # of drifting by host clock skew
+            "wall_offset_s": wall_offset(),
             "events": events,
         }
 
@@ -117,6 +134,31 @@ def _best_effort_rank() -> int:
         return int(v)
     except ValueError:
         return 0
+
+
+_WALL_OFFSET = 0.0
+
+
+def set_wall_offset(seconds: float) -> None:
+    """Record this rank's estimated ``my_wall - coordinator_wall``
+    (measured once at init by :mod:`horovod_tpu.diagnostics.clock` and
+    shared with the timeline shards) so flight dumps are mergeable onto
+    the coordinator's clock."""
+    global _WALL_OFFSET
+    _WALL_OFFSET = float(seconds)
+
+
+def wall_offset() -> float:
+    """The recorded offset, with ``HVD_TPU_CLOCK_OFFSET_S`` overriding
+    live (same contract as the shard anchor: tests inject known skew,
+    operators pin NTP-disciplined fleets to 0)."""
+    forced = os.environ.get("HVD_TPU_CLOCK_OFFSET_S")
+    if forced not in (None, ""):
+        try:
+            return float(forced)
+        except ValueError:
+            pass
+    return _WALL_OFFSET
 
 
 _RECORDER: Optional[FlightRecorder] = None

@@ -77,9 +77,19 @@ def _shard_meta(events: List[dict], path: str) -> Dict[str, Any]:
 
 
 def merge_shards(paths: Sequence[str],
-                 out_path: Optional[str] = None) -> Dict[str, Any]:
+                 out_path: Optional[str] = None,
+                 extra_tracks: Optional[Sequence[tuple]] = None
+                 ) -> Dict[str, Any]:
     """Fold shards into one chrome trace document (also written to
-    ``out_path`` when given).  Returns the document."""
+    ``out_path`` when given).  Returns the document.
+
+    ``extra_tracks`` adds non-shard planes (the unified timeline's
+    flight dumps, request logs, action/remesh history — see
+    :mod:`horovod_tpu.tracing.reader`): a sequence of ``(label,
+    sort_index, events)`` where each event already carries an ABSOLUTE
+    wall-clock ``ts`` in µs on the coordinator's clock (the caller
+    applied its plane's offset); they are rebased together with the
+    shard events so every plane shares one t=0."""
     shards = []
     for i, path in enumerate(sorted(paths)):
         try:
@@ -92,6 +102,17 @@ def merge_shards(paths: Sequence[str],
                                  "(%r)", path, e)
             continue
         meta = _shard_meta(events, path)
+        if extra_tracks and meta["epoch_us"] is None:
+            # the extras carry absolute wall-clock µs; an anchor-less
+            # shard only has shard-relative time, and mixing the two
+            # scales would rebase the whole timeline ~epoch apart —
+            # drop it loudly rather than render an unusable trace
+            # (plain shard-only merges keep the old relative behavior)
+            from horovod_tpu.common.logging import get_logger
+            get_logger().warning(
+                "merge: shard %s has no SHARD_META wall anchor; "
+                "skipping it in the multi-plane timeline", path)
+            continue
         rank = meta["rank"] if meta["rank"] is not None else i
         shards.append((path, events, meta, rank))
 
@@ -114,6 +135,20 @@ def merge_shards(paths: Sequence[str],
                        "pid": pid, "tid": "meta",
                        "args": {"sort_index": rank}})
         placed.append((pid, events, meta))
+
+    # the extra planes get their own tracks past the shard pid space
+    next_pid = 10_000
+    for label, sort_index, events in (extra_tracks or ()):
+        pid = next_pid
+        next_pid += 1
+        merged.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": "meta", "args": {"name": label}})
+        merged.append({"ph": "M", "name": "process_sort_index",
+                       "pid": pid, "tid": "meta",
+                       "args": {"sort_index": sort_index}})
+        placed.append((pid, list(events),
+                       {"epoch_us": None, "wall_offset_us": 0.0,
+                        "anchor_ts": 0.0}))
 
     # map onto the coordinator's wall clock where anchors exist
     timed = []

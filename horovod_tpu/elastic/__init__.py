@@ -544,7 +544,7 @@ def run(func: Callable) -> Callable:
                 # closes at the first completed step/commit of the new
                 # world; each phase lands as a flight span and an
                 # hvd_remesh_seconds{phase} observation
-                remesh.begin("internal_error", old_size=size())
+                ep = remesh.begin("internal_error", old_size=size())
                 with remesh.phase("drain"):
                     state.restore()
                 # peer death? the driver publishes the shrunken world as
@@ -555,6 +555,15 @@ def run(func: Callable) -> Callable:
                 with remesh.phase("failure_detect"):
                     update = _await_world_update()
                 if update is not None:
+                    # the recovery world carries the trace the driver
+                    # rooted for this reactive re-mesh — the episode's
+                    # phases join it (docs/OBSERVABILITY.md)
+                    try:
+                        from horovod_tpu import tracing
+                        ep.set_trace(tracing.child(
+                            tracing.from_doc(update), "remesh"))
+                    except Exception:
+                        pass
                     _apply_world_update(update, force_shutdown=True)
                     with remesh.phase("restore"):
                         state.on_reset()
@@ -581,7 +590,21 @@ def run(func: Callable) -> Callable:
                 trigger = "preemption_drain" \
                     if isinstance(e.update, dict) and e.update.get("drain") \
                     else "hosts_updated"
-                remesh.begin(trigger, old_size=size())
+                ep = remesh.begin(trigger, old_size=size())
+                # the drain stamp carries the causal trace the notice/
+                # finding rooted (a plain growth doc may carry a
+                # doc-level one); this survivor's episode is a child
+                # span of the driver's handling, so the whole chain —
+                # finding → decision → action → drain → these phases →
+                # first healthy step — shares one trace id
+                try:
+                    from horovod_tpu import tracing
+                    src = e.update.get("drain") \
+                        if trigger == "preemption_drain" else e.update
+                    ep.set_trace(tracing.child(
+                        tracing.from_doc(src), "remesh"))
+                except Exception:
+                    pass
                 with remesh.phase("failure_detect"):
                     pass  # the doc arrived WITH the interrupt
                 if trigger == "preemption_drain":

@@ -163,19 +163,28 @@ class PreemptionWatcher:
                 "(HVD_ELASTIC_KV) — this process will be lost reactively")
             return False
         addr, port_i = endpoint
-        notice = json.dumps({
+        # causal tracing: the notice ROOTS a trace the driver handling,
+        # the drain-stamped world, and every survivor's re-mesh episode
+        # continue — "what caused this re-mesh" is one trace query
+        from horovod_tpu import tracing
+        nctx = tracing.new_trace("elastic")
+        doc = {
             "rank": int(rank), "host": host, "source": source,
             # metadata maintenance dooms the whole HOST; a chaos or
             # SIGTERM notice targets this worker process
             "scope": "host" if source == "metadata" else "worker",
             "generation": int(os.environ.get("HVD_ELASTIC_GENERATION",
                                              "0")),
-            "at": time.time()}).encode()
+            "at": time.time()}
+        if nctx is not None:
+            doc[tracing.TRACEPARENT] = nctx.traceparent
+        notice = json.dumps(doc).encode()
         try:
             from horovod_tpu.runner import kv_relay
-            kv_relay.client(addr, port_i).put(
-                "drain", rank, notice, timeout=5.0,
-                site="elastic.drain_notice")
+            with tracing.activate(nctx):
+                kv_relay.client(addr, port_i).put(
+                    "drain", rank, notice, timeout=5.0,
+                    site="elastic.drain_notice")
             self._retry_source = None
             # evidence is stamped only for a notice that actually
             # LANDED: the transient-failure path re-runs notify() every
@@ -186,7 +195,8 @@ class PreemptionWatcher:
                 from horovod_tpu.diagnostics.flight_recorder import \
                     record_event
                 record_event("preemption_notice", source=source,
-                             rank=rank, host=host)
+                             rank=rank, host=host,
+                             **tracing.fields(nctx))
             except Exception:
                 pass
             _metric("hvd_drain_notices_total",

@@ -65,11 +65,26 @@ class Episode:
         self.phases: Dict[str, float] = {}
         self._recovered_at: Optional[float] = None
         self.finished = False
+        # causal tracing: a planned re-mesh continues the trace its
+        # drain-stamped world doc carries (finding → decision → action
+        # → drain → THESE phases); a reactive one roots its own
+        self.trace = None
+
+    def set_trace(self, ctx) -> None:
+        """Adopt a trace context (the survivor's child span of the
+        world doc's ``traceparent``); every phase emitted from here on
+        is stamped with it.  Explicit stamping, not thread-local
+        activation: recovery spans several threads."""
+        self.trace = ctx
+
+    def _trace_fields(self) -> Dict[str, str]:
+        return self.trace.fields() if self.trace is not None else {}
 
     def add_phase(self, name: str, seconds: float) -> None:
         self.phases[name] = self.phases.get(name, 0.0) + float(seconds)
         _record_flight("remesh_phase", phase=name,
-                       seconds=round(seconds, 4), trigger=self.trigger)
+                       seconds=round(seconds, 4), trigger=self.trigger,
+                       **self._trace_fields())
 
     def mark_recovered(self) -> None:
         """The new world is up and state is restored: the clock on
@@ -109,6 +124,7 @@ class Episode:
                        trigger=self.trigger, total_s=round(total, 4),
                        old_size=self.old_size, new_size=self.new_size,
                        generation=self.generation,
+                       **self._trace_fields(),
                        **{f"{k}_s": round(v, 4)
                           for k, v in self.phases.items()})
         try:
@@ -120,7 +136,32 @@ class Episode:
                 "trigger": self.trigger,
                 "old_size": self.old_size, "new_size": self.new_size,
                 "generation": self.generation,
-                "complete": complete})
+                "complete": complete,
+                **self._trace_fields()})
+        except Exception:
+            pass
+        try:
+            # the episode as proper spans (one parent, one child per
+            # phase laid out in pipeline order — starts are
+            # approximate, durations measured): what `diagnostics
+            # trace <id>` renders as the recovery subtree
+            from horovod_tpu import tracing
+            if self.trace is not None:
+                end_wall = time.time()
+                tracing.record_span(
+                    "remesh", f"remesh_{self.trigger}", self.trace,
+                    start=end_wall - total, dur_s=total,
+                    old_size=self.old_size, new_size=self.new_size,
+                    generation=self.generation, complete=complete)
+                t = end_wall - total
+                for name in PHASES:
+                    if name in self.phases:
+                        dur = self.phases[name]
+                        tracing.record_span(
+                            "remesh", name,
+                            tracing.child(self.trace, "remesh"),
+                            start=t, dur_s=dur)
+                        t += dur
         except Exception:
             pass
         try:

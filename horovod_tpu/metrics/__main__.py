@@ -275,6 +275,10 @@ def render_actions_table(decisions) -> str:
                   "cooldown_remaining_s", "actions_in_window"):
             if gate.get(k) is not None:
                 extras.append(f"{k}={gate[k]}")
+        if d.get("trace"):
+            # the causal join key: paste it into
+            # `python -m horovod_tpu.diagnostics trace <id>`
+            extras.append(f"trace={d['trace'][:12]}")
         if extras:
             detail = (detail + " " if detail else "") + " ".join(extras)
         lines.append(

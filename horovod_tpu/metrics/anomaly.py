@@ -244,6 +244,19 @@ class AnomalyEngine:
     def _flag(self, finding: dict, **extra: Any) -> dict:
         finding.update(extra)
         finding["ts"] = round(time.time(), 3)
+        try:
+            # causal tracing (docs/OBSERVABILITY.md "Causal tracing"):
+            # the finding ROOTS a trace that the autopilot decision,
+            # the action/ KV doc, the driver's handling, and the
+            # resulting re-mesh episode all continue — one id from
+            # detection to the first healthy step of the cure
+            from horovod_tpu import tracing
+            ctx = tracing.new_trace("anomaly")
+            if ctx is not None:
+                finding.update(ctx.fields())
+                finding[tracing.TRACEPARENT] = ctx.traceparent
+        except Exception:
+            pass
         self.findings.append(finding)
         del self.findings[:-MAX_FINDINGS]
         kind = finding["kind"]
@@ -270,7 +283,8 @@ class AnomalyEngine:
             # (same convention as the chaos seam's "fault" field)
             record_event("anomaly",
                          **{("detector" if k == "kind" else k): v
-                            for k, v in finding.items() if k != "ts"})
+                            for k, v in finding.items()
+                            if k not in ("ts", "traceparent")})
         except Exception:
             pass
         try:

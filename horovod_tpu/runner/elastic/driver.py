@@ -431,18 +431,30 @@ class ElasticDriver:
             "planned preemption drain" if drain
             else "in-place crash recovery", new_np,
             len(survivors), len(replacements))
+        extra = {"drain": drain} if drain else {}
+        from horovod_tpu import tracing
+        if drain is None:
+            # a REACTIVE recovery has no inbound context to continue
+            # (the planned path's drain stamp carries the notice's) —
+            # root one here so every survivor's re-mesh episode still
+            # shares a single trace id with this publish
+            ctx = tracing.new_trace("elastic")
+            if ctx is not None:
+                extra["traceparent"] = ctx.traceparent
         self._publish_world(gen, new_slots, coord_addr, coord_port,
-                            keyed_slots=keyed,
-                            extra={"drain": drain} if drain else None)
+                            keyed_slots=keyed, extra=extra or None)
         # driver-side half of the re-mesh timeline: the survivors
         # measure their own phases (hvd_remesh_seconds); the driver
         # stamps WHEN it published the recovery world, so a merged
         # flight view can attribute the workers' failure_detect wait
         from horovod_tpu.diagnostics.flight_recorder import record_event
+        doc_ctx = tracing.decode((drain or {}).get("traceparent")) \
+            if drain else ctx
         record_event("remesh_driver_published", generation=gen,
                      np=new_np, survivors=len(survivors),
                      replacements=len(replacements),
-                     charge_reset=charge_reset)
+                     charge_reset=charge_reset,
+                     **tracing.fields(doc_ctx))
         # registrations are stale the moment ranks renumber: survivors
         # re-register at their first commit in the new world, and a crash
         # BEFORE that commit conservatively takes the restart path
@@ -530,10 +542,14 @@ class ElasticDriver:
                            and g.threads[k].is_alive()}
             else:
                 doomed.add(origin)
-            notice_meta.append(
-                {"rank": nrank,
-                 "host": g.slot_by_key[origin].hostname,
-                 "source": notice.get("source", "unknown")})
+            entry = {"rank": nrank,
+                     "host": g.slot_by_key[origin].hostname,
+                     "source": notice.get("source", "unknown")}
+            if isinstance(notice.get("traceparent"), str):
+                # the publisher's trace context rides the notice doc;
+                # the handling and the published world continue it
+                entry["traceparent"] = notice["traceparent"]
+            notice_meta.append(entry)
         return doomed, notice_meta, tokens
 
     def _scan_action_requests(self, g: _GenRuntime):
@@ -564,6 +580,10 @@ class ElasticDriver:
                      "source": "autopilot",
                      "policy": req.get("policy"),
                      "action": kind}
+            if isinstance(req.get("traceparent"), str):
+                # finding → decision → action doc: the trace continues
+                # through the driver's handling into the re-mesh
+                entry["traceparent"] = req["traceparent"]
             if isinstance(req.get("evidence"), dict):
                 # quarantine requests carry the canary digests that
                 # convicted the rank — recorded with the blocklist
@@ -599,6 +619,17 @@ class ElasticDriver:
         if any(str(g.current_rank[k]) not in notify for k in involved):
             return False
         g.handled_tokens.update(tokens)
+        # the driver's handling is a CHILD span of the notice/action
+        # that asked for it (docs/OBSERVABILITY.md "Causal tracing");
+        # the drain-stamped world carries the context onward so every
+        # survivor's re-mesh episode joins the same trace
+        from horovod_tpu import tracing
+        hctx = None
+        for m in notice_meta:
+            hctx = tracing.child(
+                tracing.decode(m.get("traceparent")), "elastic")
+            if hctx is not None:
+                break
         by_host: Dict[str, int] = {}
         for k in doomed:
             h = g.slot_by_key[k].hostname
@@ -622,7 +653,8 @@ class ElasticDriver:
             event_kind,
             notices=notice_meta,
             drained_ranks=sorted(g.current_rank[k] for k in doomed),
-            hosts=sorted(by_host), cooldown_s=cooldown)
+            hosts=sorted(by_host), cooldown_s=cooldown,
+            **tracing.fields(hctx))
         get_logger().warning(
             "%s %s: planning world around doomed rank(s) %s (hosts %s "
             "reserved for %.0fs)", event_kind, notice_meta,
@@ -635,7 +667,9 @@ class ElasticDriver:
             drain={"ranks": sorted(g.current_rank[k] for k in doomed),
                    "hosts": sorted(by_host),
                    "sources": sorted({m["source"]
-                                      for m in notice_meta})})
+                                      for m in notice_meta}),
+                   **({"traceparent": hctx.traceparent}
+                      if hctx is not None else {})})
         if recovered is None:
             # no viable planned world (the doomed host was the last
             # one, min_np would be violated, or a completion race): the

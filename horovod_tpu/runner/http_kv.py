@@ -297,6 +297,22 @@ def _with_retries(do, attempts: int = 4,
         max_delay_s=2.0, jitter=0.25, deadline_s=deadline_s)
 
 
+def _trace_headers() -> Dict[str, str]:
+    """The thread's active trace context as a ``traceparent`` header
+    (docs/OBSERVABILITY.md "Causal tracing"): a KV hop made inside an
+    ``activate()`` block carries its span, so relay forwards and the
+    receiving server can continue the causal chain.  Empty when
+    untraced — zero wire cost."""
+    try:
+        from horovod_tpu import tracing
+        ctx = tracing.current()
+        if ctx is not None:
+            return {tracing.TRACEPARENT: ctx.traceparent}
+    except Exception:
+        pass
+    return {}
+
+
 def kv_put(addr: str, port: int, scope: str, key: str, value: bytes,
            timeout: float = 30.0, site: str = "http_kv.put",
            peer=None, attempts: int = 4) -> None:
@@ -306,7 +322,7 @@ def kv_put(addr: str, port: int, scope: str, key: str, value: bytes,
     makes the call fail fast — the relay client uses it for parent hops,
     where the root fallback IS the retry."""
     req = Request(f"http://{addr}:{port}/{scope}/{key}", data=value,
-                  method="PUT")
+                  method="PUT", headers=_trace_headers())
 
     def do():
         from horovod_tpu import chaos
@@ -325,8 +341,9 @@ def kv_get(addr: str, port: int, scope: str, key: str,
         from horovod_tpu import chaos
         chaos.fire("kv.request")
         chaos.fire("kv.partition", peer=peer)
-        return urlopen(f"http://{addr}:{port}/{scope}/{key}",
-                       timeout=timeout).read()
+        req = Request(f"http://{addr}:{port}/{scope}/{key}",
+                      headers=_trace_headers())
+        return urlopen(req, timeout=timeout).read()
 
     try:
         return _with_retries(do, attempts=attempts,

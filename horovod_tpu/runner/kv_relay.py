@@ -284,16 +284,30 @@ class _RelayHandler(_KVHandler):
                 self.send_response(503)  # child falls back to the root
                 self.end_headers()
                 return
+            # causal tracing: the publisher's context arrives as the
+            # traceparent header; each relay hop re-stamps a CHILD span
+            # and forwards under it, so the merged tree shows the doc's
+            # path up the tree hop by hop
+            from horovod_tpu import tracing
+            fwd_ctx = tracing.child(
+                tracing.decode(self.headers.get(tracing.TRACEPARENT)),
+                "kv")
+            t0 = time.monotonic()
             try:
                 _metric("hvd_kv_relay_upstream_total",
                         "relay-node refreshes/forwards sent upstream, "
                         "per op", op="put")
-                upstream.put(scope, key, body, timeout=5.0,
-                             site="kv_relay.forward")
+                with tracing.activate(fwd_ctx):
+                    upstream.put(scope, key, body, timeout=5.0,
+                                 site="kv_relay.forward")
             except OSError:
                 self.send_response(503)
                 self.end_headers()
                 return
+            finally:
+                tracing.record_span("kv", "relay_forward", fwd_ctx,
+                                    dur_s=time.monotonic() - t0,
+                                    scope=scope, key=key)
             self.send_response(200)
             self.end_headers()
             return

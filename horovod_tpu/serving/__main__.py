@@ -56,12 +56,15 @@ class _FrontHandler(BaseHTTPRequestHandler):
             self._send(400, {"error": "bad request body"})
             return
         router = self.server.router
+        from horovod_tpu import tracing
+        trace = tracing.decode(self.headers.get(tracing.TRACEPARENT))
         try:
             resp = router.submit(doc.get("x"), req_id=doc.get("id"),
                                  deadline_s=(float(doc["deadline_ms"])
                                              / 1000.0
                                              if "deadline_ms" in doc
-                                             else None))
+                                             else None),
+                                 trace=trace)
             self._send(200, resp)
         except SheddedError as e:
             self._send(429, {"error": str(e)})
@@ -117,6 +120,12 @@ def main(argv=None) -> int:
                    help="exit after this many seconds (0 = forever)")
     args = p.parse_args(argv)
 
+    # crash hooks: with HVD_TPU_FLIGHT_DUMP_ON_EXIT=1 the front's
+    # flight ring (router request/dispatch trace spans) lands as a
+    # dump next to the replicas' — the merged timeline's router track
+    from horovod_tpu.diagnostics.flight_recorder import \
+        install_crash_hooks
+    install_crash_hooks()
     from horovod_tpu.runner.http_kv import ThreadedHTTPServer
     from horovod_tpu.serving import ReplicaFleet, Router
     fleet = ReplicaFleet(size=args.replicas, store_dir=args.store_dir,

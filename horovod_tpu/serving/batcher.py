@@ -49,8 +49,9 @@ class PendingRequest:
     :meth:`wait`; the serving loop fulfills it with :meth:`set_result`
     / :meth:`set_error`."""
 
-    __slots__ = ("id", "payload", "deadline", "enqueued_at", "_event",
-                 "_result", "_error")
+    __slots__ = ("id", "payload", "deadline", "enqueued_at",
+                 "formed_at", "forward_s", "_event", "_result",
+                 "_error")
 
     def __init__(self, req_id: str, payload: Any,
                  deadline: float) -> None:
@@ -58,6 +59,11 @@ class PendingRequest:
         self.payload = payload
         self.deadline = deadline
         self.enqueued_at = time.monotonic()
+        # causal-tracing attribution (docs/OBSERVABILITY.md): when the
+        # batch formed (queue wait ends) and how long its padded
+        # forward took — stamped by next_batch / the serving loop
+        self.formed_at: float = 0.0
+        self.forward_s: float = 0.0
         self._event = threading.Event()
         self._result: Any = None
         self._error: Optional[BaseException] = None
@@ -158,6 +164,7 @@ class DynamicBatcher:
                         f"request {req.id}: deadline expired after "
                         f"{now - req.enqueued_at:.3f}s in queue"))
                     continue
+                req.formed_at = now
                 batch.append(req)
             smetrics.set_queue_depth(len(self._q))
             if not batch:

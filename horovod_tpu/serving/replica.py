@@ -333,10 +333,18 @@ class ReplicaServer:
                 "version": self._version}
 
     # -- request path -------------------------------------------------------
-    def handle_infer(self, doc: dict) -> tuple:
-        """(HTTP code, response doc).  Runs on a handler thread."""
+    def handle_infer(self, doc: dict, trace=None) -> tuple:
+        """(HTTP code, response doc).  Runs on a handler thread.
+        ``trace`` is the dispatching router's attempt span (decoded
+        from the ``traceparent`` header): this replica's ``serve`` span
+        — covering batcher queue wait and the padded forward — becomes
+        its child, so a hedged request's tree covers BOTH replicas."""
         from horovod_tpu import chaos
+        from horovod_tpu import tracing
         req_id = str(doc.get("id") or f"anon-{time.monotonic_ns()}")
+        serve_ctx = tracing.child(trace, "serving")
+        t_handle = time.monotonic()
+        wall_handle = time.time()
         # chaos seam: `error` RAISES inside fire() -> caught here as
         # 500 (the router must retry it to a survivor); `shed` is a
         # pure-signal kind -> explicit 429; `delay` sleeps in place
@@ -352,6 +360,10 @@ class ReplicaServer:
         # idempotency: an already-answered id returns the SAME response
         cached = self._cached_response(req_id)
         if cached is not None:
+            tracing.record_span(
+                "serving", "serve", serve_ctx, start=wall_handle,
+                dur_s=time.monotonic() - t_handle,
+                replica=self.replica_id, cached=True)
             return 200, cached
         try:
             x = np.asarray(doc.get("x"), dtype=np.float32)
@@ -385,6 +397,33 @@ class ReplicaServer:
             y, version = pending.wait(timeout=max(wait_s, 0.1))
             resp = {"id": req_id, "y": np.asarray(y).tolist(),
                     "version": version, "replica": self.replica_id}
+            if serve_ctx is not None:
+                # the response names its trace so clients/benches can
+                # join it against the span store without headers
+                resp["trace"] = serve_ctx.trace_id
+                resp["span"] = serve_ctx.span_id
+            # the request's path THROUGH this replica: queue wait
+            # (enqueue → batch formation) and the padded forward, as
+            # child spans of the serve span — the per-hop latency
+            # attribution the `diagnostics trace` tree prints
+            queue_s = max(pending.formed_at - pending.enqueued_at, 0.0) \
+                if pending.formed_at else 0.0
+            tracing.record_span(
+                "serving", "batcher_queue",
+                tracing.child(serve_ctx, "serving"),
+                start=wall_handle, dur_s=queue_s,
+                replica=self.replica_id)
+            tracing.record_span(
+                "serving", "padded_forward",
+                tracing.child(serve_ctx, "serving"),
+                start=wall_handle + queue_s, dur_s=pending.forward_s,
+                replica=self.replica_id, version=version)
+            tracing.record_span(
+                "serving", "serve", serve_ctx, start=wall_handle,
+                dur_s=time.monotonic() - t_handle,
+                replica=self.replica_id, version=version,
+                queue_s=round(queue_s, 6),
+                forward_s=round(pending.forward_s, 6))
             if fresh:
                 # cache BEFORE the finally pops the in-flight entry: a
                 # duplicate arriving in between must hit one of the two
@@ -452,16 +491,17 @@ class ReplicaServer:
             padded[i, :] = x
         t0 = time.monotonic()
         out = np.asarray(self._compiled(params, padded))
+        forward_s = time.monotonic() - t0
         smetrics.observe_batch(n)
         smetrics._reg().histogram(
             "hvd_serving_forward_seconds",
             help="compiled forward-pass wall time per batch",
-            buckets=smetrics.LATENCY_BUCKETS).observe(
-            time.monotonic() - t0)
+            buckets=smetrics.LATENCY_BUCKETS).observe(forward_s)
         for i, req in enumerate(batch):
             # the version rides the result: a response must name the
             # weights that COMPUTED it, not whatever is live by the
             # time the handler unblocks (a swap can land in between)
+            req.forward_s = forward_s
             req.set_result((out[i], version))
 
 
@@ -521,7 +561,9 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
             except (ValueError, OSError):
                 self._send(400, {"error": "bad request body"})
                 return
-            code, resp = replica.handle_infer(doc)
+            from horovod_tpu import tracing
+            trace = tracing.decode(self.headers.get(tracing.TRACEPARENT))
+            code, resp = replica.handle_infer(doc, trace=trace)
             self._send(code, resp)
         elif path == "/drain":
             replica.drain(source="admin")
@@ -549,6 +591,13 @@ def main(argv=None) -> int:
     # HVD_TPU_RANK=<slot> so rank-scoped rules can target ONE replica
     from horovod_tpu import chaos
     chaos.install()
+    # crash hooks: an uncaught exception — or, with
+    # HVD_TPU_FLIGHT_DUMP_ON_EXIT=1, any exit — leaves this replica's
+    # flight ring (serve/queue/forward trace spans included) as a dump
+    # the merged timeline reader joins with the router's
+    from horovod_tpu.diagnostics.flight_recorder import \
+        install_crash_hooks
+    install_crash_hooks()
 
     replica = ReplicaServer(store_dir=args.store_dir, dim=args.dim,
                             port=args.port,

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import queue
 import threading
 import time
@@ -34,6 +35,7 @@ import urllib.error
 import urllib.request
 from typing import Callable, List, Optional, Sequence, Tuple
 
+from horovod_tpu import tracing
 from horovod_tpu.common.config import env_float, env_int
 from horovod_tpu.common.logging import get_logger
 from horovod_tpu.serving import metrics as smetrics
@@ -41,6 +43,8 @@ from horovod_tpu.serving.batcher import SheddedError
 from horovod_tpu.serving.metrics import LatencyWindow
 
 Endpoint = Tuple[str, int]
+
+DEFAULT_REQLOG_MAX_BYTES = 16 * 1024 * 1024
 
 
 class RequestFailed(RuntimeError):
@@ -61,16 +65,40 @@ class RequestRejected(RuntimeError):
 
 
 class RequestLog:
-    """Append-only JSONL accounting, thread-safe; ``None`` path = in-
-    memory only (the entries list is still kept, bounded)."""
+    """JSONL accounting with size-based rotation, thread-safe; ``None``
+    path = in-memory only (the entries list is still kept, bounded).
+
+    The on-disk file rotates at ``HVD_TPU_SERVING_REQLOG_MAX_BYTES``
+    (one previous generation kept as ``<path>.1`` — the OBS-store
+    treatment, :class:`horovod_tpu.metrics.timeseries.SeriesWriter`),
+    always at a line boundary, so each generation's lines are a
+    self-consistent audit window and :func:`read_request_log` reads
+    across the boundary in recording order.  The exactly-once
+    ``accounting()`` audit runs over the in-memory entries and is
+    untouched by rotation."""
 
     MAX_MEMORY = 100_000
 
-    def __init__(self, path: Optional[str] = None) -> None:
+    def __init__(self, path: Optional[str] = None,
+                 max_bytes: Optional[int] = None) -> None:
         self._path = path
         self._lock = threading.Lock()
-        self._fh = open(path, "a", buffering=1) if path else None
         self.entries: List[dict] = []
+        self.max_bytes = int(max_bytes) if max_bytes else env_int(
+            "SERVING_REQLOG_MAX_BYTES", DEFAULT_REQLOG_MAX_BYTES)
+        self._fh = None
+        self._written = 0
+        self._closed = False
+        if path:
+            # a bad path fails LOUDLY at construction (an audit log
+            # that silently never existed is worse than a crash);
+            # mid-life errors degrade to dropped lines below
+            self._open()
+
+    def _open(self):
+        self._fh = open(self._path, "a", buffering=1)
+        self._written = self._fh.tell()
+        return self._fh
 
     def note(self, req_id: str, outcome: str, **fields) -> None:
         doc = {"ts": round(time.time(), 4), "id": req_id,
@@ -79,14 +107,28 @@ class RequestLog:
             self.entries.append(doc)
             if len(self.entries) > self.MAX_MEMORY:
                 del self.entries[: self.MAX_MEMORY // 10]
-            if self._fh is not None:
+            if self._path is not None and not self._closed:
                 try:
-                    self._fh.write(json.dumps(doc) + "\n")
+                    line = json.dumps(doc) + "\n"
+                    # lazy reopen heals a transient mid-life failure
+                    # (the OBS SeriesWriter's contract); close() is
+                    # final — the flag above stops late completions
+                    # from resurrecting the handle
+                    fh = self._fh or self._open()
+                    if self._written > 0 and \
+                            self._written + len(line) > self.max_bytes:
+                        fh.close()
+                        self._fh = None
+                        os.replace(self._path, self._path + ".1")
+                        fh = self._open()
+                    fh.write(line)
+                    self._written += len(line)
                 except OSError:
-                    pass
+                    pass  # accounting stays in memory; never raise
 
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             if self._fh is not None:
                 try:
                     self._fh.close()
@@ -129,6 +171,15 @@ class RequestLog:
             "answered_twice": sorted(accepted.get(s, "?") for s, n in
                                      ok.items() if n > 1),
         }
+
+
+def read_request_log(path: str) -> List[dict]:
+    """Read a request log back, rotated generation first so lines come
+    out in recording order; torn trailing lines (a crash mid-append)
+    are skipped.  THE one rotated-JSONL reader — shared with the
+    causal-tracing planes so both sides always agree on the format."""
+    from horovod_tpu.tracing.reader import read_jsonl
+    return read_jsonl(path)
 
 
 class Router:
@@ -190,12 +241,16 @@ class Router:
                 pass
 
     # -- dispatch plumbing --------------------------------------------------
-    def _post(self, ep: Endpoint, body: bytes,
-              timeout: float) -> Tuple[int, dict]:
+    def _post(self, ep: Endpoint, body: bytes, timeout: float,
+              ctx=None) -> Tuple[int, dict]:
         url = f"http://{ep[0]}:{ep[1]}/infer"
+        headers = {"Content-Type": "application/json"}
+        if ctx is not None:
+            # the attempt's OWN span travels as the traceparent header;
+            # the replica's spans become its children
+            headers[tracing.TRACEPARENT] = ctx.traceparent
         req = urllib.request.Request(
-            url, data=body, method="POST",
-            headers={"Content-Type": "application/json"})
+            url, data=body, method="POST", headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=timeout) as r:
                 return r.status, json.loads(r.read())
@@ -207,44 +262,69 @@ class Router:
             return e.code, doc
 
     def _fire(self, ep: Endpoint, body: bytes, deadline: float,
-              results: "queue.Queue") -> None:
+              results: "queue.Queue", ctx=None) -> None:
         def run():
             timeout = min(self.attempt_timeout_s,
                           max(deadline - time.monotonic(), 0.05))
+            t0 = time.monotonic()
+            wall0 = time.time()
             try:
-                code, doc = self._post(ep, body, timeout)
+                code, doc = self._post(ep, body, timeout, ctx=ctx)
                 results.put((ep, code, doc, None))
+                err = None
             except Exception as e:
                 results.put((ep, None, None, e))
+                code, err = None, e
+            # every attempt records its span — including the hedge
+            # loser whose answer arrives after the request returned:
+            # the causal tree must cover BOTH replicas a hedge touched
+            tracing.record_span(
+                "serving", "dispatch", ctx, start=wall0,
+                dur_s=time.monotonic() - t0,
+                target=f"{ep[0]}:{ep[1]}", code=code,
+                error=repr(err) if err is not None else None)
 
         threading.Thread(target=run, daemon=True,
                          name="hvd-serving-dispatch").start()
 
     # -- the public request path --------------------------------------------
     def submit(self, x, req_id: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> dict:
+               deadline_s: Optional[float] = None,
+               trace=None) -> dict:
         """Blocking request.  Returns the replica's response doc.
         Raises :class:`SheddedError` at admission (429 — explicit
         backpressure) or :class:`RequestFailed` when an ACCEPTED
         request exhausts retries/hedges inside its deadline (explicit
-        terminal error, logged)."""
+        terminal error, logged).  ``trace`` is the CALLER's trace
+        context (a front end decodes the client's ``traceparent``
+        header into it); the request's root span is its child, or a
+        fresh trace when the client sent none."""
         seq = next(self._seq)
         if req_id is None:
             req_id = f"req-{seq}-{time.monotonic_ns()}"
+        root = tracing.child(trace, "serving") if trace is not None \
+            else tracing.new_trace("serving")
         if not self._inflight.acquire(blocking=False):
             smetrics.inc_shed("admission")
             self.window.note_shed()
-            self.log.note(req_id, "shed", seq=seq, where="admission")
+            self.log.note(req_id, "shed", seq=seq, where="admission",
+                          **tracing.fields(root))
             raise SheddedError("router inflight budget exhausted")
         with self._lock:
             self._inflight_n += 1
             smetrics.set_inflight(self._inflight_n)
         smetrics.inc_accepted()
-        self.log.note(req_id, "accepted", seq=seq)
+        self.log.note(req_id, "accepted", seq=seq,
+                      **tracing.fields(root))
         t0 = time.monotonic()
+        wall0 = time.time()
         try:
-            doc = self._dispatch(req_id, x, deadline_s)
+            doc = self._dispatch(req_id, x, deadline_s, root)
             latency = time.monotonic() - t0
+            tracing.record_span("serving", "request", root, start=wall0,
+                                dur_s=latency,
+                                replica=doc.get("replica"),
+                                version=doc.get("version"))
             smetrics.inc_completed()
             if doc.get("version") is not None:
                 # the router-side registry mirrors the version it just
@@ -256,7 +336,8 @@ class Router:
             self.log.note(req_id, "ok", seq=seq,
                           latency_s=round(latency, 6),
                           replica=doc.get("replica"),
-                          version=doc.get("version"))
+                          version=doc.get("version"),
+                          **tracing.fields(root))
             return doc
         except RequestRejected as e:
             # the replica ANSWERED — with a client error.  Not a drop,
@@ -267,11 +348,12 @@ class Router:
                      "error (4xx) by a replica — terminal, never "
                      "retried").inc()
             self.log.note(req_id, "rejected", seq=seq, code=e.code,
-                          error=str(e))
+                          error=str(e), **tracing.fields(root))
             raise
         except Exception as e:
             smetrics.inc_failed()
-            self.log.note(req_id, "failed", seq=seq, error=repr(e))
+            self.log.note(req_id, "failed", seq=seq, error=repr(e),
+                          **tracing.fields(root))
             raise
         finally:
             self._inflight.release()
@@ -279,7 +361,7 @@ class Router:
                 self._inflight_n -= 1
                 smetrics.set_inflight(self._inflight_n)
 
-    def _dispatch(self, req_id: str, x, deadline_s) -> dict:
+    def _dispatch(self, req_id: str, x, deadline_s, root=None) -> dict:
         deadline = time.monotonic() + (
             deadline_s if deadline_s is not None
             else self.default_deadline_s)
@@ -301,6 +383,7 @@ class Router:
         attempts = 0
         outstanding = 0
         tried = []
+        spans = []  # one per attempt, aligned with `tried`
 
         def launch():
             nonlocal attempts, outstanding
@@ -310,7 +393,13 @@ class Router:
             attempts += 1
             outstanding += 1
             tried.append(ep)
-            self._fire(ep, body, deadline, results)
+            # every attempt — primary, hedge, retry — is a child of the
+            # request's root span: the duplicates share the trace id
+            # and are SIBLINGS of each other, so the causal tree shows
+            # one request fanning out across replicas
+            ctx = tracing.child(root, "serving")
+            spans.append(ctx)
+            self._fire(ep, body, deadline, results, ctx=ctx)
             return True
 
         launch()
@@ -331,7 +420,8 @@ class Router:
                     if launch():  # appends the hedge TARGET to tried
                         smetrics.inc_hedged()
                         self.log.note(req_id, "hedged",
-                                      to=str(tried[-1]))
+                                      to=str(tried[-1]),
+                                      **tracing.fields(spans[-1]))
                 elif outstanding == 0:
                     # everything launched has answered badly and the
                     # attempt budget may still allow a retry
@@ -358,7 +448,8 @@ class Router:
             if launch():
                 smetrics.inc_retried()
                 self.log.note(req_id, "retried", after=last_error,
-                              to=str(tried[-1]))
+                              to=str(tried[-1]),
+                              **tracing.fields(spans[-1]))
             elif outstanding == 0:
                 break
             # tiny backoff so a fully-shedding fleet is not hammered

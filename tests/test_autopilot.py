@@ -736,7 +736,8 @@ def _straggler_worker_prog(log, flights, metrics_out, finish_step,
             with open({str(metrics_out)!r}, "w") as f:
                 f.write(render_prometheus(default_registry().snapshot()))
         recorder().dump_to(os.path.join(
-            {str(flights)!r}, f"rank{{hvd.rank()}}_pid{{os.getpid()}}.json"))
+            {str(flights)!r},
+            f"flight_rank{{hvd.rank()}}_pid{{os.getpid()}}.json"))
         with open({str(log)!r}, "a") as f:
             f.write(f"DONE rank={{hvd.rank()}} pid={{os.getpid()}} "
                     f"size={{hvd.size()}} step={{state.step}}\\n")
@@ -854,11 +855,37 @@ def test_autopilot_straggler_drain_act(tmp_path, monkeypatch):
     assert "autopilot_decision" in flight_kinds, sorted(flight_kinds)
     # the survivors measured the planned re-mesh (drain-stamped world)
     remesh = []
+    anomaly_evs = []
+    phase_evs = []
     for f in flights.glob("*.json"):
-        remesh += [e for e in json.load(open(f)).get("events", [])
-                   if e["kind"] == "remesh_complete"]
+        for e in json.load(open(f)).get("events", []):
+            if e["kind"] == "remesh_complete":
+                remesh.append(e)
+            elif e["kind"] == "remesh_phase":
+                phase_evs.append(e)
+            elif e["kind"] == "anomaly" \
+                    and e.get("detector") == "persistent_straggler":
+                anomaly_evs.append(e)
     assert any(e.get("trigger") == "preemption_drain" for e in remesh), \
         remesh
+    # ISSUE 15 acceptance (b): ONE trace id links the whole causal
+    # chain — the persistent_straggler finding, the SLO-gated
+    # decision, the driver's autopilot_action_handled, and every phase
+    # of the resulting re-mesh episode
+    tr = fired[0].get("trace")
+    assert tr and len(tr) == 32, fired[0]
+    assert fired[0].get("parent"), fired[0]  # childs the finding span
+    assert any(e.get("trace") == tr for e in anomaly_evs), \
+        (tr, anomaly_evs)
+    assert any(e.get("trace") == tr for e in handled), (tr, handled)
+    drain_episode = [e for e in remesh if e.get("trace") == tr]
+    assert drain_episode \
+        and drain_episode[0]["trigger"] == "preemption_drain", \
+        (tr, remesh)
+    traced_phases = {e.get("phase") for e in phase_evs
+                     if e.get("trace") == tr}
+    assert {"failure_detect", "restore", "first_step"} <= traced_phases, \
+        (tr, traced_phases)
     # and the CLI renders the trail
     import subprocess
     out = subprocess.run(
@@ -867,6 +894,29 @@ def test_autopilot_straggler_drain_act(tmp_path, monkeypatch):
         capture_output=True, text=True, cwd=REPO)
     assert out.returncode == 0, out.stderr
     assert "straggler-drain" in out.stdout and "fired" in out.stdout
+    # the merged timeline joins worker flight dumps + the actions/
+    # re-mesh history on one clock, and `trace <id>` prints the chain
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.diagnostics", "trace", tr,
+         "--dir", str(flights),
+         "--obs-dir", str(tmp_path / "act" / "obs")],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stderr + out.stdout
+    assert "anomaly" in out.stdout or "persistent_straggler" \
+        in out.stdout, out.stdout
+    assert "fired" in out.stdout, out.stdout
+    assert "remesh" in out.stdout, out.stdout
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.diagnostics", "timeline",
+         "--dir", str(flights),
+         "--obs-dir", str(tmp_path / "act" / "obs"),
+         "-o", str(tmp_path / "act" / "merged_timeline.json")],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    merged = json.load(open(tmp_path / "act" / "merged_timeline.json"))
+    traced = [e for e in merged["traceEvents"]
+              if (e.get("args") or {}).get("trace") == tr]
+    assert len({e["pid"] for e in traced}) >= 2, traced
 
 
 @pytest.mark.slow

@@ -1,0 +1,580 @@
+"""Causal fleet tracing (ISSUE 15, docs/OBSERVABILITY.md "Causal
+tracing").
+
+Fast battery: the trace-context unit battery (encode/decode/
+propagate/malformed-header-ignored), hedged-duplicate sibling-span
+semantics through a real router, the replica serve-span childing from
+the HTTP header, the KV-doc roundtrip through the relay tree, the
+finding→decision trace chain, re-mesh episode stamping, the request-
+log/actions-JSONL rotation satellites, and the merged-timeline /
+``trace <id>`` readers joining ≥2 planes.
+
+Slow (tier-1 budget rule — multiprocess): the ISSUE acceptance (a): a
+chaos-delayed replica of a 2-replica SUBPROCESS fleet under load —
+``diagnostics trace <id>`` shows the hedged request's spans covering
+the router and BOTH replicas with correct parentage and the delay
+attributed to the slow hop.  (Acceptance (b) — the straggler→autopilot
+→re-mesh chain under ``act`` — rides the existing scenario in
+tests/test_autopilot.py, which asserts the single trace id end to
+end.)
+"""
+
+import io
+import json
+import os
+import time
+import urllib.request
+from contextlib import redirect_stdout
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from horovod_tpu import tracing  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    monkeypatch.delenv("HVD_TPU_TRACE", raising=False)
+    monkeypatch.delenv("HVD_TPU_TRACE_SAMPLE", raising=False)
+    monkeypatch.delenv("HVD_TPU_CLOCK_OFFSET_S", raising=False)
+    tracing.set_current(None)
+    yield
+    tracing.set_current(None)
+
+
+# -- the context unit battery -------------------------------------------------
+def test_traceparent_roundtrip():
+    ctx = tracing.new_trace("generic")
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    assert ctx.parent_id is None
+    header = tracing.encode(ctx)
+    assert header == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+    back = tracing.decode(header)
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.parent_id is None  # the wire carries trace+span only
+
+
+def test_malformed_headers_ignored_and_counted():
+    from horovod_tpu.metrics.registry import default_registry
+    before = getattr(default_registry().get("hvd_trace_dropped_total"),
+                     "value", 0)
+    for bad in ("junk", "00-zz-xx-01", "00-1234-5678-01",
+                "01-" + "a" * 32 + "-" + "b" * 16 + "-01",
+                "00-" + "0" * 32 + "-" + "b" * 16 + "-01"):
+        assert tracing.decode(bad) is None, bad
+    # absent is untraced, NOT a drop
+    assert tracing.decode(None) is None
+    assert tracing.decode("") is None
+    after = default_registry().get("hvd_trace_dropped_total").value
+    assert after - before == 5
+
+
+def test_child_and_sibling_parentage():
+    root = tracing.new_trace()
+    c1 = tracing.child(root)
+    c2 = tracing.child(root)
+    assert c1.trace_id == root.trace_id
+    assert c1.parent_id == root.span_id == c2.parent_id
+    assert c1.span_id != c2.span_id
+    # a hedged duplicate: same trace, same PARENT, fresh span
+    dup = tracing.sibling(c1)
+    assert dup.trace_id == c1.trace_id
+    assert dup.parent_id == c1.parent_id
+    assert dup.span_id != c1.span_id
+    # None-safety end to end
+    assert tracing.child(None) is None
+    assert tracing.sibling(None) is None
+    assert tracing.encode(None) is None
+    assert tracing.fields(None) == {}
+
+
+def test_disabled_env_kills_every_source(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_TRACE", "0")
+    assert tracing.new_trace() is None
+    assert tracing.decode("00-" + "a" * 32 + "-" + "b" * 16 + "-01") \
+        is None
+    live = tracing.TraceContext("a" * 32, "b" * 16)
+    assert tracing.child(live) is None
+
+
+def test_sampling_is_a_property_of_the_id(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_TRACE_SAMPLE", "0")
+    assert tracing.new_trace() is None
+    monkeypatch.setenv("HVD_TPU_TRACE_SAMPLE", "1.0")
+    assert tracing.new_trace() is not None
+    monkeypatch.setenv("HVD_TPU_TRACE_SAMPLE", "not-a-float")
+    assert tracing.new_trace() is not None  # bad knob degrades to keep
+
+
+def test_activation_stamps_flight_events():
+    from horovod_tpu.diagnostics.flight_recorder import (record_event,
+                                                         recorder)
+    ctx = tracing.new_trace()
+    inner = tracing.child(ctx)
+    with tracing.activate(ctx):
+        record_event("outer_ev")
+        with tracing.activate(inner):
+            record_event("inner_ev")
+        record_event("outer_again")
+    record_event("outside")
+    evs = {e["kind"]: e for e in recorder().events()[-4:]}
+    assert evs["outer_ev"]["span"] == ctx.span_id
+    assert evs["inner_ev"]["span"] == inner.span_id
+    assert evs["inner_ev"]["parent"] == ctx.span_id
+    assert evs["outer_again"]["span"] == ctx.span_id  # restored
+    assert "trace" not in evs["outside"]
+    # explicit fields always win over the ambient context
+    with tracing.activate(ctx):
+        record_event("explicit", **inner.fields())
+    assert recorder().events()[-1]["span"] == inner.span_id
+
+
+def test_flight_dump_carries_wall_offset(monkeypatch):
+    from horovod_tpu.diagnostics import flight_recorder as fr
+    old = fr.wall_offset()
+    try:
+        fr.set_wall_offset(2.5)
+        assert fr.recorder().dump()["wall_offset_s"] == 2.5
+        monkeypatch.setenv("HVD_TPU_CLOCK_OFFSET_S", "7.25")
+        assert fr.recorder().dump()["wall_offset_s"] == 7.25
+    finally:
+        fr.set_wall_offset(old)
+
+
+# -- hedged duplicates through a real router ---------------------------------
+@pytest.fixture
+def replica_pair():
+    from horovod_tpu.serving.replica import ReplicaServer
+    slow = ReplicaServer(dim=4, replica_id="slowr").start()
+    fast = ReplicaServer(dim=4, replica_id="fastr").start()
+    orig = slow.handle_infer
+
+    def delayed(doc, trace=None):
+        time.sleep(0.5)
+        return orig(doc, trace=trace)
+
+    slow.handle_infer = delayed
+    yield slow, fast
+    slow.stop()
+    fast.stop()
+
+
+def test_hedged_attempts_are_sibling_spans(replica_pair):
+    from horovod_tpu.diagnostics.flight_recorder import recorder
+    from horovod_tpu.serving.router import Router
+    slow, fast = replica_pair
+    router = Router([("127.0.0.1", slow.port),
+                     ("127.0.0.1", fast.port)],
+                    hedge_ms=80, max_inflight=8)
+    try:
+        doc = router.submit([1.0, 2.0, 3.0, 4.0], req_id="h1")
+        assert doc["replica"] == "fastr"  # the hedge won
+        time.sleep(0.8)  # let the slow primary's span record too
+        entries = [e for e in router.log.entries if e["id"] == "h1"]
+        by_outcome = {e["outcome"]: e for e in entries}
+        assert "hedged" in by_outcome, entries
+        trace_id = by_outcome["accepted"]["trace"]
+        assert by_outcome["ok"]["trace"] == trace_id
+        assert by_outcome["hedged"]["trace"] == trace_id
+        root_span = by_outcome["accepted"]["span"]
+        spans = [e for e in recorder().events()
+                 if e.get("kind") == "trace_span"
+                 and e.get("trace") == trace_id]
+        dispatch = [s for s in spans if s["name"] == "dispatch"]
+        assert len(dispatch) == 2
+        # SIBLINGS: both attempts child the request's root span
+        assert {d["parent"] for d in dispatch} == {root_span}
+        assert len({d["span"] for d in dispatch}) == 2
+        # the replicas' serve spans child their own attempt
+        serve = {s["replica"]: s for s in spans
+                 if s["name"] == "serve"}
+        assert set(serve) == {"slowr", "fastr"}
+        attempt_ids = {d["span"] for d in dispatch}
+        assert serve["slowr"]["parent"] in attempt_ids
+        assert serve["fastr"]["parent"] in attempt_ids
+        assert serve["slowr"]["parent"] != serve["fastr"]["parent"]
+        # the response names its trace (bench/client join key)
+        assert doc["trace"] == trace_id
+    finally:
+        router.close()
+
+
+def test_replica_serve_span_childs_from_header(replica_pair):
+    from horovod_tpu.diagnostics.flight_recorder import recorder
+    _slow, fast = replica_pair
+    ctx = tracing.new_trace("serving")
+    body = json.dumps({"id": "hdr1", "x": [1, 0, 0, 0]}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{fast.port}/infer", data=body,
+        method="POST", headers={"Content-Type": "application/json",
+                                tracing.TRACEPARENT: ctx.traceparent})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        doc = json.loads(r.read())
+    assert doc["trace"] == ctx.trace_id
+    spans = [e for e in recorder().events()
+             if e.get("kind") == "trace_span"
+             and e.get("trace") == ctx.trace_id]
+    serve = [s for s in spans if s["name"] == "serve"]
+    assert serve and serve[0]["parent"] == ctx.span_id
+    # queue + padded forward are the serve span's children, version on
+    # the forward (the request is traceable through the batcher)
+    kids = {s["name"]: s for s in spans
+            if s.get("parent") == serve[0]["span"]}
+    assert set(kids) == {"batcher_queue", "padded_forward"}
+    assert kids["padded_forward"]["version"] == doc["version"]
+
+
+# -- KV-doc roundtrip through the relay ---------------------------------------
+def test_kv_doc_roundtrip_through_relay(monkeypatch):
+    from horovod_tpu.diagnostics.flight_recorder import recorder
+    from horovod_tpu.runner import kv_relay
+    from horovod_tpu.runner.http_kv import KVStoreServer
+    root = KVStoreServer()
+    root.start()
+    try:
+        monkeypatch.setenv("HVD_TPU_KV_RELAY_ARITY", "2")
+        relay = kv_relay.RelayKVServer(
+            lambda: kv_relay.RelayClient(1, "127.0.0.1", root.port,
+                                         arity=2))
+        relay.start()
+        try:
+            ctx = tracing.new_trace("autopilot")
+            doc = json.dumps({"action": "drain", "rank": 1,
+                              "traceparent": ctx.traceparent}).encode()
+            # publish THROUGH the relay node, as a worker would
+            with tracing.activate(ctx):
+                from horovod_tpu.runner.http_kv import kv_put
+                kv_put("127.0.0.1", relay.port, "action", "1-1", doc)
+            stored = root.get("action", "1-1")
+            assert stored is not None
+            got = json.loads(stored)
+            # the doc's embedded context survives the hop unchanged —
+            # the driver childs from exactly what the worker stamped
+            assert tracing.from_doc(got).trace_id == ctx.trace_id
+            assert tracing.from_doc(got).span_id == ctx.span_id
+            # and the relay recorded its forward hop as a child span
+            fwd = [e for e in recorder().events()
+                   if e.get("kind") == "trace_span"
+                   and e.get("name") == "relay_forward"
+                   and e.get("trace") == ctx.trace_id]
+            assert fwd and fwd[0]["parent"] == ctx.span_id
+        finally:
+            relay.stop()
+    finally:
+        root.stop()
+        kv_relay.reset()
+
+
+# -- the finding → decision → action chain ------------------------------------
+def test_decision_chain_carries_finding_trace(monkeypatch):
+    from horovod_tpu.autopilot.engine import PolicyEngine
+    from horovod_tpu.autopilot.policy import Policy
+    policy = Policy(name="t-freeze", finding="recompile_storm",
+                    action="freeze_alert", hysteresis=1)
+    eng = PolicyEngine(policies=[policy], mode="observe", rank=0)
+    ctx = tracing.new_trace("anomaly")
+    finding = {"kind": "recompile_storm", "function": "f",
+               tracing.TRACEPARENT: ctx.traceparent, **ctx.fields()}
+    decisions = eng.on_finding(finding)
+    assert len(decisions) == 1
+    d = decisions[0]
+    assert d["trace"] == ctx.trace_id
+    assert d["parent"] == ctx.span_id  # decision childs the finding
+    assert d["span"] != ctx.span_id
+    assert tracing.decode(d["traceparent"]).span_id == d["span"]
+
+
+def test_anomaly_finding_roots_a_trace():
+    from horovod_tpu.metrics.anomaly import AnomalyEngine
+    eng = AnomalyEngine()
+    finding = eng.report("recompile_storm", function="g", compiles=5)
+    assert len(finding["trace"]) == 32 and len(finding["span"]) == 16
+    assert tracing.decode(finding["traceparent"]).trace_id \
+        == finding["trace"]
+    from horovod_tpu.diagnostics.flight_recorder import recorder
+    flight = [e for e in recorder().events()
+              if e.get("kind") == "anomaly"
+              and e.get("trace") == finding["trace"]]
+    assert flight and flight[0]["span"] == finding["span"]
+    assert "traceparent" not in flight[0]
+
+
+def test_remesh_episode_stamps_trace():
+    from horovod_tpu.diagnostics.flight_recorder import recorder
+    from horovod_tpu.elastic import remesh
+    remesh.reset()
+    try:
+        drain_stamp = {"ranks": [2]}
+        parent = tracing.new_trace("elastic")
+        drain_stamp["traceparent"] = parent.traceparent
+        ep = remesh.begin("preemption_drain", old_size=3)
+        ep.set_trace(tracing.child(
+            tracing.from_doc(drain_stamp), "remesh"))
+        with remesh.phase("drain"):
+            time.sleep(0.01)
+        remesh.mark_recovered(new_size=3, generation=7)
+        remesh.note_step_end()
+        evs = [e for e in recorder().events()
+               if e.get("trace") == parent.trace_id]
+        kinds = {e["kind"] for e in evs}
+        assert {"remesh_phase", "remesh_complete",
+                "trace_span"} <= kinds
+        phases = [e for e in evs if e["kind"] == "trace_span"
+                  and e["plane"] == "remesh"]
+        # episode span + per-phase children
+        names = {e["name"] for e in phases}
+        assert "remesh_preemption_drain" in names
+        assert "drain" in names and "first_step" in names
+        # the episode childs from the drain stamp's span
+        episode = next(e for e in phases
+                       if e["name"] == "remesh_preemption_drain")
+        assert episode["parent"] == parent.span_id
+    finally:
+        remesh.reset()
+
+
+# -- rotation satellites ------------------------------------------------------
+def test_reqlog_rotation_and_torn_tail_reader(tmp_path):
+    from horovod_tpu.serving.router import RequestLog, read_request_log
+    path = str(tmp_path / "reqlog.jsonl")
+    log = RequestLog(path, max_bytes=600)
+    n = 40
+    for i in range(n):
+        log.note(f"r{i}", "accepted", seq=i)
+    log.close()
+    assert os.path.exists(path + ".1")  # rotated exactly one gen back
+    assert os.path.getsize(path) <= 600
+    # torn tail: a crash mid-append leaves half a line
+    with open(path, "a") as f:
+        f.write('{"ts": 1, "id": "torn')
+    entries = read_request_log(path)
+    ids = [e["id"] for e in entries]
+    assert ids == sorted(ids, key=lambda s: int(s[1:]))  # in order
+    assert ids[-1] == f"r{n - 1}" and "torn" not in ids
+    # the in-memory exactly-once audit is untouched by rotation
+    acct = log.accounting()
+    assert acct["accepted"] == n and not acct["answered_twice"]
+    # close() is FINAL: a late hedge completion noting after close
+    # stays in memory but must not resurrect the file handle
+    size_before = os.path.getsize(path)
+    log.note("late", "ok", seq=n)
+    assert os.path.getsize(path) == size_before
+    assert log.entries[-1]["id"] == "late"
+    # and a bad path fails loudly at construction, not silently
+    with pytest.raises(OSError):
+        RequestLog(str(tmp_path / "no-such-dir" / "log.jsonl"))
+
+
+def test_actions_jsonl_rotation_reads_across_boundary(tmp_path,
+                                                      monkeypatch):
+    from horovod_tpu.autopilot.engine import PolicyEngine
+    from horovod_tpu.autopilot.policy import Policy
+    from horovod_tpu.metrics.timeseries import read_series
+    monkeypatch.setenv("HVD_TPU_OBS_DIR", str(tmp_path))
+    monkeypatch.setenv("HVD_TPU_ACTIONS_MAX_BYTES", "900")
+    policy = Policy(name="t-rot", finding="recompile_storm",
+                    action="freeze_alert", hysteresis=1, cooldown_s=0.0,
+                    max_actions=1000, window_s=60.0,
+                    key_field="function")
+    eng = PolicyEngine(policies=[policy], mode="observe", rank=0)
+    n = 30
+    for i in range(n):
+        eng.on_finding({"kind": "recompile_storm", "function": f"f{i}"})
+    path = tmp_path / "actions_rank0.jsonl"
+    prev = tmp_path / "actions_rank0.jsonl.1"
+    assert path.exists() and prev.exists()  # rotated, one gen kept
+    assert path.stat().st_size <= 900
+    decisions = read_series(str(tmp_path), basename="actions")
+    current = path.read_text().splitlines()
+    # the reader crossed the rotation boundary: strictly more than the
+    # live file holds, in recording order, ending at the newest
+    assert len(decisions) == len(current) + \
+        len(prev.read_text().splitlines())
+    assert len(decisions) > len(current)
+    keys = [d["key"] for d in decisions]
+    assert keys[-1] == f"f{n - 1}"
+    assert keys == sorted(keys, key=lambda k: int(k[1:]))
+
+
+# -- the unified readers ------------------------------------------------------
+def _fake_planes(tmp_path, offset_s=0.0):
+    """A two-plane fixture: a flight dump (request spans, offset
+    clock) + an OBS store (a traced re-mesh point and a decision)."""
+    ctx = tracing.TraceContext("ab" * 16, "12" * 8)
+    child = tracing.TraceContext(ctx.trace_id, "34" * 8, ctx.span_id)
+    now = time.time()
+    flight = {
+        "rank": 1, "wall_offset_s": offset_s,
+        "events": [
+            {"ts": now + offset_s, "kind": "trace_span",
+             "plane": "serving", "name": "request",
+             "start": now + offset_s - 0.2, "dur_s": 0.2,
+             "trace": ctx.trace_id, "span": ctx.span_id},
+            {"ts": now + offset_s, "kind": "trace_span",
+             "plane": "serving", "name": "dispatch",
+             "start": now + offset_s - 0.19, "dur_s": 0.18,
+             "trace": ctx.trace_id, "span": child.span_id,
+             "parent": ctx.span_id, "target": "h:1"},
+            {"ts": now + offset_s, "kind": "serving_swap",
+             "version": 3},
+        ],
+    }
+    with open(tmp_path / "hvd_flight_rank1.json", "w") as f:
+        json.dump(flight, f)
+    obs = tmp_path / "obs"
+    obs.mkdir()
+    with open(obs / "obs_rank0.jsonl", "w") as f:
+        f.write(json.dumps({
+            "ts": now, "remesh": {"drain": 0.1},
+            "remesh_total_s": 0.5, "trigger": "preemption_drain",
+            "trace": ctx.trace_id, "span": "56" * 8,
+            "parent": ctx.span_id}) + "\n")
+    with open(obs / "actions_rank0.jsonl", "w") as f:
+        f.write(json.dumps({
+            "ts": now, "policy": "p", "outcome": "fired",
+            "trace": ctx.trace_id, "span": "78" * 8,
+            "parent": ctx.span_id}) + "\n")
+    reqlog = tmp_path / "reqlog.jsonl"
+    with open(reqlog, "w") as f:
+        f.write(json.dumps({
+            "ts": now, "id": "r1", "outcome": "ok",
+            "latency_s": 0.2, "trace": ctx.trace_id,
+            "span": ctx.span_id}) + "\n")
+    return ctx, obs, reqlog
+
+
+def test_merged_timeline_joins_planes_and_corrects_skew(tmp_path):
+    from horovod_tpu.diagnostics.__main__ import main as diag_main
+    ctx, obs, reqlog = _fake_planes(tmp_path, offset_s=100.0)
+    out = tmp_path / "merged.json"
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = diag_main(["timeline", "--dir", str(tmp_path),
+                        "--obs-dir", str(obs),
+                        "--reqlog", str(reqlog), "-o", str(out)])
+    assert rc == 0, buf.getvalue()
+    doc = json.load(open(out))
+    evs = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    tracks = {e["pid"] for e in evs}
+    assert len(tracks) >= 3  # flight + reqlog + obs planes
+    spans = [e for e in evs if e.get("ph") == "X"]
+    assert spans
+    # skew correction: the flight dump's 100s offset was subtracted,
+    # so its request span and the reqlog's (offset-free) ok span —
+    # the same 0.2s window — land together after rebasing (µs scale)
+    req = [e for e in spans if e["name"] == "serving:request"][0]
+    ok = [e for e in spans if e["name"] == "ok"][0]
+    assert abs(req["ts"] - ok["ts"]) < 0.05e6, (req["ts"], ok["ts"])
+
+
+def test_trace_cli_renders_causal_tree_across_planes(tmp_path):
+    from horovod_tpu.diagnostics.__main__ import main as diag_main
+    ctx, obs, reqlog = _fake_planes(tmp_path)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = diag_main(["trace", ctx.trace_id[:8],  # prefix resolves
+                        "--dir", str(tmp_path), "--obs-dir", str(obs),
+                        "--reqlog", str(reqlog)])
+    assert rc == 0
+    out = buf.getvalue()
+    assert ctx.trace_id in out
+    assert "serving:request" in out
+    assert "dispatch" in out and "<< slow hop" in out
+    # the OBS planes joined the same tree: the re-mesh point and the
+    # decision hang off the request's root span
+    assert "preemption_drain" in out and "fired" in out
+    # unknown id fails loudly
+    buf2 = io.StringIO()
+    with redirect_stdout(buf2):
+        assert diag_main(["trace", "ffff0000", "--dir",
+                          str(tmp_path)]) == 1
+
+
+# -- acceptance (a): hedge across a chaos-delayed SUBPROCESS fleet -----------
+@pytest.mark.slow
+def test_hedged_trace_covers_router_and_both_replicas(tmp_path):
+    """ISSUE 15 acceptance (a): one replica of a 2-replica subprocess
+    fleet is chaos-delayed; under load, a hedged request's
+    ``diagnostics trace <id>`` output shows spans from the router and
+    BOTH replica processes with correct parentage, and the injected
+    delay attributed to the slow hop."""
+    from horovod_tpu.diagnostics.flight_recorder import recorder
+    from horovod_tpu.serving.fleet import ReplicaFleet
+    from horovod_tpu.serving.router import Router
+    dumps = tmp_path / "dumps"
+    dumps.mkdir()
+    plan = json.dumps({"faults": [
+        {"seam": "serving.request", "kind": "delay", "rank": 0,
+         "start": 0, "stop": 1_000_000, "delay_ms": 400}]})
+    fleet = ReplicaFleet(size=2, dim=4, extra_env={
+        "HVD_TPU_FAULT_PLAN": plan,
+        "HVD_TPU_FLIGHT_DUMP_ON_EXIT": "1",
+        "HVD_TPU_AUTOPSY_DIR": str(dumps),
+        "HVD_TPU_TRACE": "1",
+    }).start(ready_timeout_s=120.0)
+    router = Router(fleet.endpoints, hedge_ms=80, max_inflight=16)
+    hedged_trace = None
+    try:
+        for i in range(12):
+            try:
+                router.submit([1.0, 0.0, 0.0, 0.0], req_id=f"acc-{i}")
+            except Exception:
+                pass
+            hedged = [e for e in router.log.entries
+                      if e["outcome"] == "hedged" and e.get("trace")]
+            if hedged:
+                hedged_trace = hedged[0]["trace"]
+                break
+        assert hedged_trace, router.log.entries
+        time.sleep(1.0)  # the delayed primary's spans must land too
+        # graceful drain so each replica's atexit flight dump lands
+        fleet._stop.set()  # no heal-respawns during the drain
+        for slot in (0, 1):
+            fleet.drain(slot)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and any(
+                r.proc.poll() is None
+                for r in fleet._replicas.values()):
+            time.sleep(0.2)
+    finally:
+        router_dump = str(dumps / "hvd_flight_rank9.json")
+        recorder().dump_to(router_dump)
+        router.close()
+        fleet.stop()
+    dump_files = sorted(os.listdir(dumps))
+    assert len([n for n in dump_files if "flight" in n]) >= 3, dump_files
+
+    from horovod_tpu.tracing.reader import collect
+    data = collect(
+        flight_paths=[str(dumps / n) for n in dump_files
+                      if "flight" in n],
+        trace_id=hedged_trace)
+    spans = {s["span"]: s for s in data["spans"]}
+    root = [s for s in spans.values() if s["name"] == "request"]
+    assert len(root) == 1
+    dispatch = [s for s in spans.values() if s["name"] == "dispatch"]
+    assert len(dispatch) == 2
+    assert all(d["parent"] == root[0]["span"] for d in dispatch)
+    serve = [s for s in spans.values() if s["name"] == "serve"]
+    # BOTH replica processes contributed their spans, each childing
+    # the router attempt that reached it
+    assert {s["attrs"]["replica"].split(".")[0] for s in serve} \
+        == {"slot0", "slot1"}
+    for s in serve:
+        assert s["parent"] in {d["span"] for d in dispatch}
+    # the injected 400ms lives on the slow dispatch hop
+    slowest = max(d["dur_s"] for d in dispatch)
+    assert slowest >= 0.35, dispatch
+
+    from horovod_tpu.diagnostics.__main__ import main as diag_main
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = diag_main(["trace", hedged_trace, "--dir", str(dumps)])
+    assert rc == 0
+    out = buf.getvalue()
+    assert "serving:request" in out
+    assert out.count("serving:dispatch") == 2
+    assert "slot0" in out and "slot1" in out
+    assert "<< slow hop" in out
