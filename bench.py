@@ -270,6 +270,31 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
         except Exception:
             return 0
 
+    # goodput ledger (docs/OBSERVABILITY.md "Goodput ledger"): the bench
+    # loop brackets each step itself (it does not run StepTimer), so the
+    # artifact carries the same closed-books account a training run
+    # would — where the measured window's wall clock went, category by
+    # category, plus the roofline decomposition of 1-MFU.  On CPU
+    # children the categories are real but mfu stays null (no peak).
+    try:
+        from horovod_tpu.metrics import goodput as _gp
+    except Exception as e:
+        _gp = None
+        _log(f"goodput ledger unavailable ({e!r})")
+
+    def _goodput_doc(mfu):
+        if _gp is None:
+            return None, None
+        try:
+            from horovod_tpu.profiling import attribution
+            snap = _gp.snapshot(flush_open=True)
+            if snap is None:
+                return None, None
+            return snap, attribution.attribute(snap, mfu=mfu)
+        except Exception as e:
+            _log(f"goodput snapshot failed ({e!r})")
+            return None, None
+
     def _tracing_enabled():
         """Whether causal tracing (HVD_TPU_TRACE) was live during the
         measurement.  Recorded so a standing perf number cannot
@@ -287,6 +312,7 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
         peak = _peak_flops(jax.devices()[0].device_kind)
         mfu = (round(flops_per_device * n_iters / dt_window / peak, 4)
                if peak and flops_per_device else None)
+        gp_snap, gp_att = _goodput_doc(mfu)
         # extra values may be callables of the per-chip rate
         ex = {k: (v(value) if callable(v) else v) for k, v in extra.items()}
         if not provisional and late_extra is not None:
@@ -316,6 +342,8 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
             "hbm_peak_bytes": _hbm_peak(),
             "timing_iters": n_iters,
             "guard_skipped_steps": _guard_skipped(),
+            "goodput": gp_snap,
+            "mfu_attribution": gp_att,
             "tracing_enabled": _tracing_enabled(),
             "commit": _git_commit(),
             "phases": dict(_PHASES),
@@ -346,9 +374,15 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
         _T_SETUP0 = None
     _log("compiling (first step)...")
     t_c0 = _begin_phase("compile")
+    if _gp is not None:
+        _gp.note_step_begin()
     state, loss = step_fn(state)
     readback(loss)
     compile_s = _end_phase("compile", t_c0)
+    if _gp is not None:
+        # the first step pays the compile; the compile_watch delta
+        # claims that slice out of the in-step account
+        _gp.note_step_end(compile_s)
     _log(f"first step (compile+run) took {compile_s:.1f}s; warmup window...")
 
     # XLA:CPU on a starved host (the 8-virtual-device test mesh on one
@@ -369,10 +403,17 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
     # provisional window is one step, refined when full warmup lands.
     warmup_iters = 2
     t_w0 = _begin_phase("warmup")
+    t_gp = time.perf_counter()
     for i in range(warmup_iters):
+        if _gp is not None:
+            _gp.note_step_begin()
         state, loss = step_fn(state)
         if sync_every_step or i == 0:
             readback(loss)
+        if _gp is not None:
+            now_gp = time.perf_counter()
+            _gp.note_step_end(now_gp - t_gp)
+            t_gp = now_gp
         if i == 0:
             dt_1 = time.perf_counter() - t_w0
             emit(per_step_units / dt_1 / n_chips, dt_1, 1,
@@ -413,12 +454,16 @@ def _measure_and_report(step_fn, state, readback, analytic_flops_per_device,
     for i in range(iters):
         if tracer is not None:
             tracer.collective_begin("measure_step", "step", f"step#{i+1}")
+        if _gp is not None:
+            _gp.note_step_begin()
         state, loss = step_fn(state)
         if sync_every_step:
             readback(loss)
         if tracer is not None:
             tracer.collective_end("measure_step", f"step#{i+1}")
         t_now = time.perf_counter()
+        if _gp is not None:
+            _gp.note_step_end(t_now - t_prev)
         step_series.append(round(t_now - t_prev, 6))
         t_prev = t_now
     readback(loss)  # forces completion of the whole chain

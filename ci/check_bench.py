@@ -59,6 +59,20 @@ baseline's, and — baseline or not — when the artifact is not CLEAN:
 a measurement of the same system), failed requests, or a violated
 zero-drop audit (unanswered / double-answered ids) all fail.
 
+``--goodput NEW [--baseline OLD] [--tolerance T]`` is the goodput
+regression gate (ISSUE 16): the bench doc records ``goodput`` — the
+closed-books wall-clock ledger (docs/OBSERVABILITY.md "Goodput
+ledger") — and ``mfu_attribution`` (the roofline decomposition of
+1-MFU into category shares).  The gate fails when (a) NEW carries a
+real measured value but no goodput section (recording contract broke),
+(b) NEW's books did not close (the categories failed to sum to wall
+time within the ledger's tolerance — the accounting itself is broken),
+or (c) the ``exposed_comm`` or ``compile`` share grew more than T
+(default 0.1, ABSOLUTE share points — CPU windows are noisy) over the
+baseline's.  Baselines auto-discover from committed ``BENCH_r*.json``;
+null-valued failure artifacts are skipped LOUDLY (a silent skip reads
+as "compared against the last round" when it wasn't).
+
 ``--trajectory ARTIFACT [--tolerance T]`` is the within-window drift
 gate (ISSUE 7): the bench doc now records ``step_time_series`` — every
 iteration of the timing window — so a run whose *mean* looks fine but
@@ -176,6 +190,41 @@ def _load_bench_doc(path: str):
     return doc if isinstance(doc, dict) else None
 
 
+def discover_baseline(pattern, exclude, want, what):
+    """Newest committed artifact matching ``pattern`` whose doc
+    satisfies ``want(doc)``.  Every rejected candidate is reported
+    LOUDLY with the reason — a gate that silently skipped a null-valued
+    round reads as "compared against the last artifact" when it
+    actually reached further back (or found nothing).  ``what`` names
+    the gated section for the messages."""
+    for path in sorted(glob.glob(os.path.join(REPO, pattern)),
+                       reverse=True):
+        if os.path.abspath(path) == os.path.abspath(exclude):
+            continue
+        name = os.path.basename(path)
+        try:
+            doc = _load_bench_doc(path)
+        except (OSError, ValueError) as e:
+            print(f"baseline discovery: skipping {name} "
+                  f"(unreadable: {e})")
+            continue
+        if not doc:
+            print(f"baseline discovery: skipping {name} "
+                  "(no parseable bench doc)")
+            continue
+        if doc.get("value") is None:
+            print(f"baseline discovery: skipping {name} "
+                  "(null-valued failure artifact — no measurement to "
+                  "compare against)")
+            continue
+        if not want(doc):
+            print(f"baseline discovery: skipping {name} "
+                  f"(no {what} recorded)")
+            continue
+        return path, doc
+    return None, None
+
+
 def doc_compile_seconds(doc):
     """Measured compile seconds with wall-clock fallback for artifacts
     predating the compile-hook contract."""
@@ -224,15 +273,10 @@ def compile_budget_main(argv) -> int:
         baseline = _load_bench_doc(base_path)
     else:
         # newest committed BENCH_r*.json carrying a compile time
-        for path in sorted(glob.glob(os.path.join(REPO,
-                                                  "BENCH_r*.json")),
-                           reverse=True):
-            if os.path.abspath(path) == os.path.abspath(new_path):
-                continue
-            doc = _load_bench_doc(path)
-            if doc and doc_compile_seconds(doc)[0] is not None:
-                base_path, baseline = path, doc
-                break
+        base_path, baseline = discover_baseline(
+            "BENCH_r*.json", new_path,
+            lambda d: doc_compile_seconds(d)[0] is not None,
+            what="compile time")
     problem = check_compile_budget(new, baseline, tolerance)
     if problem:
         print(f"compile-budget gate FAILED for {new_path}: {problem}")
@@ -253,6 +297,107 @@ def compile_budget_main(argv) -> int:
         print(f"compile-budget gate OK vs {base_path} "
               f"(tolerance {tolerance:.0%}): {n:.1f}s ({src}) vs "
               f"{b:.1f}s ({bsrc})")
+    return 0
+
+
+# the shares the goodput gate holds against the baseline: the two
+# costs an engineering change most plausibly regresses silently (an
+# overlap-schedule break shows up as exposed_comm; a graph-growth or
+# cache-bust regression as compile)
+GOODPUT_GATED_CATEGORIES = ("exposed_comm", "compile")
+
+
+def doc_goodput(doc):
+    """The goodput ledger section of a bench doc, or None."""
+    if not isinstance(doc, dict):
+        return None
+    gp = doc.get("goodput")
+    return gp if isinstance(gp, dict) else None
+
+
+def check_goodput(new: dict, baseline, tolerance: float) -> list:
+    """Problems with an artifact's goodput books: list of failure
+    strings (empty = gate passes).
+
+    Three rules (ISSUE 16): (1) a real-valued artifact must CARRY the
+    ledger — a measured number whose wall-clock account is missing is a
+    recording-contract break; (2) the books must CLOSE — categories
+    summing to wall time within the ledger's own tolerance is the whole
+    point, and an artifact that failed its double-entry check is
+    evidence of broken accounting, not a perf number; (3) the
+    ``exposed_comm`` and ``compile`` shares must not grow more than
+    ``tolerance`` ABSOLUTE share points over the baseline's."""
+    gp = doc_goodput(new)
+    if gp is None:
+        if new.get("value") is None:
+            return []  # a failure doc has no window to account
+        return ["new artifact carries a measured value but no goodput "
+                "section — the recording contract broke"]
+    problems = []
+    if not gp.get("closed", False) or gp.get("books_violations"):
+        problems.append(
+            f"goodput books did NOT close: residual {gp.get('residual_s')}s "
+            f"over {gp.get('wall_s')}s wall "
+            f"({gp.get('books_violations', 0)} violating window(s), "
+            f"ledger tolerance {gp.get('tolerance')}) — the accounting "
+            "is broken, not just slow")
+    fr = gp.get("fractions") or {}
+    base_gp = doc_goodput(baseline) if baseline else None
+    if base_gp:
+        base_fr = base_gp.get("fractions") or {}
+        for cat in GOODPUT_GATED_CATEGORIES:
+            n, b = fr.get(cat), base_fr.get(cat)
+            if isinstance(n, (int, float)) and isinstance(b, (int, float)) \
+                    and n > b + tolerance:
+                problems.append(
+                    f"{cat} share REGRESSION: {n:.1%} of wall time vs "
+                    f"baseline {b:.1%} (> {tolerance:.0%} absolute "
+                    "growth)")
+    return problems
+
+
+def goodput_main(argv) -> int:
+    new_path = argv[argv.index("--goodput") + 1]
+    tolerance = float(argv[argv.index("--tolerance") + 1]) \
+        if "--tolerance" in argv else 0.1
+    new = _load_bench_doc(new_path)
+    if not new:
+        print(f"no bench doc in {new_path}")
+        return 1
+    baseline = None
+    base_path = None
+    if "--baseline" in argv:
+        base_path = argv[argv.index("--baseline") + 1]
+        baseline = _load_bench_doc(base_path)
+        if baseline and doc_goodput(baseline) is None:
+            print(f"baseline {base_path} predates the goodput contract; "
+                  "judging the new artifact standalone")
+    else:
+        base_path, baseline = discover_baseline(
+            "BENCH_r*.json", new_path,
+            lambda d: doc_goodput(d) is not None,
+            what="goodput section")
+    problems = check_goodput(new, baseline, tolerance)
+    if problems:
+        for p in problems:
+            print(f"goodput gate FAILED for {new_path}: {p}")
+        return 1
+    gp = doc_goodput(new)
+    if gp is None:
+        print(f"goodput gate: {new_path} is a failure artifact with no "
+              "window to account; nothing to judge")
+        return 0
+    att = new.get("mfu_attribution") or {}
+    note = f" vs {base_path}" if baseline and doc_goodput(baseline) \
+        else " (no baseline: standalone books check only)"
+    mfu = att.get("mfu")
+    print(f"goodput gate OK{note} (tolerance {tolerance:.0%}): "
+          f"productive={gp.get('fraction')} over {gp.get('wall_s')}s / "
+          f"{gp.get('windows')} window(s), "
+          f"dominating_loss={att.get('dominating')}, "
+          f"mfu={'n/a' if mfu is None else mfu}, "
+          f"kernel_inefficiency="
+          f"{'n/a' if att.get('kernel_inefficiency') is None else att['kernel_inefficiency']}")
     return 0
 
 
@@ -660,6 +805,8 @@ if __name__ == "__main__":
         sys.exit(tuned_main(sys.argv))
     if "--scaling" in sys.argv:
         sys.exit(scaling_main(sys.argv))
+    if "--goodput" in sys.argv:
+        sys.exit(goodput_main(sys.argv))
     if "--trajectory" in sys.argv:
         sys.exit(trajectory_main(sys.argv))
     if "--pipeline" in sys.argv:

@@ -194,6 +194,7 @@ class ShardedCheckpointer:
             # fresh peer already wrote here — costs a LOUD commit
             # timeout this round, never a silently mixed checkpoint.
             fmt.remove_tree(tmp)
+        t_inline = time.monotonic()
         try:
             plan = self._snapshot(state)
         except BaseException:
@@ -212,6 +213,15 @@ class ShardedCheckpointer:
             raise
         if wait:
             self.wait()
+        # goodput ledger: only the slice that BLOCKED the caller counts
+        # as checkpoint_stall — the inline device→host cut plus a
+        # waited-for commit; the background shard write is free wall
+        # time (it overlaps training) and stays out of the books
+        try:
+            from horovod_tpu.metrics import goodput
+            goodput.note_checkpoint_stall(time.monotonic() - t_inline)
+        except Exception:
+            pass
 
     def wait(self) -> None:
         """Drain queued saves; re-raises the first background failure."""
@@ -525,6 +535,11 @@ class ShardedCheckpointer:
         record_event("ckpt_restore", step=step, bytes=nbytes[0])
         # a long restore before step 1 must not read as a hang
         notify_progress()
+        try:
+            from horovod_tpu.metrics import goodput
+            goodput.note_checkpoint_stall(time.monotonic() - t0)
+        except Exception:
+            pass
         return out
 
     def _restore_leaf(self, rec: dict, rank_payload, step: int) -> Any:

@@ -319,6 +319,16 @@ def write_autopsy(out_dir: Optional[str] = None, reason: str = "",
         return recent_decisions()
 
     actions = step(_actions) or []
+
+    def _goodput():
+        # the final ledger snapshot (docs/OBSERVABILITY.md "Goodput
+        # ledger"): where the job's wall-clock went before it died,
+        # with the open window flushed so the last partial window's
+        # evidence is in the books too
+        from horovod_tpu.metrics import goodput
+        return goodput.snapshot(flush_open=True)
+
+    goodput_snap = step(_goodput)
     step(lambda: _write_json(
         os.path.join(bundle, f"summary_rank{rank}.json"), {
         "reason": reason,
@@ -328,6 +338,7 @@ def write_autopsy(out_dir: Optional[str] = None, reason: str = "",
         "anomalies": anomalies,
         "actions": actions,
         "profiles": profiles,
+        "goodput": goodput_snap,
         "peers_fetched": fetched,
         "peers_unreachable": unreachable,
     }))

@@ -119,6 +119,15 @@ class Episode:
                     help="completed elastic re-mesh recoveries").inc()
         except Exception:
             pass
+        if complete:
+            # goodput ledger: a completed recovery's total is this
+            # window's remesh_recovery charge (abandoned episodes roll
+            # into the episode that finally completes)
+            try:
+                from horovod_tpu.metrics import goodput
+                goodput.note_remesh(total)
+            except Exception:
+                pass
         _record_flight("remesh_complete" if complete
                        else "remesh_abandoned",
                        trigger=self.trigger, total_s=round(total, 4),

@@ -132,6 +132,26 @@ def render_top(series: Dict[str, float], source: str) -> str:
                     if k.startswith("hvd_remesh_seconds_sum"))
         lines.append(f"re-meshes       : {int(remeshes)} "
                      f"({_fmt_seconds(rsecs)} total recovery)")
+    # goodput ledger (docs/OBSERVABILITY.md "Goodput ledger"): the
+    # fleet-summed per-category seconds as fractions of accounted wall
+    # time, plus the worst rank's productive fraction
+    goodput = _labeled(series, "hvd_goodput_seconds_total")
+    if goodput:
+        total = sum(goodput.values())
+        cats = {k.split('=')[1].strip(chr(34)): v
+                for k, v in goodput.items()}
+        productive = cats.get("compute", 0.0) / total if total else 0.0
+        loss = sorted(((c, v / total) for c, v in cats.items()
+                       if c != "compute" and total and v > 0),
+                      key=lambda cv: -cv[1])
+        detail = ", ".join(f"{c} {f:.1%}" for c, f in loss[:4])
+        line = (f"GOODPUT         : {productive:.1%} productive"
+                + (f"  ({detail})" if detail else ""))
+        worst_rank = series.get("hvd_fleet_goodput_worst_rank")
+        worst = series.get("hvd_fleet_goodput_min")
+        if worst_rank is not None and worst is not None:
+            line += f"  worst rank {int(worst_rank)} @ {worst:.1%}"
+        lines.append(line)
     # serving view (docs/SERVING.md): the windowed SLO signal plus the
     # robustness counters — sheds are EXPLICIT 429s, hedges/retries are
     # requests that survived a slow or dead replica
@@ -313,7 +333,53 @@ def render_serving_table(points) -> str:
     return "\n".join(lines)
 
 
+GOODPUT_CATEGORIES = ("compute", "exposed_comm", "compile",
+                      "remesh_recovery", "checkpoint_stall", "input_wait",
+                      "guard_skipped", "idle_other")
+
+
+def render_goodput_table(points) -> str:
+    """The per-window goodput category table (docs/OBSERVABILITY.md
+    "Goodput ledger"): one row per closed ledger window, category
+    seconds in fixed order plus the window's productive fraction and
+    whether its books closed."""
+    head = (f"{'ts':<19} {'rank':>4} {'steps':>6} {'wall':>9} "
+            + " ".join(f"{c[:10]:>10}" for c in GOODPUT_CATEGORIES)
+            + f" {'frac':>6} {'books':>6}")
+    lines = [head]
+    for p in points:
+        w = p["goodput"]
+        ts = time.strftime("%Y-%m-%d %H:%M:%S",
+                           time.localtime(p.get("ts", 0)))
+        cells = " ".join(f"{_fmt_seconds(w.get(c, 0.0)):>10}"
+                         for c in GOODPUT_CATEGORIES)
+        frac = p.get("goodput_fraction")
+        lines.append(
+            f"{ts:<19} {str(p.get('rank', '-')):>4} "
+            f"{p.get('goodput_steps', '-'):>6} "
+            f"{_fmt_seconds(p.get('goodput_wall_s')):>9} {cells} "
+            f"{frac if frac is None else format(frac, '.1%'):>6} "
+            f"{'ok' if p.get('goodput_closed', True) else 'OPEN!':>6}")
+    lines.append(f"-- {len(points)} goodput window(s)")
+    return "\n".join(lines)
+
+
 def cmd_history(args: argparse.Namespace) -> int:
+    if getattr(args, "goodput", False):
+        points = [p for p in read_series(args.dir, rank=args.rank)
+                  if isinstance(p.get("goodput"), dict)]
+        if args.last:
+            points = points[-args.last:]
+        if not points:
+            print(f"no goodput windows recorded under {args.dir}",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            for p in points:
+                print(json.dumps(p))
+            return 0
+        print(render_goodput_table(points))
+        return 0
     if getattr(args, "serving", False):
         points = [p for p in read_series(args.dir, rank=args.rank)
                   if isinstance(p.get("serving"), dict)]
@@ -363,7 +429,7 @@ def cmd_history(args: argparse.Namespace) -> int:
         return 0
     # step points only: free-form episode points have their own view
     points = [p for p in points if "remesh" not in p
-              and "serving" not in p]
+              and "serving" not in p and "goodput" not in p]
     if args.last:
         points = points[-args.last:]
     if not points:
@@ -419,6 +485,11 @@ def main(argv=None) -> int:
                    help="render the per-window serving latency series "
                         "(qps, p50/p99, shed) instead of the step "
                         "series — one row per closed latency window")
+    h.add_argument("--goodput", action="store_true",
+                   help="render the per-window goodput category table "
+                        "(wall seconds per category, productive "
+                        "fraction, books-closed flag) instead of the "
+                        "step series — one row per closed ledger window")
     h.set_defaults(fn=cmd_history)
     args = p.parse_args(argv)
     try:

@@ -14,7 +14,11 @@ over the points the time-series layer records
   overlap schedule is losing (docs/PERF.md "Overlap & bucketing");
 * ``persistent_straggler`` — the fleet view charges the SAME rank as
   slowest for N consecutive aggregation windows (fed by the fleet
-  aggregator on rank 0, :mod:`horovod_tpu.metrics.fleet`).
+  aggregator on rank 0, :mod:`horovod_tpu.metrics.fleet`);
+* ``goodput_regression`` — the goodput ledger's productive (compute)
+  fraction falls below its rolling baseline (fed once per closed
+  ledger window, :mod:`horovod_tpu.metrics.goodput`); the finding
+  names the dominating loss category.
 
 Every finding lands three ways: a ``hvd_anomaly_total{kind=...}``
 counter on ``/metrics``, an ``anomaly`` flight-recorder event, and the
@@ -83,9 +87,16 @@ class EwmaMad:
         if self.mean is None:
             self.mean = v
             return
+        # Bias-corrected warmup: early on, weight new points as a plain
+        # sample mean (1/n) instead of the steady-state alpha.  A slow
+        # alpha otherwise lags the mean for the whole warmup ramp and
+        # the MAD learns that LAG as if it were noise — a first window
+        # skewed by compile then inflates k*dev past the entire value
+        # range, hiding even an 80% drop from the drift rule.
+        a = max(self.alpha, 1.0 / self.n)
         resid = abs(v - self.mean)
-        self.mean += self.alpha * (v - self.mean)
-        self.mad += self.alpha * (resid - self.mad)
+        self.mean += a * (v - self.mean)
+        self.mad += a * (resid - self.mad)
 
     def deviation(self) -> float:
         m = abs(self.mean or 0.0)
@@ -159,6 +170,13 @@ class AnomalyEngine:
         self._exposed = _DriftDetector(
             "exposed_comm_growth", +1, alpha, k, min_ratio, consecutive,
             warmup)
+        # goodput windows land once per HVD_TPU_GOODPUT_WINDOW steps,
+        # so the same consecutive/warmup knobs span a proportionally
+        # longer wall-clock learning period — deliberately: a goodput
+        # regression is a sustained condition, not a blip
+        self._goodput = _DriftDetector(
+            "goodput_regression", -1, alpha, k, min_ratio, consecutive,
+            warmup)
         self._straggler_windows = max(
             2, _envi("ANOMALY_STRAGGLER_WINDOWS", 3))
         self._straggler_ratio = _envf("ANOMALY_STRAGGLER_RATIO", 1.3)
@@ -187,6 +205,22 @@ class AnomalyEngine:
                 if f:
                     out.append(self._flag(f, step=step))
         return out
+
+    def observe_goodput(self, fraction: float,
+                        dominating: Optional[str] = None) -> List[dict]:
+        """One closed goodput-ledger window: the productive (compute)
+        fraction of wall time (docs/OBSERVABILITY.md "Goodput ledger").
+        A sustained drop below the learned baseline flags a
+        ``goodput_regression`` finding naming the category that now
+        dominates the loss — the anomaly→profile hook captures a device
+        trace of exactly the regressed window shape."""
+        with self._lock:
+            f = self._goodput.observe(max(0.0, min(1.0, float(fraction))))
+            if not f:
+                return []
+            if dominating:
+                f["category"] = dominating
+            return [self._flag(f)]
 
     def observe_fleet(self, per_rank: Dict[Any, dict]) -> List[dict]:
         """One fleet aggregation window: ``per_rank`` maps rank to a
@@ -317,7 +351,8 @@ class AnomalyEngine:
         stays available to the autopsy."""
         alpha = self._step.baseline.alpha
         with self._lock:
-            for det in (self._step, self._thr, self._exposed):
+            for det in (self._step, self._thr, self._exposed,
+                        self._goodput):
                 det.baseline = EwmaMad(alpha)
                 det._streak = 0
                 det._active = False

@@ -200,6 +200,17 @@ class FleetAggregator:
                     self._last_win = win
             if win is not None:
                 entry["win_step_time"] = win
+        try:
+            # goodput ledger (docs/OBSERVABILITY.md "Goodput ledger"):
+            # last closed window's productive fraction + dominating
+            # loss category ride the breakdown entry, so rank 0 can
+            # name the fleet's worst offender without extra traffic
+            from horovod_tpu.metrics import goodput
+            gp = goodput.fleet_summary()
+            if gp is not None:
+                entry["goodput"] = gp
+        except Exception:
+            pass
         return entry
 
     # -- tree plumbing -------------------------------------------------------
@@ -334,6 +345,20 @@ class FleetAggregator:
               "fleet mean windowed step time")
             g("hvd_fleet_straggler_rank", max(win, key=lambda r: win[r]),
               "rank with the slowest windowed mean step time")
+        gp = {int(r): e["goodput"]["fraction"]
+              for r, e in doc["per_rank"].items()
+              if isinstance(e, dict) and isinstance(e.get("goodput"), dict)
+              and isinstance(e["goodput"].get("fraction"), (int, float))}
+        for r in sorted(gp):
+            g("hvd_fleet_rank_goodput_fraction", gp[r],
+              "last goodput window's productive fraction of this rank",
+              labels={"rank": str(r)})
+        if gp:
+            worst = min(gp, key=lambda r: gp[r])
+            g("hvd_fleet_goodput_min", gp[worst],
+              "worst rank's productive goodput fraction")
+            g("hvd_fleet_goodput_worst_rank", worst,
+              "rank with the lowest productive goodput fraction")
         return {"doc": doc, "snapshot": merged}
 
     def render_fleet(self) -> str:

@@ -270,7 +270,7 @@ class ProfileManager:
         if act.get("trigger"):
             record["trigger"] = {k: v for k, v in act["trigger"].items()
                                  if k in ("kind", "function", "rank",
-                                          "step")}
+                                          "step", "category")}
         if act.get("aborted"):
             record["aborted"] = act["aborted"]
         retained = self._rotate(keep=act["path"])

@@ -127,6 +127,11 @@ class StepTimer:
         # this step boundary; cheap no-op otherwise
         from horovod_tpu import profiling
         profiling.on_step_begin(step_no)
+        # goodput ledger (docs/OBSERVABILITY.md "Goodput ledger"): the
+        # step envelope is the ledger's spine — begin/end bracket the
+        # in-step account, the gap between them is the out-of-step one
+        from horovod_tpu.metrics import goodput
+        goodput.note_step_begin()
 
     def end_step(self, units: float = 0.0) -> Optional[float]:
         """Close the step opened by :meth:`start_step`; returns the step
@@ -157,6 +162,8 @@ class StepTimer:
         profiling.on_step_end(step_no)
         from horovod_tpu.elastic import remesh
         remesh.note_step_end(step_no)
+        from horovod_tpu.metrics import goodput
+        goodput.note_step_end(dt)
         if units:
             self.units.inc(units)
             if dt > 0:

@@ -46,7 +46,16 @@ def parse(text):
 
 def run_steps(n):
     telemetry = TelemetryCallback(units_per_step=32, unit="examples")
+    # goodput mode (HVD_TEST_GOODPUT=1, window=2 via env): rank 1 slow
+    # ON PURPOSE — an inter-step stall books as its input_wait, so the
+    # merged view must name rank 1 the worst goodput rank while rank
+    # 0's own blocking allreduce wait stays inside its step envelope
+    import time
+    gp_stall = 0.05 if (os.environ.get("HVD_TEST_GOODPUT")
+                        and hvd.rank() == 1) else 0.0
     for _ in range(n):
+        if gp_stall:
+            time.sleep(gp_stall)
         telemetry.on_step_begin()
         hvd.allreduce(jnp.ones(8), op=hvd.Sum, name="fleet_grad")
         telemetry.on_step_end()
@@ -92,6 +101,24 @@ def assert_fleet_view(base_port, expected_steps, generation_label):
         (generation_label, series["hvd_examples_per_second"], own)
     # histogram merge: bucket counts add across ranks
     assert series["hvd_step_time_seconds_count"] == expected_steps
+    # goodput mode: every rank's ledger closed a window (window=2 via
+    # env) and the merged view carries the per-rank productive fraction
+    # plus the worst-offender pair (docs/OBSERVABILITY.md "Goodput
+    # ledger") — and they AGREE with each other
+    if os.environ.get("HVD_TEST_GOODPUT"):
+        fr = {}
+        for r in range(size):
+            key = f'hvd_fleet_rank_goodput_fraction{{rank="{r}"}}'
+            assert key in series, (generation_label, sorted(series))
+            fr[r] = series[key]
+            assert 0 < fr[r] <= 1, (generation_label, fr)
+        worst = int(series["hvd_fleet_goodput_worst_rank"])
+        assert abs(series["hvd_fleet_goodput_min"]
+                   - min(fr.values())) < 1e-6, (generation_label, series)
+        assert abs(fr[worst] - min(fr.values())) < 1e-6, \
+            (generation_label, fr, worst)
+        # rank 1 stalls between steps: it must be the worst offender
+        assert worst == 1, (generation_label, fr)
 
 
 def main():

@@ -112,6 +112,16 @@ def autopsy():
     assert suspects[0]["tensor"] == "step.hang", suspects
     assert suspects[0]["missing_ranks"] == [1], suspects
 
+    # goodput ledger rides the bundle (docs/OBSERVABILITY.md "Goodput
+    # ledger"): the final snapshot is present (the telemetry loop's 3
+    # healthy steps opened a window; the autopsy flushed it) and its
+    # books CLOSE — categories sum to wall time within tolerance
+    gp = summary["goodput"]
+    assert gp is not None and gp["windows"] >= 1, summary
+    assert gp["closed"] and not gp["books_violations"], gp
+    assert abs(sum(gp["seconds"].values()) - gp["wall_s"]) <= \
+        gp["tolerance"] * gp["wall_s"] + 0.01, gp
+
     stacks = open(os.path.join(bundle, "stacks_rank0.txt")).read()
     assert "Thread" in stacks or "File" in stacks, stacks[:200]
 

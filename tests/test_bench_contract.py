@@ -465,6 +465,124 @@ def test_pipeline_plan_gate(tmp_path):
     assert pipeline_main(["--pipeline", str(path)]) == 1
 
 
+def _gp_section(fractions, closed=True, violations=0, wall=100.0):
+    """A synthetic ledger snapshot shaped like goodput.snapshot()."""
+    secs = {c: round(f * wall, 4) for c, f in fractions.items()}
+    return {"windows": 2, "steps": 100, "wall_s": wall,
+            "seconds": secs, "fractions": fractions,
+            "fraction": fractions.get("compute", 0.0),
+            "residual_s": 0.0, "closed": closed,
+            "books_violations": violations, "tolerance": 0.01}
+
+
+def test_goodput_gate(tmp_path):
+    """ci/check_bench.py --goodput (ISSUE 16): real-valued artifacts
+    must carry a CLOSED ledger, and the exposed_comm/compile shares are
+    gated against the baseline — both directions (pass + synthesized
+    regression)."""
+    sys.path.insert(0, REPO)
+    try:
+        from ci.check_bench import check_goodput, goodput_main
+    finally:
+        sys.path.remove(REPO)
+    good = {"metric": "m", "value": 10.0,
+            "goodput": _gp_section({"compute": 0.8, "exposed_comm": 0.1,
+                                    "compile": 0.05, "idle_other": 0.05}),
+            "mfu_attribution": {"mfu": 0.3, "dominating": "exposed_comm",
+                                "kernel_inefficiency": 0.5}}
+    base = {"metric": "m", "value": 11.0,
+            "goodput": _gp_section({"compute": 0.88, "exposed_comm": 0.05,
+                                    "compile": 0.05, "idle_other": 0.02})}
+    # within band: passes
+    assert check_goodput(good, base, tolerance=0.1) == []
+    # synthesized regression: exposed_comm share triples past the band
+    bad = {"metric": "m", "value": 6.0,
+           "goodput": _gp_section({"compute": 0.6, "exposed_comm": 0.3,
+                                   "compile": 0.05, "idle_other": 0.05})}
+    problems = check_goodput(bad, base, tolerance=0.1)
+    assert len(problems) == 1 and "exposed_comm" in problems[0] \
+        and "REGRESSION" in problems[0], problems
+    # ... a wide-enough band accepts it
+    assert check_goodput(bad, base, tolerance=0.5) == []
+    # real value without the ledger: the recording contract broke
+    problems = check_goodput({"value": 1.0}, base, tolerance=0.1)
+    assert problems and "contract" in problems[0]
+    # a failure doc (value null) has nothing to account
+    assert check_goodput({"value": None, "error": "x"}, base, 0.1) == []
+    # books that did not close fail even with no baseline
+    open_books = {"value": 1.0,
+                  "goodput": _gp_section({"compute": 0.7,
+                                          "idle_other": 0.1},
+                                         closed=False, violations=1)}
+    problems = check_goodput(open_books, None, tolerance=0.1)
+    assert problems and "did NOT close" in problems[0]
+
+    # CLI both ways, incl. the BENCH_r* "parsed" wrapper form
+    new_path = tmp_path / "new.json"
+    new_path.write_text(json.dumps(good))
+    base_path = tmp_path / "BENCH_base.json"
+    base_path.write_text(json.dumps({"n": 1, "parsed": base}))
+    assert goodput_main(["--goodput", str(new_path),
+                         "--baseline", str(base_path)]) == 0
+    bad_path = tmp_path / "bad.json"
+    bad_path.write_text(json.dumps(bad))
+    assert goodput_main(["--goodput", str(bad_path),
+                         "--baseline", str(base_path)]) == 1
+    assert goodput_main(["--goodput", str(bad_path),
+                         "--baseline", str(base_path),
+                         "--tolerance", "0.5"]) == 0
+    # a pre-contract baseline is judged standalone, not crashed on
+    old_path = tmp_path / "old.json"
+    old_path.write_text(json.dumps({"value": 5.0}))
+    assert goodput_main(["--goodput", str(new_path),
+                         "--baseline", str(old_path)]) == 0
+
+
+def test_baseline_discovery_skips_null_artifacts_loudly(tmp_path,
+                                                        monkeypatch,
+                                                        capsys):
+    """Baseline auto-discovery (--goodput / --compile-budget): a
+    null-valued BENCH_r* round is skipped with an explicit message —
+    never silently — and the gate compares against the newest REAL
+    artifact behind it."""
+    sys.path.insert(0, REPO)
+    try:
+        import ci.check_bench as cb
+    finally:
+        sys.path.remove(REPO)
+    monkeypatch.setattr(cb, "REPO", str(tmp_path))
+    # newest round failed (value null); the round before it is real
+    (tmp_path / "BENCH_r9.json").write_text(json.dumps(
+        {"parsed": {"value": None, "error": "relay down", "mfu": None}}))
+    (tmp_path / "BENCH_r8.json").write_text("{not json")
+    real = {"value": 10.0, "compile_seconds": 5.0,
+            "goodput": _gp_section({"compute": 0.9, "idle_other": 0.1})}
+    (tmp_path / "BENCH_r7.json").write_text(json.dumps({"parsed": real}))
+    path, doc = cb.discover_baseline(
+        "BENCH_r*.json", str(tmp_path / "new.json"),
+        lambda d: cb.doc_goodput(d) is not None, what="goodput section")
+    assert path.endswith("BENCH_r7.json") and doc["value"] == 10.0
+    out = capsys.readouterr().out
+    assert "BENCH_r9.json" in out and "null-valued" in out, out
+    assert "BENCH_r8.json" in out, out
+    # nothing real at all -> (None, None), every skip still reported
+    (tmp_path / "BENCH_r7.json").unlink()
+    path, doc = cb.discover_baseline(
+        "BENCH_r*.json", str(tmp_path / "new.json"),
+        lambda d: cb.doc_goodput(d) is not None, what="goodput section")
+    assert path is None and doc is None
+    assert "null-valued" in capsys.readouterr().out
+    # the compile-budget gate's auto-discovery goes through the same
+    # loud helper: its messages surface there too
+    (tmp_path / "BENCH_r7.json").write_text(json.dumps({"parsed": real}))
+    new_path = tmp_path / "candidate.json"
+    new_path.write_text(json.dumps({"value": 9.0, "compile_seconds": 6.0}))
+    assert cb.compile_budget_main(
+        ["--compile-budget", str(new_path)]) == 0
+    out = capsys.readouterr().out
+    assert "null-valued" in out and "BENCH_r7.json" in out, out
+
+
 def test_pipeline_plan_gate_never_raises_on_corrupt_docs():
     """Corrupt artifacts must FAIL the gate with a message, not kill it
     with a traceback (review hardening)."""

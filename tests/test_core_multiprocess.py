@@ -273,6 +273,23 @@ def test_fleet_scrape_survives_remesh():
 
 
 @needs_core
+@pytest.mark.slow  # tier-1 budget rule: multiprocess tests are
+#                    slow-marked; the smoke/parallel CI tiers run it
+#                    unfiltered (ci/matrix.yaml)
+def test_fleet_merged_goodput_two_process():
+    """ISSUE 16 acceptance (fleet leg): with a 2-step ledger window,
+    rank 0's ``/metrics/fleet`` carries every rank's productive goodput
+    fraction plus the worst-offender pair — and rank 1, which stalls
+    between its step envelopes, is the rank the merged view names
+    (assertions in fleet_worker.py, HVD_TEST_GOODPUT gate)."""
+    _launch(2, {"HVD_TPU_METRICS_PORT": str(_free_port_pair()),
+                "HVD_TPU_FLEET_PUSH_SECONDS": "0.5",
+                "HVD_TPU_GOODPUT_WINDOW": "2",
+                "HVD_TEST_GOODPUT": "1"},
+            timeout=480, worker=FLEET_WORKER)
+
+
+@needs_core
 def test_torch_adapter_multiprocess():
     """Torch drop-in at size 2: dense + sparse allreduce and
     DistributedOptimizer equivalence to full-batch single-process SGD
