@@ -161,6 +161,144 @@ def run_bench(replicas: int = 2, clients: int = 4, duration_s: float = 5.0,
     }
 
 
+def run_gen_bench(requests: int = 24, n_slots: int = 4,
+                  prefill_chunk: int = 8, max_prompt: int = 24,
+                  max_new_lo: int = 4, max_new_hi: int = 16,
+                  page_bytes: int = 4096, seed: int = 0) -> dict:
+    """Generate-mode bench: overlapping mixed-length prompt streams
+    through the continuous token-level engine, then the SAME request
+    set through the request-level gang baseline on the SAME warm
+    compiled step functions — the artifact carries both so the
+    ``--serving-gen`` gate can refuse a continuous engine that stopped
+    beating request-granular batching (``speedup``), alongside
+    tokens/s, TTFT/ITL percentiles, slot occupancy, page-pool
+    high-water, and the one-compile guarantee (``decode_compiles``)."""
+    import numpy as np
+
+    from horovod_tpu import tracing
+    from horovod_tpu.profiling import compile_watch
+    from horovod_tpu.serving.generate import (GenerateEngine,
+                                              demo_gen_setup,
+                                              request_level_generate)
+    from horovod_tpu.serving.metrics import percentile
+
+    compile_watch.ensure_installed()
+    compile_watch.reset_counts()
+    params, cfg = demo_gen_setup()
+    # a small page budget on the tiny demo model so the bench actually
+    # exercises multi-page tables, not one page per slot
+    engine = GenerateEngine(params, cfg, n_slots=n_slots,
+                            prefill_chunk=prefill_chunk,
+                            page_bytes=page_bytes)
+    rng = np.random.RandomState(seed)
+    reqset = [
+        (rng.randint(1, cfg.vocab_size,
+                     size=int(rng.randint(1, max_prompt + 1))),
+         int(rng.randint(max_new_lo, max_new_hi + 1)))
+        for _ in range(requests)
+    ]
+
+    # warmup: pay both compiles outside the measured WINDOW but inside
+    # the compile COUNT — decode_compiles must end the whole bench
+    # (warmup + continuous churn + gang baseline) at exactly 1
+    warm = engine.submit("warmup", [1, 2, 3], 2)
+    while warm.state != "done":
+        engine.step_once()
+
+    # continuous run: all streams overlap, token-level batching
+    emit_times: dict = {i: [] for i in range(requests)}
+    reqs = []
+    for i, (prompt, max_new) in enumerate(reqset):
+        def on_token(_tok, _i=i):
+            emit_times[_i].append(time.monotonic())
+        reqs.append(engine.submit(
+            f"gen-{i}", prompt, max_new,
+            trace=tracing.new_trace("serving"), on_token=on_token))
+    steps0, chunks0 = engine.decode_steps_total, engine.prefill_chunks_total
+    occupancy: list = []
+    t0 = time.monotonic()
+    while any(r.state != "done" for r in reqs):
+        engine.step_once()
+        occupancy.append(engine.scheduler.occupied() / n_slots)
+    cont_s = max(time.monotonic() - t0, 1e-9)
+    cont_steps = engine.decode_steps_total - steps0
+    cont_chunks = engine.prefill_chunks_total - chunks0
+    total_tokens = sum(len(r.tokens) for r in reqs)
+    failed = sum(1 for r in reqs if r.finish_reason != "length")
+
+    ttfts = sorted(r.first_token_at - r.submitted_at
+                   for r in reqs if r.first_token_at)
+    itls = sorted(b - a for times in emit_times.values()
+                  for a, b in zip(times, times[1:]))
+
+    # baseline: same requests, gang-scheduled at request granularity
+    # through the same warm engine (early finishers strand their slot),
+    # with the SAME per-request tracing/callback instrumentation so the
+    # comparison charges identical overhead to both sides
+    base_times: dict = {i: [] for i in range(requests)}
+    t0 = time.monotonic()
+    base_reqs = request_level_generate(
+        engine, reqset, traced=True,
+        on_token_factory=lambda i: (
+            lambda _tok: base_times[i].append(time.monotonic())))
+    base_s = max(time.monotonic() - t0, 1e-9)
+    base_steps = engine.decode_steps_total - steps0 - cont_steps
+    base_tokens = sum(len(r.tokens) for r in base_reqs)
+
+    tokens_per_s = total_tokens / cont_s
+    base_tokens_per_s = base_tokens / base_s
+
+    # the slowest stream's causal path: submit→prefill→decode→finish
+    slowest = None
+    sl = max(reqs, key=lambda r: (r.last_token_at or 0) - r.submitted_at)
+    if sl.trace is not None:
+        try:
+            from horovod_tpu.diagnostics.flight_recorder import recorder
+            from horovod_tpu.tracing.reader import spans_from_events
+            spans, _pts = spans_from_events(recorder().events(),
+                                            trace_id=sl.trace.trace_id)
+            slowest = {
+                "trace": sl.trace.trace_id,
+                "latency_s": round(sl.last_token_at - sl.submitted_at, 6),
+                "hops": [{"name": s["name"], "dur_s": s["dur_s"]}
+                         for s in sorted(spans,
+                                         key=lambda s: s["start"])],
+            }
+        except Exception:
+            slowest = {"trace": sl.trace.trace_id, "hops": []}
+
+    pool = engine.pool
+    return {
+        "bench": "serving_generate",
+        "tracing_enabled": bool(tracing.enabled()),
+        "requests": requests,
+        "failed": failed,
+        "n_slots": n_slots,
+        "prefill_chunk": prefill_chunk,
+        "total_tokens": total_tokens,
+        "duration_s": round(cont_s, 3),
+        "tokens_per_s": round(tokens_per_s, 2),
+        "ttft_p50_s": round(percentile(ttfts, 0.50), 6),
+        "ttft_p99_s": round(percentile(ttfts, 0.99), 6),
+        "itl_p50_s": round(percentile(itls, 0.50), 6),
+        "itl_p99_s": round(percentile(itls, 0.99), 6),
+        "slot_occupancy_mean": round(
+            sum(occupancy) / len(occupancy), 4) if occupancy else 0.0,
+        "decode_steps": cont_steps,
+        "prefill_chunks": cont_chunks,
+        "decode_compiles": compile_watch.per_function_compiles().get(
+            "gen_decode_step", 0),
+        "kv_page_tokens": pool.plan.page_tokens,
+        "kv_pages_total": pool.capacity,
+        "kv_pages_high_water": pool.high_water,
+        "baseline_tokens_per_s": round(base_tokens_per_s, 2),
+        "baseline_decode_steps": base_steps,
+        "speedup": round(tokens_per_s / max(base_tokens_per_s, 1e-9), 4),
+        "slowest_request_trace": slowest,
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="serving_bench")
     p.add_argument("--replicas", type=int, default=2)
@@ -169,13 +307,27 @@ def main(argv=None) -> int:
     p.add_argument("--warmup", type=float, default=1.0)
     p.add_argument("--dim", type=int, default=16)
     p.add_argument("--in-process", action="store_true")
+    p.add_argument("--generate", action="store_true",
+                   help="bench the continuous-batching generate engine "
+                        "(emits BENCH_SERVE_GEN)")
+    p.add_argument("--requests", type=int, default=24,
+                   help="generate mode: request count")
+    p.add_argument("--slots", type=int, default=4,
+                   help="generate mode: decode slots")
+    p.add_argument("--prefill-chunk", type=int, default=8)
     p.add_argument("--out", default=None, help="also write the JSON here")
     args = p.parse_args(argv)
-    doc = run_bench(replicas=args.replicas, clients=args.clients,
-                    duration_s=args.duration, dim=args.dim,
-                    in_process=args.in_process, warmup_s=args.warmup)
+    if args.generate:
+        doc = run_gen_bench(requests=args.requests, n_slots=args.slots,
+                            prefill_chunk=args.prefill_chunk)
+        prefix = "BENCH_SERVE_GEN"
+    else:
+        doc = run_bench(replicas=args.replicas, clients=args.clients,
+                        duration_s=args.duration, dim=args.dim,
+                        in_process=args.in_process, warmup_s=args.warmup)
+        prefix = "BENCH_SERVE"
     line = json.dumps(doc)
-    print(f"BENCH_SERVE {line}", flush=True)
+    print(f"{prefix} {line}", flush=True)
     if args.out:
         with open(args.out, "w") as f:
             f.write(line + "\n")

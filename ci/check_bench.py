@@ -59,6 +59,21 @@ baseline's, and — baseline or not — when the artifact is not CLEAN:
 a measurement of the same system), failed requests, or a violated
 zero-drop audit (unanswered / double-answered ids) all fail.
 
+``--serving-gen NEW [--baseline OLD] [--tolerance T]`` is the
+generative-throughput gate (ISSUE 17): NEW/OLD are ``BENCH_SERVE_GEN``
+artifacts from ``benchmarks/serving_bench.py --generate`` (raw JSON or
+captured output).  Baseline or not, the artifact must be CLEAN: zero
+failed requests (a tokens/s number that dropped streams is not a
+measurement), ``decode_compiles == 1`` (slot churn re-triggering XLA
+compilation is the one failure mode the static-slot design exists to
+prevent — a second compile IS the regression), and ``speedup > 1``
+(continuous batching must beat the request-level gang baseline it
+ships next to, measured on the same warm engine with identical
+tracing/callback overhead).  With a baseline, ``tokens_per_s`` must
+not regress more than T (default 0.5 — CPU decode windows are noisy).
+Baselines auto-discover from committed ``BENCH_SERVE_GEN*.json``;
+failure artifacts are skipped LOUDLY, same semantics as ``--goodput``.
+
 ``--goodput NEW [--baseline OLD] [--tolerance T]`` is the goodput
 regression gate (ISSUE 16): the bench doc records ``goodput`` — the
 closed-books wall-clock ledger (docs/OBSERVABILITY.md "Goodput
@@ -709,6 +724,138 @@ def serving_main(argv) -> int:
     return 0
 
 
+def _load_serving_gen_doc(path: str):
+    """A generate-bench artifact: raw JSON, or the last
+    ``BENCH_SERVE_GEN {json}`` line of captured bench output.  The
+    space-suffixed prefix keeps ``BENCH_SERVE `` lines (request-level
+    serving artifacts) from matching."""
+    with open(path) as f:
+        text = f.read()
+    doc = None
+    try:
+        parsed = json.loads(text)
+        if isinstance(parsed, dict) and \
+                parsed.get("bench") == "serving_generate":
+            doc = parsed
+    except ValueError:
+        pass
+    if doc is None:
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("BENCH_SERVE_GEN "):
+                try:
+                    parsed = json.loads(line[len("BENCH_SERVE_GEN "):])
+                except ValueError:
+                    continue
+                if isinstance(parsed, dict):
+                    doc = parsed
+    return doc
+
+
+def check_serving_gen(new: dict, baseline, tolerance: float):
+    """Problems with a generate-bench artifact: list of failure strings.
+
+    Three standalone rules (ISSUE 17) plus a baseline rule: (1) zero
+    failed requests — a tokens/s bought by dropping streams is not a
+    measurement of the same system; (2) ``decode_compiles`` must be
+    EXACTLY 1 — the static-slot engine's whole contract is that slot
+    churn never changes the compiled shape, so a second compile is the
+    regression this gate exists to catch (and 0 means the compile
+    counter broke — also not a pass); (3) ``speedup > 1`` — the
+    continuous engine must beat the request-level gang baseline
+    measured alongside it on the same warm engine; (4) with a
+    baseline, ``tokens_per_s`` must not fall more than ``tolerance``
+    below the baseline's."""
+    problems = []
+    if not new.get("requests"):
+        problems.append("no requests measured (empty window)")
+    if new.get("failed"):
+        problems.append(
+            f"{new['failed']} request(s) FAILED (finish_reason != "
+            "'length') during the measurement window")
+    compiles = new.get("decode_compiles")
+    if compiles != 1:
+        problems.append(
+            f"decode_compiles={compiles}, expected exactly 1: the "
+            "static-slot contract is one compile regardless of churn "
+            "(0 means the compile counter itself broke)")
+    speedup = new.get("speedup")
+    if not isinstance(speedup, (int, float)) or speedup <= 1.0:
+        problems.append(
+            f"speedup={speedup}: continuous batching must beat the "
+            "request-level gang baseline measured on the same engine")
+    if baseline and baseline.get("tokens_per_s") \
+            and new.get("tokens_per_s"):
+        base_tps, new_tps = baseline["tokens_per_s"], new["tokens_per_s"]
+        if new_tps < base_tps * (1.0 - tolerance):
+            problems.append(
+                f"tokens/s REGRESSION: {new_tps:.2f} vs baseline "
+                f"{base_tps:.2f} (> {tolerance:.0%} below)")
+    return problems
+
+
+def serving_gen_main(argv) -> int:
+    new_path = argv[argv.index("--serving-gen") + 1]
+    tolerance = float(argv[argv.index("--tolerance") + 1]) \
+        if "--tolerance" in argv else 0.5
+    new = _load_serving_gen_doc(new_path)
+    if not new:
+        print(f"no generate artifact in {new_path}: run "
+              "benchmarks/serving_bench.py --generate first")
+        return 1
+    baseline = None
+    base_path = None
+    if "--baseline" in argv:
+        base_path = argv[argv.index("--baseline") + 1]
+        baseline = _load_serving_gen_doc(base_path)
+        if not baseline:
+            print(f"baseline {base_path} carries no generate artifact; "
+                  "judging the new run standalone")
+    else:
+        # Gen docs carry no "value" key, so discover_baseline (which
+        # requires one) cannot be reused — mirror its loud-skip
+        # semantics over the gen artifact pattern instead.
+        for path in sorted(
+                glob.glob(os.path.join(REPO, "BENCH_SERVE_GEN*.json")),
+                reverse=True):
+            if os.path.abspath(path) == os.path.abspath(new_path):
+                continue
+            name = os.path.basename(path)
+            try:
+                doc = _load_serving_gen_doc(path)
+            except (OSError, ValueError) as e:
+                print(f"baseline discovery: skipping {name} "
+                      f"(unreadable: {e})")
+                continue
+            if not doc:
+                print(f"baseline discovery: skipping {name} "
+                      "(no parseable generate artifact)")
+                continue
+            if not doc.get("tokens_per_s"):
+                print(f"baseline discovery: skipping {name} "
+                      "(null tokens/s — a failure artifact has no "
+                      "measurement to compare against)")
+                continue
+            base_path, baseline = path, doc
+            break
+    problems = check_serving_gen(new, baseline, tolerance)
+    if problems:
+        for p in problems:
+            print(f"serving-gen gate FAILED for {new_path}: {p}")
+        return 1
+    note = f" vs {base_path}" if baseline else \
+        " (no baseline: standalone checks only)"
+    print(f"serving-gen gate OK{note}: "
+          f"tokens_per_s={new.get('tokens_per_s')} "
+          f"speedup={new.get('speedup')}x "
+          f"ttft_p99={new.get('ttft_p99_s')}s "
+          f"itl_p99={new.get('itl_p99_s')}s "
+          f"occupancy={new.get('slot_occupancy_mean')} "
+          f"compiles={new.get('decode_compiles')} over "
+          f"{new.get('requests')} requests")
+    return 0
+
+
 def main() -> int:
     # budget = bench.py's own hard total wall-clock cap
     # (HVD_BENCH_TOTAL_BUDGET_S, default 1200 s) plus slack: bench must
@@ -811,6 +958,8 @@ if __name__ == "__main__":
         sys.exit(trajectory_main(sys.argv))
     if "--pipeline" in sys.argv:
         sys.exit(pipeline_main(sys.argv))
+    if "--serving-gen" in sys.argv:
+        sys.exit(serving_gen_main(sys.argv))
     if "--serving" in sys.argv:
         sys.exit(serving_main(sys.argv))
     sys.exit(main())

@@ -476,3 +476,209 @@ def shard_batch(tokens, targets, mesh: Mesh):
     spec = data_sharding_spec(mesh)
     sh = NamedSharding(mesh, spec)
     return jax.device_put(tokens, sh), jax.device_put(targets, sh)
+
+
+# ---------------------------------------------------------------------------
+# Generative decode: KV-cache forward over the serving engine's paged pool
+# ---------------------------------------------------------------------------
+# The serving-side decode path (horovod_tpu/serving/generate/) runs the
+# SAME weights the training step produced, but at token granularity: one
+# fixed-shape decode step over a static slot array, with K/V history in
+# block-granular pages.  Everything below is single-device math in fp32
+# (serving replicas are world_size=1; bitwise-stable greedy decode is
+# the parity contract tests/test_generate.py enforces).  Layout:
+#
+#   k_pages / v_pages  [L, total_pages + 1, page_tokens, H*Dh]
+#       (+1 = the scratch page inactive/padded lanes write into, so
+#       membership churn never changes the compiled shape)
+#   page_table         [slots, pages_per_slot] int32 — a slot's j-th
+#       page holds its token positions [j*page_tokens, (j+1)*page_tokens);
+#       gathered back, position p of a slot lands at flat index p.
+
+def kv_cache_spec(cfg: TransformerConfig) -> Tuple[int, int, Any]:
+    """(n_layers, per-token K width, cache dtype) — the model
+    fingerprint the page planner sizes pages from."""
+    return cfg.n_layers, cfg.n_heads * cfg.head_dim, jnp.float32
+
+
+def flatten_decode_params(params: Dict) -> Dict:
+    """Collapse the stacked-stage layout ``[pp, L/pp, ...]`` to
+    ``[L, ...]`` — decode scans all layers on one device; the pipeline
+    split is a training-time concern."""
+    layers = params["layers"]
+    if "w1" not in layers:
+        raise NotImplementedError(
+            "paged decode supports dense-FFN transformers (n_experts=0)")
+    flat = {k: jnp.asarray(v).reshape((-1,) + tuple(np.shape(v)[2:]))
+            for k, v in layers.items()}
+    return {"embed": jnp.asarray(params["embed"]),
+            "ln_f": jnp.asarray(params["ln_f"]),
+            "layers": flat}
+
+
+def _rope_rows(x, pos):
+    """Rotary embedding for per-row positions: x [N, H, D], pos [N] —
+    the decode-time counterpart of :func:`_rope` (one token per row,
+    each at its own absolute position)."""
+    half = x.shape[-1] // 2
+    freqs = 1.0 / (10000 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    ang = pos[:, None].astype(jnp.float32) * freqs[None, :]   # [N, half]
+    cos = jnp.cos(ang)[:, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[:, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+
+
+def _paged_layer(lp, x, q_pos, kv_pages, dest_page, offs, gather_rows,
+                 key_mask, cfg: TransformerConfig):
+    """One transformer block over paged KV: write this call's K/V into
+    the pool, gather the full history back, attend, FFN.
+
+    x [N, M] (N = slots for decode, chunk for prefill); ``dest_page``/
+    ``offs`` [N] address each row's write; ``gather_rows`` indexes the
+    pages to read back ([N, P] per-row for decode, [P] shared for
+    prefill); ``key_mask`` [N, T] marks the attended positions."""
+    kp, vp = kv_pages
+    H, Dh = cfg.n_heads, cfg.head_dim
+    N = x.shape[0]
+    h = _rmsnorm(x, lp["ln1"].astype(jnp.float32))
+    q = _rope_rows((h @ lp["wq"].astype(jnp.float32)).reshape(N, H, Dh),
+                   q_pos)
+    k = _rope_rows((h @ lp["wk"].astype(jnp.float32)).reshape(N, H, Dh),
+                   q_pos)
+    v = (h @ lp["wv"].astype(jnp.float32))
+    kp = kp.at[dest_page, offs].set(k.reshape(N, H * Dh))
+    vp = vp.at[dest_page, offs].set(v)
+    k_all = kp[gather_rows].reshape(gather_rows.shape[:-1] + (-1, H, Dh))
+    v_all = vp[gather_rows].reshape(gather_rows.shape[:-1] + (-1, H, Dh))
+    if k_all.ndim == 3:           # shared gather (prefill): [T, H, Dh]
+        scores = jnp.einsum("nhd,thd->nht", q, k_all)
+    else:                         # per-row gather (decode): [N, T, H, Dh]
+        scores = jnp.einsum("nhd,nthd->nht", q, k_all)
+    scores = scores / np.sqrt(Dh).astype(np.float32)
+    scores = jnp.where(key_mask[:, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if k_all.ndim == 3:
+        o = jnp.einsum("nht,thd->nhd", probs, v_all)
+    else:
+        o = jnp.einsum("nht,nthd->nhd", probs, v_all)
+    x = x + o.reshape(N, H * Dh) @ lp["wo"].astype(jnp.float32)
+    h2 = _rmsnorm(x, lp["ln2"].astype(jnp.float32))
+    f = jax.nn.gelu(h2 @ lp["w1"].astype(jnp.float32))
+    return x + f @ lp["w2"].astype(jnp.float32), (kp, vp)
+
+
+def decode_step_paged(params: Dict, k_pages, v_pages, page_table,
+                      lengths, last_token, active,
+                      cfg: TransformerConfig):
+    """ONE decode step for every slot at once — the function the engine
+    jits exactly once, whatever joins or leaves between calls.
+
+    Shapes (all static): page_table [S, P] int32, lengths/last_token
+    [S] int32, active [S] bool.  Each active slot embeds its last
+    token, appends its K/V at position ``lengths[s]``, attends over its
+    own gathered history, and emits the greedy next token.  Inactive
+    slots compute masked garbage into the scratch page — their lanes
+    exist only to keep the shape constant.  Returns
+    ``(next_token [S] int32, k_pages, v_pages)``."""
+    S = last_token.shape[0]
+    pt = k_pages.shape[2]
+    scratch = k_pages.shape[1] - 1
+    emb = params["embed"].astype(jnp.float32)
+    x = emb[last_token]                                    # [S, M]
+    page_idx = jnp.clip(lengths // pt, 0, page_table.shape[1] - 1)
+    dest = jnp.take_along_axis(page_table, page_idx[:, None], axis=1)[:, 0]
+    dest = jnp.where(active, dest, scratch)
+    offs = lengths % pt
+    T = page_table.shape[1] * pt
+    key_mask = jnp.arange(T)[None, :] <= lengths[:, None]  # incl. new token
+
+    def body(x, layer):
+        lp, kp, vp = layer
+        x, pages = _paged_layer(lp, x, lengths, (kp, vp), dest, offs,
+                                page_table, key_mask, cfg)
+        return x, pages
+
+    x, (k_pages, v_pages) = lax.scan(
+        body, x, (params["layers"], k_pages, v_pages))
+    x = _rmsnorm(x, params["ln_f"].astype(jnp.float32))
+    logits = x @ emb.T                                     # [S, V]
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pages, v_pages
+
+
+def prefill_chunk_paged(params: Dict, k_pages, v_pages, page_row,
+                        tokens, pos0, valid, cfg: TransformerConfig):
+    """Prefill ONE ``chunk``-token slice of ONE slot's prompt (fixed
+    chunk shape — the last chunk arrives padded with ``valid`` marking
+    the real tokens).  Writes the chunk's K/V into the slot's pages and
+    returns the greedy next token after the last VALID position — the
+    first generated token once the final chunk lands.  Returns
+    ``(next_token scalar int32, k_pages, v_pages)``."""
+    C = tokens.shape[0]
+    pt = k_pages.shape[2]
+    scratch = k_pages.shape[1] - 1
+    emb = params["embed"].astype(jnp.float32)
+    x = emb[tokens]                                        # [C, M]
+    pos = pos0 + jnp.arange(C, dtype=jnp.int32)
+    live = jnp.arange(C) < valid
+    dest = jnp.where(live,
+                     page_row[jnp.clip(pos // pt, 0,
+                                       page_row.shape[0] - 1)],
+                     scratch)
+    offs = pos % pt
+    T = page_row.shape[0] * pt
+    # causal within the chunk AND over every earlier chunk's positions
+    key_mask = jnp.arange(T)[None, :] <= pos[:, None]
+
+    def body(x, layer):
+        lp, kp, vp = layer
+        x, pages = _paged_layer(lp, x, pos, (kp, vp), dest, offs,
+                                page_row, key_mask, cfg)
+        return x, pages
+
+    x, (k_pages, v_pages) = lax.scan(
+        body, x, (params["layers"], k_pages, v_pages))
+    x = _rmsnorm(x, params["ln_f"].astype(jnp.float32))
+    x_last = x[jnp.clip(valid - 1, 0, C - 1)]
+    logits = x_last @ emb.T                                # [V]
+    return jnp.argmax(logits).astype(jnp.int32), k_pages, v_pages
+
+
+def reference_greedy_decode(params: Dict, cfg: TransformerConfig,
+                            prompt, max_new: int) -> list:
+    """Sequential non-paged oracle: recompute full-history attention
+    for every emitted token (no cache, no paging, no batching).  Slow
+    on purpose — this is the ground truth the paged continuous engine
+    must match token-for-token (tests/test_generate.py)."""
+    flat = flatten_decode_params(params)
+    H, Dh, L = cfg.n_heads, cfg.head_dim, cfg.n_layers
+    toks = [int(t) for t in np.asarray(prompt).reshape(-1)]
+    out = []
+    for _ in range(int(max_new)):
+        ids = jnp.asarray(toks, dtype=jnp.int32)
+        Tn = ids.shape[0]
+        emb = flat["embed"].astype(jnp.float32)
+        x = emb[ids]
+        pos = jnp.arange(Tn, dtype=jnp.int32)
+        for li in range(L):
+            lp = {k: v[li] for k, v in flat["layers"].items()}
+            h = _rmsnorm(x, lp["ln1"].astype(jnp.float32))
+            q = _rope_rows((h @ lp["wq"].astype(jnp.float32))
+                           .reshape(Tn, H, Dh), pos)
+            k = _rope_rows((h @ lp["wk"].astype(jnp.float32))
+                           .reshape(Tn, H, Dh), pos)
+            v = (h @ lp["wv"].astype(jnp.float32)).reshape(Tn, H, Dh)
+            scores = jnp.einsum("qhd,khd->hqk", q, k) / np.sqrt(Dh)
+            mask = pos[None, :] <= pos[:, None]
+            scores = jnp.where(mask[None, :, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum("hqk,khd->qhd", probs, v).reshape(Tn, H * Dh)
+            x = x + o @ lp["wo"].astype(jnp.float32)
+            h2 = _rmsnorm(x, lp["ln2"].astype(jnp.float32))
+            f = jax.nn.gelu(h2 @ lp["w1"].astype(jnp.float32))
+            x = x + f @ lp["w2"].astype(jnp.float32)
+        x = _rmsnorm(x, flat["ln_f"].astype(jnp.float32))
+        nxt = int(jnp.argmax(x[-1] @ emb.T))
+        out.append(nxt)
+        toks.append(nxt)
+    return out

@@ -12,6 +12,13 @@ backpressure, drain semantics reusing the preemption-notice plumbing,
 and per-request p50/p99 SLO gauges with an autopilot ``slo_breach`` →
 scale-out policy.
 
+Generative traffic decodes at TOKEN granularity through the
+continuous-batching engine (:mod:`horovod_tpu.serving.generate`): one
+jit'd fixed-shape decode step over a static slot array, paged KV-cache
+pool, prefill/decode split — replicas gain a ``generate`` mode and the
+router a hedging-free :meth:`Router.submit_generate` path
+(docs/SERVING.md "Continuous batching & KV paging").
+
 Reference analog: the reference's elastic driver plus its Spark/Ray
 integrations ship the serve-from-the-training-fleet story
 (PAPER.md L6/L7); here it ships as a robustness guarantee — under
@@ -26,6 +33,11 @@ from horovod_tpu.serving.fleet import ReplicaFleet
 from horovod_tpu.serving.metrics import LatencyWindow
 from horovod_tpu.serving.replica import (ReplicaServer, demo_apply,
                                          demo_params)
+from horovod_tpu.serving.generate import (GenerateEngine, GenRequest,
+                                          KVPagePlan, PagePool,
+                                          SlotScheduler, demo_gen_setup,
+                                          plan_kv_pages,
+                                          request_level_generate)
 from horovod_tpu.serving.router import (RequestFailed, RequestLog,
                                         RequestRejected, Router,
                                         ready_endpoints)
@@ -35,4 +47,7 @@ __all__ = [
     "DeadlineError", "ReplicaServer", "demo_apply", "demo_params",
     "Router", "RequestLog", "RequestFailed", "RequestRejected",
     "ready_endpoints", "ReplicaFleet", "LatencyWindow",
+    "GenerateEngine", "GenRequest", "KVPagePlan", "PagePool",
+    "SlotScheduler", "demo_gen_setup", "plan_kv_pages",
+    "request_level_generate",
 ]

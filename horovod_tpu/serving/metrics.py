@@ -145,6 +145,93 @@ def inc_respawn() -> None:
                         "fleet back to target size").inc()
 
 
+# ---------------------------------------------------------------------------
+# Generative decode engine (horovod_tpu/serving/generate/)
+# ---------------------------------------------------------------------------
+#: TTFT/ITL buckets: inter-token latency bottoms out well under the
+#: request-latency buckets' floor on a warm decode step
+GEN_LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                       0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+
+def observe_prefill(seconds: float) -> None:
+    _reg().counter("hvd_serving_prefill_seconds_total",
+                   help="wall seconds spent in prefill chunks (prompt "
+                        "ingestion) by the generate engine").inc(
+        max(0.0, float(seconds)))
+    _reg().counter("hvd_serving_prefill_chunks_total",
+                   help="fixed-size prefill chunks executed").inc()
+
+
+def count_gen_tokens(n: int) -> None:
+    """Every emitted token lands here exactly once — decode steps in
+    batches, plus the single token the LAST prefill chunk emits (it is
+    a real emission; leaving it out under-counts by one per request)."""
+    if n > 0:
+        _reg().counter("hvd_serving_gen_tokens_total",
+                       help="tokens emitted by the generate engine "
+                            "across all sequences").inc(float(n))
+
+
+def observe_decode(seconds: float, batch_tokens: int) -> None:
+    _reg().counter("hvd_serving_decode_seconds_total",
+                   help="wall seconds spent in batched decode steps by "
+                        "the generate engine").inc(
+        max(0.0, float(seconds)))
+    _reg().counter("hvd_serving_decode_steps_total",
+                   help="batched decode steps executed (one jit call "
+                        "over the full slot array)").inc()
+    count_gen_tokens(batch_tokens)
+
+
+def set_slot_occupancy(occupied: int, total: int) -> None:
+    _reg().gauge("hvd_serving_slot_occupancy",
+                 help="fraction of decode slots holding a live "
+                      "sequence (occupied / total)").set(
+        occupied / total if total else 0.0)
+
+
+def set_gen_waiting(n: int) -> None:
+    _reg().gauge("hvd_serving_gen_waiting",
+                 help="generate requests admitted past the queue but "
+                      "still waiting for a slot + pages").set(float(n))
+
+
+def set_kv_pool(in_use: int, total: int, page_bytes: int) -> None:
+    _reg().gauge("hvd_serving_kv_pages_in_use",
+                 help="KV-cache pages currently owned by live "
+                      "sequences").set(float(in_use))
+    _reg().gauge("hvd_serving_kv_pages_total",
+                 help="KV-cache page pool capacity under the active "
+                      "plan").set(float(total))
+    _reg().gauge("hvd_serving_kv_page_bytes",
+                 help="bytes one KV page holds (K+V, all layers) under "
+                      "the active plan").set(float(page_bytes))
+
+
+def observe_ttft(seconds: float) -> None:
+    _reg().histogram("hvd_serving_ttft_seconds",
+                     help="time to first token: submit to first "
+                          "emitted token",
+                     buckets=GEN_LATENCY_BUCKETS).observe(float(seconds))
+
+
+def observe_itl(seconds: float) -> None:
+    _reg().histogram("hvd_serving_itl_seconds",
+                     help="inter-token latency between consecutive "
+                          "emissions of one sequence",
+                     buckets=GEN_LATENCY_BUCKETS).observe(float(seconds))
+
+
+def inc_gen_finished(reason: str) -> None:
+    """``reason`` ∈ {``length`` (hit max_new), ``deadline``,
+    ``error``, ``drain``}."""
+    _reg().counter("hvd_serving_gen_finished_total",
+                   help="generate sequences finished, per reason "
+                        "(length=hit max_new, deadline, error, drain)",
+                   labels={"reason": reason}).inc()
+
+
 def percentile(sorted_vals: List[float], q: float) -> float:
     """Nearest-rank percentile over an ASCENDING-sorted list — THE one
     implementation (the bench artifact's p99 and the SLO plane's p99

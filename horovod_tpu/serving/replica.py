@@ -102,9 +102,22 @@ class ReplicaServer:
                  params: Any = None, store_dir: Optional[str] = None,
                  dim: int = 16, port: int = 0, replica_id: str = "r0",
                  batcher: Optional[DynamicBatcher] = None,
-                 swap_poll_s: Optional[float] = None) -> None:
+                 swap_poll_s: Optional[float] = None,
+                 mode: str = "infer", gen_model: Any = None) -> None:
         self.replica_id = replica_id
         self.dim = dim
+        # generate mode: a continuous-batching decode engine rides
+        # alongside the request-level path (POST /generate; the /infer
+        # plumbing stays untouched).  ``gen_model`` is a (params, cfg)
+        # pair; None = the deterministic demo transformer.
+        self.mode = mode
+        self.engine = None
+        if mode == "generate":
+            from horovod_tpu.serving.generate import (GenerateEngine,
+                                                      demo_gen_setup)
+            g_params, g_cfg = gen_model if gen_model is not None \
+                else demo_gen_setup()
+            self.engine = GenerateEngine(g_params, g_cfg)
         self._apply_fn = apply_fn or demo_apply
         self._store_dir = store_dir
         self._swap_poll_s = swap_poll_s if swap_poll_s is not None \
@@ -163,12 +176,16 @@ class ReplicaServer:
                              name="hvd-serving-http", daemon=True)
         t.start()
         self._threads.append(t)
+        if self.engine is not None:
+            self.engine.start()
         _flight("serving_replica_start", replica=self.replica_id,
                 port=self.port, version=self._version)
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        if self.engine is not None:
+            self.engine.stop()
         try:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -274,10 +291,17 @@ class ReplicaServer:
         get_logger().warning("serving: replica %s draining (%s)",
                              self.replica_id, source)
         self.batcher.drain()
+        if self.engine is not None:
+            self.engine.drain()
 
         def _finish():
-            ok = self.batcher.wait_drained(
-                timeout_s=env_float("SERVING_DRAIN_TIMEOUT_S", 30.0))
+            timeout_s = env_float("SERVING_DRAIN_TIMEOUT_S", 30.0)
+            ok = self.batcher.wait_drained(timeout_s=timeout_s)
+            if self.engine is not None:
+                # drained = every admitted SEQUENCE answered, not just
+                # the admission queue emptied (the engine hands off to
+                # the slot scheduler long before tokens finish)
+                ok = self.engine.wait_drained(timeout_s=timeout_s) and ok
             _flight("serving_drained", replica=self.replica_id,
                     source=source, clean=ok)
             self._drained_event.set()
@@ -441,6 +465,104 @@ class ReplicaServer:
                 with self._pending_lock:
                     self._pending.pop(req_id, None)
 
+    def handle_generate(self, doc: dict, trace=None) -> tuple:
+        """(HTTP code, response doc) for ``POST /generate``: admit the
+        prompt into the continuous-batching engine and block until the
+        sequence finishes (tokens ride back in one response; streaming
+        consumers use the engine API directly).
+
+        Idempotency is the hedge-dedupe contract for MULTI-TOKEN
+        responses: a duplicate of an id that is still decoding joins
+        the live request BEFORE any second decode could start (the
+        ``_pending`` table is checked under the same lock the fresh
+        submit fills it), and a duplicate of a finished id replays the
+        cached response — one id never decodes twice on this replica.
+        Cross-replica duplication is closed on the router side: it
+        never hedges /generate dispatches."""
+        from horovod_tpu import chaos
+        from horovod_tpu import tracing
+        if self.engine is None:
+            return 404, {"error": "this replica does not serve "
+                                  "generate (mode=infer)"}
+        req_id = str(doc.get("id") or f"anon-{time.monotonic_ns()}")
+        serve_ctx = tracing.child(trace, "serving")
+        t_handle = time.monotonic()
+        wall_handle = time.time()
+        try:
+            applied = chaos.fire("serving.request")
+        except Exception as e:
+            return 500, {"id": req_id, "error": f"chaos: {e!r}"}
+        if "shed" in {kind for _seam, kind in applied}:
+            smetrics.inc_shed("chaos")
+            return 429, {"id": req_id, "error": "chaos: injected shed"}
+        cached = self._cached_response(req_id)
+        if cached is not None:
+            tracing.record_span(
+                "serving", "serve", serve_ctx, start=wall_handle,
+                dur_s=time.monotonic() - t_handle,
+                replica=self.replica_id, mode="generate", cached=True)
+            return 200, cached
+        try:
+            prompt = np.asarray(doc.get("prompt"),
+                                dtype=np.int32).reshape(-1)
+        except (TypeError, ValueError):
+            return 400, {"id": req_id, "error": "bad 'prompt' payload"}
+        try:
+            max_new = int(doc.get("max_new") or 16)
+        except (TypeError, ValueError):
+            return 400, {"id": req_id, "error": "bad 'max_new'"}
+        deadline_ms = doc.get("deadline_ms")
+        deadline_s = float(deadline_ms) / 1000.0 \
+            if deadline_ms is not None else None
+        with self._pending_lock:
+            pending = self._pending.get(req_id)
+            fresh = pending is None
+            if fresh:
+                try:
+                    req = self.engine.submit(req_id, prompt, max_new,
+                                             deadline_s=deadline_s,
+                                             trace=serve_ctx)
+                except DrainingError:
+                    smetrics.inc_shed("draining")
+                    return 503, {"id": req_id, "error": "draining"}
+                except SheddedError as e:
+                    return 429, {"id": req_id, "error": str(e)}
+                except ValueError as e:
+                    # definitive client error (too long, bad max_new):
+                    # the router must NOT retry it fleet-wide
+                    return 400, {"id": req_id, "error": str(e)}
+                pending = req.pending
+                self._pending[req_id] = pending
+        try:
+            wait_s = (pending.deadline - time.monotonic()) + 1.0
+            result = pending.wait(timeout=max(wait_s, 0.1))
+            resp = {"id": req_id, **result,
+                    "version": self._version,
+                    "replica": self.replica_id}
+            if serve_ctx is not None:
+                resp["trace"] = serve_ctx.trace_id
+                resp["span"] = serve_ctx.span_id
+            tracing.record_span(
+                "serving", "serve", serve_ctx, start=wall_handle,
+                dur_s=time.monotonic() - t_handle,
+                replica=self.replica_id, mode="generate",
+                tokens_emitted=result.get("tokens_emitted"),
+                finish_reason=result.get("finish_reason"))
+            if fresh:
+                # cache BEFORE the finally pops the in-flight entry
+                # (same window as handle_infer: a duplicate arriving in
+                # between must hit one of the two, never re-decode)
+                self._cache_response(req_id, resp)
+            return 200, resp
+        except DeadlineError as e:
+            return 504, {"id": req_id, "error": str(e)}
+        except Exception as e:
+            return 500, {"id": req_id, "error": repr(e)}
+        finally:
+            if fresh:
+                with self._pending_lock:
+                    self._pending.pop(req_id, None)
+
     def _cached_response(self, req_id: str) -> Optional[dict]:
         with self._pending_lock:
             resp = self._resp_cache.get(req_id)
@@ -554,7 +676,7 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         replica: ReplicaServer = self.server.replica
         path = self.path.split("?", 1)[0].rstrip("/")
-        if path == "/infer":
+        if path in ("/infer", "/generate"):
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 doc = json.loads(self.rfile.read(length))
@@ -563,7 +685,9 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
                 return
             from horovod_tpu import tracing
             trace = tracing.decode(self.headers.get(tracing.TRACEPARENT))
-            code, resp = replica.handle_infer(doc, trace=trace)
+            handler = replica.handle_generate if path == "/generate" \
+                else replica.handle_infer
+            code, resp = handler(doc, trace=trace)
             self._send(code, resp)
         elif path == "/drain":
             replica.drain(source="admin")
@@ -584,6 +708,10 @@ def main(argv=None) -> int:
     p.add_argument("--store-dir", default=None)
     p.add_argument("--dim", type=int, default=16)
     p.add_argument("--replica-id", default="r0")
+    p.add_argument("--mode", choices=("infer", "generate"),
+                   default="infer",
+                   help="generate adds the continuous-batching decode "
+                        "engine (POST /generate, demo transformer)")
     args = p.parse_args(argv)
 
     # the chaos plan (preemption notices, serving.request faults) arms
@@ -601,7 +729,8 @@ def main(argv=None) -> int:
 
     replica = ReplicaServer(store_dir=args.store_dir, dim=args.dim,
                             port=args.port,
-                            replica_id=args.replica_id).start()
+                            replica_id=args.replica_id,
+                            mode=args.mode).start()
 
     import signal
 

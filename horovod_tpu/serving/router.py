@@ -242,8 +242,8 @@ class Router:
 
     # -- dispatch plumbing --------------------------------------------------
     def _post(self, ep: Endpoint, body: bytes, timeout: float,
-              ctx=None) -> Tuple[int, dict]:
-        url = f"http://{ep[0]}:{ep[1]}/infer"
+              ctx=None, path: str = "/infer") -> Tuple[int, dict]:
+        url = f"http://{ep[0]}:{ep[1]}{path}"
         headers = {"Content-Type": "application/json"}
         if ctx is not None:
             # the attempt's OWN span travels as the traceparent header;
@@ -262,14 +262,16 @@ class Router:
             return e.code, doc
 
     def _fire(self, ep: Endpoint, body: bytes, deadline: float,
-              results: "queue.Queue", ctx=None) -> None:
+              results: "queue.Queue", ctx=None,
+              path: str = "/infer") -> None:
         def run():
             timeout = min(self.attempt_timeout_s,
                           max(deadline - time.monotonic(), 0.05))
             t0 = time.monotonic()
             wall0 = time.time()
             try:
-                code, doc = self._post(ep, body, timeout, ctx=ctx)
+                code, doc = self._post(ep, body, timeout, ctx=ctx,
+                                       path=path)
                 results.put((ep, code, doc, None))
                 err = None
             except Exception as e:
@@ -299,7 +301,35 @@ class Router:
         context (a front end decodes the client's ``traceparent``
         header into it); the request's root span is its child, or a
         fresh trace when the client sent none."""
+        payload = {"x": x if isinstance(x, list)
+                   else list(map(float, x))}
+        return self._submit(payload, "/infer", True, x_req_id=req_id,
+                            deadline_s=deadline_s, trace=trace)
+
+    def submit_generate(self, prompt, max_new: int = 16,
+                        req_id: Optional[str] = None,
+                        deadline_s: Optional[float] = None,
+                        trace=None) -> dict:
+        """Blocking GENERATE request: same admission/accounting
+        contract as :meth:`submit`, dispatched to ``/generate`` with
+        hedging DISABLED — a hedge would land the same idempotency key
+        on a SECOND replica whose in-flight table has never seen it,
+        and two replicas would decode the same stream.  Within one
+        replica, duplicates (retries after a timeout) still dedupe on
+        the key before decode starts; the terminal ``ok`` log line
+        records ``tokens_emitted`` so the exactly-once audit covers the
+        multi-token response."""
+        payload = {"prompt": [int(t) for t in prompt],
+                   "max_new": int(max_new)}
+        return self._submit(payload, "/generate", False,
+                            x_req_id=req_id, deadline_s=deadline_s,
+                            trace=trace)
+
+    def _submit(self, payload: dict, path: str, allow_hedge: bool,
+                x_req_id: Optional[str], deadline_s: Optional[float],
+                trace) -> dict:
         seq = next(self._seq)
+        req_id = x_req_id
         if req_id is None:
             req_id = f"req-{seq}-{time.monotonic_ns()}"
         root = tracing.child(trace, "serving") if trace is not None \
@@ -319,7 +349,8 @@ class Router:
         t0 = time.monotonic()
         wall0 = time.time()
         try:
-            doc = self._dispatch(req_id, x, deadline_s, root)
+            doc = self._dispatch(req_id, payload, deadline_s, root,
+                                 path=path, allow_hedge=allow_hedge)
             latency = time.monotonic() - t0
             tracing.record_span("serving", "request", root, start=wall0,
                                 dur_s=latency,
@@ -333,10 +364,15 @@ class Router:
                 # reaching into replica registries
                 smetrics.set_weight_version(int(doc["version"]))
             self.window.observe(latency)
+            extra = {}
+            if doc.get("tokens_emitted") is not None:
+                # multi-token responses: the audit line carries how
+                # many tokens this exactly-one success delivered
+                extra["tokens_emitted"] = int(doc["tokens_emitted"])
             self.log.note(req_id, "ok", seq=seq,
                           latency_s=round(latency, 6),
                           replica=doc.get("replica"),
-                          version=doc.get("version"),
+                          version=doc.get("version"), **extra,
                           **tracing.fields(root))
             return doc
         except RequestRejected as e:
@@ -361,13 +397,14 @@ class Router:
                 self._inflight_n -= 1
                 smetrics.set_inflight(self._inflight_n)
 
-    def _dispatch(self, req_id: str, x, deadline_s, root=None) -> dict:
+    def _dispatch(self, req_id: str, payload: dict, deadline_s,
+                  root=None, path: str = "/infer",
+                  allow_hedge: bool = True) -> dict:
         deadline = time.monotonic() + (
             deadline_s if deadline_s is not None
             else self.default_deadline_s)
         body = json.dumps({
-            "id": req_id,
-            "x": x if isinstance(x, list) else list(map(float, x)),
+            "id": req_id, **payload,
             "deadline_ms": max((deadline - time.monotonic()) * 1000.0,
                                1.0),
         }).encode()
@@ -399,7 +436,7 @@ class Router:
             # one request fanning out across replicas
             ctx = tracing.child(root, "serving")
             spans.append(ctx)
-            self._fire(ep, body, deadline, results, ctx=ctx)
+            self._fire(ep, body, deadline, results, ctx=ctx, path=path)
             return True
 
         launch()
@@ -407,8 +444,11 @@ class Router:
         last_error: Optional[str] = None
         while time.monotonic() < deadline:
             # wait for an answer; hedge once if the fleet has a spare
-            # replica and the primary has gone silent past hedge_s
-            can_hedge = (self.hedge_s > 0 and not hedged and len(eps) > 1
+            # replica and the primary has gone silent past hedge_s —
+            # never for /generate (allow_hedge=False): a hedged decode
+            # stream on a second replica cannot dedupe on the key
+            can_hedge = (allow_hedge and self.hedge_s > 0 and not hedged
+                         and len(eps) > 1
                          and attempts < self.max_attempts)
             timeout = min(self.hedge_s if can_hedge else 0.25,
                           max(deadline - time.monotonic(), 0.01))
