@@ -1,0 +1,151 @@
+"""Rollout transition bench: promote + rollback latency under load.
+
+Emits one ``BENCH_ROLLOUT``-prefixed JSON line (and optionally a file)
+— the standing artifact ``ci/check_bench.py --rollout`` gates: how
+long a governed fleet transition takes in each direction, measured as
+hook-invocation → every live replica observed serving the target
+version, plus the zero-drop audit over the WHOLE run (both
+transitions ride under sustained closed-loop traffic; a transition
+that dropped a request is not 'governed', and the gate refuses the
+artifact).
+
+The bench drives the :class:`RolloutController`'s promote/rollback
+hooks DIRECTLY (no autopilot in the loop): the standing number
+measures the mechanical repin/flip latency, not comparator window
+arithmetic — windows are knob-dependent, the flip is the system.
+
+Run:  python benchmarks/rollout_bench.py --out BENCH_ROLLOUT.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _wait_versions(fleet, version: int, timeout_s: float = 30.0) -> bool:
+    """Every live slot observed serving ``version`` (readyz probes)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        vs = fleet.versions()
+        if vs and all(v == version for v in vs.values()):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def run_rollout_bench(replicas: int = 3, clients: int = 4,
+                      dim: int = 8) -> dict:
+    from horovod_tpu.checkpoint import ShardedCheckpointer
+    from horovod_tpu.serving import ReplicaFleet, Router
+    from horovod_tpu.serving.replica import demo_params
+    from horovod_tpu.serving.rollout import (RolloutConfig,
+                                             RolloutController)
+
+    tmp = tempfile.mkdtemp(prefix="hvd_rollout_bench_")
+    store = ShardedCheckpointer(tmp, rank=0, world_size=1)
+    store.save(1, {"params": demo_params(dim, scale=1.0)}, wait=True)
+    fleet = ReplicaFleet(
+        size=replicas, dim=dim, store_dir=tmp,
+        extra_env={"HVD_TPU_SERVING_SWAP_POLL_S": "0.05"}).start(
+        ready_timeout_s=120)
+    router = Router(fleet.endpoints, hedge_ms=200, max_attempts=8)
+    cfg = RolloutConfig(canary_pct=34, window_s=0.5, min_requests=5)
+    ctl = RolloutController(fleet, router, cfg, store_dir=tmp)
+
+    stop = threading.Event()
+    errors = []
+
+    def client(i):
+        n = 0
+        while not stop.is_set():
+            n += 1
+            try:
+                router.submit([float(i)] + [1.0] * (dim - 1),
+                              req_id=f"b{i}-{n}")
+            except Exception as e:  # noqa: BLE001 - audit catches all
+                errors.append(repr(e))
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    promote_s = rollback_s = None
+    try:
+        time.sleep(1.0)  # warm traffic on the incumbent
+        # the candidate commit lands; begin() pins the fleet right
+        # after (the brief chase window before the pins land is the
+        # production race too — zero-drop must hold through it)
+        store.save(2, {"params": demo_params(dim, scale=2.0)},
+                   wait=True)
+        # transition 1: canary v2, then ROLL BACK to v1
+        ctl.begin(candidate=2, incumbent=1)
+        time.sleep(0.5)  # split traffic actually flows
+        t0 = time.monotonic()
+        ctl._on_rollback({"rollout_id": ctl.rollout_id,
+                          "reason": "bench"})
+        if _wait_versions(fleet, 1):
+            rollback_s = round(time.monotonic() - t0, 4)
+        # transition 2: canary v2 again, PROMOTE fleet-wide
+        ctl.begin(candidate=2, incumbent=1)
+        time.sleep(0.5)
+        t0 = time.monotonic()
+        ctl._on_promote({"rollout_id": ctl.rollout_id})   # -> 50%
+        ctl._on_promote({"rollout_id": ctl.rollout_id})   # -> fleet
+        if _wait_versions(fleet, 2):
+            promote_s = round(time.monotonic() - t0, 4)
+        time.sleep(0.5)  # post-transition traffic on the new version
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        router.close()
+    acct = router.accounting()
+    fleet.stop()
+    store.close()
+    return {
+        "bench": "rollout",
+        "replicas": replicas,
+        "clients": clients,
+        "requests": acct["accepted"],
+        "failed": acct["outcomes"].get("failed", 0)
+        + len(errors),
+        "unanswered": len(acct["unanswered"]),
+        "answered_twice": len(acct["answered_twice"]),
+        "by_version": acct["by_version"],
+        "promote_s": promote_s,
+        "rollback_s": rollback_s,
+        "final_state": ctl.state,
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="rollout_bench")
+    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--clients", type=int, default=4)
+    p.add_argument("--dim", type=int, default=8)
+    p.add_argument("--out", default=None, help="also write the JSON here")
+    args = p.parse_args(argv)
+    doc = run_rollout_bench(replicas=args.replicas,
+                            clients=args.clients, dim=args.dim)
+    line = json.dumps(doc)
+    print(f"BENCH_ROLLOUT {line}", flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -856,6 +856,122 @@ def serving_gen_main(argv) -> int:
     return 0
 
 
+def _load_rollout_doc(path: str):
+    """A rollout-bench artifact: raw JSON, or the last
+    ``BENCH_ROLLOUT {json}`` line of captured bench output."""
+    with open(path) as f:
+        text = f.read()
+    doc = None
+    try:
+        parsed = json.loads(text)
+        if isinstance(parsed, dict) and parsed.get("bench") == "rollout":
+            doc = parsed
+    except ValueError:
+        pass
+    if doc is None:
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("BENCH_ROLLOUT "):
+                try:
+                    parsed = json.loads(line[len("BENCH_ROLLOUT "):])
+                except ValueError:
+                    continue
+                if isinstance(parsed, dict):
+                    doc = parsed
+    return doc
+
+
+def check_rollout(new: dict, baseline, tolerance: float):
+    """Problems with a rollout-bench artifact: list of failure strings.
+
+    Standalone rules (ISSUE 18): (1) traffic was actually served
+    during the rollout; (2) the zero-drop assertion — zero failed,
+    zero unanswered, zero answered-twice across BOTH governed
+    transitions (pin → rollback repin, pin → promote): a rollout that
+    dropped a request is not 'governed'; (3) both transition latencies
+    were measured (a null promote_s/rollback_s is a failure artifact,
+    not a pass).  Baseline rule: neither latency may regress more than
+    ``tolerance`` above the baseline's."""
+    problems = []
+    if not new.get("requests"):
+        problems.append("no requests measured during the rollout")
+    for key in ("failed", "unanswered", "answered_twice"):
+        if new.get(key):
+            problems.append(
+                f"{key}={new[key]}: the rollout dropped/duplicated "
+                "requests — the zero-drop assertion failed")
+    for key in ("promote_s", "rollback_s"):
+        v = new.get(key)
+        if not isinstance(v, (int, float)) or v <= 0:
+            problems.append(
+                f"{key}={v}: transition latency was not measured "
+                "(a failure artifact has no measurement)")
+        elif baseline and isinstance(baseline.get(key), (int, float)) \
+                and baseline[key] > 0 \
+                and v > baseline[key] * (1.0 + tolerance):
+            problems.append(
+                f"{key} REGRESSION: {v:.3f}s vs baseline "
+                f"{baseline[key]:.3f}s (> {tolerance:.0%} above)")
+    return problems
+
+
+def rollout_main(argv) -> int:
+    new_path = argv[argv.index("--rollout") + 1]
+    tolerance = float(argv[argv.index("--tolerance") + 1]) \
+        if "--tolerance" in argv else 0.5
+    new = _load_rollout_doc(new_path)
+    if not new:
+        print(f"no rollout artifact in {new_path}: run "
+              "benchmarks/rollout_bench.py first")
+        return 1
+    baseline = None
+    base_path = None
+    if "--baseline" in argv:
+        base_path = argv[argv.index("--baseline") + 1]
+        baseline = _load_rollout_doc(base_path)
+        if not baseline:
+            print(f"baseline {base_path} carries no rollout artifact; "
+                  "judging the new run standalone")
+    else:
+        # same loud-skip discovery convention as the serving-gen gate:
+        # a skipped baseline must SAY why, and a failure artifact
+        # (null latency) is never silently compared against
+        for path in sorted(
+                glob.glob(os.path.join(REPO, "BENCH_ROLLOUT*.json")),
+                reverse=True):
+            if os.path.abspath(path) == os.path.abspath(new_path):
+                continue
+            name = os.path.basename(path)
+            try:
+                doc = _load_rollout_doc(path)
+            except (OSError, ValueError) as e:
+                print(f"baseline discovery: skipping {name} "
+                      f"(unreadable: {e})")
+                continue
+            if not doc:
+                print(f"baseline discovery: skipping {name} "
+                      "(no parseable rollout artifact)")
+                continue
+            if not doc.get("promote_s") or not doc.get("rollback_s"):
+                print(f"baseline discovery: skipping {name} "
+                      "(null transition latency — a failure artifact "
+                      "has no measurement to compare against)")
+                continue
+            base_path, baseline = path, doc
+            break
+    problems = check_rollout(new, baseline, tolerance)
+    if problems:
+        for p in problems:
+            print(f"rollout gate FAILED for {new_path}: {p}")
+        return 1
+    note = f" vs {base_path}" if baseline else \
+        " (no baseline: standalone checks only)"
+    print(f"rollout gate OK{note}: promote_s={new.get('promote_s')} "
+          f"rollback_s={new.get('rollback_s')} zero-drop over "
+          f"{new.get('requests')} requests")
+    return 0
+
+
 def main() -> int:
     # budget = bench.py's own hard total wall-clock cap
     # (HVD_BENCH_TOTAL_BUDGET_S, default 1200 s) plus slack: bench must
@@ -958,6 +1074,8 @@ if __name__ == "__main__":
         sys.exit(trajectory_main(sys.argv))
     if "--pipeline" in sys.argv:
         sys.exit(pipeline_main(sys.argv))
+    if "--rollout" in sys.argv:
+        sys.exit(rollout_main(sys.argv))
     if "--serving-gen" in sys.argv:
         sys.exit(serving_gen_main(sys.argv))
     if "--serving" in sys.argv:

@@ -54,6 +54,8 @@ _frozen: Set[str] = set()
 _retune_hooks: List[Callable[[], None]] = []
 _rollback_hooks: List[Callable[[], None]] = []
 _scale_out_hooks: List[Callable[[], None]] = []
+_promote_rollout_hooks: List[Callable[[dict], None]] = []
+_rollback_rollout_hooks: List[Callable[[dict], None]] = []
 
 #: finding fields carried as quarantine EVIDENCE into the driver's
 #: blocklist record (docs/OBSERVABILITY.md "Autopilot"): the canary
@@ -87,6 +89,10 @@ def _run(policy: Policy, finding: dict, decision: dict) -> None:
             rollback(policy, finding)
         elif policy.action == "scale_out":
             scale_out(policy, finding)
+        elif policy.action == "promote_rollout":
+            promote_rollout(policy, finding, decision)
+        elif policy.action == "rollback_rollout":
+            rollback_rollout(policy, finding, decision)
         elif policy.action == "freeze_alert":
             freeze(str(finding.get("function", "unknown")), policy,
                    finding)
@@ -329,6 +335,131 @@ def scale_out(policy: Optional[Policy] = None,
     return ran
 
 
+def register_promote_rollout_hook(fn: Callable[[dict], None]) -> None:
+    """A rollout controller registers a one-arg callable (receiving the
+    ``rollout_verdict`` finding) that advances its canary stage; the
+    ``promote_rollout`` remediation runs every hook when a "promote"
+    verdict fires (docs/SERVING.md "Canary rollout")."""
+    with _lock:
+        _promote_rollout_hooks.append(fn)
+
+
+def register_rollback_rollout_hook(fn: Callable[[dict], None]) -> None:
+    """A rollout controller registers a one-arg callable (receiving the
+    ``rollout_verdict`` finding) that repins every canary replica to
+    the incumbent version; the ``rollback_rollout`` remediation runs
+    every hook when a "rollback" verdict fires."""
+    with _lock:
+        _rollback_rollout_hooks.append(fn)
+
+
+def _run_rollout_hooks(which: str, hooks: List[Callable[[dict], None]],
+                       policy: Optional[Policy], finding: Optional[dict],
+                       decision: Optional[dict]) -> int:
+    """Shared promote/rollback machinery: run the hooks INSIDE the
+    decision's trace (finding → decision → action → repin flips share
+    one id — the whole governed transition is one causal tree), record
+    the flight event, and alert loudly either way."""
+    finding = finding or {}
+    from horovod_tpu import tracing
+    actx = tracing.child(
+        tracing.decode((decision or {}).get(tracing.TRACEPARENT)),
+        "autopilot")
+    ran = 0
+    t0 = time.time()
+    with tracing.activate(actx):
+        for fn in hooks:
+            try:
+                fn(finding)
+                ran += 1
+            except Exception:
+                try:
+                    from horovod_tpu.common.logging import get_logger
+                    get_logger().warning(
+                        "autopilot: %s hook %r failed", which, fn,
+                        exc_info=True)
+                except Exception:
+                    pass
+    tracing.record_span("autopilot", which, actx, start=t0,
+                        dur_s=time.time() - t0,
+                        rollout=finding.get("rollout_id"),
+                        verdict=finding.get("verdict"))
+    _flight(f"autopilot_{which}",
+            policy=policy.name if policy else None, hooks=len(hooks),
+            ran=ran, verdict=finding.get("verdict"),
+            rollout_id=finding.get("rollout_id"),
+            candidate=finding.get("candidate"),
+            incumbent=finding.get("incumbent"))
+    return ran
+
+
+def promote_rollout(policy: Optional[Policy] = None,
+                    finding: Optional[dict] = None,
+                    decision: Optional[dict] = None) -> int:
+    """A "promote" rollout verdict: the candidate version beat its SLO
+    comparison against the incumbent — advance the canary stage via
+    the registered hooks.  Returns how many ran; with none registered
+    the decision is still a first-class audit artifact."""
+    with _lock:
+        hooks = list(_promote_rollout_hooks)
+    ran = _run_rollout_hooks("promote_rollout", hooks, policy, finding,
+                             decision)
+    try:
+        from horovod_tpu.common.logging import get_logger
+        f = finding or {}
+        if hooks:
+            get_logger().error(
+                "autopilot: rollout %s — candidate v%s healthy vs "
+                "incumbent v%s; advanced the canary stage via %d/%d "
+                "hook(s)", f.get("rollout_id", "?"),
+                f.get("candidate", "?"), f.get("incumbent", "?"),
+                ran, len(hooks))
+        else:
+            get_logger().error(
+                "autopilot: rollout %s verdict 'promote' and NO "
+                "promote hook is registered — advance the rollout "
+                "manually (docs/SERVING.md \"Canary rollout\")",
+                f.get("rollout_id", "?"))
+    except Exception:
+        pass
+    return ran
+
+
+def rollback_rollout(policy: Optional[Policy] = None,
+                     finding: Optional[dict] = None,
+                     decision: Optional[dict] = None) -> int:
+    """A "rollback" rollout verdict: the candidate version degraded
+    latency/errors or diverged on the golden set — repin every canary
+    replica to the incumbent through the registered hooks.  The repin
+    is the same atomic between-batch flip as a hot swap, so in-flight
+    requests finish on whichever version computed them and ZERO
+    requests fail.  Returns how many hooks ran."""
+    with _lock:
+        hooks = list(_rollback_rollout_hooks)
+    ran = _run_rollout_hooks("rollback_rollout", hooks, policy, finding,
+                             decision)
+    try:
+        from horovod_tpu.common.logging import get_logger
+        f = finding or {}
+        if hooks:
+            get_logger().error(
+                "autopilot: rollout %s — candidate v%s FAILED its "
+                "canary vs incumbent v%s (%s); repinned every canary "
+                "replica to the incumbent via %d/%d hook(s)",
+                f.get("rollout_id", "?"), f.get("candidate", "?"),
+                f.get("incumbent", "?"), f.get("reason", "verdict"),
+                ran, len(hooks))
+        else:
+            get_logger().error(
+                "autopilot: rollout %s verdict 'rollback' and NO "
+                "rollback hook is registered — repin the canary "
+                "replicas to the incumbent manually (docs/SERVING.md "
+                "\"Canary rollout\" runbook)", f.get("rollout_id", "?"))
+    except Exception:
+        pass
+    return ran
+
+
 def register_retune_hook(fn: Callable[[], None]) -> None:
     """Training loops that hold a live autotuned step register a zero-
     arg callable here; the ``retune`` remediation runs every hook (in
@@ -389,4 +520,6 @@ def reset() -> None:
         _retune_hooks.clear()
         _rollback_hooks.clear()
         _scale_out_hooks.clear()
+        _promote_rollout_hooks.clear()
+        _rollback_rollout_hooks.clear()
         _seq = 0

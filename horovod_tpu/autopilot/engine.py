@@ -299,6 +299,15 @@ class PolicyEngine:
             frac = max(0.0, float(margin or 0.0)) / float(limit)
             gate["margin_frac"] = round(frac, 4)
             return frac < policy.max_margin_frac, gate
+        if policy.action in ("promote_rollout", "rollback_rollout"):
+            # both rollout policies subscribe to the SAME
+            # rollout_verdict finding; the verdict field routes it to
+            # exactly one of them — the other's decision is suppressed
+            # here with the mismatched verdict recorded as the reason
+            want = "promote" if policy.action == "promote_rollout" \
+                else "rollback"
+            verdict = finding.get("verdict")
+            return verdict == want, {"verdict": verdict, "want": want}
         return True, {}
 
     # -- the audit trail -----------------------------------------------------

@@ -84,6 +84,19 @@ ACTIONS: Dict[str, bool] = {
     #                              fleet's target size via the
     #                              registered scale-out hooks (the
     #                              ReplicaFleet wires itself in)
+    # ISSUE 18 (canary weight rollout, docs/SERVING.md "Canary
+    # rollout"): both subscribe to the SAME rollout_verdict finding;
+    # the engine's SLO gate passes each policy only when the verdict
+    # matches its action, so one comparator report drives exactly one
+    # of the two transitions
+    "promote_rollout": False,    # verdict "promote": advance the
+    #                              canary stage (N% → 50% → fleet-wide)
+    #                              via the registered rollout hooks
+    "rollback_rollout": False,   # verdict "rollback": repin every
+    #                              canary replica to the incumbent
+    #                              version — the same atomic
+    #                              between-batch flip as a hot swap,
+    #                              so zero requests fail
 }
 
 MODES = ("off", "observe", "act")
@@ -248,6 +261,18 @@ def default_policies() -> List[Policy]:
         # re-firing faster than that just overshoots
         Policy(name="serving-slo-scaleout", finding="slo_breach",
                action="scale_out", cooldown_s=60.0),
+        # canary weight rollout (ISSUE 18): the comparator reports one
+        # rollout_verdict per evaluation window; the verdict gate
+        # routes it to exactly one of these.  Promotion advances
+        # through MULTIPLE stages (canary → 50% → fleet-wide) within
+        # one rollout, so its cooldown is just hysteresis against a
+        # duplicate report and its budget covers every stage; rollback
+        # is one-shot per rollout and keeps the conservative defaults
+        Policy(name="rollout-promote", finding="rollout_verdict",
+               action="promote_rollout", cooldown_s=1.0,
+               max_actions=6, window_s=3600.0),
+        Policy(name="rollout-rollback", finding="rollout_verdict",
+               action="rollback_rollout", cooldown_s=60.0),
     ]
 
 

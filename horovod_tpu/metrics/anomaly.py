@@ -285,7 +285,16 @@ class AnomalyEngine:
             # resulting re-mesh episode all continue — one id from
             # detection to the first healthy step of the cure
             from horovod_tpu import tracing
-            ctx = tracing.new_trace("anomaly")
+            supplied = finding.get(tracing.TRACEPARENT)
+            if supplied:
+                # the caller is ALREADY inside a trace (a rollout
+                # controller reporting its verdict): the finding
+                # CONTINUES that trace as a child span instead of
+                # rooting a new one — one id from the operation that
+                # detected trouble through the autopilot's cure
+                ctx = tracing.child(tracing.decode(supplied), "anomaly")
+            else:
+                ctx = tracing.new_trace("anomaly")
             if ctx is not None:
                 finding.update(ctx.fields())
                 finding[tracing.TRACEPARENT] = ctx.traceparent

@@ -41,6 +41,8 @@ from horovod_tpu.serving.generate import (GenerateEngine, GenRequest,
 from horovod_tpu.serving.router import (RequestFailed, RequestLog,
                                         RequestRejected, Router,
                                         ready_endpoints)
+from horovod_tpu.serving.rollout import (RolloutConfig,
+                                         RolloutController)
 
 __all__ = [
     "DynamicBatcher", "PendingRequest", "SheddedError", "DrainingError",
@@ -49,5 +51,5 @@ __all__ = [
     "ready_endpoints", "ReplicaFleet", "LatencyWindow",
     "GenerateEngine", "GenRequest", "KVPagePlan", "PagePool",
     "SlotScheduler", "demo_gen_setup", "plan_kv_pages",
-    "request_level_generate",
+    "request_level_generate", "RolloutConfig", "RolloutController",
 ]

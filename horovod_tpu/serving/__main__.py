@@ -105,7 +105,34 @@ class _FrontHandler(BaseHTTPRequestHandler):
             self._send(404, {"error": "not found"})
 
 
+def _rollout_main(argv) -> int:
+    """``python -m horovod_tpu.serving rollout status --store-dir D`` —
+    the stuck-rollout runbook's first stop (docs/SERVING.md "Canary
+    rollout"): print the controller's durably persisted status doc
+    (state, canary slots, split, transition history, trace id) from
+    OUTSIDE the controller process."""
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.serving rollout")
+    p.add_argument("command", choices=["status"])
+    p.add_argument("--store-dir", required=True,
+                   help="the store the rollout persists its status "
+                        "next to")
+    args = p.parse_args(argv)
+    from horovod_tpu.serving.rollout import read_status
+    doc = read_status(args.store_dir)
+    if doc is None:
+        print(f"rollout: no status recorded under {args.store_dir!r} "
+              "(no rollout ever ran against this store)")
+        return 1
+    print(json.dumps(doc, indent=1))
+    return 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "rollout":
+        return _rollout_main(argv[1:])
     p = argparse.ArgumentParser(prog="python -m horovod_tpu.serving")
     p.add_argument("--replicas", type=int, default=2)
     p.add_argument("--port", type=int, default=0,

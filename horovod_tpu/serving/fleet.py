@@ -19,12 +19,14 @@ remediation with the same audit trail as every other autopilot action
 
 from __future__ import annotations
 
+import json
 import os
 import socket
 import subprocess
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Tuple
 
@@ -60,6 +62,10 @@ class _Replica:
         self.proc = proc
         self.log_path = log_path
         self.ready = False
+        # last observed serving state (from the readyz probes / pin
+        # responses): the per-version membership view reads these
+        self.version: Optional[int] = None
+        self.pinned: Optional[int] = None
 
     def log_tail(self, n: int = 2000) -> str:
         try:
@@ -105,6 +111,14 @@ class ReplicaFleet:
             else env_float("SERVING_FLEET_POLL_S", 0.25)
         self._replicas: Dict[int, _Replica] = {}
         self._incarnations = 0
+        # per-slot version pins (docs/SERVING.md "Canary rollout"):
+        # _pins is what the slot's replica serves NOW (re-applied on a
+        # drained respawn); _heal_pins is what a replacement after a
+        # FAILURE restores — the rollout controller sets it to the
+        # incumbent for canary slots, so a crashed canary heals at the
+        # incumbent version, not the candidate
+        self._pins: Dict[int, int] = {}
+        self._heal_pins: Dict[int, int] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
@@ -162,6 +176,13 @@ class ReplicaFleet:
                "--replica-id", f"slot{slot}.{inc}"]
         if self.store_dir:
             cmd += ["--store-dir", self.store_dir]
+        with self._lock:
+            pin = self._pins.get(slot)
+        if pin is not None:
+            # a pinned slot's replacement joins AT the pin, never at
+            # latest — a respawn during a rollout must not widen the
+            # canary (docs/SERVING.md "Canary rollout")
+            cmd += ["--pin-version", str(pin)]
         # log to a FILE, not a pipe: nobody drains a pipe while the
         # replica lives, and a full pipe would wedge it mid-request
         import tempfile
@@ -179,12 +200,31 @@ class ReplicaFleet:
         return replica
 
     # -- monitoring ---------------------------------------------------------
+    def _note_ready_doc(self, replica: _Replica, raw: bytes) -> None:
+        try:
+            doc = json.loads(raw)
+        except Exception:
+            return
+        if isinstance(doc, dict):
+            replica.version = doc.get("version")
+            replica.pinned = doc.get("pinned")
+
     def _probe_ready(self, replica: _Replica) -> bool:
         try:
             with urllib.request.urlopen(
                     f"http://127.0.0.1:{replica.port}/readyz",
                     timeout=1.0) as r:
+                self._note_ready_doc(replica, r.read())
                 return r.status == 200
+        except urllib.error.HTTPError as e:
+            # a 503 (draining / still restoring) raises, but its body
+            # still carries the readyz doc — per-version membership
+            # keeps tracking a not-ready replica's observed version
+            try:
+                self._note_ready_doc(replica, e.read())
+            except Exception:
+                pass
+            return False
         except Exception:
             return False
 
@@ -227,6 +267,16 @@ class ReplicaFleet:
                     "serving fleet: replica %s exited rc=%s (%s); "
                     "respawning", replica.name(), rc, outcome)
                 smetrics.inc_respawn()
+                if outcome == "failure":
+                    # heal-at-incumbent: a crash mid-rollout is not
+                    # evidence the candidate deserves more traffic —
+                    # the replacement joins at the heal pin (the
+                    # rollout controller sets it to the incumbent for
+                    # canary slots) rather than rejoining the canary
+                    with self._lock:
+                        heal = self._heal_pins.get(slot)
+                        if heal is not None:
+                            self._pins[slot] = heal
                 self._spawn(slot)
             # scale-out: spawn slots beyond the current map.  NOT a
             # respawn — planned growth must not read as crash-healing
@@ -284,7 +334,81 @@ class ReplicaFleet:
             time.sleep(0.2)
         return False
 
+    def slots(self) -> List[int]:
+        with self._lock:
+            return sorted(s for s, r in self._replicas.items()
+                          if r.proc.poll() is None)
+
+    def pins(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._pins)
+
+    def versions(self) -> Dict[int, Optional[int]]:
+        """slot -> last observed serving weight version (refreshed by
+        the monitor loop's readyz probes and by pin responses)."""
+        with self._lock:
+            return {s: r.version for s, r in self._replicas.items()
+                    if r.proc.poll() is None}
+
+    def members_by_version(self) -> Dict[Optional[int], List[Endpoint]]:
+        """READY endpoints grouped by observed weight version — the
+        router's version-split arms draw from this view."""
+        out: Dict[Optional[int], List[Endpoint]] = {}
+        with self._lock:
+            for r in self._replicas.values():
+                if r.ready and r.proc.poll() is None:
+                    out.setdefault(r.version, []).append(r.endpoint)
+        return out
+
+    def endpoints_at(self, version: int) -> List[Endpoint]:
+        """READY endpoints currently serving ``version``."""
+        return self.members_by_version().get(int(version), [])
+
     # -- actions ------------------------------------------------------------
+    def pin_slot(self, slot: int, version: Optional[int],
+                 reason: str = "pin",
+                 heal_version: Optional[int] = None) -> bool:
+        """Pin one slot's replica to ``version`` via its ``/pin`` seam
+        (``None`` unpins), and remember the pin so a respawn in the
+        slot joins at the right version.  ``heal_version`` overrides
+        what a replacement after a FAILURE restores: the rollout
+        controller heals canary slots at the INCUMBENT — a crash
+        mid-canary must shrink the canary, not re-grow it."""
+        with self._lock:
+            if version is None:
+                self._pins.pop(slot, None)
+                self._heal_pins.pop(slot, None)
+            else:
+                self._pins[slot] = int(version)
+                self._heal_pins[slot] = int(
+                    heal_version if heal_version is not None else version)
+            replica = self._replicas.get(slot)
+        _flight("serving_fleet_pin", slot=slot, version=version,
+                reason=reason, heal_version=heal_version)
+        if replica is None or replica.proc.poll() is not None:
+            return False
+        body = json.dumps({"version": version, "reason": reason}).encode()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{replica.port}/pin", data=body,
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                doc = json.loads(r.read())
+            if isinstance(doc, dict):
+                replica.version = doc.get("version")
+                replica.pinned = doc.get("pinned")
+            return True
+        except Exception:
+            get_logger().warning(
+                "serving fleet: pin slot %d -> %s (%s) failed", slot,
+                version, reason, exc_info=True)
+            return False
+
+    def unpin_slot(self, slot: int) -> bool:
+        """Clear a slot's pin; its replica resumes chasing latest."""
+        return self.pin_slot(slot, None, reason="unpin")
+
     def drain(self, slot: int) -> bool:
         """Ask one replica to drain (admin path; preemption notices
         reach replicas directly through the chaos/maintenance seam)."""

@@ -85,6 +85,59 @@ def set_weight_version(step: int) -> None:
                       "serving").set(float(step))
 
 
+def inc_weight_swap(reason: str) -> None:
+    """Every ``(version, params)`` flip lands here once, per cause —
+    ``chase`` (the swapper following the store's latest commit),
+    ``pin`` (a rollout controller pinning a candidate/incumbent) or
+    ``rollback`` (repin to the incumbent during an auto-rollback).  The
+    weight version gauge alone cannot show a BACKWARD move after the
+    fact; this counter plus the ``weight_swap`` flight event are what
+    the autopsy reads the rollback from."""
+    _reg().counter("hvd_serving_weight_swaps_total",
+                   help="weight-version flips, per cause (chase=follow "
+                        "latest commit, pin=rollout pin, "
+                        "rollback=repin to incumbent)",
+                   labels={"reason": reason}).inc()
+
+
+# ---------------------------------------------------------------------------
+# Canary weight rollout (horovod_tpu/serving/rollout/)
+# ---------------------------------------------------------------------------
+#: rollout state machine positions, as published on the state gauge
+ROLLOUT_STATES = ("idle", "canary", "expanding", "promoted",
+                  "rolling_back", "rolled_back")
+
+
+def set_rollout_state(state: str) -> None:
+    _reg().gauge("hvd_serving_rollout_state",
+                 help="rollout state machine position (0=idle, "
+                      "1=canary, 2=expanding, 3=promoted, "
+                      "4=rolling_back, 5=rolled_back)").set(
+        float(ROLLOUT_STATES.index(state))
+        if state in ROLLOUT_STATES else -1.0)
+
+
+def set_rollout_canary_pct(pct: float) -> None:
+    _reg().gauge("hvd_serving_rollout_canary_pct",
+                 help="traffic percentage currently routed to the "
+                      "candidate weight version (0 = no active "
+                      "split)").set(float(pct))
+
+
+def inc_rollout_verdict(verdict: str) -> None:
+    _reg().counter("hvd_serving_rollout_verdicts_total",
+                   help="per-version SLO/quality comparator verdicts, "
+                        "per outcome (promote/rollback)",
+                   labels={"verdict": verdict}).inc()
+
+
+def inc_rollout_transition(to: str) -> None:
+    _reg().counter("hvd_serving_rollout_transitions_total",
+                   help="rollout state-machine transitions, per "
+                        "destination state",
+                   labels={"to": to}).inc()
+
+
 def set_queue_depth(depth: int) -> None:
     _reg().gauge("hvd_serving_queue_depth",
                  help="requests waiting in the dynamic batcher "

@@ -103,9 +103,20 @@ class ReplicaServer:
                  dim: int = 16, port: int = 0, replica_id: str = "r0",
                  batcher: Optional[DynamicBatcher] = None,
                  swap_poll_s: Optional[float] = None,
-                 mode: str = "infer", gen_model: Any = None) -> None:
+                 mode: str = "infer", gen_model: Any = None,
+                 pin_version: Optional[int] = None) -> None:
         self.replica_id = replica_id
         self.dim = dim
+        # version pinning (docs/SERVING.md "Canary rollout"): while
+        # pinned, the swapper serves EXACTLY this durable-store step —
+        # it never chases a newer commit (that is how a canary holds
+        # the candidate while the rest of the fleet holds the
+        # incumbent, and how a rollback repins without a restart).
+        # ``pin_version`` at construction restores the pinned step
+        # directly, so a healed replacement never transits through
+        # whatever happens to be latest.
+        self._pin: Optional[int] = None if pin_version is None \
+            else int(pin_version)
         # generate mode: a continuous-batching decode engine rides
         # alongside the request-level path (POST /generate; the /infer
         # plumbing stays untouched).  ``gen_model`` is a (params, cfg)
@@ -211,13 +222,23 @@ class ReplicaServer:
         if self._store_dir:
             try:
                 store = self._store()
-                # return_step: on a corrupt-newest fallback the state
-                # is OLDER than latest_step(), and the serving version
-                # must name the weights actually loaded
-                step, doc = store.restore_latest(return_step=True)
-                if step is not None:
+                if self._pin is not None:
+                    # a pinned spawn (fleet heal during a rollout)
+                    # restores THE pinned step: the replacement joins
+                    # the fleet at its slot's assigned version, never
+                    # at whatever commit happens to be newest
+                    doc = store.restore(self._pin)
                     self._set_params(self._extract_params(doc),
-                                     version=int(step), swap=False)
+                                     version=self._pin, swap=False)
+                else:
+                    # return_step: on a corrupt-newest fallback the
+                    # state is OLDER than latest_step(), and the
+                    # serving version must name the weights actually
+                    # loaded
+                    step, doc = store.restore_latest(return_step=True)
+                    if step is not None:
+                        self._set_params(self._extract_params(doc),
+                                         version=int(step), swap=False)
             except Exception:
                 get_logger().warning(
                     "serving: initial restore from %s failed; starting "
@@ -234,19 +255,74 @@ class ReplicaServer:
         self._compiled = jax.jit(self._apply_fn)
 
     def _set_params(self, params: Any, version: int,
-                    swap: bool = True) -> None:
+                    swap: bool = True, reason: str = "chase") -> None:
         import jax
         device = jax.tree_util.tree_map(jax.numpy.asarray, params)
         with self._params_lock:
+            from_version = self._version
             self._params = device
             self._version = version
         smetrics.set_weight_version(version)
         if swap:
             smetrics.inc_swap()
+            smetrics.inc_weight_swap(reason)
+            # the gauge alone cannot show a BACKWARD move after the
+            # fact — the flight event names both endpoints and the
+            # cause, so the autopsy shows the rollback (a backward flip
+            # is legitimate exactly when a pin/rollback asked for it,
+            # and must never happen silently)
+            _flight("weight_swap", replica=self.replica_id,
+                    from_version=from_version, to_version=version,
+                    reason=reason)
             _flight("serving_swap", replica=self.replica_id,
                     version=version)
-            get_logger().info("serving: hot-swapped to weight version "
-                              "%d (replica %s)", version, self.replica_id)
+            if version < from_version:
+                get_logger().warning(
+                    "serving: weight version moved BACKWARD %d -> %d "
+                    "(replica %s, reason=%s) — expected only during a "
+                    "rollout rollback", from_version, version,
+                    self.replica_id, reason)
+            else:
+                get_logger().info(
+                    "serving: hot-swapped to weight version %d "
+                    "(replica %s, reason=%s)", version,
+                    self.replica_id, reason)
+
+    # -- version pinning ----------------------------------------------------
+    def pin(self, version: int, reason: str = "pin") -> dict:
+        """Pin this replica to durable-store step ``version``: restore
+        it now (the same atomic between-batch flip as a hot swap — no
+        request is dropped) and stop the swapper from chasing newer
+        commits until :meth:`unpin`.  ``reason`` ∈ {``pin``,
+        ``rollback``} stamps the ``weight_swap`` audit event."""
+        version = int(version)
+        if self._store_dir and version != self._version:
+            # restore BEFORE committing the pin: a nonexistent/corrupt
+            # step raises out of the /pin route (500) with the replica
+            # UNPINNED and still serving its old weights — never
+            # pinned to an unloadable version that _swap_loop would
+            # retry forever while refusing to chase commits
+            doc = self._store().restore(version)
+            self._set_params(self._extract_params(doc),
+                             version=version, reason=reason)
+        self._pin = version
+        _flight("serving_pin", replica=self.replica_id,
+                version=version, reason=reason)
+        return {"replica": self.replica_id, "pinned": self._pin,
+                "version": self._version}
+
+    def unpin(self) -> dict:
+        """Clear the pin; the swapper resumes chasing the store's
+        latest commit on its next poll."""
+        self._pin = None
+        _flight("serving_unpin", replica=self.replica_id,
+                version=self._version)
+        return {"replica": self.replica_id, "pinned": None,
+                "version": self._version}
+
+    @property
+    def pinned(self) -> Optional[int]:
+        return self._pin
 
     def _swap_loop(self) -> None:
         if not self._store_dir:
@@ -254,6 +330,18 @@ class ReplicaServer:
         bad_newest = None  # a newest step whose restore fell back
         while not self._stop.wait(self._swap_poll_s):
             try:
+                pin = self._pin
+                if pin is not None:
+                    # pinned: converge onto the pinned step if a failed
+                    # pinned-SPAWN initial restore left us elsewhere
+                    # (pin() itself only commits after its restore
+                    # succeeds), then HOLD — a pinned replica never
+                    # chases the latest commit
+                    if self._version != pin:
+                        doc = self._store().restore(pin)
+                        self._set_params(self._extract_params(doc),
+                                         version=pin, reason="pin")
+                    continue
                 store = self._store()
                 step = store.latest_step()
                 if step is None or step <= self._version \
@@ -347,7 +435,8 @@ class ReplicaServer:
                 "draining": self.batcher.draining,
                 "queue_depth": depth,
                 "queue_budget": self._ready_queue_max,
-                "version": self._version}
+                "version": self._version,
+                "pinned": self._pin}
 
     def health_doc(self) -> dict:
         return {"status": "ok" if self._loop_alive else "starting",
@@ -693,6 +782,28 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
             replica.drain(source="admin")
             self._send(200, {"draining": True,
                              "replica": replica.replica_id})
+        elif path == "/pin":
+            # {"version": N, "reason": "pin"|"rollback"} pins; a null/
+            # absent version unpins.  The rollout controller's control
+            # seam — same flip as a hot swap, never a dropped request.
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                doc = json.loads(self.rfile.read(length)) if length \
+                    else {}
+            except (ValueError, OSError):
+                self._send(400, {"error": "bad request body"})
+                return
+            version = doc.get("version")
+            reason = str(doc.get("reason") or "pin")
+            try:
+                if version is None:
+                    self._send(200, replica.unpin())
+                else:
+                    self._send(200, replica.pin(int(version),
+                                                reason=reason))
+            except Exception as e:
+                self._send(500, {"error": repr(e),
+                                 "replica": replica.replica_id})
         else:
             self._send(404, {"error": "not found"})
 
@@ -712,6 +823,10 @@ def main(argv=None) -> int:
                    default="infer",
                    help="generate adds the continuous-batching decode "
                         "engine (POST /generate, demo transformer)")
+    p.add_argument("--pin-version", type=int, default=None,
+                   help="restore and HOLD this durable-store step "
+                        "instead of chasing the latest commit (fleet "
+                        "heals during a rollout spawn pinned)")
     args = p.parse_args(argv)
 
     # the chaos plan (preemption notices, serving.request faults) arms
@@ -730,7 +845,8 @@ def main(argv=None) -> int:
     replica = ReplicaServer(store_dir=args.store_dir, dim=args.dim,
                             port=args.port,
                             replica_id=args.replica_id,
-                            mode=args.mode).start()
+                            mode=args.mode,
+                            pin_version=args.pin_version).start()
 
     import signal
 

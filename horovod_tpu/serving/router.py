@@ -33,6 +33,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+import zlib
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from horovod_tpu import tracing
@@ -84,6 +85,7 @@ class RequestLog:
         self._path = path
         self._lock = threading.Lock()
         self.entries: List[dict] = []
+        self.trimmed = 0  # lines dropped from memory by the MAX_MEMORY cap
         self.max_bytes = int(max_bytes) if max_bytes else env_int(
             "SERVING_REQLOG_MAX_BYTES", DEFAULT_REQLOG_MAX_BYTES)
         self._fh = None
@@ -106,7 +108,9 @@ class RequestLog:
         with self._lock:
             self.entries.append(doc)
             if len(self.entries) > self.MAX_MEMORY:
-                del self.entries[: self.MAX_MEMORY // 10]
+                cut = self.MAX_MEMORY // 10
+                del self.entries[:cut]
+                self.trimmed += cut
             if self._path is not None and not self._closed:
                 try:
                     line = json.dumps(doc) + "\n"
@@ -125,6 +129,21 @@ class RequestLog:
                     self._written += len(line)
                 except OSError:
                     pass  # accounting stays in memory; never raise
+
+    def seq_now(self) -> int:
+        """Monotonic count of lines ever noted — unlike a raw index
+        into ``entries``, it survives the in-memory trim, so windowed
+        readers (the rollout stage window) can anchor on it without
+        over-skipping entries after a trim."""
+        with self._lock:
+            return self.trimmed + len(self.entries)
+
+    def since(self, seq: int) -> List[dict]:
+        """Entries noted at-or-after absolute sequence ``seq`` (a prior
+        :meth:`seq_now`), trim-compensated; entries the cap already
+        dropped are gone, but nothing that survived is skipped."""
+        with self._lock:
+            return list(self.entries[max(0, seq - self.trimmed):])
 
     def close(self) -> None:
         with self._lock:
@@ -147,6 +166,7 @@ class RequestLog:
         with self._lock:
             entries = list(self.entries)
         by_outcome: dict = {}
+        by_version: dict = {}
         accepted: dict = {}
         ok: dict = {}
         terminal: set = set()
@@ -160,6 +180,12 @@ class RequestLog:
             elif e["outcome"] == "ok":
                 ok[seq] = ok.get(seq, 0) + 1
                 terminal.add(seq)
+                # per-version success counts: rollout verdicts are
+                # auditable from the log alone (docs/SERVING.md
+                # "Canary rollout")
+                v = e.get("version")
+                v = "unversioned" if v is None else v
+                by_version[v] = by_version.get(v, 0) + 1
             elif e["outcome"] in ("failed", "rejected"):
                 terminal.add(seq)
         return {
@@ -170,6 +196,7 @@ class RequestLog:
                                  set(accepted) - terminal),
             "answered_twice": sorted(accepted.get(s, "?") for s, n in
                                      ok.items() if n > 1),
+            "by_version": by_version,
         }
 
 
@@ -226,6 +253,17 @@ class Router:
         self._lock = threading.Lock()
         self._seq = itertools.count()
         self._rr = itertools.count()  # per-request round-robin offset
+        # version split (docs/SERVING.md "Canary rollout"): when a
+        # rollout is live, requests are deterministically assigned to
+        # the canary or incumbent arm by request id, and retries/hedges
+        # rotate WITHIN the arm — a canary request never silently
+        # escapes to the incumbent (and vice versa) unless its arm is
+        # empty, in which case zero-drop outranks split fidelity
+        self._split: Optional[dict] = None
+        # (host, port) -> last weight version OBSERVED answering there
+        # (fed by every 200 dispatch) — hedge/retry log lines attribute
+        # outcomes per version from this map
+        self._ep_versions: dict = {}
         # windows must close on IDLE too: with rolls driven only by
         # observe(), a fleet whose traffic stopped would freeze the
         # qps/p50/p99 gauges at their last busy values forever
@@ -239,6 +277,86 @@ class Router:
                 self.window.maybe_roll()
             except Exception:
                 pass
+
+    # -- version split (canary rollout) -------------------------------------
+    def set_version_split(self, pct: int, canary_eps,
+                          incumbent_eps,
+                          canary_version: Optional[int] = None,
+                          incumbent_version: Optional[int] = None
+                          ) -> None:
+        """Install a version split: ``pct``% of requests go to the
+        canary arm, the rest to the incumbent arm.  Each arm is a list
+        of endpoints or a zero-arg callable returning the CURRENT list
+        (the fleet's ``endpoints_at(version)`` view, so heals and
+        repins are picked up per request).  Assignment is by request
+        id (crc32 bucket), so an idempotent replay of a request lands
+        on the SAME arm — and is answered by the same version — as the
+        original."""
+        pct = max(0, min(100, int(pct)))
+        self._split = {
+            "pct": pct,
+            "canary": canary_eps if callable(canary_eps)
+            else (lambda eps=list(canary_eps): list(eps)),
+            "incumbent": incumbent_eps if callable(incumbent_eps)
+            else (lambda eps=list(incumbent_eps): list(eps)),
+            "canary_version": canary_version,
+            "incumbent_version": incumbent_version,
+        }
+        smetrics.set_rollout_canary_pct(pct)
+
+    def clear_version_split(self) -> None:
+        self._split = None
+        smetrics.set_rollout_canary_pct(0)
+
+    def version_split(self) -> Optional[dict]:
+        s = self._split
+        if s is None:
+            return None
+        return {"pct": s["pct"],
+                "canary_version": s["canary_version"],
+                "incumbent_version": s["incumbent_version"]}
+
+    def _pick_arm(self, req_id: str) -> Tuple[List[Endpoint],
+                                              Optional[str]]:
+        """The request's endpoint pool.  No split: the full fleet.
+        Split: the arm its id hashes into — empty arms degrade to the
+        full fleet (counted) rather than failing the request."""
+        split = self._split
+        if split is None:
+            return list(self._endpoints()), None
+        bucket = zlib.crc32(req_id.encode()) % 100
+        arm = "canary" if bucket < split["pct"] else "incumbent"
+        try:
+            eps = list(split[arm]())
+        except Exception:
+            eps = []
+        if not eps:
+            smetrics._reg().counter(
+                "hvd_serving_rollout_split_fallback_total",
+                help="requests whose version-split arm was empty and "
+                     "fell back to the full fleet (zero-drop outranks "
+                     "split fidelity)",
+                labels={"arm": arm}).inc()
+            return list(self._endpoints()), f"{arm}-fallback"
+        return eps, arm
+
+    def _version_at(self, ep: Endpoint) -> Optional[int]:
+        """Best-effort weight-version attribution for an endpoint.
+        Under a split, CURRENT arm membership names the version — a
+        poisoned candidate that fails every request has never answered
+        200, so the observed-version map alone would attribute its
+        failures to the version it previously served (or to nothing)
+        and the canary error window would never accrue.  Outside a
+        split, the last version observed answering there."""
+        split = self._split
+        if split is not None:
+            for arm_name in ("canary", "incumbent"):
+                try:
+                    if ep in split[arm_name]():
+                        return split[f"{arm_name}_version"]
+                except Exception:
+                    pass
+        return self._ep_versions.get(ep)
 
     # -- dispatch plumbing --------------------------------------------------
     def _post(self, ep: Endpoint, body: bytes, timeout: float,
@@ -272,6 +390,9 @@ class Router:
             try:
                 code, doc = self._post(ep, body, timeout, ctx=ctx,
                                        path=path)
+                if code == 200 and isinstance(doc, dict) \
+                        and doc.get("version") is not None:
+                    self._ep_versions[ep] = int(doc["version"])
                 results.put((ep, code, doc, None))
                 err = None
             except Exception as e:
@@ -408,14 +529,37 @@ class Router:
             "deadline_ms": max((deadline - time.monotonic()) * 1000.0,
                                1.0),
         }).encode()
-        eps = list(self._endpoints())
+        eps, arm = self._pick_arm(req_id)
         if not eps:
             raise RequestFailed("no replica endpoints")
-        # spread primaries round-robin across the fleet; retries/hedges
-        # continue the rotation so they land on a DIFFERENT replica
+        # spread primaries round-robin across the pool (the whole
+        # fleet, or the request's version-split arm); retries/hedges
+        # continue the rotation so they land on a DIFFERENT replica —
+        # and, under a split, stay WITHIN the arm
         start = next(self._rr) % len(eps)
         rotation = itertools.cycle(
             list(range(start, len(eps))) + list(range(start)))
+        arm_size = len(eps)
+        widened = False
+
+        def widen():
+            # a DEAD arm must not fail the request: the empty-arm rule
+            # (zero-drop outranks split fidelity) applied mid-flight —
+            # once every arm replica has refused/died, the retry pool
+            # becomes the REST of the fleet, counted as a fallback
+            nonlocal eps, rotation, widened
+            widened = True
+            rest = [e for e in self._endpoints() if e not in eps]
+            if not rest:
+                return
+            eps = rest
+            rotation = itertools.cycle(range(len(eps)))
+            smetrics._reg().counter(
+                "hvd_serving_rollout_split_fallback_total",
+                help="requests whose version-split arm was empty and "
+                     "fell back to the full fleet (zero-drop outranks "
+                     "split fidelity)",
+                labels={"arm": arm}).inc()
         results: "queue.Queue" = queue.Queue()
         attempts = 0
         outstanding = 0
@@ -459,9 +603,10 @@ class Router:
                     hedged = True
                     if launch():  # appends the hedge TARGET to tried
                         smetrics.inc_hedged()
-                        self.log.note(req_id, "hedged",
-                                      to=str(tried[-1]),
-                                      **tracing.fields(spans[-1]))
+                        self.log.note(
+                            req_id, "hedged", to=str(tried[-1]),
+                            version=self._version_at(tried[-1]),
+                            arm=arm, **tracing.fields(spans[-1]))
                 elif outstanding == 0:
                     # everything launched has answered badly and the
                     # attempt budget may still allow a retry
@@ -485,11 +630,17 @@ class Router:
             # replica sick or dead: in every case the survivor is the
             # answer — retry there (counted only when a retry actually
             # LAUNCHES: an exhausted attempt budget is not a retry)
+            if arm is not None and not widened \
+                    and len(set(tried)) >= arm_size:
+                widen()
             if launch():
                 smetrics.inc_retried()
-                self.log.note(req_id, "retried", after=last_error,
-                              to=str(tried[-1]),
-                              **tracing.fields(spans[-1]))
+                self.log.note(
+                    req_id, "retried", after=last_error,
+                    after_version=self._version_at(ep),
+                    to=str(tried[-1]),
+                    version=self._version_at(tried[-1]),
+                    arm=arm, **tracing.fields(spans[-1]))
             elif outstanding == 0:
                 break
             # tiny backoff so a fully-shedding fleet is not hammered

@@ -121,14 +121,15 @@ def test_env_inline_and_file_loading(tmp_path, monkeypatch):
 
 def test_default_policies_cover_every_remediation():
     """The shipped set: the four ISSUE 12 remediations, the two ISSUE
-    13 data-plane integrity ones (quarantine + rollback), and the
-    ISSUE 14 serving SLO scale-out."""
+    13 data-plane integrity ones (quarantine + rollback), the ISSUE 14
+    serving SLO scale-out, and the ISSUE 18 rollout promote/rollback
+    pair (both gating on the same rollout_verdict finding)."""
     ps = default_policies()
     assert {p.action for p in ps} == set(ACTIONS)
     assert {p.finding for p in ps} == {
         "persistent_straggler", "hbm_growth", "recompile_storm",
         "world_changed", "replica_divergence", "grad_nonfinite",
-        "slo_breach"}
+        "slo_breach", "rollout_verdict"}
     # unset env -> the default set
     assert [p.name for p in load_policies_from_env()] == \
         [p.name for p in ps]
