@@ -55,6 +55,7 @@ def run_bench(replicas: int = 2, clients: int = 4, duration_s: float = 5.0,
     counts_lock = threading.Lock()
     latencies: list = []
     traced: list = []  # (latency, trace_id) per measured ok request
+    stage_sums: dict = {}  # ledger stage -> total seconds (measured oks)
 
     def client(i: int) -> None:
         n = 0
@@ -77,6 +78,12 @@ def run_bench(replicas: int = 2, clients: int = 4, duration_s: float = 5.0,
                         latencies.append(dt)
                         if doc.get("trace"):
                             traced.append((dt, doc["trace"]))
+                        st = doc.get("stages")
+                        if isinstance(st, dict):
+                            for k, v in st.items():
+                                if isinstance(v, (int, float)):
+                                    stage_sums[k] = \
+                                        stage_sums.get(k, 0.0) + float(v)
 
     threads = [threading.Thread(target=client, args=(i,), daemon=True)
                for i in range(clients)]
@@ -138,6 +145,33 @@ def run_bench(replicas: int = 2, clients: int = 4, duration_s: float = 5.0,
             slowest = {"trace": trace_id, "latency_s": round(lat, 6),
                        "hops": []}
 
+    # the request ledger's view of the run (docs/OBSERVABILITY.md
+    # "Serving request ledger"): per-stage totals across every measured
+    # ok, their shares, and the books-close check — check_bench
+    # --serving refuses an artifact whose unattributed residual says
+    # the decomposition no longer explains the latency it reports
+    from horovod_tpu.serving import ledger
+    stage_total = sum(stage_sums.values())
+    stage_doc = {
+        "stage_seconds": {k: round(v, 6)
+                          for k, v in sorted(stage_sums.items())},
+        "stage_shares": {k: round(v / stage_total, 4)
+                         for k, v in sorted(stage_sums.items())}
+        if stage_total > 0 else {},
+        "stage_unattributed_frac": round(
+            stage_sums.get(ledger.RESIDUAL, 0.0) / stage_total, 6)
+        if stage_total > 0 else None,
+        "dominant_stage": ledger.dominant_stage(stage_sums),
+    }
+    # a bounded latency sample (strided over the sorted list, endpoints
+    # kept) so the gate can REPLAY the percentile math with the shared
+    # quantile implementation instead of trusting the number
+    sample = latencies
+    if len(sample) > 512:
+        stride = len(sample) / 511.0
+        sample = [latencies[min(int(i * stride), len(latencies) - 1)]
+                  for i in range(511)] + [latencies[-1]]
+
     from horovod_tpu.tracing import enabled as tracing_enabled
     total = sum(counts.values())
     return {
@@ -153,6 +187,8 @@ def run_bench(replicas: int = 2, clients: int = 4, duration_s: float = 5.0,
         "qps": round(counts["ok"] / max(measured_s, 1e-9), 2),
         "p50_s": round(pct(0.50), 6),
         "p99_s": round(pct(0.99), 6),
+        "latency_sample": [round(v, 6) for v in sample],
+        **stage_doc,
         "shed_fraction": round(counts["shed"] / total, 6) if total else 0.0,
         "failed": counts["failed"],
         "unanswered": len(acct["unanswered"]),

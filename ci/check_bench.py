@@ -57,7 +57,13 @@ gate fails when p99 regresses more than T (default 0.5) over the
 baseline's, and — baseline or not — when the artifact is not CLEAN:
 ``shed_fraction > 0`` (a latency number bought by refusing load is not
 a measurement of the same system), failed requests, or a violated
-zero-drop audit (unanswered / double-answered ids) all fail.
+zero-drop audit (unanswered / double-answered ids) all fail.  The
+request ledger (ISSUE 19) adds two standalone rules: the artifact must
+carry the per-stage decomposition with its books CLOSED
+(``stage_unattributed_frac`` under 10% — a p99 whose decomposition no
+longer explains it is not actionable), and the reported p99 is
+replayed through the shared quantile over the artifact's own
+``latency_sample``.
 
 ``--serving-gen NEW [--baseline OLD] [--tolerance T]`` is the
 generative-throughput gate (ISSUE 17): NEW/OLD are ``BENCH_SERVE_GEN``
@@ -659,15 +665,31 @@ def _load_serving_doc(path: str):
     return doc
 
 
+#: the books-close bar (ISSUE 19): the stage decomposition must explain
+#: at least 90% of the latency it rides with — past this, the ledger is
+#: no longer measuring where the time went
+SERVING_UNATTRIBUTED_MAX = 0.10
+
+
 def check_serving(new: dict, baseline, tolerance: float):
     """Problems with a serving artifact: list of failure strings.
 
-    Two rules (ISSUE 14): (1) a "clean" latency number that SHED
-    requests is not clean — load-shedding trades completeness for
-    latency, so a p99 bought that way must not pass as a measurement
-    of the same system; same for failed/unanswered/double-answered
-    requests (the zero-drop audit rides the artifact).  (2) p99 must
-    not regress more than ``tolerance`` over the baseline's."""
+    Rules (ISSUE 14 + the request ledger, ISSUE 19): (1) a "clean"
+    latency number that SHED requests is not clean — load-shedding
+    trades completeness for latency, so a p99 bought that way must not
+    pass as a measurement of the same system; same for failed/
+    unanswered/double-answered requests (the zero-drop audit rides the
+    artifact).  (2) p99 must not regress more than ``tolerance`` over
+    the baseline's.  (3) the BOOKS must CLOSE: a measured artifact
+    carries the per-stage decomposition
+    (``stage_seconds``/``stage_unattributed_frac``) and its
+    unattributed residual stays under
+    :data:`SERVING_UNATTRIBUTED_MAX` — a p99 whose decomposition no
+    longer explains it is a number nobody can act on.  (4) when the
+    artifact ships its ``latency_sample``, the reported p99 is REPLAYED
+    through the shared quantile implementation
+    (:func:`horovod_tpu.serving.ledger.quantile`) — the gate checks the
+    math, not just the number (wide band: the sample is strided)."""
     problems = []
     if not new.get("requests"):
         problems.append("no requests measured (empty window)")
@@ -684,6 +706,49 @@ def check_serving(new: dict, baseline, tolerance: float):
             f"zero-drop audit violated: unanswered="
             f"{new.get('unanswered')} answered_twice="
             f"{new.get('answered_twice')}")
+    stages = new.get("stage_seconds")
+    unattr = new.get("stage_unattributed_frac")
+    if not isinstance(stages, dict) or not stages:
+        if new.get("requests"):
+            problems.append(
+                "no stage_seconds breakdown: the request ledger's "
+                "books are missing — the recording contract broke "
+                "(rerun with a current benchmarks/serving_bench.py)")
+    elif not isinstance(unattr, (int, float)):
+        problems.append(
+            "stage_seconds present but stage_unattributed_frac is "
+            "missing — the books-close evidence did not ride the "
+            "artifact")
+    elif unattr >= SERVING_UNATTRIBUTED_MAX:
+        problems.append(
+            f"request-ledger books did NOT close: "
+            f"{unattr:.1%} of attributed wall-clock is unattributed "
+            f"(>= {SERVING_UNATTRIBUTED_MAX:.0%}) — the stage "
+            f"decomposition no longer explains the p99 it ships with "
+            f"(dominant stage: {new.get('dominant_stage')})")
+    sample = new.get("latency_sample")
+    if isinstance(sample, list) and len(sample) >= 10 \
+            and new.get("p99_s"):
+        sys.path.insert(0, REPO)
+        try:
+            from horovod_tpu.serving.ledger import quantile
+            replay = quantile(sorted(float(v) for v in sample), 0.99)
+        except Exception as e:
+            replay = None
+            problems.append(f"latency_sample replay failed: {e!r}")
+        finally:
+            sys.path.remove(REPO)
+        if replay is not None:
+            # the band is generous (strided sample + absolute floor):
+            # this catches a percentile implementation drifting, not
+            # sampling noise
+            band = max(new["p99_s"] * 0.5, 0.002)
+            if abs(replay - new["p99_s"]) > band:
+                problems.append(
+                    f"p99 replay mismatch: artifact says "
+                    f"{new['p99_s']:.6f}s but the shared quantile over "
+                    f"its own latency_sample says {replay:.6f}s — the "
+                    "percentile math diverged")
     if baseline and baseline.get("p99_s") and new.get("p99_s"):
         base_p99, new_p99 = baseline["p99_s"], new["p99_s"]
         if new_p99 > base_p99 * (1.0 + tolerance):
@@ -719,7 +784,9 @@ def serving_main(argv) -> int:
         " (no baseline: standalone checks only)"
     print(f"serving gate OK{note}: qps={new.get('qps')} "
           f"p50={new.get('p50_s')}s p99={new.get('p99_s')}s "
-          f"shed_fraction={new.get('shed_fraction')} over "
+          f"shed_fraction={new.get('shed_fraction')} "
+          f"dominant_stage={new.get('dominant_stage')} "
+          f"unattributed={new.get('stage_unattributed_frac')} over "
           f"{new.get('requests')} requests")
     return 0
 

@@ -89,6 +89,13 @@ SEAMS: Dict[str, frozenset] = {
     # never silently drop).  Invocation index = per-process request
     # count.
     "serving.request": frozenset({"error", "delay", "shed"}),
+    # KV page-pool starvation (docs/CHAOS.md): fired by the generate
+    # engine's page pool per allocation attempt — ``starve`` makes the
+    # pool refuse the grant as if it could not cover the request, so
+    # admitted traffic piles up in ``page_wait`` (the request ledger
+    # must attribute it there and the ``kv_thrash`` detector must name
+    # it).  Invocation index = per-process allocation attempt count.
+    "serving.kv": frozenset({"starve"}),
     # gradient corruption at the train step (docs/CHAOS.md): the seam
     # index IS the training step (like ``step``); the armed kinds are
     # read by the guard-integrated train-step factories

@@ -329,6 +329,21 @@ def write_autopsy(out_dir: Optional[str] = None, reason: str = "",
         return goodput.snapshot(flush_open=True)
 
     goodput_snap = step(_goodput)
+
+    def _exemplars():
+        # the serving ledger's tail exemplars (docs/OBSERVABILITY.md
+        # "Serving request ledger"): the worst requests per latency
+        # window, each with its trace id and full stage breakdown — a
+        # serving-plane death ships WHERE its slowest requests spent
+        # their time
+        from horovod_tpu.serving.ledger import exemplars
+        return exemplars()
+
+    exemplar_docs = step(_exemplars) or []
+    if exemplar_docs:
+        step(lambda: _write_json(
+            os.path.join(bundle, f"exemplars_rank{rank}.json"),
+            {"exemplars": exemplar_docs}))
     step(lambda: _write_json(
         os.path.join(bundle, f"summary_rank{rank}.json"), {
         "reason": reason,
@@ -339,6 +354,7 @@ def write_autopsy(out_dir: Optional[str] = None, reason: str = "",
         "actions": actions,
         "profiles": profiles,
         "goodput": goodput_snap,
+        "exemplars": len(exemplar_docs),
         "peers_fetched": fetched,
         "peers_unreachable": unreachable,
     }))

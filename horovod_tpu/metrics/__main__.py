@@ -314,21 +314,32 @@ def render_actions_table(decisions) -> str:
 def render_serving_table(points) -> str:
     """The per-window serving latency series (docs/SERVING.md): one row
     per closed :class:`~horovod_tpu.serving.metrics.LatencyWindow` —
-    the trajectory behind "my p99 spiked" (docs/TROUBLESHOOTING.md)."""
+    the trajectory behind "my p99 spiked" (docs/TROUBLESHOOTING.md).
+    Windows observed with the request ledger also say WHERE the window
+    went: the dominant stage with its share, and the unattributed
+    residual fraction (the books-close check, live)."""
     head = (f"{'ts':<19} {'rank':>4} {'window':>8} {'requests':>9} "
-            f"{'qps':>9} {'p50':>10} {'p99':>10} {'shed':>6}")
+            f"{'qps':>9} {'p50':>10} {'p99':>10} {'shed':>6} "
+            f"{'dominant':<16} {'unattr':>7}")
     lines = [head]
     for p in points:
         w = p["serving"]
         ts = time.strftime("%Y-%m-%d %H:%M:%S",
                            time.localtime(p.get("ts", 0)))
+        dom = w.get("dominant_stage") or "-"
+        share = (w.get("stage_shares") or {}).get(dom)
+        if share is not None:
+            dom = f"{dom} {share * 100:.0f}%"
+        unattr = w.get("unattributed_frac")
+        unattr_s = f"{unattr * 100:.1f}%" if unattr is not None else "-"
         lines.append(
             f"{ts:<19} {str(p.get('rank', '-')):>4} "
             f"{w.get('window_s', 0):>7.1f}s {w.get('requests', 0):>9} "
             f"{w.get('qps', 0):>9.1f} "
             f"{_fmt_seconds(w.get('p50_s')):>10} "
             f"{_fmt_seconds(w.get('p99_s')):>10} "
-            f"{w.get('shed', 0):>6}")
+            f"{w.get('shed', 0):>6} "
+            f"{dom:<16} {unattr_s:>7}")
     lines.append(f"-- {len(points)} serving window(s)")
     return "\n".join(lines)
 

@@ -20,6 +20,21 @@ over the points the time-series layer records
   ledger window, :mod:`horovod_tpu.metrics.goodput`); the finding
   names the dominating loss category.
 
+Three serving detectors ride the request ledger's closed windows
+(:mod:`horovod_tpu.serving.ledger`, fed once per ``LatencyWindow``
+roll via :func:`observe_serving_window`) and let the autopilot tell a
+scale-out-shaped breach from a swap/KV-shaped one:
+
+* ``ttft_drift`` — windowed time-to-first-token p50 drifts above its
+  rolling baseline (generate traffic only);
+* ``queue_growth`` — the queueing stages (``queue`` + ``batch_wait``)
+  take over the request wall-clock: their windowed stage share stays
+  over ``HVD_TPU_SERVING_QUEUE_SHARE`` — the scale-out-shaped signal;
+* ``kv_thrash`` — the ``page_wait`` stage share stays over
+  ``HVD_TPU_SERVING_KV_THRASH_SHARE``: sequences starve for KV pages,
+  which more replicas will NOT fix (grow the pool / shrink worst-case
+  budgets instead).
+
 Every finding lands three ways: a ``hvd_anomaly_total{kind=...}``
 counter on ``/metrics``, an ``anomaly`` flight-recorder event, and the
 engine's bounded findings list, which the autopsy bundle's summary
@@ -148,6 +163,49 @@ class _DriftDetector:
                 "consecutive": self._streak}
 
 
+class _StageShareDetector:
+    """Threshold detector over one windowed stage-share signal from the
+    serving request ledger: flags after ``windows`` consecutive closed
+    windows where the summed share of ``stages`` exceeds ``threshold``,
+    with the same one-finding-per-episode hysteresis as the drift
+    detectors.  An idle window (no requests) resets the episode — the
+    condition did not survive the traffic that caused it."""
+
+    def __init__(self, kind: str, stages: tuple, threshold: float,
+                 windows: int) -> None:
+        self.kind = kind
+        self.stages = stages
+        self.threshold = threshold
+        self.windows = max(1, windows)
+        self._streak = 0
+        self._active = False
+
+    def observe(self, doc: dict) -> Optional[dict]:
+        if not doc.get("requests"):
+            self._streak = 0
+            self._active = False
+            return None
+        shares = doc.get("stage_shares") or {}
+        share = sum(shares.get(s, 0.0) for s in self.stages)
+        if share <= self.threshold:
+            self._streak = 0
+            self._active = False
+            return None
+        self._streak += 1
+        if self._active or self._streak < self.windows:
+            return None
+        self._active = True
+        worst = max(self.stages, key=lambda s: shares.get(s, 0.0))
+        finding = {"kind": self.kind, "value": round(share, 4),
+                   "threshold": self.threshold,
+                   "dominant_stage": worst,
+                   "stage_share": round(shares.get(worst, 0.0), 4),
+                   "consecutive": self._streak}
+        if doc.get("worst_trace"):
+            finding["worst_trace"] = doc["worst_trace"]
+        return finding
+
+
 class AnomalyEngine:
     """Per-process detector bank; feed it from the train loop
     (``observe_step``) and, on rank 0, from the fleet aggregator
@@ -177,6 +235,19 @@ class AnomalyEngine:
         self._goodput = _DriftDetector(
             "goodput_regression", -1, alpha, k, min_ratio, consecutive,
             warmup)
+        # serving-plane detectors (fed per closed LatencyWindow by
+        # observe_serving): TTFT drifts like step time; the stage-share
+        # pair are threshold detectors — a share is already normalized,
+        # a learned baseline would only blunt the "where" answer
+        self._ttft = _DriftDetector(
+            "ttft_drift", +1, alpha, k, min_ratio, consecutive, warmup)
+        share_windows = max(1, _envi("SERVING_STAGE_WINDOWS", 2))
+        self._queue_share = _StageShareDetector(
+            "queue_growth", ("queue", "batch_wait"),
+            _envf("SERVING_QUEUE_SHARE", 0.5), share_windows)
+        self._kv_share = _StageShareDetector(
+            "kv_thrash", ("page_wait",),
+            _envf("SERVING_KV_THRASH_SHARE", 0.25), share_windows)
         self._straggler_windows = max(
             2, _envi("ANOMALY_STRAGGLER_WINDOWS", 3))
         self._straggler_ratio = _envf("ANOMALY_STRAGGLER_RATIO", 1.3)
@@ -262,6 +333,26 @@ class AnomalyEngine:
                 "win_step_time": round(times[worst], 6),
                 "fleet_mean": round(mean, 6),
                 "windows": self._straggler_run})]
+
+    def observe_serving(self, doc: dict) -> List[dict]:
+        """One closed serving ``LatencyWindow`` doc (carrying the
+        request ledger's stage shares, docs/OBSERVABILITY.md "Serving
+        request ledger"): runs the ``ttft_drift`` / ``queue_growth`` /
+        ``kv_thrash`` detectors and returns any NEW findings."""
+        out = []
+        with self._lock:
+            ttft = doc.get("ttft_p50_s")
+            if ttft is not None and doc.get("requests"):
+                f = self._ttft.observe(float(ttft))
+                if f:
+                    if doc.get("worst_trace"):
+                        f["worst_trace"] = doc["worst_trace"]
+                    out.append(self._flag(f))
+            for det in (self._queue_share, self._kv_share):
+                f = det.observe(doc)
+                if f:
+                    out.append(self._flag(f))
+        return out
 
     # -- reporting -----------------------------------------------------------
     def report(self, kind: str, **fields: Any) -> dict:
@@ -361,8 +452,11 @@ class AnomalyEngine:
         alpha = self._step.baseline.alpha
         with self._lock:
             for det in (self._step, self._thr, self._exposed,
-                        self._goodput):
+                        self._goodput, self._ttft):
                 det.baseline = EwmaMad(alpha)
+                det._streak = 0
+                det._active = False
+            for det in (self._queue_share, self._kv_share):
                 det._streak = 0
                 det._active = False
             self._straggler_rank = None
@@ -392,6 +486,13 @@ def recent_findings() -> List[dict]:
     autopsy summary embeds under ``anomalies``."""
     eng = _ENGINE
     return eng.recent_findings() if eng is not None else []
+
+
+def observe_serving_window(doc: dict) -> List[dict]:
+    """Feed one closed serving window doc to the process-wide engine's
+    serving detectors ([] when ``HVD_TPU_ANOMALY=0``)."""
+    eng = default_engine()
+    return eng.observe_serving(doc) if eng is not None else []
 
 
 def report_finding(kind: str, **fields: Any) -> Optional[dict]:

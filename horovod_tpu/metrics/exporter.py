@@ -156,6 +156,15 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                 self._send(200,
                            json.dumps(engine_doc(), default=str).encode(),
                            "application/json")
+            elif kind == "exemplars":
+                # the serving ledger's tail exemplars: worst requests
+                # per window with trace id + full stage breakdown
+                # (docs/OBSERVABILITY.md "Serving request ledger")
+                from horovod_tpu.serving.ledger import exemplars
+                self._send(200,
+                           json.dumps({"exemplars": exemplars()},
+                                      default=str).encode(),
+                           "application/json")
             else:
                 self._send(404, b"unknown debug endpoint\n", "text/plain")
         except Exception as e:  # evidence collection must never crash

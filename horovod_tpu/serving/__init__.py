@@ -30,6 +30,10 @@ from horovod_tpu.serving.batcher import (DeadlineError, DrainingError,
                                          DynamicBatcher, PendingRequest,
                                          SheddedError)
 from horovod_tpu.serving.fleet import ReplicaFleet
+from horovod_tpu.serving.ledger import (STAGES, BurnRateSlo,
+                                        ExemplarRing, WindowBooks,
+                                        close_books, dominant_stage,
+                                        quantile, residual_fraction)
 from horovod_tpu.serving.metrics import LatencyWindow
 from horovod_tpu.serving.replica import (ReplicaServer, demo_apply,
                                          demo_params)
@@ -52,4 +56,6 @@ __all__ = [
     "GenerateEngine", "GenRequest", "KVPagePlan", "PagePool",
     "SlotScheduler", "demo_gen_setup", "plan_kv_pages",
     "request_level_generate", "RolloutConfig", "RolloutController",
+    "STAGES", "BurnRateSlo", "ExemplarRing", "WindowBooks",
+    "close_books", "dominant_stage", "quantile", "residual_fraction",
 ]

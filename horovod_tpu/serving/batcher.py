@@ -50,8 +50,8 @@ class PendingRequest:
     / :meth:`set_error`."""
 
     __slots__ = ("id", "payload", "deadline", "enqueued_at",
-                 "formed_at", "forward_s", "_event", "_result",
-                 "_error")
+                 "formed_at", "started_at", "forward_s",
+                 "swap_pause_s", "_event", "_result", "_error")
 
     def __init__(self, req_id: str, payload: Any,
                  deadline: float) -> None:
@@ -59,11 +59,16 @@ class PendingRequest:
         self.payload = payload
         self.deadline = deadline
         self.enqueued_at = time.monotonic()
-        # causal-tracing attribution (docs/OBSERVABILITY.md): when the
-        # batch formed (queue wait ends) and how long its padded
-        # forward took — stamped by next_batch / the serving loop
+        # request-ledger attribution (docs/OBSERVABILITY.md "Serving
+        # request ledger"): when the batch formed (queue wait ends),
+        # when its forward launched (batch_wait ends — padding + params
+        # lock), how long the padded forward took, and any weight-swap
+        # pause the batch sat out — stamped by next_batch / the serving
+        # loop
         self.formed_at: float = 0.0
+        self.started_at: float = 0.0
         self.forward_s: float = 0.0
+        self.swap_pause_s: float = 0.0
         self._event = threading.Event()
         self._result: Any = None
         self._error: Optional[BaseException] = None

@@ -122,6 +122,12 @@ class GenerateEngine:
         self._active = np.zeros((self.n_slots,), bool)
         self.decode_steps_total = 0
         self.prefill_chunks_total = 0
+        # weight-swap pause gate: the replica's hot swap clears it
+        # around the params flip so the decode loop holds at a step
+        # boundary; the held time is charged to every live sequence's
+        # ``swap_pause`` ledger stage
+        self._swap_gate = threading.Event()
+        self._swap_gate.set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -199,12 +205,36 @@ class GenerateEngine:
             time.sleep(0.01)
         return True
 
+    # -- weight-swap pause --------------------------------------------------
+    def begin_swap(self) -> None:
+        """Hold the decode loop at the next step boundary (the replica's
+        hot weight swap brackets the params flip with begin/end)."""
+        self._swap_gate.clear()
+
+    def end_swap(self) -> None:
+        self._swap_gate.set()
+
+    def _swap_wait(self) -> None:
+        if self._swap_gate.is_set():
+            return
+        t0 = time.monotonic()
+        self._swap_gate.wait()
+        pause = time.monotonic() - t0
+        if pause <= 0:
+            return
+        # charge the pause to every LIVE sequence's ledger (waiting
+        # requests keep accruing slot/page wait through the scheduler)
+        for req in list(self.scheduler.slots):
+            if req is not None:
+                req.swap_pause_s += pause
+
     # -- the step -----------------------------------------------------------
     def step_once(self, idle_wait_s: float = 0.0) -> bool:
         """One engine iteration: pull admissions, sweep deadlines,
         admit into slots, ONE prefill chunk per prefilling sequence,
         ONE batched decode step, deliver finishes.  Returns True when
         any work happened."""
+        self._swap_wait()
         pulled = self._pull_admissions(idle_wait_s)
         self._sweep_deadlines()
         admitted = self.scheduler.admit()
@@ -278,6 +308,7 @@ class GenerateEngine:
             dur = time.monotonic() - t0
             smetrics.observe_prefill(dur)
             self.prefill_chunks_total += 1
+            req.prefill_s += dur
             req.prefill_pos += length
             req.prefill_chunks += 1
             self._span(req, "gen_prefill", dur_s=dur,
@@ -318,6 +349,7 @@ class GenerateEngine:
             s = req.slot
             tok = int(nxt[s])
             req.decode_steps += 1
+            req.decode_s += dur  # each rider experiences the full step
             self._lengths[s] += 1
             self._last_token[s] = tok
             self._emit(req, tok)
@@ -327,6 +359,7 @@ class GenerateEngine:
             if len(req.tokens) >= req.max_new:
                 self._finish(req, "length")
         smetrics.observe_decode(dur, len(decoding))
+        smetrics.observe_batch(len(decoding), top=self.n_slots)
         return True
 
     # -- delivery -----------------------------------------------------------
@@ -348,13 +381,16 @@ class GenerateEngine:
             self._page_table[s, :] = self.plan.total_pages
         smetrics.inc_gen_finished(reason)
         now = time.monotonic()
+        stages = {k: round(v, 6) for k, v in req.stages().items()}
         self._span(req, "gen_finish",
                    dur_s=now - req.submitted_at, reason=reason,
                    tokens_emitted=len(req.tokens),
                    prefill_chunks=req.prefill_chunks,
                    decode_steps=req.decode_steps,
                    ttft_s=round((req.first_token_at - req.submitted_at)
-                                if req.first_token_at else 0.0, 6))
+                                if req.first_token_at else 0.0, 6),
+                   **{f"stage_{k}": v for k, v in stages.items()
+                      if v > 0})
         if req.pending is None:
             return
         if error is not None:
@@ -371,6 +407,10 @@ class GenerateEngine:
             "decode_steps": req.decode_steps,
             "ttft_s": round(ttft, 6),
             "total_s": round(now - req.submitted_at, 6),
+            # the generate-plane slice of the request ledger — the
+            # replica handler adds its own stages and the router closes
+            # the books (docs/OBSERVABILITY.md "Serving request ledger")
+            "stages": stages,
         })
 
     def _span(self, req: GenRequest, name: str, dur_s: float,

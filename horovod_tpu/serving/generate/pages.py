@@ -138,6 +138,13 @@ class PagePool:
         caller keeps the request WAITING — never a partial grant)."""
         if n <= 0:
             return []
+        try:
+            from horovod_tpu import chaos
+            if any(kind == "starve"
+                   for _, kind in chaos.fire("serving.kv")):
+                return None  # injected starvation: refuse the grant
+        except Exception:
+            pass
         with self._lock:
             if n > len(self._free):
                 return None

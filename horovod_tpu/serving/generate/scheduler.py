@@ -47,7 +47,8 @@ class GenRequest:
                  "prefill_pos", "tokens", "submitted_at", "admitted_at",
                  "first_token_at", "last_token_at", "prefill_chunks",
                  "decode_steps", "trace", "pending", "on_token",
-                 "finish_reason")
+                 "finish_reason", "wait_mark", "slot_wait_s",
+                 "page_wait_s", "prefill_s", "decode_s", "swap_pause_s")
 
     def __init__(self, req_id: str, prompt, max_new: int,
                  trace=None, on_token=None) -> None:
@@ -69,6 +70,24 @@ class GenRequest:
         self.pending = None           # admission-queue PendingRequest
         self.on_token = on_token
         self.finish_reason: Optional[str] = None
+        # request-ledger stage accounting (docs/OBSERVABILITY.md
+        # "Serving request ledger"): waiting time split by WHY the line
+        # was blocked (free-slot scarcity vs page-pool scarcity), plus
+        # wall seconds inside prefill/decode and weight-swap pauses
+        self.wait_mark = self.submitted_at
+        self.slot_wait_s = 0.0
+        self.page_wait_s = 0.0
+        self.prefill_s = 0.0
+        self.decode_s = 0.0
+        self.swap_pause_s = 0.0
+
+    def stages(self) -> dict:
+        """The generate-plane slice of this request's stage ledger."""
+        return {"slot_wait": self.slot_wait_s,
+                "page_wait": self.page_wait_s,
+                "prefill": self.prefill_s,
+                "decode": self.decode_s,
+                "swap_pause": self.swap_pause_s}
 
     @property
     def prompt_len(self) -> int:
@@ -105,6 +124,12 @@ class SlotScheduler:
         self._lock = threading.Lock()
         self._waiting: Deque[GenRequest] = deque()
         self.slots: List[Optional[GenRequest]] = [None] * self.n_slots
+        # what blocked the LAST admission pass: "slot" (no free slot)
+        # or "page" (pool can't cover the head's worst case) — the
+        # request ledger charges waiting time since that pass to the
+        # matching stage (slot_wait vs page_wait), which is exactly the
+        # discrimination the kv_thrash detector runs on
+        self._block_cause: Optional[str] = None
 
     # -- intake -------------------------------------------------------------
     def add_waiting(self, req: GenRequest) -> None:
@@ -125,15 +150,30 @@ class SlotScheduler:
         admitted: List[GenRequest] = []
         now = time.monotonic()
         with self._lock:
+            # settle waiting time accrued since the previous pass under
+            # the cause that blocked it (default: slot — queue transit
+            # before the first classification is batch-join wait)
+            cause = self._block_cause
+            for r in self._waiting:
+                dt = max(0.0, now - r.wait_mark)
+                r.wait_mark = now
+                if cause == "page":
+                    r.page_wait_s += dt
+                else:
+                    r.slot_wait_s += dt
+            self._block_cause = None
             while self._waiting:
                 free = [i for i, r in enumerate(self.slots) if r is None]
                 if not free:
+                    self._block_cause = "slot"
                     break
                 req = self._waiting[0]
                 pages = self.pool.alloc(
                     self.pool.plan.pages_for(req.worst_case_tokens))
                 if pages is None:
-                    break  # pool can't cover the head yet; keep FIFO
+                    # pool can't cover the head yet; keep FIFO
+                    self._block_cause = "page"
+                    break
                 self._waiting.popleft()
                 req.slot = free[0]
                 req.pages = pages

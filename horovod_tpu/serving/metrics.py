@@ -21,6 +21,7 @@ from typing import List, Optional
 
 from horovod_tpu.common.config import env_float, env_int
 from horovod_tpu.metrics.registry import default_registry
+from horovod_tpu.serving import ledger
 
 #: latency buckets: serving answers in milliseconds, not the step-time
 #: seconds the default buckets are shaped for
@@ -157,13 +158,30 @@ def set_draining(draining: bool) -> None:
         1.0 if draining else 0.0)
 
 
-def observe_batch(size: int) -> None:
+def batch_size_buckets(top: Optional[int] = None) -> tuple:
+    """Power-of-two batch-size buckets whose top covers ``top`` —
+    derived from the configured slot count / batch bound when omitted
+    (``HVD_TPU_GEN_SLOTS`` slot arrays can exceed the old fixed top of
+    128, which dumped every decode batch into +Inf)."""
+    t = top if top else max(env_int("GEN_SLOTS", 4),
+                            env_int("SERVING_MAX_BATCH", 8))
+    edges = [1]
+    while edges[-1] < max(128, t):
+        edges.append(edges[-1] * 2)
+    return tuple(edges)
+
+
+def observe_batch(size: int, top: Optional[int] = None) -> None:
+    """``top`` — the caller's configured maximum batch (slot count for
+    the generate engine, ``max_batch_size`` for the dynamic batcher);
+    the registry keeps the FIRST creation's buckets, so the first
+    caller's configuration shapes the histogram."""
     _reg().counter("hvd_serving_batches_total",
                    help="forward batches executed by the serving "
                         "loop").inc()
     _reg().histogram("hvd_serving_batch_size",
                      help="formed dynamic-batch sizes",
-                     buckets=(1, 2, 4, 8, 16, 32, 64, 128)
+                     buckets=batch_size_buckets(top)
                      ).observe(float(size))
 
 
@@ -285,46 +303,61 @@ def inc_gen_finished(reason: str) -> None:
                    labels={"reason": reason}).inc()
 
 
-def percentile(sorted_vals: List[float], q: float) -> float:
-    """Nearest-rank percentile over an ASCENDING-sorted list — THE one
-    implementation (the bench artifact's p99 and the SLO plane's p99
-    must mean the same thing, `ci/check_bench.py --serving` compares
-    them)."""
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
-    return sorted_vals[idx]
+#: THE one nearest-rank quantile — canonical implementation lives in
+#: :mod:`horovod_tpu.serving.ledger` (the SLO plane, the rollout
+#: comparator and ``ci/check_bench.py --serving`` all share it, so
+#: "p99" means the same thing everywhere)
+percentile = ledger.quantile
 
 
 class LatencyWindow:
     """Windowed latency/percentile tracker (one per router, feeding the
     fleet SLO plane).
 
-    ``observe()`` per completed request; every ``HVD_TPU_SERVING_WINDOW_S``
+    ``observe()`` per completed request — with its stage ledger when
+    the request path carried one; every ``HVD_TPU_SERVING_WINDOW_S``
     (default 5s) the closing window publishes ``hvd_serving_p50/p99
-    _seconds`` + ``hvd_serving_qps`` gauges, records a ``{"serving":
-    {...}}`` time-series point, and — when ``HVD_TPU_SERVING_SLO_P99_MS``
-    is set (> 0) — checks the SLO: ``HVD_TPU_SERVING_SLO_WINDOWS``
-    (default 2) consecutive breaching windows report ONE ``slo_breach``
-    anomaly finding (hysteresis mirrors the anomaly engine's: one
-    finding per episode, re-armed after a healthy window)."""
+    _seconds`` + ``hvd_serving_qps`` + ``hvd_serving_stage_share``
+    gauges, records a ``{"serving": {...}}`` time-series point carrying
+    the stage breakdown, pushes the window's worst requests into the
+    tail-exemplar ring, and — when ``HVD_TPU_SERVING_SLO_P99_MS`` is
+    set (> 0) — runs the multi-window burn-rate SLO check
+    (:class:`horovod_tpu.serving.ledger.BurnRateSlo`: one ``slo_breach``
+    finding per episode, naming the dominant stage).  The closed doc is
+    also fed to the anomaly engine's serving detectors (``ttft_drift``,
+    ``queue_growth``, ``kv_thrash``)."""
 
-    def __init__(self, window_s: Optional[float] = None) -> None:
+    def __init__(self, window_s: Optional[float] = None,
+                 ring: Optional[ledger.ExemplarRing] = None) -> None:
         self.window_s = window_s if window_s is not None \
             else env_float("SERVING_WINDOW_S", 5.0)
-        self.slo_p99_s = env_float("SERVING_SLO_P99_MS", 0.0) / 1000.0
-        self.slo_windows = max(1, env_int("SERVING_SLO_WINDOWS", 2))
+        self.slo = ledger.BurnRateSlo()
+        self.slo_p99_s = self.slo.slo_p99_s
+        self._ring = ring if ring is not None else ledger.default_ring()
         self._lock = threading.Lock()
         self._lat: List[float] = []
         self._shed = 0
+        self._bad = 0
+        self._books = ledger.WindowBooks()
         self._opened = time.monotonic()
-        self._breach_streak = 0
-        self._breach_active = False
 
-    def observe(self, seconds: float) -> None:
+    def observe(self, seconds: float,
+                stages: Optional[dict] = None,
+                trace: Optional[str] = None,
+                req_id: Optional[str] = None,
+                version: Optional[int] = None,
+                ttft_s: Optional[float] = None) -> None:
         observe_latency(seconds)
+        if stages:
+            ledger.observe_stage_seconds(
+                ledger.close_books(seconds, stages))
         with self._lock:
             self._lat.append(seconds)
+            if self.slo.is_bad(seconds):
+                self._bad += 1
+            self._books.add(seconds, stages, trace=trace,
+                            req_id=req_id, version=version,
+                            ttft_s=ttft_s)
         self.maybe_roll()
 
     def note_shed(self) -> None:
@@ -338,9 +371,10 @@ class LatencyWindow:
         with self._lock:
             if not force and now - self._opened < self.window_s:
                 return None
-            lat, shed = self._lat, self._shed
+            lat, shed, bad = self._lat, self._shed, self._bad
             elapsed = max(now - self._opened, 1e-9)
-            self._lat, self._shed = [], 0
+            self._lat, self._shed, self._bad = [], 0, 0
+            stage_doc, exemplars = self._books.close()
             self._opened = now
         lat.sort()
         doc = {
@@ -351,6 +385,9 @@ class LatencyWindow:
             "p99_s": round(percentile(lat, 0.99), 6),
             "shed": shed,
         }
+        if self.slo.enabled:
+            doc["slo_bad"] = bad
+        doc.update(stage_doc)
         reg = _reg()
         reg.gauge("hvd_serving_qps",
                   help="completed requests per second over the last "
@@ -360,37 +397,20 @@ class LatencyWindow:
         reg.gauge("hvd_serving_p99_seconds",
                   help="windowed p99 request latency — the serving SLO "
                        "signal").set(doc["p99_s"])
+        # every canonical stage publishes each roll (absent -> 0.0), so
+        # an idle window zeroes the shares instead of freezing them
+        ledger.publish_stage_shares(doc.get("stage_shares") or {})
+        for ex in exemplars:
+            self._ring.add(ex)
         try:
             from horovod_tpu.metrics.timeseries import record_point
             record_point({"serving": doc})
         except Exception:
             pass
-        self._check_slo(doc)
+        self.slo.observe_window(doc["requests"], bad, doc)
+        try:
+            from horovod_tpu.metrics.anomaly import observe_serving_window
+            observe_serving_window(doc)
+        except Exception:
+            pass
         return doc
-
-    def _check_slo(self, doc: dict) -> None:
-        if self.slo_p99_s <= 0:
-            return
-        if not doc["requests"]:
-            # an idle window is not a breach — and a breach episode
-            # does not survive the traffic that caused it
-            self._breach_streak = 0
-            self._breach_active = False
-            return
-        if doc["p99_s"] > self.slo_p99_s:
-            self._breach_streak += 1
-            if self._breach_streak >= self.slo_windows \
-                    and not self._breach_active:
-                self._breach_active = True
-                try:
-                    from horovod_tpu.metrics.anomaly import report_finding
-                    report_finding(
-                        "slo_breach", p99_s=doc["p99_s"],
-                        slo_s=self.slo_p99_s, qps=doc["qps"],
-                        shed=doc["shed"],
-                        consecutive=self._breach_streak)
-                except Exception:
-                    pass
-        else:
-            self._breach_streak = 0
-            self._breach_active = False

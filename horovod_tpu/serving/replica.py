@@ -258,10 +258,21 @@ class ReplicaServer:
                     swap: bool = True, reason: str = "chase") -> None:
         import jax
         device = jax.tree_util.tree_map(jax.numpy.asarray, params)
-        with self._params_lock:
-            from_version = self._version
-            self._params = device
-            self._version = version
+        # hold the decode loop at a step boundary for the flip; the
+        # held time lands on live sequences' ``swap_pause`` ledger
+        # stage (the infer path charges its params-lock wait the same
+        # way in _run_batch)
+        engine = getattr(self, "engine", None)
+        if engine is not None:
+            engine.begin_swap()
+        try:
+            with self._params_lock:
+                from_version = self._version
+                self._params = device
+                self._version = version
+        finally:
+            if engine is not None:
+                engine.end_swap()
         smetrics.set_weight_version(version)
         if swap:
             smetrics.inc_swap()
@@ -521,6 +532,28 @@ class ReplicaServer:
             # attribution the `diagnostics trace` tree prints
             queue_s = max(pending.formed_at - pending.enqueued_at, 0.0) \
                 if pending.formed_at else 0.0
+            # the replica slice of the request ledger
+            # (docs/OBSERVABILITY.md "Serving request ledger"): the
+            # four stages sum EXACTLY to this handler's wall time, so
+            # the router can close the books — batch_wait is formation
+            # → forward launch minus the named swap pause, response is
+            # everything else (pre-queue admission, wakeup, assembly)
+            total_s = time.monotonic() - t_handle
+            swap_s = max(pending.swap_pause_s, 0.0)
+            batch_wait_s = max(
+                pending.started_at - pending.formed_at - swap_s, 0.0) \
+                if pending.started_at and pending.formed_at else 0.0
+            stages = {
+                "queue": queue_s,
+                "batch_wait": batch_wait_s,
+                "forward": max(pending.forward_s, 0.0),
+                "response": max(total_s - queue_s - batch_wait_s
+                                - swap_s - pending.forward_s, 0.0),
+            }
+            if swap_s > 0:
+                stages["swap_pause"] = swap_s
+            resp["stages"] = {k: round(v, 6)
+                              for k, v in stages.items()}
             tracing.record_span(
                 "serving", "batcher_queue",
                 tracing.child(serve_ctx, "serving"),
@@ -533,10 +566,12 @@ class ReplicaServer:
                 replica=self.replica_id, version=version)
             tracing.record_span(
                 "serving", "serve", serve_ctx, start=wall_handle,
-                dur_s=time.monotonic() - t_handle,
+                dur_s=total_s,
                 replica=self.replica_id, version=version,
                 queue_s=round(queue_s, 6),
-                forward_s=round(pending.forward_s, 6))
+                forward_s=round(pending.forward_s, 6),
+                **{f"stage_{k}": round(v, 6)
+                   for k, v in stages.items() if v > 0})
             if fresh:
                 # cache BEFORE the finally pops the in-flight entry: a
                 # duplicate arriving in between must hit one of the two
@@ -631,12 +666,26 @@ class ReplicaServer:
             if serve_ctx is not None:
                 resp["trace"] = serve_ctx.trace_id
                 resp["span"] = serve_ctx.span_id
+            # merge the engine's ledger slice with the handler's:
+            # ``response`` is the handler wall-clock OUTSIDE the
+            # engine's submit→finish interval; the engine's own host
+            # bookkeeping between ticks stays in the router's
+            # unattributed residual — never relabeled
+            total_s = time.monotonic() - t_handle
+            stages = {k: float(v)
+                      for k, v in (result.get("stages") or {}).items()}
+            stages["response"] = max(
+                total_s - float(result.get("total_s") or 0.0), 0.0)
+            resp["stages"] = {k: round(v, 6)
+                              for k, v in stages.items()}
             tracing.record_span(
                 "serving", "serve", serve_ctx, start=wall_handle,
-                dur_s=time.monotonic() - t_handle,
+                dur_s=total_s,
                 replica=self.replica_id, mode="generate",
                 tokens_emitted=result.get("tokens_emitted"),
-                finish_reason=result.get("finish_reason"))
+                finish_reason=result.get("finish_reason"),
+                **{f"stage_{k}": round(v, 6)
+                   for k, v in stages.items() if v > 0})
             if fresh:
                 # cache BEFORE the finally pops the in-flight entry
                 # (same window as handle_infer: a duplicate arriving in
@@ -690,8 +739,14 @@ class ReplicaServer:
                 self.batcher.batch_done()
 
     def _run_batch(self, batch) -> None:
+        # params-lock acquire time IS the weight-swap pause this batch
+        # sat out (the hot swap holds the lock only for the pointer
+        # flip) — named in the request ledger instead of hiding in
+        # batch_wait
+        t_lock = time.monotonic()
         with self._params_lock:
             params, version = self._params, self._version
+        swap_pause_s = time.monotonic() - t_lock
         n = len(batch)
         xs = [np.atleast_1d(r.payload) for r in batch]
         width = xs[0].shape[-1]
@@ -701,9 +756,12 @@ class ReplicaServer:
         for i, x in enumerate(xs):
             padded[i, :] = x
         t0 = time.monotonic()
+        for req in batch:
+            req.started_at = t0
+            req.swap_pause_s = swap_pause_s
         out = np.asarray(self._compiled(params, padded))
         forward_s = time.monotonic() - t0
-        smetrics.observe_batch(n)
+        smetrics.observe_batch(n, top=self.batcher.max_batch_size)
         smetrics._reg().histogram(
             "hvd_serving_forward_seconds",
             help="compiled forward-pass wall time per batch",

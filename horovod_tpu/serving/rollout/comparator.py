@@ -30,7 +30,10 @@ import time
 import urllib.request
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from horovod_tpu.serving.metrics import percentile
+# one quantile implementation serves the whole SLO plane (the
+# LatencyWindow, this comparator, and ci/check_bench --serving): a
+# verdict replayed through any of them sees the same p99
+from horovod_tpu.serving.ledger import dominant_stage, quantile as percentile
 
 Endpoint = Tuple[str, int]
 
@@ -38,14 +41,18 @@ Endpoint = Tuple[str, int]
 def version_windows(entries: Sequence[dict], versions: Sequence[int]
                     ) -> Dict[int, dict]:
     """Reduce request-log ``entries`` to per-version stats for each of
-    ``versions``: ok count, latency p50/p99, and the error count
+    ``versions``: ok count, latency p50/p99, the error count
     attributed to the version (``retried`` lines name the version that
     failed via ``after_version``; terminal ``failed`` lines count
-    against the version of the last retry target when known)."""
+    against the version of the last retry target when known), and —
+    when the ``ok`` lines carry the request ledger's ``stages`` dict —
+    the per-version stage shares plus the dominant stage, so a rollback
+    verdict can say WHERE the canary spends its extra latency."""
     wanted = {int(v) for v in versions}
     lat: Dict[int, List[float]] = {v: [] for v in wanted}
     ok: Dict[int, int] = {v: 0 for v in wanted}
     errs: Dict[int, int] = {v: 0 for v in wanted}
+    stage_s: Dict[int, Dict[str, float]] = {v: {} for v in wanted}
     for e in entries:
         out = e.get("outcome")
         if out == "ok":
@@ -54,6 +61,12 @@ def version_windows(entries: Sequence[dict], versions: Sequence[int]
                 ok[v] += 1
                 if isinstance(e.get("latency_s"), (int, float)):
                     lat[v].append(float(e["latency_s"]))
+                st = e.get("stages")
+                if isinstance(st, dict):
+                    acc = stage_s[v]
+                    for k, dur in st.items():
+                        if isinstance(dur, (int, float)):
+                            acc[k] = acc.get(k, 0.0) + float(dur)
         elif out == "retried":
             av = e.get("after_version")
             if av in wanted:
@@ -74,6 +87,12 @@ def version_windows(entries: Sequence[dict], versions: Sequence[int]
             "p50_s": round(percentile(xs, 0.50), 6) if xs else None,
             "p99_s": round(percentile(xs, 0.99), 6) if xs else None,
         }
+        total_stage = sum(stage_s[v].values())
+        if total_stage > 0:
+            stats[v]["stage_shares"] = {
+                k: round(dur / total_stage, 4)
+                for k, dur in sorted(stage_s[v].items())}
+            stats[v]["dominant_stage"] = dominant_stage(stage_s[v])
     return stats
 
 
@@ -104,9 +123,14 @@ def compare(canary: dict, incumbent: dict, *, min_requests: int,
     if canary["p99_s"] is not None and incumbent["p99_s"] is not None \
             and incumbent["p99_s"] > 0 \
             and canary["p99_s"] > max_p99_ratio * incumbent["p99_s"]:
+        # the ledger's per-version breakdown names WHERE the canary
+        # spends its extra latency — a rollback reason an operator can
+        # act on, not just a ratio
+        dom = canary.get("dominant_stage")
+        where = f" (dominant stage: {dom})" if dom else ""
         return "rollback", (
             f"canary p99 {canary['p99_s']:.6f}s > {max_p99_ratio:g}x "
-            f"incumbent p99 {incumbent['p99_s']:.6f}s")
+            f"incumbent p99 {incumbent['p99_s']:.6f}s{where}")
     return "promote", "canary held p99/error-rate vs incumbent"
 
 

@@ -39,6 +39,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 from horovod_tpu import tracing
 from horovod_tpu.common.config import env_float, env_int
 from horovod_tpu.common.logging import get_logger
+from horovod_tpu.serving import ledger
 from horovod_tpu.serving import metrics as smetrics
 from horovod_tpu.serving.batcher import SheddedError
 from horovod_tpu.serving.metrics import LatencyWindow
@@ -393,10 +394,11 @@ class Router:
                 if code == 200 and isinstance(doc, dict) \
                         and doc.get("version") is not None:
                     self._ep_versions[ep] = int(doc["version"])
-                results.put((ep, code, doc, None))
+                results.put((ep, code, doc, None, t0,
+                             time.monotonic()))
                 err = None
             except Exception as e:
-                results.put((ep, None, None, e))
+                results.put((ep, None, None, e, t0, time.monotonic()))
                 code, err = None, e
             # every attempt records its span — including the hedge
             # loser whose answer arrives after the request returned:
@@ -470,13 +472,21 @@ class Router:
         t0 = time.monotonic()
         wall0 = time.time()
         try:
+            dmeta: dict = {}
             doc = self._dispatch(req_id, payload, deadline_s, root,
-                                 path=path, allow_hedge=allow_hedge)
+                                 path=path, allow_hedge=allow_hedge,
+                                 meta=dmeta)
             latency = time.monotonic() - t0
+            stages = self._close_books(t0, latency, dmeta, doc)
+            doc["stages"] = {k: round(v, 6)
+                             for k, v in stages.items()}
             tracing.record_span("serving", "request", root, start=wall0,
                                 dur_s=latency,
                                 replica=doc.get("replica"),
-                                version=doc.get("version"))
+                                version=doc.get("version"),
+                                **{f"stage_{k}": round(v, 6)
+                                   for k, v in stages.items()
+                                   if v > 0})
             smetrics.inc_completed()
             if doc.get("version") is not None:
                 # the router-side registry mirrors the version it just
@@ -484,7 +494,12 @@ class Router:
                 # (metrics top "weights vN") reports live truth without
                 # reaching into replica registries
                 smetrics.set_weight_version(int(doc["version"]))
-            self.window.observe(latency)
+            ttft = doc.get("ttft_s")
+            self.window.observe(
+                latency, stages=stages,
+                trace=getattr(root, "trace_id", None),
+                req_id=req_id, version=doc.get("version"),
+                ttft_s=float(ttft) if ttft is not None else None)
             extra = {}
             if doc.get("tokens_emitted") is not None:
                 # multi-token responses: the audit line carries how
@@ -493,7 +508,8 @@ class Router:
             self.log.note(req_id, "ok", seq=seq,
                           latency_s=round(latency, 6),
                           replica=doc.get("replica"),
-                          version=doc.get("version"), **extra,
+                          version=doc.get("version"),
+                          stages=doc["stages"], **extra,
                           **tracing.fields(root))
             return doc
         except RequestRejected as e:
@@ -518,9 +534,50 @@ class Router:
                 self._inflight_n -= 1
                 smetrics.set_inflight(self._inflight_n)
 
+    def _close_books(self, t0: float, latency: float, dmeta: dict,
+                     doc: dict) -> dict:
+        """Decompose an accepted request's wall clock into ledger
+        stages (docs/OBSERVABILITY.md "Serving request ledger"):
+        router-side ``admission``/``hedge_wait``/``dispatch`` from the
+        attempt timing ``_dispatch`` reported, merged with the
+        replica/engine stages the response doc carried.  Whatever
+        neither side measured stays ``unattributed`` — the books close
+        on the request's true end-to-end latency, never on a guess."""
+        stages = {k: max(float(v), 0.0)
+                  for k, v in (doc.get("stages") or {}).items()
+                  if isinstance(v, (int, float))}
+        replica_s = sum(stages.values())
+        start = dmeta.get("start", t0)
+        win_launch = dmeta.get("win_launch")
+        if win_launch is not None:
+            first = dmeta.get("first_launch", start)
+            recv = dmeta.get("win_recv", win_launch)
+            stages["admission"] = max(start - t0, 0.0)
+            hedge = max(win_launch - first, 0.0)
+            if hedge > 0:
+                # the winner was a hedge/retry: its launch offset from
+                # the FIRST attempt is time spent waiting out a slow or
+                # dead primary
+                stages["hedge_wait"] = hedge
+            # dispatch = pre-launch prep (arm pick, body build) + the
+            # winning attempt's network/serialization overhead around
+            # the time the replica accounted for itself
+            stages["dispatch"] = max(first - start, 0.0) + max(
+                recv - win_launch - replica_s, 0.0)
+        return ledger.close_books(latency, stages)
+
     def _dispatch(self, req_id: str, payload: dict, deadline_s,
                   root=None, path: str = "/infer",
-                  allow_hedge: bool = True) -> dict:
+                  allow_hedge: bool = True,
+                  meta: Optional[dict] = None) -> dict:
+        # ``meta`` (out-param): attempt timing for the request ledger —
+        # dispatch entry, first-attempt launch, and the WINNING
+        # attempt's launch/receive marks (hedge_wait = winner launch −
+        # first launch; dispatch = prep + network around the winner's
+        # replica time)
+        t_dispatch = time.monotonic()
+        if meta is not None:
+            meta["start"] = t_dispatch
         deadline = time.monotonic() + (
             deadline_s if deadline_s is not None
             else self.default_deadline_s)
@@ -565,6 +622,7 @@ class Router:
         outstanding = 0
         tried = []
         spans = []  # one per attempt, aligned with `tried`
+        launched = []  # launch monotonic marks, aligned with `tried`
 
         def launch():
             nonlocal attempts, outstanding
@@ -580,6 +638,7 @@ class Router:
             # one request fanning out across replicas
             ctx = tracing.child(root, "serving")
             spans.append(ctx)
+            launched.append(time.monotonic())
             self._fire(ep, body, deadline, results, ctx=ctx, path=path)
             return True
 
@@ -597,7 +656,8 @@ class Router:
             timeout = min(self.hedge_s if can_hedge else 0.25,
                           max(deadline - time.monotonic(), 0.01))
             try:
-                ep, code, doc, err = results.get(timeout=timeout)
+                ep, code, doc, err, a_t0, a_recv = \
+                    results.get(timeout=timeout)
             except queue.Empty:
                 if can_hedge:
                     hedged = True
@@ -615,6 +675,12 @@ class Router:
                 continue
             outstanding -= 1
             if code == 200 and isinstance(doc, dict):
+                if meta is not None:
+                    meta.update(
+                        first_launch=launched[0] if launched
+                        else t_dispatch,
+                        win_launch=a_t0, win_recv=a_recv,
+                        attempts=attempts, hedged=hedged)
                 return doc
             if code is not None and 400 <= code < 500 \
                     and code not in (408, 429):

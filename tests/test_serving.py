@@ -618,7 +618,13 @@ def _serving_doc(**over):
     doc = {"bench": "serving", "replicas": 2, "clients": 4,
            "duration_s": 5.0, "requests": 1000, "qps": 200.0,
            "p50_s": 0.002, "p99_s": 0.01, "shed_fraction": 0.0,
-           "failed": 0, "unanswered": 0, "answered_twice": 0}
+           "failed": 0, "unanswered": 0, "answered_twice": 0,
+           # the request ledger's closed books (ISSUE 19): the gate
+           # refuses an artifact without them
+           "stage_seconds": {"forward": 1.6, "queue": 0.3,
+                             "dispatch": 0.05, "unattributed": 0.05},
+           "stage_unattributed_frac": 0.025,
+           "dominant_stage": "forward"}
     doc.update(over)
     return doc
 
@@ -650,6 +656,19 @@ def test_check_bench_serving_gate(tmp_path):
     base = _serving_doc(p99_s=0.005)
     assert check_serving(_serving_doc(p99_s=0.02), base, 0.5)
     assert not check_serving(_serving_doc(p99_s=0.007), base, 0.5)
+    # the ledger's books-close gate (ISSUE 19): missing breakdown and
+    # open books both fail; a closed artifact passes (above)
+    assert check_serving(_serving_doc(stage_seconds=None), None, 0.5)
+    assert check_serving(
+        _serving_doc(stage_unattributed_frac=0.25), None, 0.5)
+    assert check_serving(
+        _serving_doc(stage_unattributed_frac=None), None, 0.5)
+    # percentile replay: a sample that agrees passes, one that says the
+    # artifact's p99 math diverged fails
+    good = _serving_doc(latency_sample=[0.002] * 50 + [0.01] * 5)
+    assert not check_serving(good, None, 0.5)
+    bad = _serving_doc(latency_sample=[0.002] * 55, p99_s=0.2)
+    assert any("replay" in p for p in check_serving(bad, None, 0.5))
     # end to end with a baseline file
     shed = tmp_path / "shed.json"
     shed.write_text(json.dumps(_serving_doc(shed_fraction=0.2)))
