@@ -105,6 +105,16 @@ SEAMS: Dict[str, frozenset] = {
     # (a finite SDC stand-in the guard cannot see but the cross-replica
     # canary must).  Pure signal at the seam: nothing raises here.
     "grad": frozenset({"nan", "inf", "scale"}),
+    # the elastic DRIVER process (docs/CHAOS.md, docs/ELASTIC.md "Driver
+    # failover & takeover"): fired by the driver's own poll loop, one
+    # invocation per poll tick — ``kill``/``exit`` terminate the control
+    # plane mid-flight (the launcher's supervisor respawns it into a
+    # journal takeover), ``stall`` freezes the poll loop so workers must
+    # ride the outage out under HVD_TPU_DRIVER_OUTAGE_GRACE_S.  Driver
+    # rules should leave ``rank`` unset (the driver is not a worker —
+    # only the wildcard matches it) and use ``marker`` for at-most-once
+    # across supervisor respawns.
+    "driver": frozenset({"kill", "stall", "exit"}),
 }
 
 _UNBOUNDED = 2 ** 62

@@ -49,7 +49,8 @@ def retry_call(fn: Callable,
                deadline_s: Optional[float] = None,
                sleep: Callable[[float], None] = time.sleep,
                rng: Optional[random.Random] = None,
-               clock: Callable[[], float] = time.monotonic):
+               clock: Callable[[], float] = time.monotonic,
+               count_exhausted: bool = True):
     """Call ``fn()``; on a transient error, back off and try again.
 
     Args:
@@ -70,6 +71,13 @@ def retry_call(fn: Callable,
         error is raised (counted as exhaustion).  ``None`` = attempts
         alone bound the loop.
       sleep / rng / clock: injectable for tests.
+      count_exhausted: when False, exhaustion skips the
+        ``hvd_retry_exhausted_total`` tick (the attempts metric and the
+        log still land).  For callers whose exhaustion is an EXPECTED
+        outcome of a declared condition — a worker polling through a
+        driver-takeover window under ``HVD_TPU_DRIVER_OUTAGE_GRACE_S``
+        (docs/ELASTIC.md "Driver failover & takeover") — where the alarm
+        metric would be a false positive on every planned takeover.
 
     Raises: the last transient error on exhaustion; non-retryable errors
     immediately.
@@ -98,9 +106,10 @@ def retry_call(fn: Callable,
             over_budget = (deadline_s is not None and
                            clock() - start + delay > deadline_s)
             if last_chance or over_budget:
-                _metric("hvd_retry_exhausted_total",
-                        "retry_call gave up (attempts or deadline spent), "
-                        "per site", site=site)
+                if count_exhausted:
+                    _metric("hvd_retry_exhausted_total",
+                            "retry_call gave up (attempts or deadline "
+                            "spent), per site", site=site)
                 _log_exhausted(site, attempt + 1, clock() - start, e)
                 raise
             sleep(max(delay, 0.0))

@@ -330,6 +330,20 @@ def write_autopsy(out_dir: Optional[str] = None, reason: str = "",
 
     goodput_snap = step(_goodput)
 
+    def _driver_outage():
+        # control-plane health at time of death (docs/ELASTIC.md
+        # "Driver failover & takeover"): if the elastic driver has been
+        # unreachable past the ride-through grace window, THAT is the
+        # headline — the workers are orphaned, not stuck on each other
+        from horovod_tpu.elastic import outage
+        if not outage.enabled() or not outage.active():
+            return None
+        return {"age_s": round(outage.age_s(), 3),
+                "grace_s": outage.grace_s(),
+                "exceeded": outage.exceeded()}
+
+    driver_outage = step(_driver_outage)
+
     def _exemplars():
         # the serving ledger's tail exemplars (docs/OBSERVABILITY.md
         # "Serving request ledger"): the worst requests per latency
@@ -354,6 +368,7 @@ def write_autopsy(out_dir: Optional[str] = None, reason: str = "",
         "actions": actions,
         "profiles": profiles,
         "goodput": goodput_snap,
+        "driver_outage": driver_outage,
         "exemplars": len(exemplar_docs),
         "peers_fetched": fetched,
         "peers_unreachable": unreachable,
@@ -374,6 +389,12 @@ def write_autopsy(out_dir: Optional[str] = None, reason: str = "",
             "autopsy: %d autopilot decision(s) preceded this bundle; "
             "last: %s %s (%s)", len(actions), last.get("policy"),
             last.get("outcome"), last.get("action"))
+    if driver_outage and driver_outage.get("exceeded"):
+        get_logger().error(
+            "autopsy: driver dead > grace (unreachable %.1fs, grace "
+            "%.0fs) — the supervisor is not coming back; see "
+            "docs/TROUBLESHOOTING.md \"My driver died\"",
+            driver_outage["age_s"], driver_outage["grace_s"])
     if suspects:
         top = suspects[0]
         get_logger().error(

@@ -129,6 +129,7 @@ def _world_update(poll: bool = True) -> Optional[dict]:
             return doc
     if not poll:
         return None
+    from horovod_tpu.elastic import outage
     try:
         from horovod_tpu.runner import kv_relay
         # short timeout: commit() must stay cheap even if the driver's
@@ -136,12 +137,55 @@ def _world_update(poll: bool = True) -> Optional[dict]:
         # when enabled (HVD_TPU_KV_RELAY_ARITY): the poll hits an
         # O(arity) parent's cache instead of the root, and degrades to a
         # direct root read when the parent is dead (docs/ELASTIC.md
-        # "Relayed control-plane KV").
+        # "Relayed control-plane KV").  During a driver outage the polls
+        # relabel to their own retry site and stop raising exhausted
+        # alarms: a takeover window is a declared condition, not a fault
+        # (docs/ELASTIC.md "Driver failover & takeover").
         raw = kv_relay.client(addr, int(port)).get(
-            "world", "current", timeout=3.0, site="elastic.world_poll")
+            "world", "current", timeout=3.0,
+            site="elastic.driver_outage" if outage.active()
+            else "elastic.world_poll",
+            count_exhausted=not outage.enabled())
     except OSError:
-        return None  # driver KV transiently unreachable: not our problem
+        # driver KV unreachable: open (or age) the outage window and
+        # keep training on the cached world — ride-through, not escalate
+        outage.note_failure()
+        return None
+    outage.note_success()
     return _validate_doc(raw)
+
+
+def _publish_result() -> None:
+    """Publish this worker's signed completion receipt
+    (``result/<rank>``) to the driver KV.  A takeover driver that
+    ADOPTED an already-running worker (docs/ELASTIC.md "Driver failover
+    & takeover") never sees that worker's exit code — its original
+    parent died with the old driver's process tree — so success is
+    classified from this receipt instead.  HMAC-signed with the world
+    secret: the receipt decides a SUCCESS classification and must not be
+    forgeable by anyone who can reach the KV port.  Best-effort: a
+    worker finishing while no driver is reachable just exits (the
+    takeover driver's backstop classifies it conservatively)."""
+    kv = os.environ.get("HVD_ELASTIC_KV", "")
+    if not kv:
+        return
+    try:
+        import json
+        addr, _, port = kv.rpartition(":")
+        doc = {"rank": rank(),
+               "generation": int(
+                   os.environ.get("HVD_ELASTIC_GENERATION", "0")),
+               "ok": True}
+        secret_hex = os.environ.get("HVD_ELASTIC_SECRET", "")
+        if secret_hex:
+            doc["sig"] = world_doc_signature(
+                bytes.fromhex(secret_hex), doc)
+        from horovod_tpu.runner import kv_relay
+        kv_relay.client(addr, int(port)).put(
+            "result", str(doc["rank"]), json.dumps(doc).encode(),
+            timeout=5.0, site="elastic.result")
+    except (OSError, ValueError):
+        pass
 
 
 def has_pending_update() -> bool:
@@ -537,7 +581,12 @@ def run(func: Callable) -> Callable:
         state.sync()
         while True:
             try:
-                return func(state, *args, **kwargs)
+                result = func(state, *args, **kwargs)
+                # signed completion receipt: how a takeover driver that
+                # adopted this (already running) worker learns the run
+                # SUCCEEDED without ever having seen the exit code
+                _publish_result()
+                return result
             except HorovodInternalError:
                 # re-mesh timeline (docs/OBSERVABILITY.md "Re-mesh
                 # timeline"): the episode opens at the failure and

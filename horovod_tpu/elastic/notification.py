@@ -145,6 +145,18 @@ def _identity():
             os.environ.get("HVD_ELASTIC_GENERATION", "0"))
 
 
+def mark_stale() -> None:
+    """Forget the registered identity WITHOUT tearing the listener down:
+    the next ``ensure_listener`` re-registers the same endpoint.  Called
+    when a driver takeover is detected (``elastic.outage``) — the fresh
+    driver's KV starts with an empty ``notify`` scope, so the old
+    registration exists only in a dead process's memory and pushes would
+    silently stop until the worker re-announced itself."""
+    global _registered_as
+    with _lock:
+        _registered_as = None
+
+
 def current_listener() -> Optional[WorkerNotificationListener]:
     """The already-started listener, or None — never creates one (the
     cheap mid-step probe must not pay bind/registration latency)."""

@@ -128,11 +128,17 @@ class Episode:
                 goodput.note_remesh(total)
             except Exception:
                 pass
+        # a driver takeover that healed inside this episode's window is
+        # part of its story: `history --remesh` marks such episodes, and
+        # the chaos acceptance for the mid-re-mesh driver kill asserts
+        # the timeline shows a TAKEOVER, not a second generation restart
+        took = _spanned_takeover(self.started_at)
         _record_flight("remesh_complete" if complete
                        else "remesh_abandoned",
                        trigger=self.trigger, total_s=round(total, 4),
                        old_size=self.old_size, new_size=self.new_size,
                        generation=self.generation,
+                       **({"takeover": True} if took else {}),
                        **self._trace_fields(),
                        **{f"{k}_s": round(v, 4)
                           for k, v in self.phases.items()})
@@ -146,6 +152,7 @@ class Episode:
                 "old_size": self.old_size, "new_size": self.new_size,
                 "generation": self.generation,
                 "complete": complete,
+                **({"takeover": True} if took else {}),
                 **self._trace_fields()})
         except Exception:
             pass
@@ -182,6 +189,18 @@ class Episode:
                               total, self.trigger, breakdown)
         except Exception:
             pass
+
+
+def _spanned_takeover(started_at: float) -> bool:
+    """True when a driver takeover recovered inside the window that
+    started at ``started_at`` (a ``perf_counter`` stamp): the episode's
+    recovery rode through a control-plane crash."""
+    try:
+        from horovod_tpu.elastic import outage
+        rec = outage.last_recovery_perf()
+        return rec is not None and rec >= started_at
+    except Exception:
+        return False
 
 
 def _record_flight(kind: str, **fields) -> None:

@@ -132,6 +132,22 @@ def render_top(series: Dict[str, float], source: str) -> str:
                     if k.startswith("hvd_remesh_seconds_sum"))
         lines.append(f"re-meshes       : {int(remeshes)} "
                      f"({_fmt_seconds(rsecs)} total recovery)")
+    # control-plane HA (docs/ELASTIC.md "Driver failover & takeover"):
+    # worst-rank outage age, takeover count, journal footprint
+    outage_age = series.get("hvd_driver_outage_seconds")
+    takeovers = series.get("hvd_driver_takeovers_total")
+    jbytes = series.get("hvd_driver_journal_bytes")
+    if outage_age or takeovers or jbytes:
+        line = (f"DRIVER          : outage "
+                f"{_fmt_seconds(outage_age or 0.0)}  "
+                f"takeovers {int(takeovers or 0)}")
+        if jbytes is not None:
+            line += (f"  journal {_fmt_bytes(jbytes)} "
+                     f"({int(series.get('hvd_driver_journal_records', 0))}"
+                     f" records)")
+        if outage_age:
+            line += "  << DRIVER UNREACHABLE"
+        lines.append(line)
     # goodput ledger (docs/OBSERVABILITY.md "Goodput ledger"): the
     # fleet-summed per-category seconds as fractions of accounted wall
     # time, plus the worst rank's productive fraction
@@ -266,11 +282,19 @@ def render_remesh_table(points) -> str:
                            time.localtime(p.get("ts", 0)))
         cells = " ".join(
             f"{_fmt_seconds(phases.get(c)):>14}" for c in REMESH_PHASES)
+        # an episode that healed across a driver takeover is a
+        # control-plane recovery, not a data-plane one — say so
+        trig = str(p.get("trigger", "-"))
+        if p.get("takeover"):
+            trig += "+takeover"
         lines.append(
             f"{ts:<19} {p.get('rank', '-'):>4} "
-            f"{str(p.get('trigger', '-')):<16} {cells} "
+            f"{trig:<16} {cells} "
             f"{_fmt_seconds(p.get('remesh_total_s')):>10}")
-    lines.append(f"-- {len(rows)} re-mesh episode(s)")
+    spanned = sum(1 for p in rows if p.get("takeover"))
+    lines.append(f"-- {len(rows)} re-mesh episode(s)"
+                 + (f", {spanned} spanning a driver takeover"
+                    if spanned else ""))
     return "\n".join(lines)
 
 

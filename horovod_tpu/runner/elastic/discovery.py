@@ -88,12 +88,19 @@ class HostManager:
         self._lock = threading.Lock()
         self._current: Dict[str, int] = {}
         self._blacklist: Dict[str, float] = {}   # host -> listed-at
+        self._block_evidence: Dict[str, dict] = {}  # host -> why
         self._drained: Dict[str, tuple] = {}     # host -> (slots, expiry)
         self._order: List[str] = []   # stable ordering of known hosts
 
-    def blacklist(self, host: str) -> None:
+    def blacklist(self, host: str, evidence: Optional[dict] = None) -> None:
+        """``evidence`` is the decision record — what convinced the
+        driver this host is bad (failure counts, quarantine finding...).
+        It rides into the control-plane journal so a takeover driver can
+        show WHY a host is excluded, not just that it is."""
         with self._lock:
             self._blacklist[host] = time.monotonic()
+            if evidence is not None:
+                self._block_evidence[host] = dict(evidence)
 
     def is_blacklisted(self, host: str) -> bool:
         with self._lock:
@@ -137,6 +144,7 @@ class HostManager:
         for host in [h for h, at in self._blacklist.items()
                      if cooldown > 0 and now - at >= cooldown]:
             del self._blacklist[host]
+            self._block_evidence.pop(host, None)
             try:
                 from horovod_tpu.common.logging import get_logger
                 get_logger().info(
@@ -176,3 +184,64 @@ class HostManager:
     def slot_count(self) -> int:
         with self._lock:
             return sum(self._current.values())
+
+    # -- takeover persistence -------------------------------------------------
+    def dump_state(self) -> Dict[str, dict]:
+        """Exclusion state as WALL-clock-stamped plain data for the
+        control-plane journal.  Monotonic stamps are process-local and
+        meaningless to a takeover driver, so each entry converts to the
+        wall clock at dump time; :meth:`restore_state` re-ages them back
+        to this semantics in the new process.  Format:
+        ``{"blocklist": {host: {"ts": wall, "evidence": {...}}},
+        "drains": {host: {"slots": n, "remaining_s": secs, "ts": wall}}}``
+        """
+        now_mono = time.monotonic()
+        now_wall = time.time()
+        with self._lock:
+            return {
+                "blocklist": {
+                    h: {"ts": now_wall - (now_mono - at),
+                        "evidence": self._block_evidence.get(h)}
+                    for h, at in self._blacklist.items()},
+                "drains": {
+                    h: {"slots": slots,
+                        "remaining_s": max(0.0, exp - now_mono),
+                        "ts": now_wall}
+                    for h, (slots, exp) in self._drained.items()},
+            }
+
+    def restore_state(self, blocklist: Dict[str, dict],
+                      drains: Dict[str, dict]) -> None:
+        """Re-adopt journaled exclusion state (takeover).  Wall stamps
+        re-age onto this process's monotonic clock: a host blocklisted
+        9 minutes before the old driver died, restored 30s later under a
+        10-minute cooldown, is re-admitted in ~2.5 minutes — NOT given a
+        fresh 10 minutes (the cooldown promise is to the host, not the
+        process).  Drain reservations restore only their remaining
+        window, aged by the wall time since the dump."""
+        now_mono = time.monotonic()
+        now_wall = time.time()
+        with self._lock:
+            for host, rec in blocklist.items():
+                elapsed = max(0.0, now_wall - float(rec.get("ts",
+                                                            now_wall)))
+                self._blacklist[host] = now_mono - elapsed
+                ev = rec.get("evidence")
+                if ev is not None:
+                    self._block_evidence[host] = dict(ev)
+            for host, rec in drains.items():
+                elapsed = max(0.0, now_wall - float(rec.get("ts",
+                                                            now_wall)))
+                remaining = float(rec.get("remaining_s", 0.0)) - elapsed
+                if remaining <= 0:
+                    continue  # the reservation expired during the outage
+                slots = int(rec.get("slots", 0))
+                prev_slots, prev_exp = self._drained.get(host, (0, 0.0))
+                live = prev_slots if prev_exp > now_mono else 0
+                self._drained[host] = (max(live, slots),
+                                       now_mono + remaining)
+
+    def block_evidence(self, host: str) -> Optional[dict]:
+        with self._lock:
+            ev = self._block_evidence.get(host)
+            return dict(ev) if ev is not None else None

@@ -42,13 +42,16 @@ import time
 from typing import Dict, List, Optional
 
 from horovod_tpu.common.logging import get_logger
+from horovod_tpu.runner.elastic import journal as journal_mod
 from horovod_tpu.runner.elastic.discovery import HostDiscovery, HostManager
 from horovod_tpu.runner.elastic.registration import (DRAINED, FAILURE,
                                                      SUCCESS, TERMINATED,
                                                      WorkerStateRegistry)
 from horovod_tpu.runner.exec_run import (free_port, slot_command)
-from horovod_tpu.runner.hosts import HostInfo, get_host_assignments
-from horovod_tpu.runner.safe_exec import safe_execute
+from horovod_tpu.runner.hosts import (HostInfo, SlotInfo,
+                                      get_host_assignments)
+from horovod_tpu.runner.safe_exec import (GRACEFUL_TERMINATION_TIME_S,
+                                          safe_execute)
 
 DISCOVERY_INTERVAL_S = 1.0
 
@@ -73,6 +76,19 @@ def drain_cooldown_s() -> float:
     the growth path re-spawns onto it)."""
     from horovod_tpu.common.config import env_float
     return max(0.0, env_float("DRAIN_COOLDOWN_S", 60.0))
+
+
+def takeover_settle_s() -> float:
+    """``HVD_TPU_DRIVER_TAKEOVER_SETTLE_S``: how long a takeover driver
+    holds OFF recovery planning while adopted survivors re-register
+    their elastic listeners.  The takeover KV starts with an empty
+    ``notify`` scope — every registration the old driver held died with
+    it — so a recovery planned in the first ticks would flunk the
+    viability check and burn a generation restart, the exact outcome the
+    takeover exists to avoid.  Survivors re-register at their next
+    commit; the window only needs to outlast one step interval."""
+    from horovod_tpu.common.config import env_float
+    return max(0.0, env_float("DRIVER_TAKEOVER_SETTLE_S", 30.0))
 
 
 def restart_cooldown_s() -> float:
@@ -167,6 +183,17 @@ class _GenRuntime:
 _ACTION_KINDS = {"drain": True, "restart": False, "quarantine": True}
 
 
+def _finished_thread() -> threading.Thread:
+    """A dead, already-joined Thread object.  Takeover rebuilds preload
+    journal-replayed exits into ``_GenRuntime.threads`` — membership
+    code indexes ``threads[k].is_alive()`` without a guard, so every
+    bookkept key needs a Thread whose liveness answers correctly."""
+    t = threading.Thread(target=lambda: None, daemon=True)
+    t.start()
+    t.join()
+    return t
+
+
 class ElasticDriver:
     def __init__(self, discovery: HostDiscovery, command: List[str],
                  min_np: int = 1, max_np: Optional[int] = None,
@@ -179,7 +206,9 @@ class ElasticDriver:
                  world_secret: Optional[bytes] = None,
                  timestamp_output: bool = False,
                  start_timeout: Optional[float] = None,
-                 elastic_timeout: Optional[float] = None) -> None:
+                 elastic_timeout: Optional[float] = None,
+                 journal_dir: Optional[str] = None,
+                 takeover: bool = False) -> None:
         # remote_exec(slot, command, worker_env, events) -> rc replaces the
         # local/ssh exec when the cluster reaches hosts another way — e.g.
         # Spark tasks acting as host agents (spark/elastic.py). The
@@ -216,7 +245,6 @@ class ElasticDriver:
         import socket as _socket
         from horovod_tpu.runner.http_kv import KVStoreServer
         self._kv = KVStoreServer()
-        self._kv.start()
         self._world_secret = self._preshared_secret or \
             _secrets.token_bytes(16)
         # the KV runs on THIS driver machine; remote workers need an
@@ -224,6 +252,144 @@ class ElasticDriver:
         # not getfqdn: the latter can resolve to 'localhost' → ::1 while
         # the KV server is IPv4-only (see spark/elastic.py kv_addr)
         self._driver_addr = _socket.gethostname()
+        # -- control-plane journal + crash takeover (docs/ELASTIC.md
+        # "Driver failover & takeover") --------------------------------
+        self._journal: Optional[journal_mod.DriverJournal] = None
+        self._replay: Optional[journal_mod.ReplayState] = None
+        self._takeover = bool(takeover)
+        self._poll_tick = 0
+        # rank -> addr last journaled as a "notify" record; the poll
+        # loop journals only registration CHANGES, not every tick
+        self._journaled_notify: Dict[str, str] = {}
+        jd = journal_dir or journal_mod.journal_dir()
+        kv_port: Optional[int] = None
+        if self._takeover:
+            if not jd:
+                raise journal_mod.TakeoverRefused(
+                    "takeover requested but no journal directory is "
+                    "configured: set HVD_TPU_DRIVER_JOURNAL_DIR "
+                    "(docs/ELASTIC.md 'Driver failover & takeover')")
+            state = journal_mod.load(
+                os.path.join(jd, journal_mod.JOURNAL_NAME))
+            state.check_takeover()  # TakeoverRefused propagates: the
+            # supervisor/operator falls back to the generation-restart
+            # backstop instead of risking a stale world
+            self._replay = state
+            meta = state.meta
+            # the fleet's worker envs carry the OLD secret/ckpt/address:
+            # the takeover driver must become that identity, not mint a
+            # fresh one the workers would reject
+            if meta.get("secret") and self._preshared_secret is None:
+                self._world_secret = bytes.fromhex(meta["secret"])
+            if meta.get("ckpt_dir"):
+                self._ckpt_dir = meta["ckpt_dir"]
+            if meta.get("driver_addr"):
+                self._driver_addr = meta["driver_addr"]
+            if meta.get("kv_port"):
+                kv_port = int(meta["kv_port"])
+            self._generation = state.world_gen + 1
+        if jd:
+            self._journal = journal_mod.DriverJournal(jd)
+            # WAL worker listener registrations AS THEY ARRIVE: the poll
+            # loop may be stalled (or die this very tick) between a
+            # worker's first commit and the next tick, and a
+            # registration the journal never saw is a registration the
+            # takeover driver cannot restore
+            self._kv.on_put = self._observe_kv_put
+        # rebinds the previously advertised port on takeover (workers
+        # keep polling driver_addr:kv_port; SO_REUSEADDR rides out the
+        # dead listener's TIME_WAIT)
+        self._kv.start(port=kv_port)
+        if self._journal is not None and not self._takeover:
+            self._journal.append(
+                "job_open", secret=self._world_secret.hex(),
+                kv_port=self._kv.port, driver_addr=self._driver_addr,
+                ckpt_dir=self._ckpt_dir, min_np=self._min_np,
+                max_np=self._max_np, target_np=self._target_np,
+                pid=os.getpid(), ts=journal_mod.now_wall())
+        self._init_driver_chaos()
+
+    # -- journal plumbing ----------------------------------------------------
+    def _journal_append(self, rtype: str, critical: bool = False,
+                        **fields) -> None:
+        """Write-ahead append; no-op without a journal.  ``critical``
+        records (world publishes, takeover stamps) propagate I/O
+        failure — a driver that cannot journal the decisions a takeover
+        depends on must not keep making them; everything else degrades
+        to a warning (losing a spawn pid costs the takeover an adopted
+        monitor, not correctness)."""
+        if self._journal is None:
+            return
+        try:
+            self._journal.append(rtype, **fields)
+        except Exception:
+            if critical:
+                raise
+            get_logger().warning(
+                "driver journal append (%s) failed", rtype, exc_info=True)
+
+    def _observe_kv_put(self, scope: str, key: str,
+                        value: bytes) -> None:
+        """KV write observer (HTTP PUT path, called before the 200):
+        journals worker listener registrations synchronously so they
+        are durable the moment the worker is told they took."""
+        if scope != "notify" or self._journal is None:
+            return
+        addr = value.decode("utf-8", errors="replace") \
+            if isinstance(value, (bytes, bytearray)) else str(value)
+        if self._journaled_notify.get(str(key)) != addr:
+            self._journal_append("notify", rank=str(key), addr=addr)
+            self._journaled_notify[str(key)] = addr
+
+    def _journal_notify_observations(self) -> None:
+        """Journal worker listener registrations as the poll loop sees
+        them land in the ``notify`` scope.  A worker whose in-flight KV
+        get simply retried across a short driver outage never observes
+        the takeover and never re-registers — the journal is the only
+        place the registration survives, and a takeover driver restores
+        it so in-place recovery stays viable (docs/ELASTIC.md "Driver
+        failover & takeover")."""
+        if self._journal is None:
+            return
+        for rank, raw in self._kv.scope("notify").items():
+            addr = raw.decode("utf-8", errors="replace") \
+                if isinstance(raw, (bytes, bytearray)) else str(raw)
+            if self._journaled_notify.get(str(rank)) != addr:
+                self._journal_append("notify", rank=str(rank), addr=addr)
+                self._journaled_notify[str(rank)] = addr
+
+    def _journal_token(self, token) -> None:
+        """Journal a handled drain-notice/action token so a takeover
+        driver never re-handles a request the dead driver already acted
+        on (or deliberately burned)."""
+        scope, key, raw = token
+        self._journal_append(
+            "token", scope=scope, key=key,
+            raw=raw.decode("utf-8", errors="replace")
+            if isinstance(raw, (bytes, bytearray)) else str(raw))
+
+    def _init_driver_chaos(self) -> None:
+        """Arm ONLY the fault plan's ``driver``-seam rules, in a private
+        engine.  The module-level ``chaos.install()`` is the workers':
+        its rules default to every rank and ``_env_rank()`` resolves to
+        0 in this process, so installing globally here would fire
+        worker-targeted faults inside the control plane.  A typo'd plan
+        raises ``FaultPlanError`` out of the constructor — a chaos run
+        must fail loudly, not run fault-free."""
+        self._chaos = None
+        from horovod_tpu.chaos.plan import load_plan_from_env
+        plan = load_plan_from_env()
+        if plan is None:
+            return
+        rules = [r for r in plan.rules if r.seam == "driver"]
+        if not rules:
+            return
+        import dataclasses as _dc
+        from horovod_tpu.chaos import ChaosEngine
+        self._chaos = ChaosEngine(_dc.replace(plan, rules=rules), rank=0)
+        get_logger().warning(
+            "chaos: %d driver-seam rule(s) armed in the elastic driver",
+            len(rules))
 
     # -- discovery thread (reference: driver.py:181-201) --------------------
     def _discovery_loop(self) -> None:
@@ -265,7 +431,7 @@ class ElasticDriver:
 
     def _publish_world(self, gen: int, slots, coord_addr: str,
                        coord_port: int, keyed_slots=None,
-                       extra=None) -> None:
+                       extra=None, runtime=None) -> None:
         """Publish a signed world doc. ``slots`` keys the doc by each
         slot's own (stable) rank — the growth case. ``keyed_slots``
         overrides with an explicit ``{lookup_rank: env}`` mapping — the
@@ -273,7 +439,13 @@ class ElasticDriver:
         rank but adopt a smaller new one from the env.  ``extra`` merges
         additional signed fields into the doc (the ``drain`` stamp of a
         planned preemption re-mesh, which survivors use to label their
-        re-mesh episode ``preemption_drain``)."""
+        re-mesh episode ``preemption_drain``).  ``runtime`` is the
+        post-publish generation bookkeeping (:meth:`_runtime_record`)
+        journaled WITH the doc — the write-ahead rule: the fsync'd
+        journal line lands BEFORE the KV put, so the journal is always
+        at least as new as anything the fleet saw and a takeover can
+        complete an interrupted publish but never resurrect a stale
+        world."""
         import json
         from horovod_tpu.elastic import world_doc_signature
         doc = {"generation": gen, "size": len(slots),
@@ -284,8 +456,44 @@ class ElasticDriver:
             doc.update(extra)
         doc["sig"] = world_doc_signature(self._world_secret, doc)
         body = json.dumps(doc).encode()
+        if self._journal is not None:
+            self._journal_append("world_publish", critical=True,
+                                 doc=doc, **(runtime or {}))
+            try:
+                # world-publish boundaries are the one safe compaction
+                # point: the canonical record set re-emits this world
+                self._journal.maybe_compact()
+            except Exception:
+                get_logger().warning("driver journal compaction failed",
+                                     exc_info=True)
         self._kv.put("world", "current", body)
         self._push_world(body)
+
+    @staticmethod
+    def _runtime_record(gen: int, slots, coord_addr: str, coord_port: int,
+                        essential_keys, current_rank, numbering_gen: int,
+                        essential_gen: int, expected_exits=(),
+                        drained_exits=()) -> dict:
+        """The generation bookkeeping a ``world_publish`` record carries
+        — everything :meth:`_rebuild_generation` needs to reconstruct a
+        live :class:`_GenRuntime` without guessing.  Pure JSON-able
+        data: (gen, rank) key tuples become 2-lists, slots become their
+        dataclass dicts."""
+        import dataclasses as _dc
+        return {
+            "world_gen": gen,
+            "numbering_gen": numbering_gen,
+            "essential_gen": essential_gen,
+            "np": len(slots),
+            "coord_addr": coord_addr,
+            "coord_port": coord_port,
+            "slots": [_dc.asdict(s) for s in slots],
+            "essential_keys": [list(k) for k in essential_keys],
+            "current_rank": [[list(k), r]
+                             for k, r in current_rank.items()],
+            "expected_exits": [list(k) for k in expected_exits],
+            "drained_exits": [list(k) for k in drained_exits],
+        }
 
     def _push_world(self, body: bytes) -> None:
         """Push the published doc to every registered worker listener
@@ -320,7 +528,7 @@ class ElasticDriver:
     def _try_inplace_recovery(self, survivors, results, threads,
                               slot_by_key, current_rank, target_np,
                               host_crashes, charge_reset=True,
-                              drain=None):
+                              drain=None, gen_runtime=None):
         """A worker died mid-generation: publish a new world around the
         SURVIVORS so they re-rendezvous IN PLACE (params stay in host
         memory, PIDs unchanged — reference: the reset loop after
@@ -387,6 +595,11 @@ class ElasticDriver:
             # charged only once viability is established — a non-viable
             # attempt already pays for its generation restart
             self._registry.note_reset()
+            # the --reset-limit budget belongs to the JOB: journaled so
+            # a takeover driver inherits the spent count instead of
+            # handing a crash-looping worker a fresh allowance
+            self._journal_append("reset",
+                                 count=self._registry.reset_count)
             if self._registry.reset_limit_reached():
                 get_logger().info("in-place recovery not viable: reset "
                                   "limit reached")
@@ -441,8 +654,25 @@ class ElasticDriver:
             ctx = tracing.new_trace("elastic")
             if ctx is not None:
                 extra["traceparent"] = ctx.traceparent
+        # journaled runtime: the post-recovery world's bookkeeping —
+        # survivors under their NEW ranks plus the replacements the
+        # caller is about to spawn (exactly what the caller sets as
+        # essential_keys after we return)
+        essential2 = sorted(survivors, key=lambda k: current_rank[k]) + \
+            [(gen, s.rank) for s in replacements]
+        cr2 = {k: current_rank[k] for k in survivors}
+        cr2.update({(gen, s.rank): s.rank for s in replacements})
+        if gen_runtime is not None:
+            with gen_runtime.fail_lock:
+                exp = set(gen_runtime.expected_exits)
+                drn = set(gen_runtime.drained_exits)
+        else:
+            exp, drn = set(), set()
         self._publish_world(gen, new_slots, coord_addr, coord_port,
-                            keyed_slots=keyed, extra=extra or None)
+                            keyed_slots=keyed, extra=extra or None,
+                            runtime=self._runtime_record(
+                                gen, new_slots, coord_addr, coord_port,
+                                essential2, cr2, gen, gen, exp, drn))
         # driver-side half of the re-mesh timeline: the survivors
         # measure their own phases (hvd_remesh_seconds); the driver
         # stamps WHEN it published the recovery world, so a merged
@@ -459,6 +689,7 @@ class ElasticDriver:
         # re-register at their first commit in the new world, and a crash
         # BEFORE that commit conservatively takes the restart path
         self._kv.clear("notify")
+        self._journaled_notify.clear()
         # so are drain notices: a notice names the rank its publisher
         # held in the OLD numbering — left behind, an unhandled notice
         # would match whichever innocent worker inherits that rank
@@ -467,6 +698,10 @@ class ElasticDriver:
         # rank an action targets is only meaningful in the numbering
         # whose finding fired it
         self._kv.clear("action")
+        # completion receipts are stamped with rank + generation: after
+        # a renumbering publish a stale receipt could name an innocent
+        # worker's new rank, so they die with the old numbering too
+        self._kv.clear("result")
         return new_slots, gen, replacements, coord_addr, coord_port
 
     # -- drain notices & autopilot actions (poll-loop handlers) -------------
@@ -504,6 +739,7 @@ class ElasticDriver:
                 ngen = int(doc.get("generation", -1))
             except (ValueError, TypeError):
                 g.handled_tokens.add(token)  # never retried
+                self._journal_token(token)
                 get_logger().warning(
                     "ignoring malformed %s %r", label, key)
                 continue
@@ -516,6 +752,7 @@ class ElasticDriver:
                  and g.threads[k].is_alive()), None)
             if origin is None:
                 g.handled_tokens.add(token)
+                self._journal_token(token)
                 continue  # already gone or renumbered: stale
             out.append((token, doc, origin, nrank))
         return out
@@ -568,6 +805,7 @@ class ElasticDriver:
             kind = req.get("action")
             if kind not in _ACTION_KINDS:
                 g.handled_tokens.add(token)
+                self._journal_token(token)
                 get_logger().warning(
                     "ignoring autopilot action %r with unknown kind %r",
                     token[1], kind)
@@ -640,6 +878,11 @@ class ElasticDriver:
             # (a drain's host announced its own death; a restart's is
             # healthy and re-admits within seconds)
             self._hosts.drain(h, n, cooldown)
+            # wall-stamped so a takeover restores only the REMAINING
+            # window (discovery.restore_state re-ages it)
+            self._journal_append("drain", host=h, slots=n,
+                                 remaining_s=cooldown,
+                                 ts=journal_mod.now_wall())
         with g.fail_lock:
             # BEFORE the publish (same reason as the shrink path): the
             # doomed worker can read the pushed doc and exit before
@@ -669,7 +912,8 @@ class ElasticDriver:
                    "sources": sorted({m["source"]
                                       for m in notice_meta}),
                    **({"traceparent": hctx.traceparent}
-                      if hctx is not None else {})})
+                      if hctx is not None else {})},
+            gen_runtime=g)
         if recovered is None:
             # no viable planned world (the doomed host was the last
             # one, min_np would be violated, or a completion race): the
@@ -693,6 +937,7 @@ class ElasticDriver:
                     g.worker_lost.set()
             for h, n in by_host.items():
                 self._hosts.undrain(h, n)
+                self._journal_append("undrain", host=h, slots=n)
             # un-burn the requests: the world can BECOME viable
             # (discovery adds a host) before the doomed worker dies,
             # and a drain watcher is latched after its one publish —
@@ -708,6 +953,13 @@ class ElasticDriver:
                 "backoff, reactive recovery covers an actual death",
                 event_kind, notice_meta)
             return "retry"
+        # the tokens are journaled only now that their planned world is
+        # COMMITTED (journal + publish): journaling them earlier would
+        # let a takeover believe a notice was handled when no world was
+        # ever published for it — the worker would then die reactively,
+        # which is exactly the fallback the reactive path covers
+        for t in tokens:
+            self._journal_token(t)
         # rebind the coordinator BEFORE spawning: run_slot reads the
         # runtime's coord fields at call time, and a replacement
         # pointed at the dead world's port would never find the mesh
@@ -752,7 +1004,13 @@ class ElasticDriver:
                 from horovod_tpu.diagnostics.flight_recorder import (
                     record_event)
                 for m in meta:
-                    self._hosts.blacklist(m["host"])
+                    ev = {"reason": "quarantine", "rank": m["rank"],
+                          "policy": m.get("policy"),
+                          "evidence": m.get("evidence")}
+                    self._hosts.blacklist(m["host"], evidence=ev)
+                    self._journal_append("blocklist", host=m["host"],
+                                         evidence=ev,
+                                         ts=journal_mod.now_wall())
                     record_event("quarantine_blocklisted",
                                  host=m["host"], rank=m["rank"],
                                  policy=m.get("policy"),
@@ -794,7 +1052,7 @@ class ElasticDriver:
             g.host_crashes[h] = g.host_crashes.get(h, 0) + 1
         recovered = self._try_inplace_recovery(
             survivors, g.results, g.threads, g.slot_by_key,
-            g.current_rank, g.np, g.host_crashes)
+            g.current_rank, g.np, g.host_crashes, gen_runtime=g)
         if recovered is None:
             g.failure.set()  # not viable: generation-restart path
             return
@@ -852,7 +1110,7 @@ class ElasticDriver:
             recovered = self._try_inplace_recovery(
                 kept, g.results, g.threads, g.slot_by_key,
                 g.current_rank, new_np, g.host_crashes,
-                charge_reset=False)
+                charge_reset=False, gen_runtime=g)
             if recovered is None:
                 g.teardown.set()
                 return
@@ -886,7 +1144,19 @@ class ElasticDriver:
         get_logger().info(
             "elastic generation %d (growth, in-place): np=%d->%d",
             gen, g.np, new_np)
-        self._publish_world(gen, new_slots, g.coord_addr, g.coord_port)
+        # growth keeps the numbering: the runtime's current_rank simply
+        # extends with the about-to-be-spawned slots' keys
+        cr = dict(g.current_rank)
+        cr.update({(gen, s.rank): s.rank for s in new_slots[g.np:]})
+        with g.fail_lock:
+            exp = set(g.expected_exits)
+            drn = set(g.drained_exits)
+        self._publish_world(gen, new_slots, g.coord_addr, g.coord_port,
+                            runtime=self._runtime_record(
+                                gen, new_slots, g.coord_addr,
+                                g.coord_port, g.essential_keys, cr,
+                                g.numbering_gen, g.essential_gen,
+                                exp, drn))
         g.world_gen = gen  # survivors adopt this gen; notices carry it
         for s in new_slots[g.np:]:
             g.spawn(s, gen)
@@ -906,12 +1176,14 @@ class ElasticDriver:
         coord_addr = "127.0.0.1" if slots[0].hostname in (
             "localhost", "127.0.0.1") else slots[0].hostname
         self._registry.reset(np)
+        self._journal_append("reset", count=self._registry.reset_count)
         # drop listener registrations from the previous generation: its
         # processes are gone, and pushing signed world docs at dead (or
         # recycled) host:port addresses wastes a thread per publish and
         # could hand the doc to an unrelated process. This generation's
         # workers re-register at their first commit.
         self._kv.clear("notify")
+        self._journaled_notify.clear()
         # stale drain notices die with their generation too: the rank a
         # notice names is only meaningful in the world that published it,
         # and the doomed HOST is already held out by its HostManager
@@ -921,98 +1193,144 @@ class ElasticDriver:
         # rank a request targets is only meaningful in the world whose
         # finding fired it
         self._kv.clear("action")
+        # completion receipts are per-generation too (rank + generation
+        # stamped): a stale one must not vouch for this world's workers
+        self._kv.clear("result")
         self._hosts_changed.clear()
         gen = self._generation
         self._generation += 1
         get_logger().info("elastic generation %d: np=%d hosts=%s", gen, np,
                           [h.hostname for h in hosts])
-        self._publish_world(gen, slots, coord_addr, coord_port)
+        self._publish_world(gen, slots, coord_addr, coord_port,
+                            runtime=self._runtime_record(
+                                gen, slots, coord_addr, coord_port,
+                                [(gen, s.rank) for s in slots],
+                                {(gen, s.rank): s.rank for s in slots},
+                                gen, gen))
 
         g = _GenRuntime(slots, gen, coord_addr, coord_port)
-
-        def run_slot(slot, slot_gen):
-            extra_env = {
-                "HVD_TPU_ELASTIC": "1",
-                "HVD_ELASTIC_GENERATION": str(slot_gen),
-                "HVD_ELASTIC_CKPT": self._ckpt_dir,
-                "HVD_ELASTIC_SECRET": self._world_secret.hex(),
-                "HVD_ELASTIC_KV": f"127.0.0.1:{self._kv.port}"
-                if slot.hostname in ("localhost", "127.0.0.1")
-                else f"{self._driver_addr}:{self._kv.port}"}
-            prefix = f"[{slot.rank}]" if self._verbose else ""
-            if self._remote_exec is not None:
-                # agent transport: ship the RAW worker command + env; the
-                # agent on slot.hostname execs it locally (no ssh wrap)
-                from horovod_tpu.runner.exec_run import build_worker_env
-                wenv = build_worker_env(slot, g.coord_addr, g.coord_port,
-                                        self._env)
-                wenv.update(extra_env)
-                if self._preshared_secret is not None:
-                    # the caller distributed the secret over its own
-                    # trusted channel; keep it off the wire
-                    wenv.pop("HVD_ELASTIC_SECRET", None)
-                rc = self._remote_exec(slot, self._command, wenv,
-                                       [g.failure, g.teardown])
-            else:
-                # local-vs-ssh dispatch shared with the static launcher so
-                # multi-host elastic jobs actually place workers remotely
-                cmd, env = slot_command(
-                    slot, self._command, g.coord_addr, g.coord_port,
-                    self._env, extra_env=extra_env)
-                rc = safe_execute(cmd, env=env, prefix=prefix,
-                                  events=[g.failure, g.teardown],
-                                  timestamp=self._timestamp_output)
-            key = (slot_gen, slot.rank)
-            if rc == 0:
-                g.results[key] = SUCCESS
-                self._registry.record(slot.rank, slot.hostname, SUCCESS)
-                return
-            # Distinguish the ORIGINATING failure from its fallout:
-            # workers the driver tore down, and CASUALTIES — workers that
-            # died from the collective error the originator caused (a job
-            # without elastic state has no way to ride out a peer loss).
-            # Only the originator counts as FAILURE, so the blacklist and
-            # the restart decision see one crash, not a cascade. A crash
-            # does not fail the generation outright anymore: the main
-            # loop first tries to recover the world in place.
-            with g.fail_lock:
-                torn_down = g.failure.is_set() or g.teardown.is_set()
-                expected = key in g.expected_exits
-                casualty = bool(g.lost_keys) and not torn_down \
-                    and not expected
-                if not torn_down and not expected:
-                    g.lost_keys.add(key)
-                    if not casualty:
-                        g.originators.add(key)
-                    g.worker_lost.set()
-                # classification is atomic with the membership checks:
-                # _plan_world_out's no-viable-world revert edits these
-                # sets under the same lock and must observe either a
-                # fully recorded exit or none at all
-                if key in g.drained_exits:
-                    state = DRAINED
-                elif torn_down or casualty or expected:
-                    state = TERMINATED
-                else:
-                    state = FAILURE
-                g.results[key] = state
-            self._registry.record(slot.rank, slot.hostname, state)
-
-        def spawn(slot, slot_gen):
-            key = (slot_gen, slot.rank)
-            t = threading.Thread(target=run_slot, args=(slot, slot_gen),
-                                 daemon=True)
-            g.threads[key] = t
-            g.slot_by_key[key] = slot
-            g.current_rank[key] = slot.rank
-            t.start()
-
-        g.spawn = spawn
+        g.spawn = lambda slot, slot_gen: self._spawn_worker(
+            g, slot, slot_gen)
         for s in slots:
-            spawn(s, gen)
+            g.spawn(s, gen)
+        return self._monitor_generation(g)
 
+    def _run_slot(self, g: _GenRuntime, slot, slot_gen: int) -> None:
+        key = (slot_gen, slot.rank)
+        extra_env = {
+            "HVD_TPU_ELASTIC": "1",
+            "HVD_ELASTIC_GENERATION": str(slot_gen),
+            "HVD_ELASTIC_CKPT": self._ckpt_dir,
+            "HVD_ELASTIC_SECRET": self._world_secret.hex(),
+            "HVD_ELASTIC_KV": f"127.0.0.1:{self._kv.port}"
+            if slot.hostname in ("localhost", "127.0.0.1")
+            else f"{self._driver_addr}:{self._kv.port}"}
+        prefix = f"[{slot.rank}]" if self._verbose else ""
+
+        def note_pid(pid):
+            # the journaled pid is what lets a takeover driver ADOPT
+            # this worker: monitor its liveness, and kill its process
+            # group if the generation must die
+            self._journal_append("spawn", key=list(key),
+                                 host=slot.hostname, rank=slot.rank,
+                                 pid=pid, ts=journal_mod.now_wall())
+
+        if self._remote_exec is not None:
+            # agent transport: ship the RAW worker command + env; the
+            # agent on slot.hostname execs it locally (no ssh wrap).
+            # The remote pid is unknowable here — journaled as None, so
+            # a takeover waits on the worker's completion receipt
+            # instead of a liveness probe (documented limitation).
+            note_pid(None)
+            from horovod_tpu.runner.exec_run import build_worker_env
+            wenv = build_worker_env(slot, g.coord_addr, g.coord_port,
+                                    self._env)
+            wenv.update(extra_env)
+            if self._preshared_secret is not None:
+                # the caller distributed the secret over its own
+                # trusted channel; keep it off the wire
+                wenv.pop("HVD_ELASTIC_SECRET", None)
+            rc = self._remote_exec(slot, self._command, wenv,
+                                   [g.failure, g.teardown])
+        else:
+            # local-vs-ssh dispatch shared with the static launcher so
+            # multi-host elastic jobs actually place workers remotely
+            cmd, env = slot_command(
+                slot, self._command, g.coord_addr, g.coord_port,
+                self._env, extra_env=extra_env)
+            rc = safe_execute(cmd, env=env, prefix=prefix,
+                              events=[g.failure, g.teardown],
+                              timestamp=self._timestamp_output,
+                              on_start=note_pid)
+        self._classify_exit(g, slot, key, rc)
+
+    def _classify_exit(self, g: _GenRuntime, slot, key: tuple,
+                       rc: int) -> None:
+        """Record one worker exit.  Distinguishes the ORIGINATING
+        failure from its fallout: workers the driver tore down, and
+        CASUALTIES — workers that died from the collective error the
+        originator caused (a job without elastic state has no way to
+        ride out a peer loss).  Only the originator counts as FAILURE,
+        so the blacklist and the restart decision see one crash, not a
+        cascade.  A crash does not fail the generation outright: the
+        monitor loop first tries to recover the world in place."""
+        if rc == 0:
+            g.results[key] = SUCCESS
+            self._registry.record(slot.rank, slot.hostname, SUCCESS)
+            self._journal_append("exit", key=list(key), state=SUCCESS,
+                                 rank=slot.rank, host=slot.hostname)
+            return
+        with g.fail_lock:
+            torn_down = g.failure.is_set() or g.teardown.is_set()
+            expected = key in g.expected_exits
+            casualty = bool(g.lost_keys) and not torn_down \
+                and not expected
+            if not torn_down and not expected:
+                g.lost_keys.add(key)
+                if not casualty:
+                    g.originators.add(key)
+                g.worker_lost.set()
+            # classification is atomic with the membership checks:
+            # _plan_world_out's no-viable-world revert edits these
+            # sets under the same lock and must observe either a
+            # fully recorded exit or none at all
+            if key in g.drained_exits:
+                state = DRAINED
+            elif torn_down or casualty or expected:
+                state = TERMINATED
+            else:
+                state = FAILURE
+            g.results[key] = state
+        self._registry.record(slot.rank, slot.hostname, state)
+        self._journal_append("exit", key=list(key), state=state,
+                             rank=slot.rank, host=slot.hostname)
+
+    def _spawn_worker(self, g: _GenRuntime, slot, slot_gen: int) -> None:
+        key = (slot_gen, slot.rank)
+        t = threading.Thread(target=self._run_slot,
+                             args=(g, slot, slot_gen), daemon=True)
+        g.threads[key] = t
+        g.slot_by_key[key] = slot
+        g.current_rank[key] = slot.rank
+        t.start()
+
+    def _monitor_generation(self, g: _GenRuntime) -> str:
+        """The generation's poll loop + final classification — split
+        from :meth:`_run_generation` so a takeover driver can resume
+        monitoring a REBUILT generation without re-spawning it."""
         while any(t.is_alive() for t in g.threads.values()):
             time.sleep(0.25)
+            self._poll_tick += 1
+            if self._chaos is not None:
+                # the `driver` chaos seam: one invocation per poll tick
+                # (kill/exit end this process mid-decision — the
+                # supervisor respawns into a journal takeover; stall
+                # freezes the control plane while workers ride it out)
+                self._chaos.fire("driver", index=self._poll_tick)
+            # WAL the listener registrations this tick observes: a
+            # takeover driver restores them, because a survivor that
+            # never noticed the outage will never re-register on its own
+            self._journal_notify_observations()
             if not g.failure.is_set() and not g.teardown.is_set() and \
                     all(g.results.get(k) == SUCCESS
                         for k in g.essential_keys):
@@ -1022,6 +1340,8 @@ class ElasticDriver:
             # -- a worker crashed: recover the world in place --------------
             if g.worker_lost.is_set() and not g.failure.is_set() and \
                     not g.teardown.is_set():
+                if self._adoption_settling(g):
+                    continue  # survivors still re-registering (takeover)
                 self._recover_lost_workers(g)
                 continue
             if not g.failure.is_set() and not g.teardown.is_set():
@@ -1058,11 +1378,300 @@ class ElasticDriver:
                 host_slots = sum(1 for s in g.slots
                                  if s.hostname == host)
                 if n >= host_slots:
-                    self._hosts.blacklist(host)
+                    ev = {"reason": "all_workers_failed", "failures": n,
+                          "slots": host_slots}
+                    self._hosts.blacklist(host, evidence=ev)
+                    self._journal_append("blocklist", host=host,
+                                         evidence=ev,
+                                         ts=journal_mod.now_wall())
             return FAILURE
         self._final_np = len(g.essential_keys)
         self._final_gen = g.essential_gen
         return SUCCESS
+
+    def _adoption_settling(self, g: _GenRuntime) -> bool:
+        """True while a freshly adopted generation should HOLD OFF
+        recovery planning: right after a takeover no survivor has
+        re-registered its elastic listener yet (the old driver's
+        ``notify`` scope died with it), so planning now would flunk the
+        viability check and burn the generation restart the takeover
+        exists to avoid.  Clears as soon as every live survivor has
+        re-registered, or when the settle deadline passes (a survivor
+        that never re-registers really is unrecoverable in place)."""
+        deadline = getattr(g, "adopted_until", None)
+        if deadline is None or time.monotonic() >= deadline:
+            return False
+        notify = {str(r) for r in self._kv.scope("notify")}
+        with g.fail_lock:
+            lost = set(g.lost_keys)
+        waiting = [k for k in g.essential_keys
+                   if k not in lost and g.results.get(k) is None
+                   and g.threads[k].is_alive()
+                   and str(g.current_rank.get(k)) not in notify]
+        return bool(waiting)
+
+    # -- crash takeover (docs/ELASTIC.md "Driver failover & takeover") -------
+    def _begin_takeover(self) -> _GenRuntime:
+        """Become the driver the journal describes: restore exclusion
+        state and the reset budget, re-publish the last committed world
+        doc VERBATIM (its HMAC is over the sort_keys canonical form, so
+        the old signature stays valid), and rebuild the running
+        generation from spawn/exit records — workers mid-step never
+        re-mesh; they just find the same world at their next poll."""
+        import json as _json
+        state = self._replay
+        assert state is not None and state.world is not None
+        self._journal_append("takeover", critical=True, pid=os.getpid(),
+                             ts=journal_mod.now_wall())
+        try:
+            from horovod_tpu.metrics.registry import default_registry
+            default_registry().counter(
+                "hvd_driver_takeovers_total",
+                help="elastic driver crash takeovers completed from the "
+                     "control-plane journal").inc()
+        except Exception:
+            pass
+        # the takeover span continues the adopted generation's trace —
+        # one trace id from the world that was published through the
+        # crash and into the recovered control plane
+        from horovod_tpu import tracing
+        doc = state.world["doc"]
+        ctx = tracing.decode(doc.get("traceparent")) \
+            or tracing.new_trace("elastic")
+        try:
+            from horovod_tpu.diagnostics.flight_recorder import \
+                record_event
+            record_event("driver_takeover", pid=os.getpid(),
+                         generation=state.world_gen,
+                         np=int(state.world.get("np", 0)),
+                         adopted=len(state.live_workers()),
+                         replayed_exits=len(state.exits),
+                         blocklisted=len(state.blocklist),
+                         **tracing.fields(ctx))
+        except Exception:
+            pass
+        tracing.record_span("elastic", "driver_takeover",
+                            tracing.child(ctx, "elastic"),
+                            generation=state.world_gen,
+                            adopted=len(state.live_workers()))
+        self._hosts.restore_state(state.blocklist, state.drains)
+        self._registry.restore_reset_count(state.reset_count)
+        # seed the discovery view BEFORE clearing the change flag: the
+        # takeover must not misread "first refresh populated an empty
+        # view" as a mid-generation membership change
+        try:
+            self._hosts.update_available_hosts()
+        except Exception as e:
+            get_logger().warning(
+                "takeover: initial host discovery failed (%s); the "
+                "discovery loop will retry", e)
+        self._hosts_changed.clear()
+        # restore the journaled listener registrations: a survivor whose
+        # KV gets retried straight through the outage never notices the
+        # driver changed and never re-registers — without this restore
+        # the empty ``notify`` scope flunks the in-place recovery
+        # viability check and burns a generation restart
+        for rank, rec in state.notify.items():
+            addr = rec.get("addr", "")
+            if addr:
+                self._kv.put("notify", rank, addr.encode())
+                self._journaled_notify[rank] = addr
+        self._kv.put("world", "current", _json.dumps(doc).encode())
+        g = self._rebuild_generation(state)
+        get_logger().warning(
+            "driver takeover complete: generation %d adopted (np=%d, "
+            "%d live worker(s), %d prior exit(s), %d listener "
+            "registration(s) restored, %d blocklisted host(s), reset "
+            "budget %d spent)", g.world_gen, g.np,
+            sum(1 for t in g.threads.values() if t.is_alive()),
+            len(state.exits), len(state.notify), len(state.blocklist),
+            state.reset_count)
+        return g
+
+    def _rebuild_generation(self,
+                            state: journal_mod.ReplayState) -> _GenRuntime:
+        """A live :class:`_GenRuntime` from the journal's last
+        ``world_publish`` runtime + the spawn/exit records after it."""
+        w = state.world
+        slots = [SlotInfo(**d) for d in w["slots"]]
+        slot_by_rank = {s.rank: s for s in slots}
+        g = _GenRuntime(slots, int(w["essential_gen"]),
+                        w["coord_addr"], int(w["coord_port"]))
+        g.world_gen = int(w["world_gen"])
+        g.numbering_gen = int(w["numbering_gen"])
+        g.essential_keys = [tuple(k) for k in w["essential_keys"]]
+        g.current_rank = {tuple(k): r for k, r in w["current_rank"]}
+        g.expected_exits = {tuple(k)
+                            for k in w.get("expected_exits", [])}
+        g.drained_exits = {tuple(k) for k in w.get("drained_exits", [])}
+        # token payloads journal as utf-8 text; the live dedupe set
+        # holds the KV's raw BYTES — re-encode or every replayed token
+        # would silently fail to match and be re-handled
+        g.handled_tokens = {(s, k, r.encode("utf-8"))
+                            for (s, k, r) in state.tokens}
+        g.spawn = lambda slot, slot_gen: self._spawn_worker(
+            g, slot, slot_gen)
+        g.adopted_until = time.monotonic() + takeover_settle_s()
+
+        def slot_for(key, rec):
+            rank = g.current_rank.get(key)
+            if rank in slot_by_rank:
+                return slot_by_rank[rank]
+            # spawn record as fallback (a straggler whose publish-time
+            # rank is gone): enough identity to classify, not to place
+            return SlotInfo(hostname=rec.get("host", "localhost"),
+                            rank=key[1], local_rank=0, cross_rank=0,
+                            size=len(slots), local_size=1,
+                            cross_size=1)
+
+        # exits the dead driver already classified: preloaded as
+        # finished bookkeeping so membership checks (threads[k]
+        # .is_alive() with no KeyError guard) and the success test see
+        # them.  Only the current numbering window counts — older exits
+        # were absorbed by re-meshes the journal already published.
+        lo, hi = g.numbering_gen, g.world_gen
+        lost_essentials = []
+        for key_t, rec in state.exits.items():
+            key = tuple(key_t)
+            if not lo <= key[0] <= hi:
+                continue
+            st = rec.get("state", FAILURE)
+            slot = slot_for(key, rec)
+            g.results[key] = st
+            g.threads[key] = _finished_thread()
+            g.slot_by_key.setdefault(key, slot)
+            g.current_rank.setdefault(key, rec.get("rank", key[1]))
+            self._registry.record(rec.get("rank", key[1]),
+                                  rec.get("host", slot.hostname), st)
+            if st == FAILURE and key in g.essential_keys:
+                lost_essentials.append(key)
+        # live workers: adopt.  A local pid gets a liveness monitor
+        # (and, if the generation must die, a process-group kill — the
+        # setsid spawn is why these workers outlived their driver); a
+        # remote/pid-less worker can only be awaited via its signed
+        # completion receipt.
+        import socket as _socket
+        local_names = {"localhost", "127.0.0.1", _socket.gethostname()}
+        to_start = []
+        for key_t, rec in state.live_workers().items():
+            key = tuple(key_t)
+            if key in g.results:
+                continue
+            slot = slot_for(key, rec)
+            g.slot_by_key.setdefault(key, slot)
+            g.current_rank.setdefault(key, rec.get("rank", key[1]))
+            pid = rec.get("pid")
+            if pid and slot.hostname in local_names:
+                t = threading.Thread(
+                    target=self._monitor_adopted,
+                    args=(g, key, slot, int(pid)), daemon=True)
+            else:
+                t = threading.Thread(
+                    target=self._await_adopted_result,
+                    args=(g, key, slot), daemon=True)
+            g.threads[key] = t
+            to_start.append(t)
+        # essential keys with NEITHER an exit nor a spawn record (a
+        # lost journal append, or a spawn the crash preempted): treated
+        # as lost, which routes them through the normal in-place
+        # recovery once the survivors have re-registered
+        for key in list(g.essential_keys):
+            if key in g.threads:
+                continue
+            slot = slot_for(key, {})
+            g.slot_by_key.setdefault(key, slot)
+            g.threads[key] = _finished_thread()
+            get_logger().warning(
+                "takeover: essential worker %s has no journal record; "
+                "classifying it lost", key)
+            self._classify_exit(g, slot, key, 1)
+        # exits the dead driver classified FAILURE but never finished
+        # recovering (crashed mid-re-mesh — the worst case): re-mark
+        # them lost so the monitor loop plans the recovery the old
+        # driver never published
+        if lost_essentials:
+            with g.fail_lock:
+                g.lost_keys.update(lost_essentials)
+                g.originators.update(lost_essentials)
+                g.worker_lost.set()
+        for t in to_start:
+            t.start()
+        return g
+
+    def _monitor_adopted(self, g: _GenRuntime, key: tuple, slot,
+                         pid: int) -> None:
+        """Stand-in for the :meth:`_run_slot` thread of a worker THIS
+        process never spawned: poll the adopted pid for liveness,
+        escalate a generation teardown to its process group, and
+        classify the exit from the worker's signed completion receipt
+        (the exit CODE died with the old driver)."""
+        import signal as _signal
+        killed_at = None
+        while True:
+            if g.failure.is_set() or g.teardown.is_set():
+                try:
+                    pgid = os.getpgid(pid)
+                    if killed_at is None:
+                        os.killpg(pgid, _signal.SIGTERM)
+                        killed_at = time.monotonic()
+                    elif time.monotonic() - killed_at > \
+                            GRACEFUL_TERMINATION_TIME_S:
+                        os.killpg(pgid, _signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                break
+            except PermissionError:
+                pass  # alive, different uid — keep watching
+            time.sleep(0.25)
+        rc = 0 if self._adopted_result_ok(g, key) else 1
+        self._classify_exit(g, slot, key, rc)
+
+    def _await_adopted_result(self, g: _GenRuntime, key: tuple,
+                              slot) -> None:
+        """Adoption monitor for a worker with no observable pid (remote
+        exec, or the spawn record lost its pid): the only signal is the
+        signed completion receipt.  Documented limitation: such a
+        worker's DEATH is invisible until a peer's transport error
+        surfaces it — the reactive path still covers it, later."""
+        while not (g.failure.is_set() or g.teardown.is_set()):
+            if self._adopted_result_ok(g, key):
+                self._classify_exit(g, slot, key, 0)
+                return
+            time.sleep(1.0)
+        self._classify_exit(g, slot, key, 1)
+
+    def _adopted_result_ok(self, g: _GenRuntime, key: tuple) -> bool:
+        """True when the KV ``result`` scope holds a VALID completion
+        receipt for the worker: HMAC-signed with the world secret
+        (receipts influence SUCCESS classification and the PUT surface
+        is open to the network), rank matching, generation inside the
+        current numbering window."""
+        import hmac as _hmac
+        import json as _json
+        rank = g.current_rank.get(key)
+        if rank is None:
+            return False
+        raw = self._kv.get("result", str(rank))
+        if raw is None:
+            return False
+        try:
+            doc = _json.loads(raw)
+            if not isinstance(doc, dict):
+                return False
+            from horovod_tpu.elastic import world_doc_signature
+            sig = doc.get("sig")
+            if not isinstance(sig, str) or not _hmac.compare_digest(
+                    sig, world_doc_signature(self._world_secret, doc)):
+                return False
+            if int(doc.get("rank", -1)) != int(rank):
+                return False
+            return g.numbering_gen <= \
+                int(doc.get("generation", -1)) <= g.world_gen
+        except (ValueError, TypeError):
+            return False
 
     @property
     def final_np(self) -> Optional[int]:
@@ -1080,28 +1689,45 @@ class ElasticDriver:
 
     # -- main loop -----------------------------------------------------------
     def run(self) -> int:
-        self._wait_for_min_hosts(timeout=self._start_timeout)
+        adopted: Optional[_GenRuntime] = None
+        if self._takeover:
+            # the fleet is (presumably) still running: adopt it instead
+            # of waiting for min hosts to relaunch it
+            adopted = self._begin_takeover()
+        else:
+            self._wait_for_min_hosts(timeout=self._start_timeout)
         disc = threading.Thread(target=self._discovery_loop, daemon=True)
         disc.start()
         try:
             while True:
-                result = self._run_generation()
+                if adopted is not None:
+                    g, adopted = adopted, None
+                    result = self._monitor_generation(g)
+                else:
+                    result = self._run_generation()
                 if result == SUCCESS:
+                    # clean_exit tells a later takeover attempt (and the
+                    # supervisor) this rc was ON PURPOSE, not a crash
+                    self._journal_append("clean_exit", rc=0)
                     return 0
                 if self._registry.reset_limit_reached():
                     get_logger().error(
                         "elastic reset limit reached after %d generations",
                         self._registry.reset_count)
+                    self._journal_append("clean_exit", rc=1)
                     return 1
                 # wait until we have enough usable slots again
                 try:
                     self._wait_for_min_hosts(timeout=self._elastic_timeout)
                 except TimeoutError:
+                    self._journal_append("clean_exit", rc=1)
                     return 1
         finally:
             self._stop.set()
             disc.join(timeout=3)
             self._kv.stop()
+            if self._journal is not None:
+                self._journal.close()
 
 
 def run_elastic(discovery: HostDiscovery, np: Optional[int],
@@ -1112,10 +1738,13 @@ def run_elastic(discovery: HostDiscovery, np: Optional[int],
                 reset_limit: Optional[int] = None,
                 timestamp_output: bool = False,
                 start_timeout: Optional[float] = None,
-                elastic_timeout: Optional[float] = None) -> int:
+                elastic_timeout: Optional[float] = None,
+                journal_dir: Optional[str] = None,
+                takeover: bool = False) -> int:
     driver = ElasticDriver(discovery, command, min_np=min_np, max_np=max_np,
                            env=env, verbose=verbose, reset_limit=reset_limit,
                            target_np=np, timestamp_output=timestamp_output,
                            start_timeout=start_timeout,
-                           elastic_timeout=elastic_timeout)
+                           elastic_timeout=elastic_timeout,
+                           journal_dir=journal_dir, takeover=takeover)
     return driver.run()

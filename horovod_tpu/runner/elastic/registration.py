@@ -50,6 +50,14 @@ class WorkerStateRegistry:
         return (self._reset_limit is not None
                 and self._reset_count > self._reset_limit)
 
+    def restore_reset_count(self, count: int) -> None:
+        """Adopt a journaled reset count (driver takeover): the
+        ``--reset-limit`` budget is the JOB's, not the driver process's
+        — a crash-looping worker must not get a fresh allowance every
+        time the control plane restarts."""
+        with self._lock:
+            self._reset_count = max(self._reset_count, int(count))
+
     def record(self, rank: int, host: str, state: str) -> None:
         with self._lock:
             self._states[rank] = state

@@ -52,6 +52,18 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.server.note_request("PUT", scope)
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
+        # write observer BEFORE the store and the 200: the elastic
+        # driver journals worker registrations through this hook, and
+        # WAL ordering requires the append to be durable before the
+        # writer is told its registration took (a post-ack crash must
+        # not lose acknowledged control-plane state).  Outside kv_lock:
+        # the hook may fsync and must not stall concurrent KV traffic.
+        hook = getattr(self.server, "on_put", None)
+        if hook is not None:
+            try:
+                hook(scope, key, body)
+            except Exception:
+                pass  # observation must never fail the write itself
         with self.server.kv_lock:
             self.server.kv.setdefault(scope, {})[key] = body
         self.send_response(200)
@@ -96,6 +108,13 @@ class ThreadedHTTPServer(ThreadingHTTPServer):
     ``handler_timeout_s`` constructor arguments (0 disables)."""
 
     request_queue_size = 128
+    # SO_REUSEADDR, stated explicitly rather than inherited: a takeover
+    # driver (docs/ELASTIC.md "Driver failover & takeover") must rebind
+    # the crashed driver's advertised KV port on the same host while the
+    # old socket's connections sit in TIME_WAIT — without reuse the
+    # rebind fails for up to 2*MSL and every worker's poll would have to
+    # ride that out too.
+    allow_reuse_address = 1
 
     def __init__(self, server_address, RequestHandlerClass,
                  max_handlers: Optional[int] = None,
@@ -195,7 +214,20 @@ class KVStoreServer:
         self._httpd.kv_lock = threading.Lock()
         self._httpd.req_counts = {}
         self._httpd.req_lock = threading.Lock()
+        self._httpd.on_put = None
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def on_put(self):
+        """Optional ``(scope, key, value)`` observer invoked on every
+        HTTP PUT before the value is stored and acknowledged (the
+        driver's journal WAL hook).  Exceptions are swallowed; the
+        write always proceeds."""
+        return getattr(self._httpd, "on_put", None)
+
+    @on_put.setter
+    def on_put(self, cb) -> None:
+        self._httpd.on_put = cb
 
     def _make_server(self, port: int):
         return _KVServer(("0.0.0.0", port), _KVHandler)
@@ -204,11 +236,47 @@ class KVStoreServer:
     def port(self) -> int:
         return self._httpd.server_address[1]
 
-    def start(self) -> int:
+    def start(self, port: Optional[int] = None) -> int:
+        """Start serving.  ``port`` rebinds the server onto that specific
+        port first (takeover: a fresh driver process must come up on the
+        port the fleet's ``HVD_ELASTIC_KV`` already advertises).  The
+        in-memory KV contents survive the rebind — the takeover path
+        re-publishes into the same server object it just rebound."""
+        if port is not None and port != self.port:
+            self._rebind(port)
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
         return self.port
+
+    def _rebind(self, port: int) -> None:
+        """Replace the bound socket with one on ``port``, keeping the KV
+        dict, locks and request counts.  Retries the bind briefly: the
+        dead driver's kernel socket can linger a beat past its process
+        (SO_REUSEADDR clears TIME_WAIT but not a still-open listener in
+        a not-yet-reaped process)."""
+        import time as _time
+        old = self._httpd
+        try:
+            old.server_close()
+        except OSError:
+            pass
+        deadline = _time.monotonic() + 10.0
+        while True:
+            try:
+                httpd = self._make_server(port)
+                break
+            except OSError:
+                if _time.monotonic() >= deadline:
+                    raise
+                _time.sleep(0.25)
+        # transplant state: the KV dict IS the control-plane content
+        httpd.kv = old.kv
+        httpd.kv_lock = old.kv_lock
+        httpd.req_counts = old.req_counts
+        httpd.req_lock = old.req_lock
+        httpd.on_put = getattr(old, "on_put", None)
+        self._httpd = httpd
 
     def stop(self) -> None:
         self._httpd.shutdown()
@@ -266,7 +334,8 @@ class HTTPBusyError(OSError):
 
 def _with_retries(do, attempts: int = 4,
                   deadline_s: Optional[float] = None,
-                  site: str = "http_kv"):
+                  site: str = "http_kv",
+                  count_exhausted: bool = True):
     """Transient-error shield: a busy single-core box can overflow the
     server's listen backlog under polling bursts, resetting connections
     mid-handshake; retry with jittered backoff instead of failing a
@@ -294,7 +363,8 @@ def _with_retries(do, attempts: int = 4,
                   TimeoutError, OSError),
         give_up_on=(HTTPError,),
         attempts=attempts, base_delay_s=0.05, backoff=2.0,
-        max_delay_s=2.0, jitter=0.25, deadline_s=deadline_s)
+        max_delay_s=2.0, jitter=0.25, deadline_s=deadline_s,
+        count_exhausted=count_exhausted)
 
 
 def _trace_headers() -> Dict[str, str]:
@@ -315,7 +385,8 @@ def _trace_headers() -> Dict[str, str]:
 
 def kv_put(addr: str, port: int, scope: str, key: str, value: bytes,
            timeout: float = 30.0, site: str = "http_kv.put",
-           peer=None, attempts: int = 4) -> None:
+           peer=None, attempts: int = 4,
+           count_exhausted: bool = True) -> None:
     """``peer`` names the request's TARGET for the chaos ``kv.partition``
     seam (a worker rank for relay hops, ``"driver"`` for the root KV);
     None = target unknown, partition rules cannot match.  ``attempts=1``
@@ -331,12 +402,13 @@ def kv_put(addr: str, port: int, scope: str, key: str, value: bytes,
         return urlopen(req, timeout=timeout).read()
 
     _with_retries(do, attempts=attempts, deadline_s=2.0 * timeout,
-                  site=site)
+                  site=site, count_exhausted=count_exhausted)
 
 
 def kv_get(addr: str, port: int, scope: str, key: str,
            timeout: float = 30.0, site: str = "http_kv.get",
-           peer=None, attempts: int = 4) -> Optional[bytes]:
+           peer=None, attempts: int = 4,
+           count_exhausted: bool = True) -> Optional[bytes]:
     def do():
         from horovod_tpu import chaos
         chaos.fire("kv.request")
@@ -347,7 +419,8 @@ def kv_get(addr: str, port: int, scope: str, key: str,
 
     try:
         return _with_retries(do, attempts=attempts,
-                             deadline_s=2.0 * timeout, site=site)
+                             deadline_s=2.0 * timeout, site=site,
+                             count_exhausted=count_exhausted)
     except HTTPError as e:
         if e.code == 404:
             return None

@@ -52,7 +52,10 @@ from horovod_tpu.runner.http_kv import (KVStoreServer, _KVHandler,
 #: everything else is local to the node (e.g. the driver's world pushes).
 #: "action" carries the autopilot's remediation requests (ISSUE 12):
 #: finding→action decisions ride the same tree as drain notices.
-FORWARD_SCOPES = ("notify", "drain", "action")
+#: "result" carries each worker's signed completion receipt (docs/
+#: ELASTIC.md "Driver failover & takeover") — a takeover driver that
+#: adopted running workers classifies their exits from these.
+FORWARD_SCOPES = ("notify", "drain", "action", "result")
 
 #: scopes a relay node serves from its TTL cache (driver -> worker
 #: traffic).  GETs for any other scope go root-direct: the relay
@@ -157,7 +160,8 @@ class RelayClient:
 
     # -- the client surface -------------------------------------------------
     def get(self, scope: str, key: str, timeout: float = 30.0,
-            site: str = "kv_relay.get") -> Optional[bytes]:
+            site: str = "kv_relay.get",
+            count_exhausted: bool = True) -> Optional[bytes]:
         addr = self._parent_usable(timeout) \
             if scope in CACHED_SCOPES else None
         if addr is not None:
@@ -171,10 +175,12 @@ class RelayClient:
             except OSError:
                 self._mark_parent_dead(site)
         return kv_get(self.root_addr, self.root_port, scope, key,
-                      timeout=timeout, site=site, peer="driver")
+                      timeout=timeout, site=site, peer="driver",
+                      count_exhausted=count_exhausted)
 
     def put(self, scope: str, key: str, value: bytes,
-            timeout: float = 30.0, site: str = "kv_relay.put") -> None:
+            timeout: float = 30.0, site: str = "kv_relay.put",
+            count_exhausted: bool = True) -> None:
         addr = self._parent_usable(timeout) \
             if scope in FORWARD_SCOPES else None
         if addr is not None:
@@ -186,7 +192,8 @@ class RelayClient:
             except OSError:
                 self._mark_parent_dead(site)
         kv_put(self.root_addr, self.root_port, scope, key, value,
-               timeout=timeout, site=site, peer="driver")
+               timeout=timeout, site=site, peer="driver",
+               count_exhausted=count_exhausted)
 
 
 # -- relay node (server side) -------------------------------------------------

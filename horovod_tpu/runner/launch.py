@@ -65,6 +65,24 @@ def parse_args(argv: List[str]) -> argparse.Namespace:
                         "elastic mode")
     p.add_argument("--reset-limit", type=int, default=None,
                    help="max elastic relaunch generations before giving up")
+    # control-plane HA (docs/ELASTIC.md "Driver failover & takeover")
+    p.add_argument("--driver-journal-dir", default=None,
+                   help="journal every elastic-driver decision to this "
+                        "directory and supervise the driver: a crashed "
+                        "driver is respawned with --takeover and adopts "
+                        "the running workers (mirrors "
+                        "HVD_TPU_DRIVER_JOURNAL_DIR)")
+    p.add_argument("--takeover", action="store_true",
+                   help="replay the driver journal and adopt a running "
+                        "elastic job instead of launching a new one "
+                        "(requires a journal dir via "
+                        "--driver-journal-dir or "
+                        "HVD_TPU_DRIVER_JOURNAL_DIR)")
+    p.add_argument("--no-driver-supervisor", action="store_true",
+                   help="run the elastic driver in THIS process even "
+                        "when a journal dir is configured (no crash "
+                        "respawn; the supervisor uses it for its own "
+                        "child)")
     # knobs mirrored to env (reference: config_parser.py — full set; see
     # docs/KNOBS.md for the table)
     p.add_argument("--fusion-threshold-mb", type=float, default=None)
@@ -123,6 +141,10 @@ def parse_args(argv: List[str]) -> argparse.Namespace:
                              args.host_discovery_script)) > 1:
         p.error("specify only one of -H/--hosts, --hostfile, --tpu, "
                 "--host-discovery-script")
+    if args.takeover and args.host_discovery_script is None \
+            and args.min_np is None:
+        p.error("--takeover requires elastic mode (--min-np or "
+                "--host-discovery-script)")
     # launcher flags end where the user command begins: the probe below
     # must never see the command's own options
     launcher_argv = list(argv)[:len(argv) - len(args.command)]
@@ -370,6 +392,68 @@ Available Tensor Operations:
     [X] Local (single process)"""
 
 
+def supervise_driver(argv: List[str], env: Dict[str, str],
+                     journal_dir: str, takeover: bool = False) -> int:
+    """Driver supervisor loop (docs/ELASTIC.md "Driver failover &
+    takeover"): run the elastic driver as a CHILD process and, when it
+    dies without journaling a ``clean_exit``, respawn it with
+    ``--takeover`` so it replays the journal and adopts the running
+    fleet.  Workers lead their own process groups (safe_exec setsid),
+    so a driver crash — or a SIGKILL from the chaos ``driver`` seam —
+    leaves them training; the respawned driver re-publishes the last
+    committed world verbatim and they ride the outage out inside
+    ``HVD_TPU_DRIVER_OUTAGE_GRACE_S`` without re-meshing."""
+    import subprocess
+    from horovod_tpu.common.config import env_int
+    from horovod_tpu.common.logging import get_logger
+    from horovod_tpu.runner.elastic import journal as journal_mod
+    log = get_logger()
+    # --takeover is the supervisor's decision from here on: the child is
+    # respawned into takeover only after a crash is confirmed
+    base = [a for a in argv if a != "--takeover"]
+    max_takeovers = max(0, env_int("DRIVER_MAX_TAKEOVERS", 3))
+    path = os.path.join(journal_dir, journal_mod.JOURNAL_NAME)
+    takeovers = 0
+    while True:
+        cmd = [sys.executable, "-m", "horovod_tpu.runner.launch"] + \
+            (["--takeover"] if takeover else []) + base
+        child_env = dict(env)
+        child_env["HVD_TPU_DRIVER_SUPERVISED"] = "1"
+        child_env["HVD_TPU_DRIVER_JOURNAL_DIR"] = journal_dir
+        rc = subprocess.run(cmd, env=child_env).returncode
+        try:
+            state = journal_mod.load(path)
+        except Exception as exc:
+            log.error("driver supervisor: journal %s unreadable (%s); "
+                      "passing driver rc %d through", path, exc, rc)
+            return rc
+        if state.clean_exit is not None:
+            # the driver finished ON PURPOSE (success or classified
+            # failure) — its verdict stands, no takeover
+            return rc
+        takeovers += 1
+        if takeovers > max_takeovers:
+            log.error(
+                "driver supervisor: driver died again (rc %d) after %d "
+                "takeover(s); HVD_TPU_DRIVER_MAX_TAKEOVERS exhausted — "
+                "giving up (docs/TROUBLESHOOTING.md \"My driver died\")",
+                rc, takeovers - 1)
+            return rc or 1
+        try:
+            state.check_takeover()
+        except journal_mod.TakeoverRefused as exc:
+            log.error(
+                "driver supervisor: driver died (rc %d) but takeover is "
+                "refused: %s — recover manually (docs/TROUBLESHOOTING.md "
+                "\"My driver died\")", rc, exc)
+            return rc or 1
+        log.warning(
+            "driver supervisor: driver died (rc %d) without a clean "
+            "exit; respawning into journal takeover %d/%d",
+            rc, takeovers, max_takeovers)
+        takeover = True
+
+
 def run_commandline(argv: List[str] = None) -> int:
     """Reference: ``run_commandline`` (``launch.py:763``)."""
     args = parse_args(argv if argv is not None else sys.argv[1:])
@@ -395,13 +479,25 @@ def run_commandline(argv: List[str] = None) -> int:
             discovery = TpuPodDiscovery()
         else:
             discovery = FixedHosts(resolve_hosts(args))
+        journal_dir = args.driver_journal_dir or \
+            env.get("HVD_TPU_DRIVER_JOURNAL_DIR") or None
+        if journal_dir:
+            # the driver (and the supervisor's respawned child) read the
+            # dir from the environment; a CLI flag must reach them too
+            env["HVD_TPU_DRIVER_JOURNAL_DIR"] = journal_dir
+        if journal_dir and not args.no_driver_supervisor \
+                and os.environ.get("HVD_TPU_DRIVER_SUPERVISED") != "1":
+            return supervise_driver(
+                list(argv) if argv is not None else sys.argv[1:],
+                env, journal_dir, takeover=args.takeover)
         return run_elastic(
             discovery, args.num_proc, args.command,
             min_np=args.min_np or 1, max_np=args.max_np,
             env=env, verbose=args.verbose, reset_limit=args.reset_limit,
             timestamp_output=args.prefix_output_with_timestamp,
             start_timeout=args.start_timeout,
-            elastic_timeout=args.elastic_timeout)
+            elastic_timeout=args.elastic_timeout,
+            journal_dir=journal_dir, takeover=args.takeover)
 
     if args.start_timeout is not None:
         # STATIC path only (elastic generations use --elastic-timeout for

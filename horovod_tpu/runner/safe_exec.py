@@ -37,14 +37,27 @@ def _pump(stream, out, prefix: str = "", timestamp: bool = False) -> None:
 def safe_execute(command: List[str], env: Optional[Dict[str, str]] = None,
                  stdout=None, stderr=None, prefix: str = "",
                  events: Optional[List[threading.Event]] = None,
-                 timestamp: bool = False) -> int:
+                 timestamp: bool = False,
+                 on_start=None) -> int:
     """Run command; if any event fires, terminate the process group
-    (reference: ``safe_shell_exec.execute``)."""
+    (reference: ``safe_shell_exec.execute``).  ``on_start(pid)`` is
+    called right after the spawn — the elastic driver journals worker
+    PIDs through it, so a takeover driver can adopt (monitor, and if
+    need be kill) workers that outlived the process that spawned them.
+    Note the spawn uses ``preexec_fn=os.setsid``: each worker leads its
+    OWN process group, which is exactly why it survives its driver."""
     stdout = stdout or sys.stdout
     stderr = stderr or sys.stderr
     proc = subprocess.Popen(
         command, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         preexec_fn=os.setsid)
+    if on_start is not None:
+        try:
+            on_start(proc.pid)
+        except Exception:
+            from horovod_tpu.common.logging import get_logger
+            get_logger().warning("safe_execute: on_start callback failed",
+                                 exc_info=True)
     pumps = [
         threading.Thread(target=_pump,
                          args=(proc.stdout, stdout, prefix, timestamp),

@@ -1,0 +1,589 @@
+"""Control-plane HA tests (ISSUE 20): the driver journal's replay
+semantics, crash takeover rebuilding a live generation from the journal,
+the worker-side outage grace window, and the two chaos acceptance runs —
+driver SIGKILLed mid-training (ride-through, zero re-mesh) and mid-
+re-mesh (takeover completes the recovery the dead driver never
+published).  docs/ELASTIC.md "Driver failover & takeover"."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from horovod_tpu.core import core_available
+from horovod_tpu.runner.elastic import journal as journal_mod
+from horovod_tpu.runner.elastic.journal import (DriverJournal,
+                                                TakeoverRefused,
+                                                load, read_journal, replay)
+from horovod_tpu.runner.hosts import SlotInfo
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs_core = pytest.mark.skipif(not core_available(),
+                                reason="libhvdcore.so not built")
+
+
+# -- journal unit battery ----------------------------------------------------
+def _fill(j: DriverJournal) -> None:
+    j.append("job_open", secret="ab" * 16, kv_port=4567,
+             driver_addr="localhost", ckpt_dir="/tmp/ck", min_np=1,
+             max_np=3, target_np=2, pid=123, ts=1000.0)
+    j.append("blocklist", host="badhost",
+             evidence={"reason": "all_workers_failed"}, ts=1001.0)
+    j.append("drain", host="oldhost", slots=2, remaining_s=60.0,
+             ts=1002.0)
+    j.append("token", scope="drain", key="k1", raw="payload")
+    j.append("reset", count=2)
+    # a pre-publish registration: stale the moment the world publishes
+    # (the driver clears the notify scope), so replay must forget it
+    j.append("notify", rank="9", addr="oldhost:1111")
+    j.append("world_publish", doc={"generation": 0, "size": 2},
+             world_gen=0, numbering_gen=0, essential_gen=0, np=2,
+             coord_addr="localhost", coord_port=7777,
+             slots=[], essential_keys=[[0, 0], [0, 1]],
+             current_rank=[[[0, 0], 0], [[0, 1], 1]],
+             expected_exits=[], drained_exits=[])
+    j.append("spawn", key=[0, 0], host="localhost", rank=0, pid=111,
+             ts=1003.0)
+    j.append("spawn", key=[0, 1], host="localhost", rank=1, pid=222,
+             ts=1003.5)
+    j.append("exit", key=[0, 0], state="SUCCESS", rank=0,
+             host="localhost")
+    j.append("notify", rank="1", addr="localhost:9999")
+
+
+def test_journal_append_and_replay(tmp_path):
+    j = DriverJournal(str(tmp_path))
+    _fill(j)
+    j.close()
+    state = load(j.path)
+    assert state.meta["kv_port"] == 4567
+    assert state.world_gen == 0 and state.numbering_gen == 0
+    assert state.blocklist["badhost"]["evidence"] == {
+        "reason": "all_workers_failed"}
+    assert state.drains["oldhost"]["remaining_s"] == 60.0
+    assert ("drain", "k1", "payload") in state.tokens
+    assert state.reset_count == 2
+    # the post-publish registration survives; the pre-publish one is
+    # stale (scope cleared at publish) and replay forgot it the same way
+    assert state.notify["1"]["addr"] == "localhost:9999"
+    assert "9" not in state.notify
+    assert state.exits[(0, 0)]["state"] == "SUCCESS"
+    # rank 0 exited: only rank 1 is still live in the window
+    assert set(state.live_workers()) == {(0, 1)}
+    assert state.clean_exit is None and state.unknown == 0
+    state.check_takeover()  # has a committed world: takeover viable
+
+
+def test_journal_replay_idempotent(tmp_path):
+    j = DriverJournal(str(tmp_path))
+    _fill(j)
+    j.close()
+    records, torn = read_journal(j.path)
+    once = replay(records, torn)
+    twice = replay(records + records, torn)
+    for attr in ("meta", "world", "live", "exits", "blocklist", "drains",
+                 "tokens", "notify", "reset_count", "clean_exit"):
+        assert getattr(once, attr) == getattr(twice, attr), attr
+
+
+def test_journal_torn_tail_tolerated(tmp_path):
+    j = DriverJournal(str(tmp_path))
+    _fill(j)
+    j.close()
+    # a crash mid-append leaves a partial line with no newline
+    with open(j.path, "ab") as f:
+        f.write(b'{"t": "spawn", "key": [0, 2], "ho')
+    records, torn = read_journal(j.path)
+    assert torn is not None and journal_mod.torn_tail_type(torn) == "spawn"
+    state = replay(records, torn)
+    # every COMPLETE record survived; the torn spawn is dropped
+    assert state.exits[(0, 0)]["state"] == "SUCCESS"
+    assert (0, 2) not in state.live
+    state.check_takeover()  # a torn spawn does not poison takeover
+
+
+def test_torn_world_publish_refuses_takeover(tmp_path):
+    j = DriverJournal(str(tmp_path))
+    _fill(j)
+    j.close()
+    with open(j.path, "ab") as f:
+        f.write(b'{"t": "world_publish", "doc": {"generation"')
+    state = load(j.path)
+    with pytest.raises(TakeoverRefused) as ei:
+        state.check_takeover()
+    # the refusal points the operator at the generation-restart backstop
+    assert "backstop" in str(ei.value)
+
+
+def test_no_world_and_clean_exit_refuse_takeover(tmp_path):
+    j = DriverJournal(str(tmp_path))
+    j.append("job_open", secret="ab" * 16, kv_port=1, ts=1.0)
+    j.close()
+    with pytest.raises(TakeoverRefused):
+        load(j.path).check_takeover()
+    j2 = DriverJournal(str(tmp_path))
+    _fill(j2)
+    j2.append("clean_exit", rc=0)
+    j2.close()
+    with pytest.raises(TakeoverRefused) as ei:
+        load(j2.path).check_takeover()
+    assert "on purpose" in str(ei.value)
+
+
+def test_unknown_record_type_skipped_loudly(tmp_path):
+    j = DriverJournal(str(tmp_path))
+    _fill(j)
+    j.append("hologram", key=[9, 9])  # a newer driver's record type
+    j.close()
+    state = load(j.path)
+    assert state.unknown == 1
+    # the rest of the state is unharmed
+    assert state.reset_count == 2 and state.world is not None
+    state.check_takeover()
+
+
+def test_compaction_preserves_state(tmp_path):
+    j = DriverJournal(str(tmp_path))
+    _fill(j)
+    before = load(j.path)
+    assert j.maybe_compact(max_bytes=64) is True
+    j.close()
+    after = load(j.path)
+    for attr in ("world", "blocklist", "drains", "tokens", "notify",
+                 "reset_count", "clean_exit"):
+        assert getattr(before, attr) == getattr(after, attr), attr
+    # the live generation's spawn/exit window survives rotation
+    assert after.exits[(0, 0)]["state"] == "SUCCESS"
+    assert set(after.live_workers()) == {(0, 1)}
+    # and the compacted file folds idempotently too
+    records, torn = read_journal(j.path)
+    assert replay(records + records, torn).tokens == after.tokens
+
+
+def test_compaction_drops_pre_window_exits(tmp_path):
+    """Exit history from generations before the published numbering
+    window is dead weight — replay ignores it, so rotation drops it."""
+    j = DriverJournal(str(tmp_path))
+    _fill(j)
+    # pre-window relic from an old re-mesh, then a newer world at gen 3
+    j.append("exit", key=[1, 0], state="FAILURE", rank=0, host="gone")
+    j.append("world_publish", doc={"generation": 3, "size": 1},
+             world_gen=3, numbering_gen=3, essential_gen=3, np=1,
+             coord_addr="localhost", coord_port=7777, slots=[],
+             essential_keys=[[3, 0]], current_rank=[[[3, 0], 0]],
+             expected_exits=[], drained_exits=[])
+    assert j.maybe_compact(max_bytes=64) is True
+    j.close()
+    records, _ = read_journal(j.path)
+    exit_keys = [tuple(r["key"]) for r in records if r["t"] == "exit"]
+    assert (1, 0) not in exit_keys
+
+
+# -- crash takeover: rebuild correctness (no workers involved) ---------------
+def _free_port() -> int:
+    from horovod_tpu.runner.http_kv import KVStoreServer
+    kv = KVStoreServer()
+    kv.start()
+    port = kv.port
+    kv.stop()
+    return port
+
+
+def test_takeover_rebuilds_driver_state(tmp_path):
+    """A takeover driver replays the journal and becomes the dead
+    driver: same secret, same KV port, the last committed world doc
+    re-published VERBATIM, blocklist evidence and reset budget restored,
+    handled tokens deduped as the raw bytes the KV will serve."""
+    from horovod_tpu.runner.elastic.discovery import FixedHosts
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.hosts import HostInfo
+
+    port = _free_port()
+    secret = "cd" * 16
+    slots = [SlotInfo(hostname="localhost", rank=r, local_rank=r,
+                      cross_rank=0, size=2, local_size=2, cross_size=1)
+             for r in range(2)]
+    doc = {"generation": 0, "size": 2, "coord_addr": "localhost",
+           "coord_port": 7777, "slots": {}, "sig": "original-sig"}
+    runtime = ElasticDriver._runtime_record(
+        0, slots, "localhost", 7777, [(0, 0), (0, 1)],
+        {(0, 0): 0, (0, 1): 1}, 0, 0)
+
+    j = DriverJournal(str(tmp_path))
+    j.append("job_open", secret=secret, kv_port=port,
+             driver_addr="localhost", ckpt_dir=str(tmp_path),
+             min_np=1, max_np=2, target_np=2, pid=1,
+             ts=journal_mod.now_wall())
+    evidence = {"reason": "quarantine", "rank": 1}
+    j.append("blocklist", host="badhost", evidence=evidence,
+             ts=journal_mod.now_wall())
+    j.append("token", scope="action", key="a1", raw="req-bytes")
+    j.append("reset", count=2)
+    j.append("world_publish", doc=doc, **runtime)
+    j.append("spawn", key=[0, 0], host="localhost", rank=0, pid=111,
+             ts=journal_mod.now_wall())
+    j.append("spawn", key=[0, 1], host="localhost", rank=1, pid=None,
+             ts=journal_mod.now_wall())
+    j.append("exit", key=[0, 0], state="SUCCESS", rank=0,
+             host="localhost")
+    j.append("notify", rank="1", addr="localhost:45678")
+    j.close()
+    pre = load(j.path)
+
+    driver = ElasticDriver(
+        FixedHosts([HostInfo("localhost", 2)]),
+        [sys.executable, "-c", "pass"], min_np=1, max_np=2,
+        ckpt_dir=str(tmp_path / "other"),
+        journal_dir=str(tmp_path), takeover=True)
+    g = None
+    try:
+        # identity adopted from the journal, not minted fresh
+        assert driver._world_secret == bytes.fromhex(secret)
+        assert driver._kv.port == port
+        assert driver._ckpt_dir == str(tmp_path)
+        assert driver._generation == pre.world_gen + 1
+
+        g = driver._begin_takeover()
+        # the last committed world is re-served VERBATIM (old signature
+        # and all — its HMAC is over the canonical form)
+        assert json.loads(driver._kv.get("world", "current")) == doc
+        assert g.world_gen == 0 and g.essential_keys == [(0, 0), (0, 1)]
+        # handled tokens dedupe as BYTES (what the KV scan yields)
+        assert ("action", "a1", b"req-bytes") in g.handled_tokens
+        # exclusion state identical pre/post takeover, evidence included
+        assert driver._hosts.block_evidence("badhost") == evidence
+        dump = driver._hosts.dump_state()
+        assert set(dump["blocklist"]) == set(pre.blocklist)
+        # the reset budget is the JOB's, not the process's
+        assert driver._registry.reset_count == 2
+        # the journaled listener registration is restored into the KV:
+        # a survivor that never noticed the outage (its KV gets retried
+        # straight through it) stays viable for in-place recovery
+        assert driver._kv.get("notify", "1") == b"localhost:45678"
+        # rank 0's journaled exit is preloaded; rank 1 is adopted live
+        assert g.results[(0, 0)] == "SUCCESS"
+        assert (0, 1) in g.threads and g.threads[(0, 1)].is_alive()
+        # the takeover itself is journaled (the NEXT takeover sees it)
+        assert load(j.path).takeovers
+    finally:
+        if g is not None:
+            g.teardown.set()
+        driver._kv.stop()
+        if driver._journal is not None:
+            driver._journal.close()
+
+
+def test_takeover_remarks_unrecovered_failure_as_lost(tmp_path):
+    """Worst case (acceptance B): the dead driver classified an
+    essential worker FAILURE but crashed before publishing a recovery
+    world.  Replay must re-mark it lost so the monitor loop plans the
+    recovery the old driver never published — and the settle gate must
+    hold that planning until survivors re-register."""
+    from horovod_tpu.runner.elastic.discovery import FixedHosts
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.hosts import HostInfo
+
+    port = _free_port()
+    slots = [SlotInfo(hostname="localhost", rank=r, local_rank=r,
+                      cross_rank=0, size=2, local_size=2, cross_size=1)
+             for r in range(2)]
+    runtime = ElasticDriver._runtime_record(
+        0, slots, "localhost", 7777, [(0, 0), (0, 1)],
+        {(0, 0): 0, (0, 1): 1}, 0, 0)
+    j = DriverJournal(str(tmp_path))
+    j.append("job_open", secret="ee" * 16, kv_port=port,
+             driver_addr="localhost", ckpt_dir=str(tmp_path),
+             ts=journal_mod.now_wall())
+    j.append("world_publish", doc={"generation": 0, "size": 2},
+             **runtime)
+    j.append("spawn", key=[0, 0], host="localhost", rank=0, pid=None,
+             ts=journal_mod.now_wall())
+    j.append("spawn", key=[0, 1], host="localhost", rank=1, pid=None,
+             ts=journal_mod.now_wall())
+    # the crash interrupted the re-mesh: FAILURE journaled, no recovery
+    j.append("exit", key=[0, 1], state="FAILURE", rank=1,
+             host="localhost")
+    j.close()
+
+    driver = ElasticDriver(
+        FixedHosts([HostInfo("localhost", 2)]),
+        [sys.executable, "-c", "pass"], min_np=1, max_np=2,
+        journal_dir=str(tmp_path), takeover=True)
+    g = None
+    try:
+        g = driver._begin_takeover()
+        with g.fail_lock:
+            assert (0, 1) in g.lost_keys
+        assert g.worker_lost.is_set()
+        # the settle gate holds recovery while the (empty) notify scope
+        # proves no survivor has re-registered yet...
+        assert driver._adoption_settling(g) is True
+        # ...and clears the moment the survivor's listener re-registers
+        driver._kv.put("notify", "0", b"localhost:1")
+        assert driver._adoption_settling(g) is False
+    finally:
+        if g is not None:
+            g.teardown.set()
+        driver._kv.stop()
+        if driver._journal is not None:
+            driver._journal.close()
+
+
+# -- worker ride-through: the outage grace window ----------------------------
+def test_outage_grace_suppresses_retry_exhausted_alarms(monkeypatch):
+    """During a declared driver outage the world poll's retry site
+    relabels to ``elastic.driver_outage`` and exhaustion stops ticking
+    ``hvd_retry_exhausted_total`` — a takeover window is a declared
+    condition, not a fault (ISSUE 20 satellite: zero false alarms)."""
+    from horovod_tpu.common.retry import retry_call
+    from horovod_tpu.elastic import outage
+    from horovod_tpu.metrics.registry import default_registry
+
+    monkeypatch.setenv("HVD_TPU_DRIVER_OUTAGE_GRACE_S", "60")
+    outage.reset()
+    reg = default_registry()
+    site = "elastic.driver_outage"
+    reg.unregister("hvd_retry_exhausted_total", {"site": site})
+
+    def boom():
+        raise ConnectionRefusedError("driver dead")
+
+    outage.note_failure()
+    assert outage.active() and not outage.exceeded()
+    with pytest.raises(ConnectionRefusedError):
+        retry_call(boom, site=site, retry_on=(OSError,), attempts=2,
+                   base_delay_s=0.01, max_delay_s=0.02,
+                   count_exhausted=not outage.enabled())
+    # exhaustion during the grace window: NO alarm tick
+    c = reg.get("hvd_retry_exhausted_total", {"site": site})
+    assert c is None or c.value == 0
+    # the outage gauge is aging instead
+    gauge = reg.get("hvd_driver_outage_seconds")
+    assert gauge is not None and gauge.value > 0
+    # recovery zeroes the gauge and stamps the heal for `history`
+    outage.note_success()
+    assert not outage.active()
+    assert reg.get("hvd_driver_outage_seconds").value == 0
+    assert outage.last_recovery_perf() is not None
+    # with the window DISABLED the same exhaustion alarms as before
+    monkeypatch.setenv("HVD_TPU_DRIVER_OUTAGE_GRACE_S", "0")
+    outage.reset()
+    with pytest.raises(ConnectionRefusedError):
+        retry_call(boom, site=site, retry_on=(OSError,), attempts=2,
+                   base_delay_s=0.01, max_delay_s=0.02,
+                   count_exhausted=not outage.enabled())
+    assert reg.get("hvd_retry_exhausted_total",
+                   {"site": site}).value == 1
+
+
+def test_outage_exceeded_names_the_finding(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DRIVER_OUTAGE_GRACE_S", "0.01")
+    from horovod_tpu.elastic import outage
+    outage.reset()
+    outage.note_failure()
+    time.sleep(0.05)
+    assert outage.exceeded()
+    outage.reset()
+
+
+# -- launcher flags ----------------------------------------------------------
+def test_launch_takeover_flag_requires_elastic():
+    from horovod_tpu.runner.launch import parse_args
+    with pytest.raises(SystemExit):
+        parse_args(["--takeover", "-np", "2", "--", "true"])
+    args = parse_args(["--takeover", "--min-np", "2",
+                       "--driver-journal-dir", "/tmp/j", "--", "true"])
+    assert args.takeover and args.driver_journal_dir == "/tmp/j"
+
+
+# -- chaos acceptance A: driver killed mid-training (ride-through) -----------
+@pytest.mark.slow
+@needs_core
+def test_chaos_driver_killed_mid_training_rides_through(tmp_path):
+    """The driver is SIGKILLed by the chaos ``driver`` seam at a
+    mid-training poll tick; the supervisor respawns it into a journal
+    takeover.  The workers never notice: zero re-mesh episodes, zero
+    restarts, the per-rank step counters strictly monotonic with no
+    repeats, and the takeover is journaled."""
+    jdir = tmp_path / "journal"
+    log = tmp_path / "events.log"
+    plan = {"seed": 7, "faults": [
+        {"seam": "driver", "kind": "kill", "start": 6, "stop": 7,
+         "marker": str(tmp_path / "driver_killed")},
+    ]}
+    prog = tmp_path / "train.py"
+    prog.write_text(textwrap.dedent(f"""
+        import os, sys, time
+        sys.path.insert(0, {REPO!r})
+        import numpy as np
+        import horovod_tpu as hvd
+        from horovod_tpu import elastic
+
+        hvd.init()
+        with open({str(log)!r}, "a") as f:
+            f.write(f"BOOT rank={{hvd.rank()}} pid={{os.getpid()}}\\n")
+        state = elastic.ObjectState(name="ride", step=0)
+
+        @elastic.run
+        def train(state):
+            while state.step < 12:
+                out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                                    name=f"s{{hvd.size()}}.{{state.step}}")
+                with open({str(log)!r}, "a") as f:
+                    f.write(f"STEP rank={{hvd.rank()}} "
+                            f"step={{state.step}}\\n")
+                state.step += 1
+                time.sleep(0.25)
+                state.commit()
+            return float(np.asarray(out)[0])
+
+        out = train(state)
+        assert out == float(hvd.size()), out
+        with open({str(log)!r}, "a") as f:
+            f.write(f"DONE rank={{hvd.rank()}} size={{hvd.size()}} "
+                    f"step={{state.step}}\\n")
+        hvd.shutdown()
+    """))
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "HVD_TPU_FAULT_PLAN": json.dumps(plan),
+        "HVD_TPU_DRIVER_OUTAGE_GRACE_S": "120",
+        "HVD_ELASTIC_CKPT": str(tmp_path),
+    })
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-H", "localhost:3", "--min-np", "3", "-np", "3",
+         "--driver-journal-dir", str(jdir), "--",
+         sys.executable, str(prog)],
+        env=env, capture_output=True, text=True, timeout=240)
+    lines = log.read_text().strip().splitlines() if log.exists() else []
+    err = proc.stderr[-4000:]
+    assert proc.returncode == 0, (err, lines)
+    # the chaos kill actually happened, and the supervisor took over
+    assert (tmp_path / "driver_killed").exists()
+    assert "respawning into journal takeover" in err, err
+    state = load(str(jdir / "driver_journal.jsonl"))
+    assert state.takeovers, "takeover never journaled"
+    assert state.clean_exit == 0
+    # ZERO re-mesh: every worker booted exactly once and finished
+    boots = [l for l in lines if l.startswith("BOOT")]
+    dones = [l for l in lines if l.startswith("DONE")]
+    assert len(boots) == 3, lines
+    assert len(dones) == 3 and \
+        all("size=3" in d and "step=12" in d for d in dones), dones
+    # step counters strictly monotonic per rank, no repeats (a re-mesh
+    # or restart would replay from the last commit)
+    for r in range(3):
+        steps = [int(l.split("step=")[1]) for l in lines
+                 if l.startswith(f"STEP rank={r} ")]
+        assert steps == sorted(set(steps)) == list(range(12)), (r, steps)
+
+
+# -- chaos acceptance B: driver killed mid-re-mesh ---------------------------
+@pytest.mark.slow
+@needs_core
+def test_chaos_driver_killed_mid_remesh_takeover_completes_recovery(
+        tmp_path):
+    """Rank 2 is SIGKILLed; while the driver's poll loop is stalled by
+    the chaos seam (the failure classified + journaled, the recovery
+    world NOT yet published) the driver itself is SIGKILLed.  The
+    takeover driver must finish the dead driver's job from the journal:
+    re-mark the worker lost, wait for survivors to re-register, spawn a
+    replacement, and heal the job to full size — an in-place recovery
+    under the SAME generation, not a generation restart."""
+    jdir = tmp_path / "journal"
+    log = tmp_path / "events.log"
+    plan = {"seed": 7, "faults": [
+        # rank 2 dies at step 2; the marker spares its replacement
+        {"seam": "step", "kind": "kill", "rank": 2, "start": 2,
+         "stop": 3, "marker": str(tmp_path / "worker_killed")},
+        # freeze the poll loop long enough for the death to be
+        # classified and journaled, then kill the driver in the SAME
+        # fire() — before the loop body can publish the recovery.  The
+        # marker matters: the takeover driver restarts its poll tick at
+        # 0, so a marker-less stall would re-fire inside the takeover
+        # and starve the survivors' shrink-wait window
+        {"seam": "driver", "kind": "stall", "start": 4, "stop": 5,
+         "stall_s": 4.0, "marker": str(tmp_path / "driver_stalled")},
+        {"seam": "driver", "kind": "kill", "start": 4, "stop": 5,
+         "marker": str(tmp_path / "driver_killed")},
+    ]}
+    prog = tmp_path / "train.py"
+    prog.write_text(textwrap.dedent(f"""
+        import os, sys, time
+        sys.path.insert(0, {REPO!r})
+        import numpy as np
+        import horovod_tpu as hvd
+        from horovod_tpu import chaos, elastic
+
+        gen = int(os.environ.get("HVD_ELASTIC_GENERATION", 0))
+        hvd.init()
+        with open({str(log)!r}, "a") as f:
+            f.write(f"BOOT rank={{hvd.rank()}} gen={{gen}} "
+                    f"pid={{os.getpid()}}\\n")
+        state = elastic.ObjectState(name="remesh", step=0)
+
+        @elastic.run
+        def train(state):
+            while state.step < 10:
+                chaos.step_tick(state.step)
+                out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                                    name=f"s{{hvd.size()}}.{{state.step}}")
+                state.step += 1
+                time.sleep(0.3)
+                state.commit()
+            return float(np.asarray(out)[0])
+
+        out = train(state)
+        assert out == float(hvd.size()), out
+        with open({str(log)!r}, "a") as f:
+            f.write(f"DONE rank={{hvd.rank()}} gen={{gen}} "
+                    f"size={{hvd.size()}} step={{state.step}}\\n")
+        hvd.shutdown()
+    """))
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "HVD_TPU_FAULT_PLAN": json.dumps(plan),
+        "HVD_TPU_DRIVER_OUTAGE_GRACE_S": "120",
+        # survivors re-register quickly on localhost; don't let the
+        # settle deadline mask a registration that never comes
+        "HVD_TPU_DRIVER_TAKEOVER_SETTLE_S": "30",
+        # survivors must outlast supervisor respawn + journal replay +
+        # adoption settling before giving up on the recovery world; the
+        # 15s default was tuned for a driver that never goes away
+        "HVD_ELASTIC_SHRINK_WAIT_S": "60",
+        "HVD_ELASTIC_CKPT": str(tmp_path),
+    })
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-H", "localhost:3", "--min-np", "2", "-np", "3",
+         "--reset-limit", "4",
+         "--driver-journal-dir", str(jdir), "--",
+         sys.executable, str(prog)],
+        env=env, capture_output=True, text=True, timeout=300)
+    lines = log.read_text().strip().splitlines() if log.exists() else []
+    err = proc.stderr[-4000:]
+    assert proc.returncode == 0, (err, lines)
+    assert (tmp_path / "worker_killed").exists()
+    assert (tmp_path / "driver_killed").exists()
+    state = load(str(jdir / "driver_journal.jsonl"))
+    assert state.takeovers, "takeover never journaled"
+    assert state.clean_exit == 0
+    # the job healed to FULL size: three finishers, one replacement boot
+    dones = [l for l in lines if l.startswith("DONE")]
+    boots = [l for l in lines if l.startswith("BOOT")]
+    assert len(dones) == 3 and \
+        all("size=3" in d and "step=10" in d for d in dones), (dones,
+                                                              err)
+    assert len(boots) >= 4, lines  # 3 originals + the replacement
+    # takeover, not a second generation restart: the survivors finished
+    # in the SAME process and generation they booted with
+    survivor_dones = [d for d in dones if "gen=0" in d]
+    assert len(survivor_dones) >= 2, dones
